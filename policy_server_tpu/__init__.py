@@ -1,0 +1,26 @@
+"""policy_server_tpu — a TPU-native Kubernetes admission policy framework.
+
+A brand-new framework with the capability surface of Kubewarden's
+policy-server (reference: /root/reference, v1.23.0): an HTTPS admission
+controller that loads policies from OCI registries/HTTP/file, validates
+AdmissionReview documents against them (single policies and boolean policy
+groups), enforces monitor/protect modes and mutation gating, and exports
+OTLP traces/metrics — but re-architected TPU-first:
+
+* Policies are expressed in a tensorizable predicate IR (see
+  ``policy_server_tpu.ops.ir``) instead of per-request WASM instantiation
+  (reference: src/evaluation/evaluation_environment.rs).
+* Incoming AdmissionReviews are flattened into fixed-shape feature tensors
+  by a policy-derived codec (``ops.codec``) and evaluated micro-batched as
+  one fused, jit-compiled predicate program per batch
+  (``evaluation.environment``, ``parallel.batcher``).
+* Scale-out is a ``jax.sharding.Mesh`` with data- and policy-axis sharding
+  (``parallel.mesh``), not an HTTP load balancer.
+* A host-side interpreter of the same IR (``evaluation.oracle``) is the
+  bit-exact correctness oracle standing in for the reference's wasmtime
+  path.
+"""
+
+from policy_server_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,17 @@
+"""Config layer: CLI parsing, policies.yml / sources.yml / verification.yml.
+
+Reference parity: src/cli.rs + src/config.rs.
+"""
+
+from policy_server_tpu.config.config import Config, TlsConfig
+from policy_server_tpu.config.sources import Sources, read_sources_file
+from policy_server_tpu.config.verification import VerificationConfig, read_verification_file
+
+__all__ = [
+    "Config",
+    "TlsConfig",
+    "Sources",
+    "read_sources_file",
+    "VerificationConfig",
+    "read_verification_file",
+]

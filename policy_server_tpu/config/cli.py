@@ -1,0 +1,252 @@
+"""CLI definition with per-flag ``KUBEWARDEN_*`` env fallbacks.
+
+Reference parity: src/cli.rs — every flag has an env-var fallback
+(cli.rs:24-212); ``--long-version`` prints the builtins banner (cli.rs:7-21,
+here: the predicate-IR op registry instead of OPA builtins); the ``docs``
+subcommand regenerates the markdown CLI reference (src/main.rs:68,
+cli-docs.md), and CI can diff it for freshness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Sequence
+
+from policy_server_tpu.version import __version__
+
+PROG = "policy-server-tpu"
+
+
+def _env(name: str, default: Any = None) -> Any:
+    return os.environ.get(name, default)
+
+
+def _env_flag(name: str) -> bool:
+    v = os.environ.get(name, "")
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+# (flag, env, kwargs) — single source of truth for the parser and for docs.
+def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
+    return [
+        ("--addr", "KUBEWARDEN_BIND_ADDRESS",
+         dict(default="0.0.0.0", metavar="BIND_ADDRESS",
+              help="Bind against ADDRESS")),
+        ("--port", "KUBEWARDEN_PORT",
+         dict(type=int, default=3000, metavar="PORT",
+              help="Listen on PORT")),
+        ("--readiness-probe-port", "KUBEWARDEN_READINESS_PROBE_PORT",
+         dict(type=int, default=8081, metavar="READINESS_PROBE_PORT",
+              help="Expose the readiness endpoint on this (plaintext) port")),
+        ("--policies", "KUBEWARDEN_POLICIES",
+         dict(default="policies.yml", metavar="POLICIES_FILE",
+              help="YAML file holding the policies to be loaded and their settings")),
+        ("--policies-download-dir", "KUBEWARDEN_POLICIES_DOWNLOAD_DIR",
+         dict(default=".", metavar="POLICIES_DOWNLOAD_DIR",
+              help="Download path for the policies")),
+        ("--sources-path", "KUBEWARDEN_SOURCES_PATH",
+         dict(default=None, metavar="SOURCES_PATH",
+              help="YAML file holding source information (registries, HTTP, "
+                   "insecure sources, authorities)")),
+        ("--verification-path", "KUBEWARDEN_VERIFICATION_CONFIG_PATH",
+         dict(default=None, metavar="VERIFICATION_CONFIG_PATH",
+              help="YAML file holding verification config information "
+                   "(signatures, requirements)")),
+        ("--sigstore-cache-dir", "KUBEWARDEN_SIGSTORE_CACHE_DIR",
+         dict(default="sigstore-data", metavar="SIGSTORE_CACHE_DIR",
+              help="Directory used to cache sigstore data")),
+        ("--docker-config-json-path", "KUBEWARDEN_DOCKER_CONFIG_JSON_PATH",
+         dict(default=None, metavar="DOCKER_CONFIG",
+              help="Path to a Docker config.json-like file holding registry "
+                   "authentication details")),
+        ("--cert-file", "KUBEWARDEN_CERT_FILE",
+         dict(default=None, metavar="CERT_FILE",
+              help="Path to an X.509 certificate file for HTTPS")),
+        ("--key-file", "KUBEWARDEN_KEY_FILE",
+         dict(default=None, metavar="KEY_FILE",
+              help="Path to an X.509 private key file for HTTPS")),
+        ("--client-ca-file", "KUBEWARDEN_CLIENT_CA_FILE",
+         dict(default=None, metavar="CLIENT_CA_FILE", action="append",
+              help="Path to a CA certificate file that issued the client "
+                   "certificates; required to enable mTLS (repeatable)")),
+        ("--workers", "KUBEWARDEN_WORKERS",
+         dict(type=int, default=None, metavar="WORKERS_NUMBER",
+              help="Number of concurrent evaluation slots (default: number of CPUs); "
+                   "bounds in-flight micro-batches in the TPU backend")),
+        ("--policy-timeout", "KUBEWARDEN_POLICY_TIMEOUT",
+         dict(type=float, default=2.0, metavar="MAXIMUM_EXECUTION_TIME_SECONDS",
+              help="Interrupt policy evaluation after the given time")),
+        ("--disable-timeout-protection", "KUBEWARDEN_DISABLE_TIMEOUT_PROTECTION",
+         dict(action="store_true", help="Disable policy timeout protection")),
+        ("--ignore-kubernetes-connection-failure",
+         "KUBEWARDEN_IGNORE_KUBERNETES_CONNECTION_FAILURE",
+         dict(action="store_true",
+              help="Do not exit with an error if the Kubernetes connection fails; "
+                   "context-aware policies will break")),
+        ("--always-accept-admission-reviews-on-namespace",
+         "KUBEWARDEN_ALWAYS_ACCEPT_ADMISSION_REVIEWS_ON_NAMESPACE",
+         dict(default=None, metavar="NAMESPACE",
+              help="Always accept AdmissionReviews that target the given namespace")),
+        ("--continue-on-errors", "KUBEWARDEN_CONTINUE_ON_ERRORS",
+         dict(action="store_true", help=argparse.SUPPRESS)),  # hidden (cli.rs:207-211)
+        ("--enable-metrics", "KUBEWARDEN_ENABLE_METRICS",
+         dict(action="store_true", help="Enable OTLP metrics")),
+        ("--enable-pprof", "KUBEWARDEN_ENABLE_PPROF",
+         dict(action="store_true", help="Enable profiling endpoints")),
+        ("--log-level", "KUBEWARDEN_LOG_LEVEL",
+         dict(default="info", metavar="LOG_LEVEL",
+              choices=["trace", "debug", "info", "warn", "error"],
+              help="Log level (trace, debug, info, warn, error)")),
+        ("--log-fmt", "KUBEWARDEN_LOG_FMT",
+         dict(default="text", metavar="LOG_FMT", choices=["text", "json", "otlp"],
+              help="Log output format (text, json, otlp)")),
+        ("--log-no-color", "KUBEWARDEN_LOG_NO_COLOR",
+         dict(action="store_true", help="Disable colored output for logs")),
+        ("--daemon", "KUBEWARDEN_DAEMON",
+         dict(action="store_true",
+              help="If set, runs policy-server in detached mode as a daemon")),
+        ("--daemon-pid-file", "KUBEWARDEN_DAEMON_PID_FILE",
+         dict(default="policy-server.pid", metavar="DAEMON-PID-FILE",
+              help="Path to the PID file, used only when running in daemon mode")),
+        ("--daemon-stdout-file", "KUBEWARDEN_DAEMON_STDOUT_FILE",
+         dict(default=None, metavar="DAEMON-STDOUT-FILE",
+              help="Path to the file holding stdout, used only in daemon mode")),
+        ("--daemon-stderr-file", "KUBEWARDEN_DAEMON_STDERR_FILE",
+         dict(default=None, metavar="DAEMON-STDERR-FILE",
+              help="Path to the file holding stderr, used only in daemon mode")),
+        # --- TPU-native flags (no reference counterpart; SURVEY.md §7) ----
+        ("--evaluation-backend", "KUBEWARDEN_EVALUATION_BACKEND",
+         dict(default="jax", metavar="BACKEND", choices=["jax", "oracle"],
+              help="Evaluation backend: 'jax' (batched TPU predicate programs) "
+                   "or 'oracle' (host interpreter, the differential-test oracle)")),
+        ("--max-batch-size", "KUBEWARDEN_MAX_BATCH_SIZE",
+         dict(type=int, default=128, metavar="N",
+              help="Maximum micro-batch size dispatched to the device")),
+        ("--batch-timeout-ms", "KUBEWARDEN_BATCH_TIMEOUT_MS",
+         dict(type=float, default=1.0, metavar="MS",
+              help="Maximum time a request waits for its micro-batch to fill")),
+        ("--mesh", "KUBEWARDEN_MESH",
+         dict(default="auto", metavar="MESH_SPEC",
+              help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),
+        ("--no-warmup", "KUBEWARDEN_NO_WARMUP",
+         dict(action="store_true",
+              help="Skip AOT compilation of the policy program at boot")),
+    ]
+
+
+def long_version() -> str:
+    """``--long-version`` banner: version + the predicate-IR op registry
+    (reference prints the OPA builtins, cli.rs:7-21)."""
+    from policy_server_tpu.ops.ir import registered_op_names
+
+    ops = "\n".join(f"  - {name}" for name in registered_op_names())
+    return f"{PROG} {__version__}\npredicate IR ops:\n{ops}"
+
+
+def build_cli() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog=PROG,
+        description=(
+            "TPU-native Kubernetes admission policy server: micro-batched "
+            "JAX/XLA policy evaluation with the capability surface of "
+            "Kubewarden's policy-server."
+        ),
+    )
+    parser.add_argument("--version", action="version", version=f"{PROG} {__version__}")
+    parser.add_argument(
+        "--long-version",
+        action="store_true",
+        help="Print version information and the predicate-IR op registry",
+    )
+    for flag, env, kwargs in _flag_specs():
+        kwargs = dict(kwargs)
+        if env is not None:
+            if kwargs.get("action") == "store_true":
+                kwargs["default"] = _env_flag(env)
+            elif kwargs.get("action") == "append":
+                env_val = _env(env)
+                if env_val is not None:
+                    kwargs["default"] = env_val.split(",")
+            else:
+                env_val = _env(env)
+                if env_val is not None:
+                    t = kwargs.get("type", str)
+                    kwargs["default"] = t(env_val)
+            if kwargs.get("help") and kwargs["help"] is not argparse.SUPPRESS:
+                kwargs["help"] += f" [env: {env}]"
+        parser.add_argument(flag, **kwargs)
+
+    sub = parser.add_subparsers(dest="subcommand")
+    docs = sub.add_parser(
+        "docs", help="Generates the markdown documentation for the CLI"
+    )
+    docs.add_argument(
+        "--output", "-o", required=True, metavar="FILE", help="path where to save the docs file"
+    )
+    return parser
+
+
+def generate_docs() -> str:
+    """Render the markdown CLI reference (reference cli-docs.md generated by
+    the ``docs`` subcommand, main.rs:68)."""
+    lines = [
+        f"# Command-Line Help for `{PROG}`",
+        "",
+        f"This document contains the help content for the `{PROG}` command-line program.",
+        "",
+        f"## `{PROG}`",
+        "",
+        f"**Usage:** `{PROG} [OPTIONS] [COMMAND]`",
+        "",
+        "###### **Subcommands:**",
+        "",
+        "* `docs` — Generates the markdown documentation for the CLI",
+        "",
+        "###### **Options:**",
+        "",
+    ]
+    for flag, env, kwargs in _flag_specs():
+        help_text = kwargs.get("help")
+        if help_text is argparse.SUPPRESS:
+            continue
+        metavar = kwargs.get("metavar")
+        action = kwargs.get("action")
+        head = flag if action in ("store_true",) else f"{flag} <{metavar}>"
+        lines.append(f"* `{head}` — {help_text}")
+        if env:
+            lines.append(f"  [env: `{env}`]")
+        default = kwargs.get("default")
+        if default not in (None, False, []):
+            lines.append("")
+            lines.append(f"  Default value: `{default}`")
+        choices = kwargs.get("choices")
+        if choices:
+            lines.append("")
+            lines.append("  Possible values: " + ", ".join(f"`{c}`" for c in choices))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    """Process entry (reference src/main.rs:15-65)."""
+    parser = build_cli()
+    args = parser.parse_args(argv)
+
+    if args.long_version:
+        print(long_version())
+        return 0
+
+    if args.subcommand == "docs":
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(generate_docs())
+        return 0
+
+    from policy_server_tpu.server import run_server
+
+    return run_server(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

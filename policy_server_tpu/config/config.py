@@ -1,0 +1,272 @@
+"""Config: CLI args + env fallbacks → a validated ``Config`` struct.
+
+Reference parity: src/config.rs —
+* ``Config::from_args`` (config.rs:61-169): resolves addr/port, TLS, policy
+  file paths, download dir, workers/pool_size, timeouts, feature flags.
+* ``pool_size = --workers or num_cpus`` (config.rs:85-90).
+* ``HOSTNAME`` from env for span fields (config.rs:24-27).
+* OTLP client TLS config from OTEL_* env vars (config.rs:458-496).
+
+TPU-native additions (no reference counterpart; SURVEY.md §7):
+* ``evaluation_backend``: ``jax`` (batched TPU predicate programs) or
+  ``oracle`` (host interpreter; the stand-in for the reference's wasmtime
+  path and the differential-testing oracle).
+* micro-batcher knobs (``max_batch_size``, ``batch_timeout_ms``) — the
+  batched analog of the reference's Semaphore admission control
+  (src/api/handlers.rs:256-286).
+* device mesh spec (``mesh``) — e.g. ``data:8`` or ``data:4,policy:2`` —
+  the scale-out axis that replaces the reference's replica-based scaling
+  (SURVEY.md §2.3 last row).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+from policy_server_tpu.models.policy import (
+    PolicyOrPolicyGroup,
+    parse_policies,
+)
+from policy_server_tpu.config.sources import Sources, read_sources_file
+from policy_server_tpu.config.verification import (
+    VerificationConfig,
+    read_verification_file,
+)
+
+LOG_LEVELS = ("trace", "debug", "info", "warn", "error")
+LOG_FORMATS = ("text", "json", "otlp")
+EVALUATION_BACKENDS = ("jax", "oracle")
+
+DEFAULT_PORT = 3000
+DEFAULT_READINESS_PORT = 8081
+
+
+@dataclass(frozen=True)
+class TlsConfig:
+    """TLS material paths (src/config.rs TlsConfig; src/certs.rs:31).
+
+    ``cert_file``/``key_file`` must be provided together; ``client_ca_file``
+    (a list — multiple CAs supported, certs.rs:231-258) enables mTLS and
+    requires TLS to be enabled.
+    """
+
+    cert_file: str | None = None
+    key_file: str | None = None
+    client_ca_file: tuple[str, ...] = ()
+
+    @property
+    def enabled(self) -> bool:
+        return self.cert_file is not None
+
+    @property
+    def mtls_enabled(self) -> bool:
+        return bool(self.client_ca_file)
+
+    def validate(self) -> None:
+        if (self.cert_file is None) != (self.key_file is None):
+            raise ValueError(
+                "both --cert-file and --key-file must be provided to enable TLS"
+            )
+        if self.client_ca_file and not self.enabled:
+            raise ValueError("--client-ca-file requires --cert-file and --key-file")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Device-mesh request, e.g. ``data:8`` or ``data:4,policy:2``.
+
+    Axis names: ``data`` shards the request batch dimension; ``policy``
+    shards the loaded policy set (verdict bits all-gathered; SURVEY.md §5
+    long-context row). ``auto`` sizes the data axis to ``len(jax.devices())``
+    at boot.
+    """
+
+    axes: tuple[tuple[str, int], ...] = (("data", 0),)  # 0 = auto
+
+    @classmethod
+    def parse(cls, spec: str) -> "MeshSpec":
+        if spec in ("auto", ""):
+            return cls()
+        axes: list[tuple[str, int]] = []
+        for part in spec.split(","):
+            name, _, size = part.partition(":")
+            name = name.strip()
+            if name not in ("data", "policy"):
+                raise ValueError(f"unknown mesh axis {name!r} (expected data/policy)")
+            try:
+                n = int(size)
+            except ValueError:
+                raise ValueError(f"invalid mesh axis size in {part!r}") from None
+            if n < 1:
+                raise ValueError(f"mesh axis size must be >= 1: {part!r}")
+            axes.append((name, n))
+        if not axes:
+            raise ValueError(f"invalid mesh spec {spec!r}")
+        names = [a for a, _ in axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate mesh axis in {spec!r}")
+        return cls(axes=tuple(axes))
+
+    def data_size(self) -> int:
+        return dict(self.axes).get("data", 1)
+
+    def policy_size(self) -> int:
+        return dict(self.axes).get("policy", 1)
+
+
+def _default_pool_size() -> int:
+    return os.cpu_count() or 1
+
+
+@dataclass
+class Config:
+    """The resolved server configuration (reference Config, config.rs:29-52)."""
+
+    addr: str = "0.0.0.0"
+    port: int = DEFAULT_PORT
+    readiness_probe_port: int = DEFAULT_READINESS_PORT
+    tls_config: TlsConfig = field(default_factory=TlsConfig)
+    policies: dict[str, PolicyOrPolicyGroup] = field(default_factory=dict)
+    policies_download_dir: str = "."
+    sources: Sources | None = None
+    verification_config: VerificationConfig | None = None
+    pool_size: int = field(default_factory=_default_pool_size)
+    policy_timeout_seconds: float = 2.0  # cli.rs:164-169 default 2 s
+    disable_timeout_protection: bool = False
+    ignore_kubernetes_connection_failure: bool = False
+    always_accept_admission_reviews_on_namespace: str | None = None
+    continue_on_errors: bool = False
+    enable_metrics: bool = False
+    enable_pprof: bool = False
+    log_level: str = "info"
+    log_fmt: str = "text"
+    log_no_color: bool = False
+    daemon: bool = False
+    daemon_pid_file: str = "policy-server.pid"
+    daemon_stdout_file: str | None = None
+    daemon_stderr_file: str | None = None
+    docker_config_json_path: str | None = None
+    sigstore_cache_dir: str = "sigstore-data"
+    hostname: str = field(default_factory=socket.gethostname)
+    # --- TPU-native additions -------------------------------------------
+    evaluation_backend: str = "jax"
+    max_batch_size: int = 128
+    batch_timeout_ms: float = 1.0
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    warmup_at_boot: bool = True
+
+    def validate(self) -> None:
+        self.tls_config.validate()
+        if self.log_level not in LOG_LEVELS:
+            raise ValueError(f"invalid log level {self.log_level!r}")
+        if self.log_fmt not in LOG_FORMATS:
+            raise ValueError(f"invalid log format {self.log_fmt!r}")
+        if self.evaluation_backend not in EVALUATION_BACKENDS:
+            raise ValueError(
+                f"invalid evaluation backend {self.evaluation_backend!r} "
+                f"(expected one of {EVALUATION_BACKENDS})"
+            )
+        if self.pool_size < 1:
+            raise ValueError("--workers must be >= 1")
+        if self.max_batch_size < 1:
+            raise ValueError("--max-batch-size must be >= 1")
+        if not (0 <= self.port <= 65535) or not (0 <= self.readiness_probe_port <= 65535):
+            raise ValueError("ports must be in [0, 65535]")
+
+    @property
+    def policy_timeout(self) -> float | None:
+        """Effective evaluation deadline in seconds, None when disabled
+        (reference: --disable-timeout-protection, cli.rs:164-176)."""
+        return None if self.disable_timeout_protection else self.policy_timeout_seconds
+
+    @classmethod
+    def from_args(cls, args: Any) -> "Config":
+        """Build a Config from a parsed argparse namespace
+        (reference Config::from_args, config.rs:61-169)."""
+        policies_path = Path(args.policies)
+        policies = read_policies_file(policies_path) if policies_path.exists() else {}
+        if not policies_path.exists() and not getattr(args, "allow_missing_policies", False):
+            raise FileNotFoundError(f"policies file not found: {policies_path}")
+
+        sources = read_sources_file(args.sources_path) if args.sources_path else None
+        verification = (
+            read_verification_file(args.verification_path)
+            if args.verification_path
+            else None
+        )
+
+        tls = TlsConfig(
+            cert_file=args.cert_file,
+            key_file=args.key_file,
+            client_ca_file=tuple(args.client_ca_file or ()),
+        )
+
+        cfg = cls(
+            addr=args.addr,
+            port=args.port,
+            readiness_probe_port=args.readiness_probe_port,
+            tls_config=tls,
+            policies=policies,
+            policies_download_dir=args.policies_download_dir,
+            sources=sources,
+            verification_config=verification,
+            pool_size=args.workers if args.workers else _default_pool_size(),
+            policy_timeout_seconds=float(args.policy_timeout),
+            disable_timeout_protection=args.disable_timeout_protection,
+            ignore_kubernetes_connection_failure=args.ignore_kubernetes_connection_failure,
+            always_accept_admission_reviews_on_namespace=(
+                args.always_accept_admission_reviews_on_namespace or None
+            ),
+            continue_on_errors=args.continue_on_errors,
+            enable_metrics=args.enable_metrics,
+            enable_pprof=args.enable_pprof,
+            log_level=args.log_level,
+            log_fmt=args.log_fmt,
+            log_no_color=args.log_no_color,
+            daemon=args.daemon,
+            daemon_pid_file=args.daemon_pid_file,
+            daemon_stdout_file=args.daemon_stdout_file,
+            daemon_stderr_file=args.daemon_stderr_file,
+            docker_config_json_path=args.docker_config_json_path,
+            sigstore_cache_dir=args.sigstore_cache_dir,
+            evaluation_backend=args.evaluation_backend,
+            max_batch_size=args.max_batch_size,
+            batch_timeout_ms=float(args.batch_timeout_ms),
+            mesh=MeshSpec.parse(args.mesh),
+            warmup_at_boot=not args.no_warmup,
+        )
+        cfg.validate()
+        return cfg
+
+
+def read_policies_file(path: str | Path) -> dict[str, PolicyOrPolicyGroup]:
+    """config.rs:449-453 + parse (config.rs:219-258)."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = yaml.safe_load(f)
+    return parse_policies(doc)
+
+
+def build_client_tls_config_from_env(prefix: str = "OTEL_EXPORTER_OTLP") -> dict[str, str]:
+    """OTLP exporter TLS settings from env (config.rs:458-496):
+    ``{prefix}_CERTIFICATE`` (CA), ``{prefix}_CLIENT_CERTIFICATE``,
+    ``{prefix}_CLIENT_KEY``. Either all client vars set or none."""
+    ca = os.environ.get(f"{prefix}_CERTIFICATE")
+    cert = os.environ.get(f"{prefix}_CLIENT_CERTIFICATE")
+    key = os.environ.get(f"{prefix}_CLIENT_KEY")
+    out: dict[str, str] = {}
+    if ca:
+        out["ca_file"] = ca
+    if (cert is None) != (key is None):
+        raise ValueError(
+            f"{prefix}_CLIENT_CERTIFICATE and {prefix}_CLIENT_KEY must be set together"
+        )
+    if cert and key:
+        out["cert_file"] = cert
+        out["key_file"] = key
+    return out

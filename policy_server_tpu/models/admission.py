@@ -1,0 +1,368 @@
+"""Kubernetes AdmissionReview data model.
+
+Reference parity:
+* ``AdmissionRequest`` — policy-evaluator's ``admission_request::AdmissionRequest``
+  as used by the reference (src/api/handlers.rs:288-306, src/test_utils.rs:5-31).
+* ``AdmissionResponse`` — policy-evaluator's ``admission_response::AdmissionResponse``
+  (src/api/service.rs:60-68; src/evaluation/evaluation_environment.rs:979-1042).
+* ``AdmissionReviewRequest`` / ``AdmissionReviewResponse`` —
+  src/api/admission_review.rs:5-36 (response always ``admission.k8s.io/v1``).
+* ``RawReviewRequest`` / ``RawReviewResponse`` — src/api/raw_review.rs:5-20.
+* ``ValidateRequest`` — the enum wrapper over AdmissionRequest | raw JSON
+  (SURVEY.md §2.2), carried down to the evaluation layer.
+
+These are plain host-side types; the tensor codec (ops/codec.py) flattens them
+for the device. JSON field names use Kubernetes camelCase on the wire and
+snake_case in Python.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+
+def _drop_none(d: dict[str, Any]) -> dict[str, Any]:
+    return {k: v for k, v in d.items() if v is not None}
+
+
+@dataclass(frozen=True)
+class GroupVersionKind:
+    """K8s GroupVersionKind (AdmissionRequest.kind / requestKind)."""
+
+    group: str = ""
+    version: str = ""
+    kind: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any] | None) -> "GroupVersionKind | None":
+        if d is None:
+            return None
+        return cls(
+            group=d.get("group", "") or "",
+            version=d.get("version", "") or "",
+            kind=d.get("kind", "") or "",
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"group": self.group, "version": self.version, "kind": self.kind}
+
+
+@dataclass(frozen=True)
+class GroupVersionResource:
+    """K8s GroupVersionResource (AdmissionRequest.resource / requestResource)."""
+
+    group: str = ""
+    version: str = ""
+    resource: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any] | None) -> "GroupVersionResource | None":
+        if d is None:
+            return None
+        return cls(
+            group=d.get("group", "") or "",
+            version=d.get("version", "") or "",
+            resource=d.get("resource", "") or "",
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "group": self.group,
+            "version": self.version,
+            "resource": self.resource,
+        }
+
+
+@dataclass
+class AdmissionRequest:
+    """The ``request`` field of an AdmissionReview.
+
+    Field set mirrors the reference's span-population and test fixture usage
+    (src/api/handlers.rs:288-306, src/test_utils.rs:5-31).
+    """
+
+    uid: str = ""
+    kind: GroupVersionKind = field(default_factory=GroupVersionKind)
+    resource: GroupVersionResource = field(default_factory=GroupVersionResource)
+    sub_resource: str | None = None
+    request_kind: GroupVersionKind | None = None
+    request_resource: GroupVersionResource | None = None
+    request_sub_resource: str | None = None
+    name: str | None = None
+    namespace: str | None = None
+    operation: str = ""
+    user_info: dict[str, Any] = field(default_factory=dict)
+    object: Any = None
+    old_object: Any = None
+    dry_run: bool | None = None
+    options: Any = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "AdmissionRequest":
+        if not isinstance(d, Mapping):
+            raise ValueError("AdmissionReview.request must be an object")
+        uid = d.get("uid")
+        if not isinstance(uid, str) or not uid:
+            raise ValueError("AdmissionReview.request.uid is required")
+        return cls(
+            uid=uid,
+            kind=GroupVersionKind.from_dict(d.get("kind")) or GroupVersionKind(),
+            resource=GroupVersionResource.from_dict(d.get("resource"))
+            or GroupVersionResource(),
+            sub_resource=d.get("subResource"),
+            request_kind=GroupVersionKind.from_dict(d.get("requestKind")),
+            request_resource=GroupVersionResource.from_dict(d.get("requestResource")),
+            request_sub_resource=d.get("requestSubResource"),
+            name=d.get("name"),
+            namespace=d.get("namespace"),
+            operation=d.get("operation", "") or "",
+            user_info=dict(d.get("userInfo") or {}),
+            object=d.get("object"),
+            old_object=d.get("oldObject"),
+            dry_run=d.get("dryRun"),
+            options=d.get("options"),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return _drop_none(
+            {
+                "uid": self.uid,
+                "kind": self.kind.to_dict(),
+                "resource": self.resource.to_dict(),
+                "subResource": self.sub_resource,
+                "requestKind": self.request_kind.to_dict() if self.request_kind else None,
+                "requestResource": self.request_resource.to_dict()
+                if self.request_resource
+                else None,
+                "requestSubResource": self.request_sub_resource,
+                "name": self.name,
+                "namespace": self.namespace,
+                "operation": self.operation,
+                "userInfo": self.user_info or None,
+                "object": self.object,
+                "oldObject": self.old_object,
+                "dryRun": self.dry_run,
+                "options": self.options,
+            }
+        )
+
+
+@dataclass(frozen=True)
+class StatusCause:
+    """One cause inside status.details.causes (group denials carry
+    field=``spec.policies.<member>``, reference
+    evaluation_environment.rs:984-994)."""
+
+    field: str | None = None
+    message: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return _drop_none({"field": self.field, "message": self.message})
+
+
+@dataclass(frozen=True)
+class StatusDetails:
+    causes: tuple[StatusCause, ...] = ()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"causes": [c.to_dict() for c in self.causes]}
+
+
+@dataclass(frozen=True)
+class ValidationStatus:
+    """AdmissionResponse.status."""
+
+    message: str | None = None
+    code: int | None = None
+    reason: str | None = None
+    details: StatusDetails | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return _drop_none(
+            {
+                "message": self.message,
+                "code": self.code,
+                "reason": self.reason,
+                "details": self.details.to_dict() if self.details else None,
+            }
+        )
+
+
+JSON_PATCH = "JSONPatch"
+
+
+@dataclass
+class AdmissionResponse:
+    """Verdict model, incl. JSONPatch mutation.
+
+    Reference: policy-evaluator ``admission_response::AdmissionResponse`` as
+    used at src/api/service.rs:60-68,86-90 and
+    src/evaluation/evaluation_environment.rs:979-1042. ``patch`` is
+    base64-encoded JSONPatch, ``patch_type`` is always ``"JSONPatch"`` when a
+    patch is present.
+    """
+
+    uid: str = ""
+    allowed: bool = False
+    patch_type: str | None = None
+    patch: str | None = None
+    status: ValidationStatus | None = None
+    audit_annotations: dict[str, str] | None = None
+    warnings: list[str] | None = None
+
+    @classmethod
+    def reject(cls, uid: str, message: str, code: int) -> "AdmissionResponse":
+        """Reference: AdmissionResponse::reject (service.rs:86-90)."""
+        return cls(
+            uid=uid,
+            allowed=False,
+            status=ValidationStatus(message=message, code=code),
+        )
+
+    @classmethod
+    def reject_internal_server_error(cls, uid: str, message: str) -> "AdmissionResponse":
+        return cls.reject(uid, f"internal server error: {message}", 500)
+
+    def to_dict(self) -> dict[str, Any]:
+        return _drop_none(
+            {
+                "uid": self.uid,
+                "allowed": self.allowed,
+                "patchType": self.patch_type,
+                "patch": self.patch,
+                "status": self.status.to_dict() if self.status else None,
+                "auditAnnotations": self.audit_annotations,
+                "warnings": self.warnings,
+            }
+        )
+
+    def copy(self) -> "AdmissionResponse":
+        return AdmissionResponse(
+            uid=self.uid,
+            allowed=self.allowed,
+            patch_type=self.patch_type,
+            patch=self.patch,
+            status=self.status,
+            audit_annotations=dict(self.audit_annotations)
+            if self.audit_annotations is not None
+            else None,
+            warnings=list(self.warnings) if self.warnings is not None else None,
+        )
+
+
+API_VERSION = "admission.k8s.io/v1"
+ADMISSION_REVIEW_KIND = "AdmissionReview"
+
+
+@dataclass
+class AdmissionReviewRequest:
+    """Incoming AdmissionReview envelope (src/api/admission_review.rs:5-20).
+
+    ``kind``/``apiVersion`` are optional on input (the reference models them
+    as Option<String>); only ``request`` is required.
+    """
+
+    request: AdmissionRequest
+    kind: str | None = None
+    api_version: str | None = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "AdmissionReviewRequest":
+        if not isinstance(d, Mapping) or "request" not in d:
+            raise ValueError("AdmissionReview must contain a `request` field")
+        return cls(
+            request=AdmissionRequest.from_dict(d["request"]),
+            kind=d.get("kind"),
+            api_version=d.get("apiVersion"),
+        )
+
+
+@dataclass
+class AdmissionReviewResponse:
+    """Outgoing AdmissionReview envelope — always ``admission.k8s.io/v1``
+    (src/api/admission_review.rs:22-36)."""
+
+    response: AdmissionResponse
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": ADMISSION_REVIEW_KIND,
+            "response": self.response.to_dict(),
+        }
+
+
+@dataclass
+class RawReviewRequest:
+    """Non-Kubernetes raw JSON validation request (src/api/raw_review.rs:5-11)."""
+
+    request: Any
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "RawReviewRequest":
+        if not isinstance(d, Mapping) or "request" not in d:
+            raise ValueError("raw review must contain a `request` field")
+        return cls(request=d["request"])
+
+
+@dataclass
+class RawReviewResponse:
+    """src/api/raw_review.rs:13-20."""
+
+    response: AdmissionResponse
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"response": self.response.to_dict()}
+
+
+class ValidateRequest:
+    """Wrapper over AdmissionRequest | raw JSON (SURVEY.md §2.2
+    ``ValidateRequest``), the unit handed to the evaluation layer.
+
+    ``.uid()`` mirrors the reference usage at src/api/service.rs:61 and
+    src/api/handlers.rs:81,165 (raw requests synthesize/extract a uid from the
+    JSON body's ``uid`` key when present, else empty string).
+    """
+
+    __slots__ = ("admission_request", "raw")
+
+    def __init__(
+        self,
+        admission_request: AdmissionRequest | None = None,
+        raw: Any = None,
+    ) -> None:
+        if (admission_request is None) == (raw is None):
+            raise ValueError(
+                "ValidateRequest is either an AdmissionRequest or a raw value"
+            )
+        self.admission_request = admission_request
+        self.raw = raw
+
+    @classmethod
+    def from_admission(cls, req: AdmissionRequest) -> "ValidateRequest":
+        return cls(admission_request=req)
+
+    @classmethod
+    def from_raw(cls, value: Any) -> "ValidateRequest":
+        return cls(raw=value)
+
+    @property
+    def is_raw(self) -> bool:
+        return self.admission_request is None
+
+    def uid(self) -> str:
+        if self.admission_request is not None:
+            return self.admission_request.uid
+        if isinstance(self.raw, Mapping):
+            uid = self.raw.get("uid")
+            if isinstance(uid, str):
+                return uid
+        return ""
+
+    def payload(self) -> Any:
+        """The JSON value policies inspect: the full request dict for
+        admission requests, the raw value otherwise."""
+        if self.admission_request is not None:
+            return self.admission_request.to_dict()
+        return self.raw

@@ -1,0 +1,8 @@
+"""Version info.
+
+Reference parity: the reference exposes its version via clap
+(/root/reference/src/cli.rs:23-27) and a ``--long-version`` banner listing
+the OPA builtins (cli.rs:7-21). See ``policy_server_tpu.config.cli``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,64 @@
+"""Test configuration.
+
+Forces the JAX CPU backend with 8 virtual devices so mesh/sharding tests run
+without TPU hardware — the stand-in for a v5e-8, mirroring how the reference
+uses in-process port-0 servers to stand in for a deployment (SURVEY.md §4.2).
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def admission_review_request():
+    """Canned AdmissionReviewRequest (reference src/test_utils.rs:3-37:
+    a Deployment 'nginx-deployment' scale UPDATE)."""
+    from policy_server_tpu.models import AdmissionReviewRequest
+
+    return AdmissionReviewRequest.from_dict(build_admission_review_dict())
+
+
+def build_admission_review_dict() -> dict:
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "hello",
+            "kind": {"group": "autoscaling", "version": "v1", "kind": "Scale"},
+            "resource": {"group": "apps", "version": "v1", "resource": "deployments"},
+            "subResource": "scale",
+            "requestKind": {"group": "autoscaling", "version": "v1", "kind": "Scale"},
+            "requestResource": {
+                "group": "apps",
+                "version": "v1",
+                "resource": "deployments",
+            },
+            "requestSubResource": "scale",
+            "name": "my-deployment",
+            "namespace": "my-namespace",
+            "operation": "UPDATE",
+            "userInfo": {
+                "username": "admin",
+                "uid": "014fbff9a07c",
+                "groups": ["system:masters", "system:authenticated"],
+            },
+            "object": {
+                "apiVersion": "autoscaling/v1",
+                "kind": "Scale",
+                "metadata": {"name": "my-deployment", "namespace": "my-namespace"},
+                "spec": {"replicas": 2},
+            },
+            "oldObject": None,
+            "dryRun": False,
+            "options": None,
+        },
+    }

@@ -1,0 +1,261 @@
+"""Config-layer tests, mirroring the reference's config parsing matrix
+(src/config.rs:590-730 rstest cases)."""
+
+import textwrap
+
+import pytest
+import yaml
+
+from policy_server_tpu.config.cli import build_cli, generate_docs
+from policy_server_tpu.config.config import Config, MeshSpec, TlsConfig, read_policies_file
+from policy_server_tpu.config.sources import Sources
+from policy_server_tpu.config.verification import VerificationConfig
+from policy_server_tpu.models.policy import (
+    Policy,
+    PolicyGroup,
+    PolicyMode,
+    normalize_settings,
+    parse_policies,
+)
+
+EXAMPLE_POLICIES = textwrap.dedent(
+    """
+    psp-apparmor:
+      module: registry://ghcr.io/kubewarden/policies/psp-apparmor:v0.1.7
+    psp-capabilities:
+      module: registry://ghcr.io/kubewarden/policies/psp-capabilities:v0.1.7
+      allowedToMutate: true
+      settings:
+        allowed_capabilities: ["*"]
+        required_drop_capabilities: ["KILL"]
+    pod-image-signatures:
+      policies:
+        sigstore_pgp:
+          module: ghcr.io/kubewarden/policies/verify-image-signatures:v0.2.8
+          settings:
+            signatures:
+              - image: "*"
+                pubKeys: ["key1", "key2"]
+        reject_latest_tag:
+          module: ghcr.io/kubewarden/policies/trusted-repos-policy:v0.1.12
+          settings:
+            tags:
+              reject:
+                - latest
+      expression: "sigstore_pgp() || reject_latest_tag()"
+      message: "The group policy is rejected."
+    """
+)
+
+
+def test_parse_policies_untagged_enum():
+    policies = parse_policies(yaml.safe_load(EXAMPLE_POLICIES))
+    assert set(policies) == {"psp-apparmor", "psp-capabilities", "pod-image-signatures"}
+    apparmor = policies["psp-apparmor"]
+    assert isinstance(apparmor, Policy)
+    assert apparmor.policy_mode is PolicyMode.PROTECT
+    assert apparmor.allowed_to_mutate is None
+    caps = policies["psp-capabilities"]
+    assert isinstance(caps, Policy)
+    assert caps.allowed_to_mutate is True
+    assert caps.settings == {
+        "allowed_capabilities": ["*"],
+        "required_drop_capabilities": ["KILL"],
+    }
+    group = policies["pod-image-signatures"]
+    assert isinstance(group, PolicyGroup)
+    assert set(group.policies) == {"sigstore_pgp", "reject_latest_tag"}
+    assert group.expression == "sigstore_pgp() || reject_latest_tag()"
+    assert group.message == "The group policy is rejected."
+
+
+@pytest.mark.parametrize(
+    "mode,expected",
+    [(None, PolicyMode.PROTECT), ("monitor", PolicyMode.MONITOR), ("protect", PolicyMode.PROTECT)],
+)
+def test_policy_mode_parse(mode, expected):
+    assert PolicyMode.parse(mode) is expected
+
+
+def test_policy_mode_invalid():
+    with pytest.raises(ValueError):
+        PolicyMode.parse("enforce")
+
+
+def test_policy_name_with_slash_rejected():
+    # config.rs:237-258
+    with pytest.raises(ValueError, match="must not contain '/'"):
+        parse_policies({"bad/name": {"module": "file:///x.wasm"}})
+
+
+def test_unknown_policy_field_rejected():
+    with pytest.raises(ValueError, match="unknown policy fields"):
+        parse_policies({"p": {"module": "file:///x.wasm", "bogus": 1}})
+
+
+def test_group_requires_expression_and_message():
+    with pytest.raises(ValueError, match="expression"):
+        parse_policies(
+            {"g": {"policies": {"a": {"module": "file:///x.wasm"}}, "message": "m"}}
+        )
+
+
+def test_settings_yaml_to_json_normalization():
+    # config.rs:306-328: YAML-only scalars become JSON-safe
+    import datetime
+
+    raw = {"when": datetime.date(2020, 1, 1), "nested": {"xs": (1, 2)}}
+    assert normalize_settings(raw) == {"when": "2020-01-01", "nested": {"xs": [1, 2]}}
+
+
+def test_sources_parsing():
+    doc = yaml.safe_load(
+        textwrap.dedent(
+            """
+            insecure_sources: ["registry.dev.example.com"]
+            source_authorities:
+              "registry.pre.example.com":
+                - type: Data
+                  data: "PEM"
+            """
+        )
+    )
+    sources = Sources.from_dict(doc)
+    assert sources.is_insecure("registry.dev.example.com")
+    assert not sources.is_insecure("other")
+    assert sources.authorities_for("registry.pre.example.com")[0].data == "PEM"
+
+
+def test_verification_config():
+    doc = yaml.safe_load(
+        textwrap.dedent(
+            """
+            apiVersion: v1
+            allOf:
+              - kind: githubAction
+                owner: kubewarden
+            anyOf:
+              minimumMatches: 2
+              signatures:
+                - kind: pubKey
+                  key: k1
+                - kind: pubKey
+                  key: k2
+                - kind: genericIssuer
+                  issuer: https://example.com
+                  subject:
+                    urlPrefix: https://github.com/kubewarden
+            """
+        )
+    )
+    cfg = VerificationConfig.from_dict(doc)
+    assert cfg.all_of[0].kind == "githubAction"
+    assert cfg.any_of.minimum_matches == 2
+    # urlPrefix gets '/' appended (verification.yml.example note)
+    assert cfg.any_of.signatures[2].subject.url_prefix.endswith("kubewarden/")
+
+
+def test_verification_bad_api_version():
+    with pytest.raises(ValueError, match="apiVersion"):
+        VerificationConfig.from_dict({"apiVersion": "v2", "allOf": []})
+
+
+def test_tls_config_validation():
+    TlsConfig().validate()
+    TlsConfig(cert_file="c", key_file="k").validate()
+    with pytest.raises(ValueError):
+        TlsConfig(cert_file="c").validate()
+    with pytest.raises(ValueError):
+        TlsConfig(client_ca_file=("ca",)).validate()
+
+
+@pytest.mark.parametrize(
+    "spec,axes",
+    [
+        ("auto", (("data", 0),)),
+        ("data:8", (("data", 8),)),
+        ("data:4,policy:2", (("data", 4), ("policy", 2))),
+    ],
+)
+def test_mesh_spec(spec, axes):
+    assert MeshSpec.parse(spec).axes == axes
+
+
+@pytest.mark.parametrize("spec", ["bogus:2", "data:x", "data:0", "data:2,data:2"])
+def test_mesh_spec_invalid(spec):
+    with pytest.raises(ValueError):
+        MeshSpec.parse(spec)
+
+
+def test_config_from_args(tmp_path):
+    policies = tmp_path / "policies.yml"
+    policies.write_text(EXAMPLE_POLICIES)
+    parser = build_cli()
+    args = parser.parse_args(["--policies", str(policies), "--workers", "4"])
+    cfg = Config.from_args(args)
+    assert cfg.pool_size == 4
+    assert cfg.port == 3000
+    assert cfg.readiness_probe_port == 8081
+    assert set(cfg.policies) == {"psp-apparmor", "psp-capabilities", "pod-image-signatures"}
+    assert cfg.policy_timeout == 2.0
+    assert cfg.evaluation_backend == "jax"
+
+
+def test_config_env_fallback(tmp_path, monkeypatch):
+    # cli.rs: every flag has a KUBEWARDEN_* env fallback
+    policies = tmp_path / "policies.yml"
+    policies.write_text("{}")
+    monkeypatch.setenv("KUBEWARDEN_PORT", "3001")
+    monkeypatch.setenv("KUBEWARDEN_POLICIES", str(policies))
+    parser = build_cli()
+    args = parser.parse_args([])
+    cfg = Config.from_args(args)
+    assert cfg.port == 3001
+    assert cfg.policies == {}
+
+
+def test_timeout_protection_disable(tmp_path):
+    policies = tmp_path / "policies.yml"
+    policies.write_text("{}")
+    parser = build_cli()
+    args = parser.parse_args(
+        ["--policies", str(policies), "--disable-timeout-protection"]
+    )
+    cfg = Config.from_args(args)
+    assert cfg.policy_timeout is None
+
+
+def test_generate_docs_mentions_all_flags():
+    docs = generate_docs()
+    for flag in ["--addr", "--policies", "--policy-timeout", "--evaluation-backend", "--mesh"]:
+        assert flag in docs
+
+
+def test_admission_review_roundtrip(admission_review_request):
+    req = admission_review_request.request
+    assert req.uid == "hello"
+    assert req.kind.kind == "Scale"
+    assert req.operation == "UPDATE"
+    d = req.to_dict()
+    assert d["userInfo"]["username"] == "admin"
+    assert "oldObject" not in d  # None fields dropped
+
+
+def test_admission_response_reject():
+    from policy_server_tpu.models import AdmissionResponse
+
+    resp = AdmissionResponse.reject("uid1", "nope", 403)
+    d = resp.to_dict()
+    assert d == {
+        "uid": "uid1",
+        "allowed": False,
+        "status": {"message": "nope", "code": 403},
+    }
+
+
+def test_validate_request_uid():
+    from policy_server_tpu.models import ValidateRequest
+
+    raw = ValidateRequest.from_raw({"uid": "r1", "x": 1})
+    assert raw.uid() == "r1"
+    assert ValidateRequest.from_raw([1, 2]).uid() == ""
