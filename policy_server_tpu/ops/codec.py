@@ -1,0 +1,302 @@
+"""Feature-tensor codec: AdmissionReview JSON → fixed-shape feature arrays.
+
+TPU-first design (SURVEY.md §7.4 hard-part #1): instead of flattening
+*arbitrary* JSON, the schema is **policy-derived** — the union of JSON paths
+referenced by the loaded policies' IR defines exactly which feature columns
+exist. Shapes are static for a given policy set:
+
+* scalar path            → value ``(B,)``   + validity mask ``(B,)``
+* path with one ``*``    → value ``(B, N)`` + mask ``(B, N)``
+* path with two ``*``    → value ``(B, N1, N2)`` + mask
+
+Array axes are padded/capped at schema-build time (power-of-two caps).
+A request whose arrays exceed a cap **overflows**: it is routed to the host
+oracle backend and counted, never silently truncated (SURVEY.md §7.4 escape
+hatch). Strings are interned host-side; string predicates are precomputed
+bits (see utils/interning.py). Missing/null/type-mismatched leaves are
+encoded as mask=0.
+
+Feature keys:
+* ``{path}:v:{dtype}`` / ``{path}:m:{dtype}`` — value + dtype-valid mask
+* ``{path}:p``                               — JSON presence (Exists,
+  quantifier domain masks)
+* ``{path}:sp:{predkey}``                    — precomputed string-pred bit
+
+There is no reference counterpart — the reference hands raw JSON to WASM.
+This codec is what turns the admission stream into MXU/VPU-friendly batches.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from policy_server_tpu.ops import ir
+from policy_server_tpu.ops.ir import (
+    DType,
+    Expr,
+    Path,
+    STAR,
+    StrPred,
+)
+from policy_server_tpu.utils.interning import MISSING_ID, InternTable
+
+DEFAULT_AXIS_CAP = 64
+DEFAULT_NESTED_AXIS_CAP = 32
+
+_NP_DTYPES = {
+    DType.ID: np.int32,
+    DType.F32: np.float32,
+    DType.BOOL: np.bool_,
+    DType.I32: np.int32,
+}
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    key: str
+    segments: tuple[str, ...]
+    kind: str  # "value" | "present" | "pred"
+    dtype: DType | None
+    pred_kind: str | None
+    pred_pattern: str | None
+    caps: tuple[int, ...]
+
+    @property
+    def n_axes(self) -> int:
+        return len(self.caps)
+
+    def shape(self, batch: int) -> tuple[int, ...]:
+        return (batch, *self.caps)
+
+    def np_dtype(self) -> Any:
+        if self.kind == "value":
+            assert self.dtype is not None
+            return _NP_DTYPES[self.dtype]
+        return np.bool_
+
+    def pred_key(self) -> str:
+        return f"{self.pred_kind}:{self.pred_pattern}"
+
+
+def _pow2_cap(n: int) -> int:
+    return 1 << max(0, math.ceil(math.log2(max(1, n))))
+
+
+class SchemaOverflow(Exception):
+    """A request exceeded a schema axis cap — route to the oracle backend."""
+
+    def __init__(self, key: str, axis: int, length: int, cap: int):
+        super().__init__(
+            f"feature {key!r} axis {axis} length {length} exceeds cap {cap}"
+        )
+        self.key = key
+
+
+class FeatureSchema:
+    """The static feature layout for a fixed policy set."""
+
+    def __init__(self, specs: dict[str, FeatureSpec]):
+        self.specs = specs
+
+    @classmethod
+    def build(
+        cls,
+        exprs: Iterable[Expr],
+        axis_cap: int = DEFAULT_AXIS_CAP,
+        nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+    ) -> "FeatureSchema":
+        specs: dict[str, FeatureSpec] = {}
+
+        def caps_for(segs: tuple[str, ...]) -> tuple[int, ...]:
+            n = sum(1 for s in segs if s == STAR)
+            if n == 0:
+                return ()
+            if n == 1:
+                return (_pow2_cap(axis_cap),)
+            return (_pow2_cap(axis_cap), _pow2_cap(nested_axis_cap))
+
+        def add(spec: FeatureSpec) -> None:
+            specs.setdefault(spec.key, spec)
+
+        def add_value(p: Path) -> None:
+            base = p.key()
+            caps = caps_for(p.segments)
+            add(FeatureSpec(f"{base}:v:{p.dtype.value}", p.segments, "value",
+                            p.dtype, None, None, caps))
+
+        def add_present(segments: tuple[str, ...]) -> None:
+            key = ir.render_key(segments) + ":p"
+            add(FeatureSpec(key, segments, "present", None, None, None,
+                            caps_for(segments)))
+
+        def add_pred(p: Path, sp: StrPred) -> None:
+            base = p.key()
+            add(FeatureSpec(f"{base}:sp:{sp.key()}", p.segments, "pred", None,
+                            sp.kind, sp.pattern, caps_for(p.segments)))
+
+        for expr in exprs:
+            resolved = ir.resolve_element_paths(expr)
+
+            def visit(e: Expr) -> None:
+                if isinstance(e, (Path, ir.Elem)):
+                    # bare leaf used as a value
+                    add_value(resolved[id(e)])
+                elif isinstance(e, ir.Exists):
+                    add_present(resolved[id(e.target)].segments)
+                elif isinstance(e, ir.Not):
+                    visit(e.operand)
+                elif isinstance(e, (ir.And, ir.Or)):
+                    for op in e.operands:
+                        visit(op)
+                elif isinstance(e, ir.Cmp):
+                    visit(e.lhs)
+                    visit(e.rhs)
+                elif isinstance(e, ir.InSet):
+                    visit(e.operand)
+                elif isinstance(e, StrPred):
+                    add_pred(resolved[id(e.operand)], e)
+                elif isinstance(e, (ir.AnyOf, ir.AllOf, ir.CountOf)):
+                    add_present(resolved[id(e.over)].segments)  # domain mask
+                    visit(e.pred)
+                elif isinstance(e, ir.Const):
+                    pass
+                else:
+                    raise ir.IRError(f"unknown IR node {type(e).__name__}")
+
+            visit(expr)
+        return cls(specs)
+
+    # -- encoding ----------------------------------------------------------
+
+    def register_preds(self, table: InternTable) -> None:
+        for spec in self.specs.values():
+            if spec.kind == "pred":
+                table.register_pred(
+                    spec.pred_key(), ir.build_str_pred(spec.pred_kind, spec.pred_pattern)
+                )
+
+    def encode(
+        self, payload: Any, table: InternTable
+    ) -> dict[str, np.ndarray]:
+        """Encode one request payload → unbatched feature arrays (no leading
+        batch dim). Raises SchemaOverflow when an array exceeds its cap."""
+        out: dict[str, np.ndarray] = {}
+        for spec in self.specs.values():
+            if spec.kind == "value":
+                val = np.zeros(spec.caps, dtype=spec.np_dtype())
+                mask = np.zeros(spec.caps, dtype=np.bool_)
+                for coords, v in _extract(payload, spec.segments, spec.caps, spec.key):
+                    ok, converted = _convert(v, spec.dtype, table)
+                    if ok:
+                        val[coords] = converted
+                        mask[coords] = True
+                out[spec.key] = val
+                out[_mask_key(spec.key)] = mask
+            elif spec.kind == "present":
+                arr = np.zeros(spec.caps, dtype=np.bool_)
+                for coords, v in _extract(payload, spec.segments, spec.caps, spec.key):
+                    if v is not None:
+                        arr[coords] = True
+                out[spec.key] = arr
+            else:  # pred
+                arr = np.zeros(spec.caps, dtype=np.bool_)
+                pred_key = spec.pred_key()
+                for coords, v in _extract(payload, spec.segments, spec.caps, spec.key):
+                    if isinstance(v, str):
+                        arr[coords] = table.pred_value(pred_key, v)
+                out[spec.key] = arr
+        return out
+
+    def stack(self, encoded: list[dict[str, np.ndarray]], batch_size: int) -> dict[str, np.ndarray]:
+        """Stack per-request encodings into batch arrays padded to
+        ``batch_size`` (pad rows are all-missing; batch bucketing bounds XLA
+        recompilation, SURVEY.md §7.4)."""
+        assert encoded and len(encoded) <= batch_size
+        out: dict[str, np.ndarray] = {}
+        for spec in self.specs.values():
+            keys = [spec.key] if spec.kind != "value" else [spec.key, _mask_key(spec.key)]
+            for key in keys:
+                first = encoded[0][key]
+                arr = np.zeros((batch_size, *first.shape), dtype=first.dtype)
+                for i, enc in enumerate(encoded):
+                    arr[i] = enc[key]
+                out[key] = arr
+        return out
+
+    def empty_batch(self, batch_size: int) -> dict[str, np.ndarray]:
+        """An all-missing batch (for warmup/AOT compilation at boot,
+        SURVEY.md §7.2 step 6)."""
+        out: dict[str, np.ndarray] = {}
+        for spec in self.specs.values():
+            out[spec.key] = np.zeros(spec.shape(batch_size), dtype=spec.np_dtype())
+            if spec.kind == "value":
+                out[_mask_key(spec.key)] = np.zeros(
+                    spec.shape(batch_size), dtype=np.bool_
+                )
+        return out
+
+
+def _mask_key(value_key: str) -> str:
+    # "...:v:id" -> "...:m:id"
+    head, _, dtype = value_key.rpartition(":v:")
+    return f"{head}:m:{dtype}"
+
+
+def mask_key_for(value_key: str) -> str:
+    return _mask_key(value_key)
+
+
+def _convert(v: Any, dtype: DType, table: InternTable) -> tuple[bool, Any]:
+    """JSON leaf → typed scalar; type mismatch means missing (mask=0).
+    Mirrored exactly by the oracle interpreter (evaluation/oracle.py)."""
+    if dtype is DType.ID:
+        if isinstance(v, str):
+            return True, table.intern(v)
+        return False, MISSING_ID
+    if dtype is DType.F32:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return False, 0.0
+        return True, float(v)
+    if dtype is DType.BOOL:
+        if isinstance(v, bool):
+            return True, v
+        return False, False
+    if dtype is DType.I32:
+        if isinstance(v, bool) or not isinstance(v, int):
+            return False, 0
+        return True, int(v)
+    raise AssertionError(dtype)
+
+
+def _extract(
+    payload: Any,
+    segments: tuple[str, ...],
+    caps: tuple[int, ...],
+    key: str,
+):
+    """Yield ``(coords, json_value)`` for every leaf the path reaches.
+    ``coords`` indexes the star axes. Raises SchemaOverflow if an array is
+    longer than its axis cap."""
+
+    def rec(value: Any, segs: tuple[str, ...], coords: tuple[int, ...], axis: int):
+        if not segs:
+            yield coords, value
+            return
+        head, rest = segs[0], segs[1:]
+        if head == STAR:
+            if not isinstance(value, list):
+                return
+            if caps and len(value) > caps[axis]:
+                raise SchemaOverflow(key, axis, len(value), caps[axis])
+            for i, elem in enumerate(value):
+                yield from rec(elem, rest, coords + (i,), axis + 1)
+        else:
+            if not isinstance(value, Mapping) or head not in value:
+                return
+            yield from rec(value[head], rest, coords, axis)
+
+    yield from rec(payload, segments, (), 0)

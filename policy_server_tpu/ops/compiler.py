@@ -1,0 +1,262 @@
+"""IR → JAX lowering: each policy becomes a pure function over batched
+feature tensors; the full policy set fuses into ONE jit-compiled program.
+
+This is the TPU-native replacement for the reference's per-request wasmtime
+invocation (src/evaluation/evaluation_environment.rs:513-581) and its
+AOT precompilation (src/evaluation/precompiled_policy.rs:46-64): "precompile"
+here is jit lowering + XLA compilation, cached by (module digest, settings
+digest) — see evaluation/precompiled.py.
+
+Lowering rules (mirrored bit-exactly by evaluation/oracle.py):
+* every sub-expression lowers to ``(values, n_elem_axes)`` where values has
+  shape ``(B, *axis_prefix)`` — element axes are appended in quantifier
+  nesting order, so any two operands align by trailing-dim broadcast;
+* comparisons fold validity masks: missing operands ⇒ False;
+* AnyOf = ``any(pred & domain_mask)``; AllOf = ``all(pred | ~domain_mask)``;
+  CountOf = ``sum(pred & domain_mask)``;
+* no data-dependent control flow — everything is masked elementwise ops the
+  XLA fuser collapses into a handful of kernels (SURVEY.md §0 north star).
+
+A policy program returns ``(allowed: bool(B,), rule_idx: int32(B,))`` where
+rule_idx is the FIRST violated deny-rule (host side maps it to the message
+template) or -1 when allowed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from policy_server_tpu.ops import ir
+from policy_server_tpu.ops.codec import FeatureSchema, mask_key_for
+from policy_server_tpu.ops.ir import CmpOp, DType, Expr, Path
+from policy_server_tpu.utils.interning import InternTable
+
+Features = Mapping[str, Any]
+
+
+@dataclass
+class Lowered:
+    """A lowered sub-expression: shape (B, *axes[:naxes])."""
+
+    values: Any
+    naxes: int
+
+
+def _align(a: Lowered, b: Lowered) -> tuple[Any, Any, int]:
+    n = max(a.naxes, b.naxes)
+    av, bv = a.values, b.values
+    for _ in range(n - a.naxes):
+        av = av[..., None]
+    for _ in range(n - b.naxes):
+        bv = bv[..., None]
+    return av, bv, n
+
+
+_CMP_FNS: dict[CmpOp, Callable[[Any, Any], Any]] = {
+    CmpOp.EQ: lambda a, b: a == b,
+    CmpOp.NE: lambda a, b: a != b,
+    CmpOp.LT: lambda a, b: a < b,
+    CmpOp.LE: lambda a, b: a <= b,
+    CmpOp.GT: lambda a, b: a > b,
+    CmpOp.GE: lambda a, b: a >= b,
+}
+
+
+def lower_expr(
+    expr: Expr,
+    features: Features,
+    table: InternTable,
+) -> Any:
+    """Lower a typechecked boolean IR expression to a ``(B,)`` bool array."""
+    resolved = ir.resolve_element_paths(expr)
+
+    def value_of(e: Expr) -> tuple[Lowered, Lowered | None]:
+        """→ (values, validity-mask or None-if-always-valid)."""
+        if isinstance(e, ir.Const):
+            if e.dtype is DType.ID:
+                v = jnp.int32(table.intern(e.value))
+            elif e.dtype is DType.F32:
+                v = jnp.float32(e.value)
+            elif e.dtype is DType.I32:
+                v = jnp.int32(e.value)
+            else:
+                v = jnp.bool_(e.value)
+            return Lowered(v, 0), None
+        if isinstance(e, (Path, ir.Elem)):
+            p = resolved[id(e)]
+            key = f"{p.key()}:v:{p.dtype.value}"
+            vals = jnp.asarray(features[key])
+            mask = jnp.asarray(features[mask_key_for(key)])
+            return Lowered(vals, p.n_stars), Lowered(mask, p.n_stars)
+        if isinstance(e, ir.CountOf):
+            return Lowered(bool_of(e), _naxes_of(e)), None
+        # boolean-valued nodes used as values
+        return Lowered(bool_of(e), _naxes_of(e)), None
+
+    def _naxes_of(e: Expr) -> int:
+        # number of element axes of a lowered node at its own scope
+        if isinstance(e, (Path, ir.Elem)):
+            return resolved[id(e)].n_stars
+        if isinstance(e, ir.Exists):
+            return resolved[id(e.target)].n_stars
+        if isinstance(e, ir.StrPred):
+            return resolved[id(e.operand)].n_stars
+        if isinstance(e, ir.Not):
+            return _naxes_of(e.operand)
+        if isinstance(e, (ir.And, ir.Or)):
+            return max(_naxes_of(op) for op in e.operands)
+        if isinstance(e, ir.Cmp):
+            return max(_naxes_of(e.lhs), _naxes_of(e.rhs))
+        if isinstance(e, ir.InSet):
+            return _naxes_of(e.operand)
+        if isinstance(e, (ir.AnyOf, ir.AllOf, ir.CountOf)):
+            # the domain axis is reduced away
+            return resolved[id(e.over)].n_stars - 1
+        if isinstance(e, ir.Const):
+            return 0
+        raise ir.IRError(f"unknown IR node {type(e).__name__}")
+
+    def bool_of(e: Expr) -> Any:
+        if isinstance(e, ir.Const):
+            return jnp.bool_(e.value)
+        if isinstance(e, ir.Exists):
+            p = resolved[id(e.target)]
+            return jnp.asarray(features[f"{p.key()}:p"])
+        if isinstance(e, ir.Not):
+            return ~bool_of(e.operand)
+        if isinstance(e, ir.And):
+            parts = [Lowered(bool_of(op), _naxes_of(op)) for op in e.operands]
+            out = parts[0]
+            for p in parts[1:]:
+                a, b, n = _align(out, p)
+                out = Lowered(a & b, n)
+            return out.values
+        if isinstance(e, ir.Or):
+            parts = [Lowered(bool_of(op), _naxes_of(op)) for op in e.operands]
+            out = parts[0]
+            for p in parts[1:]:
+                a, b, n = _align(out, p)
+                out = Lowered(a | b, n)
+            return out.values
+        if isinstance(e, ir.Cmp):
+            lv, lm = value_of(e.lhs)
+            rv, rm = value_of(e.rhs)
+            a, b, n = _align(lv, rv)
+            # numeric cross-dtype comparisons promote via jnp
+            res = _CMP_FNS[e.op](a, b)
+            out = Lowered(res, n)
+            for m in (lm, rm):
+                if m is not None:
+                    mv, ov, n2 = _align(m, out)
+                    out = Lowered(mv & ov, n2)
+            return out.values
+        if isinstance(e, ir.InSet):
+            if not e.values:
+                return jnp.bool_(False)
+            ov, om = value_of(e.operand)
+            if e.dtype is DType.ID:
+                consts = np.array(
+                    sorted(table.intern(v) for v in e.values), dtype=np.int32
+                )
+            elif e.dtype is DType.F32:
+                consts = np.array(sorted(e.values), dtype=np.float32)
+            elif e.dtype is DType.I32:
+                consts = np.array(sorted(e.values), dtype=np.int32)
+            else:
+                consts = np.array(sorted(e.values), dtype=np.bool_)
+            hits = jnp.any(ov.values[..., None] == jnp.asarray(consts), axis=-1)
+            out = Lowered(hits, ov.naxes)
+            if om is not None:
+                mv, hv, n = _align(om, out)
+                out = Lowered(mv & hv, n)
+            return out.values
+        if isinstance(e, ir.StrPred):
+            p = resolved[id(e.operand)]
+            return jnp.asarray(features[f"{p.key()}:sp:{e.key()}"])
+        if isinstance(e, ir.AnyOf):
+            dom = resolved[id(e.over)]
+            mask = jnp.asarray(features[f"{dom.key()}:p"])
+            pred = Lowered(bool_of(e.pred), _naxes_of(e.pred))
+            m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+            return jnp.any(pv & m, axis=-1)
+        if isinstance(e, ir.AllOf):
+            dom = resolved[id(e.over)]
+            mask = jnp.asarray(features[f"{dom.key()}:p"])
+            pred = Lowered(bool_of(e.pred), _naxes_of(e.pred))
+            m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+            return jnp.all(pv | ~m, axis=-1)
+        if isinstance(e, ir.CountOf):
+            dom = resolved[id(e.over)]
+            mask = jnp.asarray(features[f"{dom.key()}:p"])
+            pred = Lowered(bool_of(e.pred), _naxes_of(e.pred))
+            m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+            return jnp.sum(pv & m, axis=-1, dtype=jnp.int32)
+        raise ir.IRError(f"cannot lower {type(e).__name__} as boolean")
+
+    return bool_of(expr)
+
+
+# --------------------------------------------------------------------------
+# Policy programs
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One deny-rule of a policy: ``condition`` True ⇒ the rule is violated.
+    ``message`` is a host-side template: str or fn(payload, settings) -> str
+    (device selects the rule index; host materializes the text —
+    SURVEY.md §7.4 hard-part #3 applied to messages)."""
+
+    name: str
+    condition: Expr
+    message: str | Callable[[Any], str]
+
+
+@dataclass(frozen=True)
+class PolicyProgram:
+    """A policy bound to its settings: ordered deny rules + optional host
+    mutator. ``allowed = not any(rule violated)``; the first violated rule
+    selects the rejection message (rules are priority-ordered)."""
+
+    rules: tuple[Rule, ...]
+    # host-side mutation hook: fn(payload) -> list of JSONPatch ops or None.
+    # Only consulted when the verdict is "allowed" and the policy mutates
+    # (mirrors reference patch flow, src/api/service.rs:160-208).
+    mutator: Callable[[Any], list[dict] | None] | None = None
+
+    def typecheck(self) -> None:
+        if not self.rules:
+            raise ir.IRError("policy must define at least one rule")
+        for r in self.rules:
+            ir.typecheck(r.condition)
+
+    def exprs(self) -> list[Expr]:
+        return [r.condition for r in self.rules]
+
+
+def compile_program(
+    program: PolicyProgram,
+    schema: FeatureSchema,
+    table: InternTable,
+) -> Callable[[Features], tuple[Any, Any]]:
+    """→ fn(features) -> (allowed (B,), rule_idx (B,) int32, -1 if allowed).
+
+    The returned fn is pure and trace-safe; the evaluation environment fuses
+    all policies' fns into one jitted program per batch bucket."""
+
+    def fn(features: Features) -> tuple[Any, Any]:
+        violated = jnp.stack(
+            [lower_expr(r.condition, features, table) for r in program.rules],
+            axis=-1,
+        )  # (B, R)
+        any_violated = jnp.any(violated, axis=-1)
+        first = jnp.argmax(violated, axis=-1).astype(jnp.int32)
+        rule_idx = jnp.where(any_violated, first, jnp.int32(-1))
+        return ~any_violated, rule_idx
+
+    return fn

@@ -1,0 +1,225 @@
+"""IR, codec, compiler and oracle tests.
+
+The key property (BASELINE.json north star: "bit-exact verdicts vs the WASM
+backend"): for any payload that doesn't overflow the schema, the jit-compiled
+JAX lowering and the host oracle interpreter agree exactly.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from policy_server_tpu.evaluation import oracle
+from policy_server_tpu.ops import ir
+from policy_server_tpu.ops.codec import FeatureSchema, SchemaOverflow
+from policy_server_tpu.ops.compiler import PolicyProgram, Rule, compile_program, lower_expr
+from policy_server_tpu.ops.ir import (
+    AllOf,
+    AnyOf,
+    Const,
+    CountOf,
+    DType,
+    Elem,
+    Exists,
+    IRError,
+    Path,
+    StrPred,
+    eq,
+    ge,
+    gt,
+    in_set,
+    matches_glob,
+    ne,
+)
+from policy_server_tpu.utils.interning import InternTable
+
+NS = Path("request.namespace")
+OP = Path("request.operation")
+REPLICAS = Path("request.object.spec.replicas", DType.F32)
+CONTAINERS = Path("request.object.spec.containers")
+PRIVILEGED = Elem("securityContext.privileged", DType.BOOL)
+IMAGE = Elem("image")
+CAPS_ADD = Elem("securityContext.capabilities.add")
+
+
+EXPRESSIONS = [
+    eq(NS, "default"),
+    ne(NS, "default"),
+    in_set(NS, ["kube-system", "kube-public"]),
+    Exists(Path("request.object.metadata.labels.app")),
+    eq(NS, "default") & eq(OP, "CREATE"),
+    eq(NS, "default") | eq(OP, "DELETE"),
+    ~eq(NS, "default"),
+    gt(REPLICAS, 3.0),
+    ge(REPLICAS, 2),
+    AnyOf(CONTAINERS, eq(PRIVILEGED, True)),
+    AllOf(CONTAINERS, Exists(Elem("securityContext"))),
+    AnyOf(CONTAINERS, matches_glob(IMAGE, "ghcr.io/*")),
+    AnyOf(CONTAINERS, AnyOf(CAPS_ADD, in_set(Elem(), ["SYS_ADMIN", "NET_ADMIN"]))),
+    AllOf(CONTAINERS, AllOf(CAPS_ADD, in_set(Elem(), ["KILL", "CHOWN", "NET_ADMIN", "SYS_ADMIN"]))),
+    ge(CountOf(CONTAINERS, eq(PRIVILEGED, True)), 2),
+    eq(NS, OP),  # string-to-string comparison
+    StrPred(NS, "prefix", "kube-"),
+    AnyOf(CONTAINERS, ~Exists(Elem("securityContext.privileged", DType.BOOL)))
+    & eq(OP, "CREATE"),
+]
+
+
+def random_payload(rng: random.Random) -> dict:
+    namespaces = ["default", "kube-system", "kube-public", "prod", "dev"]
+    ops = ["CREATE", "UPDATE", "DELETE"]
+    images = [
+        "ghcr.io/org/app:v1",
+        "docker.io/library/nginx:latest",
+        "ghcr.io/kubewarden/policy:1.0",
+        "quay.io/x/y",
+    ]
+    caps = ["SYS_ADMIN", "NET_ADMIN", "KILL", "CHOWN", "MKNOD"]
+
+    def container():
+        c: dict = {}
+        if rng.random() < 0.9:
+            c["image"] = rng.choice(images)
+        if rng.random() < 0.7:
+            sc: dict = {}
+            if rng.random() < 0.6:
+                sc["privileged"] = rng.random() < 0.5
+            if rng.random() < 0.5:
+                sc["capabilities"] = {
+                    "add": rng.sample(caps, rng.randint(0, 3)),
+                    "drop": rng.sample(caps, rng.randint(0, 2)),
+                }
+            c["securityContext"] = sc
+        return c
+
+    payload: dict = {
+        "request": {
+            "uid": f"u{rng.randint(0, 999)}",
+            "operation": rng.choice(ops),
+            "object": {
+                "metadata": {},
+                "spec": {},
+            },
+        }
+    }
+    req = payload["request"]
+    if rng.random() < 0.9:
+        req["namespace"] = rng.choice(namespaces)
+    if rng.random() < 0.5:
+        req["object"]["metadata"]["labels"] = {"app": "x"}
+    if rng.random() < 0.7:
+        req["object"]["spec"]["replicas"] = rng.choice([0, 1, 2, 3, 4, 5, 2.5])
+    if rng.random() < 0.85:
+        req["object"]["spec"]["containers"] = [
+            container() for _ in range(rng.randint(0, 5))
+        ]
+    if rng.random() < 0.1:
+        req["namespace"] = None  # null → missing
+    return payload
+
+
+def test_differential_compiler_vs_oracle():
+    """The load-bearing test: jit lowering == oracle on a random corpus."""
+    rng = random.Random(1234)
+    payloads = [random_payload(rng) for _ in range(64)]
+    for expr in EXPRESSIONS:
+        ir.typecheck(expr)
+        schema = FeatureSchema.build([expr], axis_cap=8, nested_axis_cap=8)
+        table = InternTable()
+        schema.register_preds(table)
+        encoded = [schema.encode(p, table) for p in payloads]
+        batch = schema.stack(encoded, batch_size=len(payloads))
+        fn = jax.jit(lambda feats: lower_expr(expr, feats, table))
+        got = np.asarray(fn(batch))
+        want = np.array([oracle.evaluate_expr(expr, p) for p in payloads])
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"mismatch for expr {expr!r}"
+        )
+
+
+def test_program_differential():
+    rng = random.Random(99)
+    payloads = [random_payload(rng) for _ in range(32)]
+    program = PolicyProgram(
+        rules=(
+            Rule("privileged", AnyOf(CONTAINERS, eq(PRIVILEGED, True)),
+                 "privileged containers are not allowed"),
+            Rule("bad-ns", in_set(NS, ["kube-system"]), "namespace denied"),
+        )
+    )
+    program.typecheck()
+    schema = FeatureSchema.build(program.exprs(), axis_cap=8)
+    table = InternTable()
+    schema.register_preds(table)
+    encoded = [schema.encode(p, table) for p in payloads]
+    batch = schema.stack(encoded, batch_size=len(payloads))
+    fn = jax.jit(compile_program(program, schema, table))
+    allowed, rule_idx = (np.asarray(x) for x in fn(batch))
+    for i, p in enumerate(payloads):
+        want_allowed, want_idx = oracle.evaluate_program(program, p)
+        assert bool(allowed[i]) == want_allowed, p
+        assert int(rule_idx[i]) == want_idx, p
+
+
+def test_padding_rows_are_inert():
+    """Batch pad rows (all-missing) must evaluate as allowed for deny-rules
+    built on comparisons (missing ⇒ False)."""
+    expr = eq(NS, "default")
+    schema = FeatureSchema.build([expr])
+    table = InternTable()
+    batch = schema.stack([schema.encode({}, table)], batch_size=4)
+    got = np.asarray(lower_expr(expr, batch, table))
+    assert got.tolist() == [False, False, False, False]
+
+
+def test_typecheck_errors():
+    with pytest.raises(IRError):
+        ir.typecheck(Path("request.namespace"))  # not boolean
+    with pytest.raises(IRError):
+        ir.typecheck(eq(Elem("x"), "v"))  # Elem outside quantifier
+    with pytest.raises(IRError):
+        ir.typecheck(eq(Path("a.b[*].c"), "v"))  # unbound star as leaf
+    with pytest.raises(IRError):
+        ir.typecheck(gt(NS, "x"))  # ordered cmp on ID
+    with pytest.raises(IRError):
+        ir.typecheck(eq(REPLICAS, Const("3", DType.ID)))  # F32 vs ID
+    with pytest.raises(IRError):
+        # nested quantifier over absolute path
+        ir.typecheck(AnyOf(CONTAINERS, AnyOf(Path("a.b"), eq(Elem(), "x"))))
+    with pytest.raises(IRError):
+        ir.typecheck(StrPred(NS, "bogus", "x"))
+    with pytest.raises(IRError):
+        ir.typecheck(StrPred(NS, "regex", "("))  # invalid regex
+
+
+def test_schema_overflow_routes_to_oracle():
+    expr = AnyOf(CONTAINERS, eq(IMAGE, "x"))
+    schema = FeatureSchema.build([expr], axis_cap=2)
+    table = InternTable()
+    payload = {
+        "request": {"object": {"spec": {"containers": [{"image": "a"}] * 3}}}
+    }
+    with pytest.raises(SchemaOverflow):
+        schema.encode(payload, table)
+    # the oracle handles it fine
+    assert oracle.evaluate_expr(expr, payload) is False
+
+
+def test_intern_table_preds():
+    t = InternTable()
+    i1 = t.intern("ghcr.io/app")
+    t.register_pred("glob:ghcr.io/*", ir.build_str_pred("glob", "ghcr.io/*"))
+    assert t.pred_bit("glob:ghcr.io/*", i1)
+    i2 = t.intern("docker.io/app")  # added after pred registration
+    assert not t.pred_bit("glob:ghcr.io/*", i2)
+    assert t.intern("ghcr.io/app") == i1
+    assert t.lookup("nope") is None
+
+
+def test_path_parsing():
+    p = Path("request.object.spec.containers[*].securityContext.capabilities.add[*]")
+    assert p.n_stars == 2
+    assert p.segments[4] == ir.STAR
+    assert p.key() == "request.object.spec.containers[*].securityContext.capabilities.add[*]"
