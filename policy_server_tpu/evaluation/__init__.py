@@ -1,0 +1,29 @@
+"""Evaluation core (reference src/evaluation/)."""
+
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironment,
+    EvaluationEnvironmentBuilder,
+)
+from policy_server_tpu.evaluation.errors import (
+    BootstrapFailure,
+    EvaluationError,
+    ExecutionDeadlineExceeded,
+    InvalidPolicyId,
+    PolicyInitializationError,
+    PolicyNotFoundError,
+)
+from policy_server_tpu.evaluation.policy_id import PolicyID
+from policy_server_tpu.evaluation.settings import PolicyEvaluationSettings
+
+__all__ = [
+    "EvaluationEnvironment",
+    "EvaluationEnvironmentBuilder",
+    "BootstrapFailure",
+    "EvaluationError",
+    "ExecutionDeadlineExceeded",
+    "InvalidPolicyId",
+    "PolicyInitializationError",
+    "PolicyNotFoundError",
+    "PolicyID",
+    "PolicyEvaluationSettings",
+]

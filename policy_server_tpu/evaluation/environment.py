@@ -1,0 +1,492 @@
+"""EvaluationEnvironment — the core registry + batched evaluator.
+
+Reference parity: src/evaluation/evaluation_environment.rs —
+* immutable registry built at boot (builder → environment, rs:130-366):
+  module dedup by digest (rs:100-108), per-policy settings
+  (rs:104-112), ``policy_initialization_errors`` map (rs:114-117, fed by
+  --continue-on-errors semantics, lib.rs:152-158), group set (rs:120);
+* settings validated at boot (rs:472-510), group expressions type-checked
+  at boot (rs:1075-1112);
+* ``validate(policy_id, request)`` dispatching single vs group
+  (rs:546-556), PolicyNotFound / PolicyInitialization errors (rs:562-581);
+* group cause aggregation + short-circuit semantics (rs:979-1042).
+
+TPU-native execution model (replaces per-request wasm rehydration,
+rs:513-543): ALL loaded policies and group expressions fuse into ONE
+jit-compiled program over the batch's feature tensors; a request batch is
+encoded once and every verdict falls out of a single device dispatch.
+Per-request isolation is free — programs are pure functions, the fused
+program is stateless, so there is nothing to rehydrate.
+
+Backends: ``jax`` (device path) and ``oracle`` (host interpreter,
+evaluation/oracle.py) — requests that overflow the feature schema
+(ops/codec.py SchemaOverflow) transparently fall back to the oracle and are
+counted (SURVEY.md §7.4 escape hatch).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+from policy_server_tpu.evaluation import groups as groups_mod
+from policy_server_tpu.evaluation import oracle as oracle_mod
+from policy_server_tpu.evaluation.errors import (
+    BootstrapFailure,
+    PolicyInitializationError,
+    PolicyNotFoundError,
+)
+from policy_server_tpu.evaluation.policy_id import PolicyID
+from policy_server_tpu.evaluation.precompiled import (
+    PolicyModule,
+    PrecompiledPolicy,
+    ProgramCache,
+)
+from policy_server_tpu.evaluation.settings import PolicyEvaluationSettings
+from policy_server_tpu.models import (
+    AdmissionResponse,
+    StatusCause,
+    StatusDetails,
+    ValidateRequest,
+    ValidationStatus,
+)
+from policy_server_tpu.models.admission import JSON_PATCH
+from policy_server_tpu.models.policy import (
+    Policy,
+    PolicyGroup,
+    PolicyMode,
+    PolicyOrPolicyGroup,
+)
+from policy_server_tpu.ops.codec import (
+    DEFAULT_AXIS_CAP,
+    DEFAULT_NESTED_AXIS_CAP,
+    FeatureSchema,
+    SchemaOverflow,
+)
+from policy_server_tpu.ops.compiler import compile_program
+from policy_server_tpu.policies import resolve_builtin
+from policy_server_tpu.utils.interning import InternTable
+
+GROUP_MUTATION_MESSAGE = "mutation is not allowed inside of policy group"
+
+
+@dataclass
+class BoundPolicy:
+    """A module bound to settings under a policy id ('name' or
+    'group/member')."""
+
+    policy_id: str
+    module_url: str
+    precompiled: PrecompiledPolicy
+    eval_settings: PolicyEvaluationSettings
+
+
+@dataclass
+class BoundGroup:
+    name: str
+    expression: str
+    ast: Any
+    message: str
+    policy_mode: PolicyMode
+    members: dict[str, BoundPolicy] = field(default_factory=dict)
+
+
+def default_module_resolver(url: str) -> PolicyModule:
+    builtin = resolve_builtin(url)
+    if builtin is None:
+        raise BootstrapFailure(
+            f"module {url!r} is not a builtin and no fetcher was configured "
+            "(use PolicyServer bootstrap, or builtin:// modules)"
+        )
+    return builtin
+
+
+class EvaluationEnvironmentBuilder:
+    """Boot-time assembly (reference EvaluationEnvironmentBuilder,
+    evaluation_environment.rs:139-194 + build at 198-332)."""
+
+    def __init__(
+        self,
+        backend: str = "jax",
+        continue_on_errors: bool = False,
+        module_resolver: Callable[[str], PolicyModule] | None = None,
+        axis_cap: int = DEFAULT_AXIS_CAP,
+        nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+        always_accept_admission_reviews_on_namespace: str | None = None,
+    ) -> None:
+        self.backend = backend
+        self.continue_on_errors = continue_on_errors
+        self.module_resolver = module_resolver or default_module_resolver
+        self.axis_cap = axis_cap
+        self.nested_axis_cap = nested_axis_cap
+        self.always_accept_namespace = always_accept_admission_reviews_on_namespace
+
+    def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
+        cache = ProgramCache()
+        bound: dict[str, BoundPolicy] = {}
+        groups: dict[str, BoundGroup] = {}
+        init_errors: dict[str, str] = {}
+
+        def bootstrap_policy(
+            pid: str,
+            module_url: str,
+            settings: Mapping[str, Any] | None,
+            policy_mode: PolicyMode,
+            allowed_to_mutate: bool,
+        ) -> BoundPolicy:
+            module = self.module_resolver(module_url)
+            validation = module.validate_settings(dict(settings or {}))
+            if not validation.valid:
+                # reference: "Policy settings are invalid" (rs:472-510)
+                raise PolicyInitializationError(
+                    pid, f"Policy settings are invalid: {validation.message or ''}"
+                )
+            pre = cache.get_or_build(module, settings or {})
+            return BoundPolicy(
+                policy_id=pid,
+                module_url=module_url,
+                precompiled=pre,
+                eval_settings=PolicyEvaluationSettings(
+                    policy_mode=policy_mode,
+                    allowed_to_mutate=allowed_to_mutate,
+                    settings=dict(settings or {}),
+                ),
+            )
+
+        for name, entry in policies.items():
+            try:
+                if isinstance(entry, Policy):
+                    bound[name] = bootstrap_policy(
+                        name,
+                        entry.module,
+                        entry.settings,
+                        entry.policy_mode,
+                        bool(entry.allowed_to_mutate),
+                    )
+                elif isinstance(entry, PolicyGroup):
+                    ast = groups_mod.validate_expression(
+                        entry.expression, set(entry.policies)
+                    )
+                    group = BoundGroup(
+                        name=name,
+                        expression=entry.expression,
+                        ast=ast,
+                        message=entry.message,
+                        policy_mode=entry.policy_mode,
+                    )
+                    for member_name, member in entry.policies.items():
+                        member_pid = f"{name}/{member_name}"
+                        group.members[member_name] = bootstrap_policy(
+                            member_pid,
+                            member.module,
+                            member.settings,
+                            entry.policy_mode,
+                            False,  # group members never mutate (rs group ban)
+                        )
+                    groups[name] = group
+                    for member_name, bp in group.members.items():
+                        bound[bp.policy_id] = bp
+                else:  # pragma: no cover
+                    raise BootstrapFailure(f"unknown policy entry type for {name!r}")
+            except (
+                PolicyInitializationError,
+                groups_mod.ExpressionError,
+                BootstrapFailure,
+                KeyError,
+                ValueError,
+            ) as e:
+                if not self.continue_on_errors:
+                    raise BootstrapFailure(
+                        f"failed to bootstrap policy {name!r}: {e}"
+                    ) from e
+                init_errors[name] = str(e)
+
+        return EvaluationEnvironment(
+            backend=self.backend,
+            bound=bound,
+            groups=groups,
+            init_errors=init_errors,
+            axis_cap=self.axis_cap,
+            nested_axis_cap=self.nested_axis_cap,
+        )
+
+
+class EvaluationEnvironment:
+    """Immutable post-boot registry + the fused batched evaluator.
+
+    Thread-safe by construction: all state is read-only after __init__
+    (reference relies on Arc for the same guarantee, lib.rs:194-197).
+    """
+
+    def __init__(
+        self,
+        backend: str,
+        bound: dict[str, BoundPolicy],
+        groups: dict[str, BoundGroup],
+        init_errors: dict[str, str],
+        axis_cap: int = DEFAULT_AXIS_CAP,
+        nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+    ) -> None:
+        self.backend = backend
+        self._bound = bound
+        self._groups = groups
+        self._init_errors = init_errors
+        self.table = InternTable()
+        exprs = [
+            rule.condition
+            for bp in bound.values()
+            for rule in bp.precompiled.program.rules
+        ]
+        self.schema = FeatureSchema.build(
+            exprs, axis_cap=axis_cap, nested_axis_cap=nested_axis_cap
+        )
+        self.schema.register_preds(self.table)
+        self._compiled = {
+            pid: compile_program(bp.precompiled.program, self.schema, self.table)
+            for pid, bp in bound.items()
+        }
+        self._fused = jax.jit(self._forward)
+        self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
+        self._fallback_lock = threading.Lock()
+
+    # -- registry accessors (reference rs:434-470) ------------------------
+
+    def policy_ids(self) -> list[str]:
+        """Top-level addressable ids (singles + groups), like the reference's
+        policies.yml keys."""
+        singles = [pid for pid in self._bound if "/" not in pid]
+        return sorted(singles + list(self._groups))
+
+    def _lookup_top_level(self, pid: PolicyID) -> BoundPolicy | BoundGroup:
+        raw = str(pid)
+        if raw in self._init_errors:
+            raise PolicyInitializationError(raw, self._init_errors[raw])
+        if pid.is_group_member:
+            bp = self._bound.get(raw)
+            if bp is None:
+                raise PolicyNotFoundError(raw)
+            return bp
+        if pid.name in self._groups:
+            return self._groups[pid.name]
+        bp = self._bound.get(pid.name)
+        if bp is None:
+            raise PolicyNotFoundError(raw)
+        return bp
+
+    def get_policy_mode(self, policy_id: str) -> PolicyMode:
+        target = self._lookup_top_level(PolicyID.parse(policy_id))
+        if isinstance(target, BoundGroup):
+            return target.policy_mode
+        return target.eval_settings.policy_mode
+
+    def get_policy_allowed_to_mutate(self, policy_id: str) -> bool:
+        target = self._lookup_top_level(PolicyID.parse(policy_id))
+        if isinstance(target, BoundGroup):
+            return False
+        return target.eval_settings.allowed_to_mutate
+
+    def get_policy_settings(self, policy_id: str) -> PolicyEvaluationSettings:
+        target = self._lookup_top_level(PolicyID.parse(policy_id))
+        if isinstance(target, BoundGroup):
+            return PolicyEvaluationSettings(policy_mode=target.policy_mode)
+        return target.eval_settings
+
+    def has_policy(self, policy_id: str) -> bool:
+        try:
+            self._lookup_top_level(PolicyID.parse(policy_id))
+            return True
+        except PolicyInitializationError:
+            return True
+        except Exception:
+            return False
+
+    # -- the fused device program -----------------------------------------
+
+    def _forward(self, features: Mapping[str, Any]) -> dict[str, Any]:
+        """All policies + group expressions over one feature batch. Pure —
+        jit-compiled once per batch bucket shape."""
+        out: dict[str, Any] = {}
+        for pid, fn in self._compiled.items():
+            allowed, rule_idx = fn(features)
+            out[f"p:{pid}:allowed"] = allowed
+            out[f"p:{pid}:rule"] = rule_idx
+        for name, group in self._groups.items():
+            member_allowed = {
+                m: out[f"p:{name}/{m}:allowed"] for m in group.members
+            }
+            verdict, evaluated = groups_mod.lower_group(group.ast, member_allowed)
+            out[f"g:{name}:allowed"] = verdict
+            for m, mask in evaluated.items():
+                out[f"g:{name}:eval:{m}"] = mask
+        return out
+
+    def run_batch(self, features: Mapping[str, Any]) -> dict[str, np.ndarray]:
+        """Dispatch one encoded feature batch to the device; returns host
+        numpy outputs."""
+        return {k: np.asarray(v) for k, v in self._fused(features).items()}
+
+    def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
+        """AOT-compile the fused program for the batch buckets so the first
+        request isn't a compile stall (reference precompiles at boot via
+        rayon, lib.rs:287-307; SURVEY.md §7.2 step 6)."""
+        for b in batch_sizes:
+            self.run_batch(self.schema.empty_batch(b))
+
+    # -- single-request evaluation (batch of 1; the batcher uses the
+    #    *_from_outputs materializers below for real micro-batches) --------
+
+    def validate(self, policy_id: str, request: ValidateRequest) -> AdmissionResponse:
+        """Reference EvaluationEnvironment::validate (rs:546-556)."""
+        pid = PolicyID.parse(policy_id)
+        target = self._lookup_top_level(pid)
+        payload = request.payload()
+        self._run_pre_eval_hooks(target, payload)
+
+        if self.backend == "oracle":
+            return self._materialize(target, request, self._oracle_outputs(payload))
+        try:
+            encoded = self.schema.encode(payload, self.table)
+        except SchemaOverflow:
+            with self._fallback_lock:
+                self.oracle_fallbacks += 1
+            return self._materialize(target, request, self._oracle_outputs(payload))
+        batch = self.schema.stack([encoded], batch_size=1)
+        outputs = {k: v[0] for k, v in self.run_batch(batch).items()}
+        return self._materialize(target, request, outputs)
+
+    def _run_pre_eval_hooks(
+        self, target: BoundPolicy | BoundGroup, payload: Any
+    ) -> None:
+        targets = (
+            list(target.members.values())
+            if isinstance(target, BoundGroup)
+            else [target]
+        )
+        for bp in targets:
+            hook = bp.precompiled.program.pre_eval_hook
+            if hook is not None:
+                hook(payload)
+
+    def _oracle_outputs(self, payload: Any) -> dict[str, Any]:
+        """Host-interpreter evaluation of every policy + group (scalar
+        outputs, same keys as the device path)."""
+        out: dict[str, Any] = {}
+        for pid, bp in self._bound.items():
+            allowed, rule_idx = oracle_mod.evaluate_program(
+                bp.precompiled.program, payload
+            )
+            out[f"p:{pid}:allowed"] = allowed
+            out[f"p:{pid}:rule"] = rule_idx
+        for name, group in self._groups.items():
+            member_allowed = {
+                m: bool(out[f"p:{name}/{m}:allowed"]) for m in group.members
+            }
+            verdict, evaluated = groups_mod.evaluate_group_host(
+                group.ast, member_allowed
+            )
+            out[f"g:{name}:allowed"] = verdict
+            for m in group.members:
+                out[f"g:{name}:eval:{m}"] = evaluated.get(m, False)
+        return out
+
+    # -- response materialization (host side) ------------------------------
+
+    def _materialize(
+        self,
+        target: BoundPolicy | BoundGroup,
+        request: ValidateRequest,
+        outputs: Mapping[str, Any],
+    ) -> AdmissionResponse:
+        uid = request.uid()
+        payload = request.payload()
+        if isinstance(target, BoundGroup):
+            return self._materialize_group(target, uid, payload, outputs)
+        return self._materialize_single(target, uid, payload, outputs)
+
+    def _materialize_single(
+        self,
+        bp: BoundPolicy,
+        uid: str,
+        payload: Any,
+        outputs: Mapping[str, Any],
+    ) -> AdmissionResponse:
+        allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
+        if not allowed:
+            rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
+            rule = bp.precompiled.program.rules[rule_idx]
+            message = (
+                rule.message if isinstance(rule.message, str) else rule.message(payload)
+            )
+            return AdmissionResponse(
+                uid=uid,
+                allowed=False,
+                status=ValidationStatus(message=message, code=400),
+            )
+        response = AdmissionResponse(uid=uid, allowed=True)
+        mutator = bp.precompiled.program.mutator
+        if mutator is not None:
+            ops = mutator(payload)
+            if ops:
+                response.patch = base64.b64encode(
+                    json.dumps(ops).encode()
+                ).decode()
+                response.patch_type = JSON_PATCH
+        return response
+
+    def _materialize_group(
+        self,
+        group: BoundGroup,
+        uid: str,
+        payload: Any,
+        outputs: Mapping[str, Any],
+    ) -> AdmissionResponse:
+        allowed = bool(outputs[f"g:{group.name}:allowed"])
+        # group-member mutation ban (reference integration_test.rs:239-251):
+        # an evaluated member that *would* mutate rejects the whole group.
+        for member_name, bp in group.members.items():
+            evaluated = bool(outputs.get(f"g:{group.name}:eval:{member_name}", False))
+            member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
+            mutator = bp.precompiled.program.mutator
+            if evaluated and member_allowed and mutator is not None:
+                if mutator(payload):
+                    return AdmissionResponse(
+                        uid=uid,
+                        allowed=False,
+                        status=ValidationStatus(
+                            message=GROUP_MUTATION_MESSAGE, code=500
+                        ),
+                    )
+        if allowed:
+            return AdmissionResponse(uid=uid, allowed=True)
+        causes: list[StatusCause] = []
+        for member_name, bp in group.members.items():
+            evaluated = bool(outputs.get(f"g:{group.name}:eval:{member_name}", False))
+            member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
+            if evaluated and not member_allowed:
+                rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
+                rule = bp.precompiled.program.rules[rule_idx]
+                message = (
+                    rule.message
+                    if isinstance(rule.message, str)
+                    else rule.message(payload)
+                )
+                causes.append(
+                    StatusCause(
+                        field=f"spec.policies.{member_name}", message=message
+                    )
+                )
+        return AdmissionResponse(
+            uid=uid,
+            allowed=False,
+            status=ValidationStatus(
+                message=group.message,
+                code=400,
+                details=StatusDetails(causes=tuple(causes)),
+            ),
+        )

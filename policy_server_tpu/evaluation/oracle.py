@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Any, Iterator, Mapping
 
 from policy_server_tpu.ops import ir
+from policy_server_tpu.ops.codec import star_elements
 from policy_server_tpu.ops.compiler import PolicyProgram
 from policy_server_tpu.ops.ir import CmpOp, DType, Expr, Path, STAR
 
@@ -40,15 +41,18 @@ def _cached_str_pred(kind: str, pattern: str):
 
 def _walk_path(payload: Any, segments: tuple[str, ...]) -> Iterator[Any]:
     """Yield every JSON value the path reaches (0 or more; wildcards fan
-    out). Missing branches yield nothing."""
+    out). Missing branches yield nothing. Wildcard element semantics are
+    shared with the codec (ops.codec.star_elements): lists yield items, maps
+    yield {__key__, __value__} wrappers in sorted key order."""
     if not segments:
         if payload is not None:
             yield payload
         return
     head, rest = segments[0], segments[1:]
     if head == STAR:
-        if isinstance(payload, list):
-            for elem in payload:
+        elems = star_elements(payload)
+        if elems is not None:
+            for elem in elems:
                 yield from _walk_path(elem, rest)
     else:
         if isinstance(payload, Mapping) and head in payload:
@@ -94,11 +98,10 @@ class OracleInterpreter:
         self.payload = payload
 
     def evaluate(self, expr: Expr) -> bool:
-        resolved = ir.resolve_element_paths(expr)
-        return bool(self._eval(expr, resolved, env=None))
+        return bool(self._eval(expr, env=None))
 
     # env: the current element JSON value per quantifier depth (innermost last)
-    def _leaf(self, e: Expr, resolved: dict[int, Path], env: Any) -> Any:
+    def _leaf(self, e: Expr, env: Any) -> Any:
         """Typed scalar value of a Path/Elem leaf in the current scope."""
         if isinstance(e, ir.Elem):
             if env is None:
@@ -114,14 +117,14 @@ class OracleInterpreter:
             )
         return _scalar_at(self.payload, e.segments, e.dtype)
 
-    def _value(self, e: Expr, resolved: dict[int, Path], env: Any) -> Any:
+    def _value(self, e: Expr, env: Any) -> Any:
         if isinstance(e, ir.Const):
             return e.value
         if isinstance(e, (Path, ir.Elem)):
-            return self._leaf(e, resolved, env)
+            return self._leaf(e, env)
         if isinstance(e, ir.CountOf):
-            return self._count(e, resolved, env)
-        return self._eval(e, resolved, env)
+            return self._count(e, env)
+        return self._eval(e, env)
 
     def _domain(self, e: Expr, env: Any) -> list[Any]:
         """Elements of a quantifier domain (path ends with STAR)."""
@@ -134,16 +137,17 @@ class OracleInterpreter:
             base = self.payload
         out: list[Any] = []
         for v in _walk_path(base, segs[:-1]):
-            if isinstance(v, list):
-                out.extend(v)
+            elems = star_elements(v)
+            if elems is not None:
+                out.extend(elems)
         return out
 
-    def _count(self, e: "ir.CountOf", resolved: dict[int, Path], env: Any) -> int:
+    def _count(self, e: "ir.CountOf", env: Any) -> int:
         return sum(
-            1 for elem in self._domain(e, env) if self._eval(e.pred, resolved, elem)
+            1 for elem in self._domain(e, env) if self._eval(e.pred, elem)
         )
 
-    def _eval(self, e: Expr, resolved: dict[int, Path], env: Any) -> bool:
+    def _eval(self, e: Expr, env: Any) -> bool:
         if isinstance(e, ir.Const):
             return bool(e.value)
         if isinstance(e, ir.Exists):
@@ -151,14 +155,14 @@ class OracleInterpreter:
             base = env if isinstance(t, ir.Elem) else self.payload
             return any(True for _ in _walk_path(base, t.segments))
         if isinstance(e, ir.Not):
-            return not self._eval(e.operand, resolved, env)
+            return not self._eval(e.operand, env)
         if isinstance(e, ir.And):
-            return all(self._eval(op, resolved, env) for op in e.operands)
+            return all(self._eval(op, env) for op in e.operands)
         if isinstance(e, ir.Or):
-            return any(self._eval(op, resolved, env) for op in e.operands)
+            return any(self._eval(op, env) for op in e.operands)
         if isinstance(e, ir.Cmp):
-            lv = self._value(e.lhs, resolved, env)
-            rv = self._value(e.rhs, resolved, env)
+            lv = self._value(e.lhs, env)
+            rv = self._value(e.rhs, env)
             if lv is _MISSING or rv is _MISSING:
                 return False
             if isinstance(lv, bool) != isinstance(rv, bool) and e.op in (
@@ -171,22 +175,22 @@ class OracleInterpreter:
         if isinstance(e, ir.InSet):
             if not e.values:
                 return False
-            v = self._value(e.operand, resolved, env)
+            v = self._value(e.operand, env)
             if v is _MISSING:
                 return False
             return v in e.values
         if isinstance(e, ir.StrPred):
-            v = self._leaf(e.operand, resolved, env)
+            v = self._leaf(e.operand, env)
             if v is _MISSING:
                 return False
             return _cached_str_pred(e.kind, e.pattern)(v)
         if isinstance(e, ir.AnyOf):
             return any(
-                self._eval(e.pred, resolved, elem) for elem in self._domain(e, env)
+                self._eval(e.pred, elem) for elem in self._domain(e, env)
             )
         if isinstance(e, ir.AllOf):
             return all(
-                self._eval(e.pred, resolved, elem) for elem in self._domain(e, env)
+                self._eval(e.pred, elem) for elem in self._domain(e, env)
             )
         if isinstance(e, ir.CountOf):
             raise ir.IRError("CountOf is not boolean; wrap in a comparison")

@@ -47,6 +47,10 @@ from policy_server_tpu.utils.interning import MISSING_ID, InternTable
 DEFAULT_AXIS_CAP = 64
 DEFAULT_NESTED_AXIS_CAP = 32
 
+# Reserved feature carrying only the batch dimension — lets constant-only
+# programs (e.g. the always-happy fixture) produce (B,)-shaped outputs.
+BATCH_KEY = "__batch__"
+
 _NP_DTYPES = {
     DType.ID: np.int32,
     DType.F32: np.float32,
@@ -138,36 +142,35 @@ class FeatureSchema:
             add(FeatureSpec(f"{base}:sp:{sp.key()}", p.segments, "pred", None,
                             sp.kind, sp.pattern, caps_for(p.segments)))
 
+        def visit(e: Expr, stack: ir.DomainStack) -> None:
+            if isinstance(e, (Path, ir.Elem)):
+                # bare leaf used as a value
+                add_value(ir.absolute_path(e, stack))
+            elif isinstance(e, ir.Exists):
+                add_present(ir.absolute_path(e.target, stack).segments)
+            elif isinstance(e, ir.Not):
+                visit(e.operand, stack)
+            elif isinstance(e, (ir.And, ir.Or)):
+                for op in e.operands:
+                    visit(op, stack)
+            elif isinstance(e, ir.Cmp):
+                visit(e.lhs, stack)
+                visit(e.rhs, stack)
+            elif isinstance(e, ir.InSet):
+                visit(e.operand, stack)
+            elif isinstance(e, StrPred):
+                add_pred(ir.absolute_path(e.operand, stack), e)
+            elif isinstance(e, (ir.AnyOf, ir.AllOf, ir.CountOf)):
+                domain = ir.absolute_path(e.over, stack)
+                add_present(domain.segments)  # domain mask
+                visit(e.pred, stack + (domain,))
+            elif isinstance(e, ir.Const):
+                pass
+            else:
+                raise ir.IRError(f"unknown IR node {type(e).__name__}")
+
         for expr in exprs:
-            resolved = ir.resolve_element_paths(expr)
-
-            def visit(e: Expr) -> None:
-                if isinstance(e, (Path, ir.Elem)):
-                    # bare leaf used as a value
-                    add_value(resolved[id(e)])
-                elif isinstance(e, ir.Exists):
-                    add_present(resolved[id(e.target)].segments)
-                elif isinstance(e, ir.Not):
-                    visit(e.operand)
-                elif isinstance(e, (ir.And, ir.Or)):
-                    for op in e.operands:
-                        visit(op)
-                elif isinstance(e, ir.Cmp):
-                    visit(e.lhs)
-                    visit(e.rhs)
-                elif isinstance(e, ir.InSet):
-                    visit(e.operand)
-                elif isinstance(e, StrPred):
-                    add_pred(resolved[id(e.operand)], e)
-                elif isinstance(e, (ir.AnyOf, ir.AllOf, ir.CountOf)):
-                    add_present(resolved[id(e.over)].segments)  # domain mask
-                    visit(e.pred)
-                elif isinstance(e, ir.Const):
-                    pass
-                else:
-                    raise ir.IRError(f"unknown IR node {type(e).__name__}")
-
-            visit(expr)
+            visit(expr, ())
         return cls(specs)
 
     # -- encoding ----------------------------------------------------------
@@ -184,7 +187,7 @@ class FeatureSchema:
     ) -> dict[str, np.ndarray]:
         """Encode one request payload → unbatched feature arrays (no leading
         batch dim). Raises SchemaOverflow when an array exceeds its cap."""
-        out: dict[str, np.ndarray] = {}
+        out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros((), dtype=np.bool_)}
         for spec in self.specs.values():
             if spec.kind == "value":
                 val = np.zeros(spec.caps, dtype=spec.np_dtype())
@@ -216,7 +219,7 @@ class FeatureSchema:
         ``batch_size`` (pad rows are all-missing; batch bucketing bounds XLA
         recompilation, SURVEY.md §7.4)."""
         assert encoded and len(encoded) <= batch_size
-        out: dict[str, np.ndarray] = {}
+        out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros(batch_size, dtype=np.bool_)}
         for spec in self.specs.values():
             keys = [spec.key] if spec.kind != "value" else [spec.key, _mask_key(spec.key)]
             for key in keys:
@@ -230,7 +233,7 @@ class FeatureSchema:
     def empty_batch(self, batch_size: int) -> dict[str, np.ndarray]:
         """An all-missing batch (for warmup/AOT compilation at boot,
         SURVEY.md §7.2 step 6)."""
-        out: dict[str, np.ndarray] = {}
+        out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros(batch_size, dtype=np.bool_)}
         for spec in self.specs.values():
             out[spec.key] = np.zeros(spec.shape(batch_size), dtype=spec.np_dtype())
             if spec.kind == "value":
@@ -288,11 +291,12 @@ def _extract(
             return
         head, rest = segs[0], segs[1:]
         if head == STAR:
-            if not isinstance(value, list):
+            elems = star_elements(value)
+            if elems is None:
                 return
-            if caps and len(value) > caps[axis]:
-                raise SchemaOverflow(key, axis, len(value), caps[axis])
-            for i, elem in enumerate(value):
+            if caps and len(elems) > caps[axis]:
+                raise SchemaOverflow(key, axis, len(elems), caps[axis])
+            for i, elem in enumerate(elems):
                 yield from rec(elem, rest, coords + (i,), axis + 1)
         else:
             if not isinstance(value, Mapping) or head not in value:
@@ -300,3 +304,19 @@ def _extract(
             yield from rec(value[head], rest, coords, axis)
 
     yield from rec(payload, segments, (), 0)
+
+
+def star_elements(value: Any) -> list[Any] | None:
+    """Elements a ``*`` axis iterates. Lists iterate their items; mappings
+    iterate ``{"__key__": k, "__value__": v}`` entry wrappers in sorted key
+    order (deterministic — lets policies quantify over dynamic-key maps like
+    metadata.annotations). Shared with the oracle (evaluation/oracle.py) so
+    both backends see identical element streams."""
+    if isinstance(value, list):
+        return value
+    if isinstance(value, Mapping):
+        return [
+            {"__key__": str(k), "__value__": value[k]}
+            for k in sorted(value, key=str)
+        ]
+    return None

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from policy_server_tpu.ops import ir
-from policy_server_tpu.ops.codec import FeatureSchema, mask_key_for
+from policy_server_tpu.ops.codec import BATCH_KEY, FeatureSchema, mask_key_for
 from policy_server_tpu.ops.ir import CmpOp, DType, Expr, Path
 from policy_server_tpu.utils.interning import InternTable
 
@@ -71,10 +71,14 @@ def lower_expr(
     features: Features,
     table: InternTable,
 ) -> Any:
-    """Lower a typechecked boolean IR expression to a ``(B,)`` bool array."""
-    resolved = ir.resolve_element_paths(expr)
+    """Lower a typechecked boolean IR expression to a ``(B,)`` bool array.
 
-    def value_of(e: Expr) -> tuple[Lowered, Lowered | None]:
+    ``stack`` is the enclosing-quantifier domain stack (ir.DomainStack),
+    threaded through the traversal — the same IR node may be reused under
+    different quantifiers, so scope is contextual, never keyed on node
+    identity."""
+
+    def value_of(e: Expr, stack: ir.DomainStack) -> tuple[Lowered, Lowered | None]:
         """→ (values, validity-mask or None-if-always-valid)."""
         if isinstance(e, ir.Const):
             if e.dtype is DType.ID:
@@ -87,64 +91,70 @@ def lower_expr(
                 v = jnp.bool_(e.value)
             return Lowered(v, 0), None
         if isinstance(e, (Path, ir.Elem)):
-            p = resolved[id(e)]
+            p = ir.absolute_path(e, stack)
             key = f"{p.key()}:v:{p.dtype.value}"
             vals = jnp.asarray(features[key])
             mask = jnp.asarray(features[mask_key_for(key)])
             return Lowered(vals, p.n_stars), Lowered(mask, p.n_stars)
-        if isinstance(e, ir.CountOf):
-            return Lowered(bool_of(e), _naxes_of(e)), None
-        # boolean-valued nodes used as values
-        return Lowered(bool_of(e), _naxes_of(e)), None
+        # boolean/integer-valued nodes used as values
+        return Lowered(bool_of(e, stack), _naxes_of(e, stack)), None
 
-    def _naxes_of(e: Expr) -> int:
+    def _naxes_of(e: Expr, stack: ir.DomainStack) -> int:
         # number of element axes of a lowered node at its own scope
         if isinstance(e, (Path, ir.Elem)):
-            return resolved[id(e)].n_stars
+            return ir.absolute_path(e, stack).n_stars
         if isinstance(e, ir.Exists):
-            return resolved[id(e.target)].n_stars
+            return ir.absolute_path(e.target, stack).n_stars
         if isinstance(e, ir.StrPred):
-            return resolved[id(e.operand)].n_stars
+            return ir.absolute_path(e.operand, stack).n_stars
         if isinstance(e, ir.Not):
-            return _naxes_of(e.operand)
+            return _naxes_of(e.operand, stack)
         if isinstance(e, (ir.And, ir.Or)):
-            return max(_naxes_of(op) for op in e.operands)
+            return max(_naxes_of(op, stack) for op in e.operands)
         if isinstance(e, ir.Cmp):
-            return max(_naxes_of(e.lhs), _naxes_of(e.rhs))
+            return max(_naxes_of(e.lhs, stack), _naxes_of(e.rhs, stack))
         if isinstance(e, ir.InSet):
-            return _naxes_of(e.operand)
+            return _naxes_of(e.operand, stack)
         if isinstance(e, (ir.AnyOf, ir.AllOf, ir.CountOf)):
             # the domain axis is reduced away
-            return resolved[id(e.over)].n_stars - 1
+            return ir.absolute_path(e.over, stack).n_stars - 1
         if isinstance(e, ir.Const):
             return 0
         raise ir.IRError(f"unknown IR node {type(e).__name__}")
 
-    def bool_of(e: Expr) -> Any:
+    def _quantifier_parts(
+        e: Any, stack: ir.DomainStack
+    ) -> tuple[Any, Any]:
+        """→ aligned (pred_values, domain_mask) for AnyOf/AllOf/CountOf."""
+        dom = ir.absolute_path(e.over, stack)
+        mask = jnp.asarray(features[f"{dom.key()}:p"])
+        inner = stack + (dom,)
+        pred = Lowered(bool_of(e.pred, inner), _naxes_of(e.pred, inner))
+        m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+        return pv, m
+
+    def bool_of(e: Expr, stack: ir.DomainStack) -> Any:
         if isinstance(e, ir.Const):
             return jnp.bool_(e.value)
         if isinstance(e, ir.Exists):
-            p = resolved[id(e.target)]
+            p = ir.absolute_path(e.target, stack)
             return jnp.asarray(features[f"{p.key()}:p"])
         if isinstance(e, ir.Not):
-            return ~bool_of(e.operand)
-        if isinstance(e, ir.And):
-            parts = [Lowered(bool_of(op), _naxes_of(op)) for op in e.operands]
+            return ~bool_of(e.operand, stack)
+        if isinstance(e, (ir.And, ir.Or)):
+            parts = [
+                Lowered(bool_of(op, stack), _naxes_of(op, stack))
+                for op in e.operands
+            ]
             out = parts[0]
+            combine = (lambda a, b: a & b) if isinstance(e, ir.And) else (lambda a, b: a | b)
             for p in parts[1:]:
                 a, b, n = _align(out, p)
-                out = Lowered(a & b, n)
-            return out.values
-        if isinstance(e, ir.Or):
-            parts = [Lowered(bool_of(op), _naxes_of(op)) for op in e.operands]
-            out = parts[0]
-            for p in parts[1:]:
-                a, b, n = _align(out, p)
-                out = Lowered(a | b, n)
+                out = Lowered(combine(a, b), n)
             return out.values
         if isinstance(e, ir.Cmp):
-            lv, lm = value_of(e.lhs)
-            rv, rm = value_of(e.rhs)
+            lv, lm = value_of(e.lhs, stack)
+            rv, rm = value_of(e.rhs, stack)
             a, b, n = _align(lv, rv)
             # numeric cross-dtype comparisons promote via jnp
             res = _CMP_FNS[e.op](a, b)
@@ -157,7 +167,7 @@ def lower_expr(
         if isinstance(e, ir.InSet):
             if not e.values:
                 return jnp.bool_(False)
-            ov, om = value_of(e.operand)
+            ov, om = value_of(e.operand, stack)
             if e.dtype is DType.ID:
                 consts = np.array(
                     sorted(table.intern(v) for v in e.values), dtype=np.int32
@@ -175,29 +185,20 @@ def lower_expr(
                 out = Lowered(mv & hv, n)
             return out.values
         if isinstance(e, ir.StrPred):
-            p = resolved[id(e.operand)]
+            p = ir.absolute_path(e.operand, stack)
             return jnp.asarray(features[f"{p.key()}:sp:{e.key()}"])
         if isinstance(e, ir.AnyOf):
-            dom = resolved[id(e.over)]
-            mask = jnp.asarray(features[f"{dom.key()}:p"])
-            pred = Lowered(bool_of(e.pred), _naxes_of(e.pred))
-            m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+            pv, m = _quantifier_parts(e, stack)
             return jnp.any(pv & m, axis=-1)
         if isinstance(e, ir.AllOf):
-            dom = resolved[id(e.over)]
-            mask = jnp.asarray(features[f"{dom.key()}:p"])
-            pred = Lowered(bool_of(e.pred), _naxes_of(e.pred))
-            m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+            pv, m = _quantifier_parts(e, stack)
             return jnp.all(pv | ~m, axis=-1)
         if isinstance(e, ir.CountOf):
-            dom = resolved[id(e.over)]
-            mask = jnp.asarray(features[f"{dom.key()}:p"])
-            pred = Lowered(bool_of(e.pred), _naxes_of(e.pred))
-            m, pv, _ = _align(Lowered(mask, dom.n_stars), pred)
+            pv, m = _quantifier_parts(e, stack)
             return jnp.sum(pv & m, axis=-1, dtype=jnp.int32)
         raise ir.IRError(f"cannot lower {type(e).__name__} as boolean")
 
-    return bool_of(expr)
+    return bool_of(expr, ())
 
 
 # --------------------------------------------------------------------------
@@ -228,6 +229,11 @@ class PolicyProgram:
     # Only consulted when the verdict is "allowed" and the policy mutates
     # (mirrors reference patch flow, src/api/service.rs:160-208).
     mutator: Callable[[Any], list[dict] | None] | None = None
+    # host-side pre-evaluation hook (latency-fault fixtures like the
+    # 'sleeping' builtin — the reference's sleeping-policy analog,
+    # tests/integration_test.rs:367-423). Runs before encoding; subject to
+    # the policy-timeout deadline.
+    pre_eval_hook: Callable[[Any], None] | None = None
 
     def typecheck(self) -> None:
         if not self.rules:
@@ -250,8 +256,12 @@ def compile_program(
     all policies' fns into one jitted program per batch bucket."""
 
     def fn(features: Features) -> tuple[Any, Any]:
+        batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))
         violated = jnp.stack(
-            [lower_expr(r.condition, features, table) for r in program.rules],
+            [
+                jnp.broadcast_to(lower_expr(r.condition, features, table), batch)
+                for r in program.rules
+            ],
             axis=-1,
         )  # (B, R)
         any_violated = jnp.any(violated, axis=-1)

@@ -486,46 +486,20 @@ def walk(e: Expr) -> Iterator[Expr]:
         yield from walk(e.pred)
 
 
-def resolve_element_paths(expr: Expr) -> dict[int, Path]:
-    """Resolve every Path/Elem/StrPred/Exists leaf to an *absolute* Path
-    (wildcards at enclosing-quantifier positions), keyed by node id. This is
-    the single place Elem-relative addressing is flattened; codec, compiler
-    and oracle all consume the same resolution."""
-    out: dict[int, Path] = {}
+DomainStack = tuple[Path, ...]
 
-    def visit(e: Expr, stack: tuple[Path, ...]) -> None:
-        if isinstance(e, Path):
-            out[id(e)] = e
-        elif isinstance(e, Elem):
-            if not stack:
-                raise IRError("Elem used outside a quantifier")
-            base = stack[-1]
-            out[id(e)] = Path(tuple(base.segments) + tuple(e.segments), e.dtype)
-        elif isinstance(e, Exists):
-            visit(e.target, stack)
-        elif isinstance(e, Not):
-            visit(e.operand, stack)
-        elif isinstance(e, (And, Or)):
-            for op in e.operands:
-                visit(op, stack)
-        elif isinstance(e, Cmp):
-            visit(e.lhs, stack)
-            visit(e.rhs, stack)
-        elif isinstance(e, InSet):
-            visit(e.operand, stack)
-        elif isinstance(e, StrPred):
-            visit(e.operand, stack)
-        elif isinstance(e, Quantifier):
-            visit(e.over, stack)
-            over_abs = out[id(e.over)]
-            visit(e.pred, stack + (over_abs,))
-        elif isinstance(e, Const):
-            pass
-        else:
-            raise IRError(f"unknown IR node {type(e).__name__}")
 
-    visit(expr, ())
-    return out
+def absolute_path(leaf: "Path | Elem", stack: DomainStack) -> Path:
+    """Absolute Path of a leaf under the enclosing-quantifier domain stack.
+    Contextual (the same Elem/Path node may be reused under different
+    quantifiers — node identity carries no scope). Codec, compiler and
+    oracle all flatten through this single helper."""
+    if isinstance(leaf, Path):
+        return leaf
+    if not stack:
+        raise IRError("Elem used outside a quantifier")
+    base = stack[-1]
+    return Path(tuple(base.segments) + tuple(leaf.segments), leaf.dtype)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,688 @@
+"""The native policy library — TPU-first re-implementations of the
+Kubewarden policy catalog used by the reference's configs and benchmarks
+(BASELINE.md configs 1-4; reference policies.yml.example;
+tests/common/mod.rs:29-105 pulls pod-privileged, raw-mutation,
+sleeping-policy from ghcr.io).
+
+Each family is a ``BuiltinPolicy``: settings (validated at boot) → a
+``PolicyProgram`` of deny rules in the predicate IR, all of which fuse into
+the batched device program. Mutating families attach host-side JSONPatch
+mutators (device decides the verdict; host materializes patches —
+SURVEY.md §7.4 hard-part #3).
+
+Payload root is the AdmissionRequest object (uid/namespace/operation/object),
+matching what the reference hands to WASM guests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Mapping
+
+from policy_server_tpu.ops.compiler import PolicyProgram, Rule
+from policy_server_tpu.ops.ir import (
+    AllOf,
+    AnyOf,
+    DType,
+    Elem,
+    Exists,
+    Expr,
+    Or,
+    Path,
+    StrPred,
+    eq,
+    false,
+    gt,
+    in_set,
+    matches_glob,
+    ne,
+    true,
+)
+from policy_server_tpu.policies.base import (
+    BuiltinPolicy,
+    SettingsError,
+    bool_setting,
+    number_setting,
+    str_list,
+)
+
+NAMESPACE = Path("namespace")
+OPERATION = Path("operation")
+
+# Pod-spec container lists (validated for Pods; container-level rules apply
+# to every list, like the upstream policies do).
+CONTAINER_LISTS = (
+    Path("object.spec.containers"),
+    Path("object.spec.initContainers"),
+    Path("object.spec.ephemeralContainers"),
+)
+
+
+def _deny_any_container(pred: Expr) -> Expr:
+    """∃ a container (in any of the three lists) matching pred."""
+    return Or(tuple(AnyOf(lst, pred) for lst in CONTAINER_LISTS))
+
+
+def _image_matches_none(patterns: list[str]) -> Expr:
+    """Container-scoped: its image matches none of the glob patterns
+    (missing image also matches none)."""
+    if not patterns:
+        return true()
+    return ~Or(tuple(matches_glob(Elem("image"), p) for p in patterns))
+
+
+# ---------------------------------------------------------------------------
+
+
+class AlwaysHappy(BuiltinPolicy):
+    """Accepts everything — the engine-test fixture, standing in for the
+    reference's embedded gatekeeper_always_happy_policy.wasm
+    (evaluation_environment.rs:727-731)."""
+
+    name = "always-happy"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        return PolicyProgram(rules=(Rule("never", false(), "unreachable"),))
+
+
+class AlwaysUnhappy(BuiltinPolicy):
+    """Rejects everything (gatekeeper_always_unhappy_policy.wasm analog).
+    The rejection message is settings-configurable like the fixture's."""
+
+    name = "always-unhappy"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        message = settings.get("message", "this policy always rejects")
+        if not isinstance(message, str):
+            raise SettingsError("setting 'message' must be a string")
+        return PolicyProgram(rules=(Rule("always", true(), message),))
+
+
+class Sleeping(BuiltinPolicy):
+    """Latency-fault fixture: sleeps ``sleep_ms`` host-side before building
+    features — the analog of the reference's sleeping-policy used for
+    timeout-protection tests (tests/integration_test.rs:367-423)."""
+
+    name = "sleeping"
+    upstream_equivalents = ("ghcr.io/kubewarden/tests/sleeping-policy",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        sleep_ms = number_setting(settings, "sleep_ms", 0.0)
+        if sleep_ms < 0:
+            raise SettingsError("setting 'sleep_ms' must be >= 0")
+
+        def hook(payload: Any) -> None:
+            time.sleep(sleep_ms / 1000.0)
+
+        return PolicyProgram(
+            rules=(Rule("never", false(), "unreachable"),),
+            pre_eval_hook=hook,
+        )
+
+
+class NamespaceValidate(BuiltinPolicy):
+    """Reject requests targeting denied namespaces (BASELINE.md config 1:
+    namespace-validate-policy)."""
+
+    name = "namespace-validate"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/namespace-validate-policy",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        denied = str_list(settings, "denied_namespaces")
+        if not denied:
+            raise SettingsError("setting 'denied_namespaces' must be a non-empty list")
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "denied-namespace",
+                    in_set(NAMESPACE, denied),
+                    lambda payload: (
+                        f"namespace '{_get(payload, 'namespace')}' is denied"
+                    ),
+                ),
+            )
+        )
+
+
+class PodPrivileged(BuiltinPolicy):
+    """Reject privileged containers (upstream pod-privileged, used by the
+    reference integration tests, tests/common/mod.rs:33-38)."""
+
+    name = "pod-privileged"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/pod-privileged",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        if settings:
+            raise SettingsError("pod-privileged accepts no settings")
+        privileged = eq(Elem("securityContext.privileged", DType.BOOL), True)
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "privileged-container",
+                    _deny_any_container(privileged),
+                    "Privileged container is not allowed",
+                ),
+            )
+        )
+
+
+class PspCapabilities(BuiltinPolicy):
+    """Capability control + mutation (upstream psp-capabilities; the
+    reference's policies.yml.example entry). Settings:
+    allowed_capabilities (["*"] = any), required_drop_capabilities,
+    default_add_capabilities. Mutating: ensures required drops / default
+    adds are present via host-side JSONPatch."""
+
+    name = "psp-capabilities"
+    mutating = True
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/psp-capabilities",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        allowed = str_list(settings, "allowed_capabilities")
+        required_drop = str_list(settings, "required_drop_capabilities")
+        default_add = str_list(settings, "default_add_capabilities")
+        for cap in default_add:
+            if allowed != ["*"] and cap not in allowed:
+                raise SettingsError(
+                    f"default_add_capabilities entry {cap!r} is not in allowed_capabilities"
+                )
+
+        rules = []
+        if "*" not in allowed:
+            rules.append(
+                Rule(
+                    "capability-not-allowed",
+                    _deny_any_container(
+                        AnyOf(
+                            Elem("securityContext.capabilities.add"),
+                            ~in_set(Elem(), allowed) if allowed else true(),
+                        )
+                    ),
+                    "PSP capabilities policies doesn't allow these capabilities to be added",
+                )
+            )
+        if not rules:
+            rules.append(Rule("never", false(), "unreachable"))
+
+        def mutator(payload: Any) -> list[dict] | None:
+            return _psp_capabilities_patch(payload, required_drop, default_add)
+
+        return PolicyProgram(rules=tuple(rules), mutator=mutator)
+
+
+def _psp_capabilities_patch(
+    payload: Any, required_drop: list[str], default_add: list[str]
+) -> list[dict] | None:
+    """JSONPatch ensuring each container drops required caps and adds the
+    default ones. Host-side by design (patches don't batch)."""
+    if not required_drop and not default_add:
+        return None
+    ops: list[dict] = []
+    spec = _get(payload, "object", "spec") or {}
+    for list_name in ("containers", "initContainers", "ephemeralContainers"):
+        containers = spec.get(list_name)
+        if not isinstance(containers, list):
+            continue
+        for i, c in enumerate(containers):
+            if not isinstance(c, Mapping):
+                continue
+            base = f"/spec/{list_name}/{i}/securityContext"
+            sc = c.get("securityContext")
+            caps = sc.get("capabilities") if isinstance(sc, Mapping) else None
+            cur_drop = list(caps.get("drop") or []) if isinstance(caps, Mapping) else []
+            cur_add = list(caps.get("add") or []) if isinstance(caps, Mapping) else []
+            new_drop = cur_drop + [c_ for c_ in required_drop if c_ not in cur_drop]
+            new_add = cur_add + [c_ for c_ in default_add if c_ not in cur_add]
+            if new_drop == cur_drop and new_add == cur_add:
+                continue
+            if not isinstance(sc, Mapping):
+                ops.append({"op": "add", "path": base, "value": {}})
+            if not isinstance(caps, Mapping):
+                ops.append({"op": "add", "path": f"{base}/capabilities", "value": {}})
+            if new_drop != cur_drop:
+                ops.append(
+                    {"op": "add", "path": f"{base}/capabilities/drop", "value": new_drop}
+                )
+            if new_add != cur_add:
+                ops.append(
+                    {"op": "add", "path": f"{base}/capabilities/add", "value": new_add}
+                )
+    # object path prefix: patches apply to the object, not the request
+    return ops or None
+
+
+class PspApparmor(BuiltinPolicy):
+    """AppArmor profile allowlist (upstream psp-apparmor; the reference's
+    policies.yml.example first entry). Checks pod annotations
+    ``container.apparmor.security.beta.kubernetes.io/<container>``."""
+
+    name = "psp-apparmor"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/psp-apparmor",)
+
+    _PREFIX = "container.apparmor.security.beta.kubernetes.io/"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        allowed = str_list(settings, "allowed_profiles", ["runtime/default"])
+        annotations = Path("object.metadata.annotations")
+        bad = AnyOf(
+            annotations,
+            StrPred(Elem("__key__"), "prefix", self._PREFIX)
+            & ~in_set(Elem("__value__"), allowed),
+        )
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "apparmor-profile-not-allowed",
+                    bad,
+                    "These AppArmor profiles are not allowed: not in the allowed list",
+                ),
+            )
+        )
+
+
+class TrustedRepos(BuiltinPolicy):
+    """Registry/tag allow-reject lists (upstream trusted-repos-policy; the
+    ``reject_latest_tag`` member of the reference's example policy group).
+    Settings: registries.allow/reject, tags.reject, images.allow/reject."""
+
+    name = "trusted-repos"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/trusted-repos-policy",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        registries = settings.get("registries") or {}
+        tags = settings.get("tags") or {}
+        images = settings.get("images") or {}
+        if not isinstance(registries, Mapping) or not isinstance(tags, Mapping) or not isinstance(images, Mapping):
+            raise SettingsError("registries/tags/images settings must be mappings")
+        reg_allow = str_list(registries, "allow")
+        reg_reject = str_list(registries, "reject")
+        tag_reject = str_list(tags, "reject")
+        img_allow = str_list(images, "allow")
+        img_reject = str_list(images, "reject")
+
+        image = Elem("image")
+        rules: list[Rule] = []
+        if reg_allow:
+            rules.append(
+                Rule(
+                    "registry-not-allowed",
+                    _deny_any_container(
+                        ~Or(tuple(StrPred(image, "prefix", r.rstrip("/") + "/") for r in reg_allow))
+                    ),
+                    "not coming from an allowed registry",
+                )
+            )
+        if reg_reject:
+            rules.append(
+                Rule(
+                    "registry-rejected",
+                    _deny_any_container(
+                        Or(tuple(StrPred(image, "prefix", r.rstrip("/") + "/") for r in reg_reject))
+                    ),
+                    "coming from a rejected registry",
+                )
+            )
+        for t in tag_reject:
+            rules.append(
+                Rule(
+                    f"tag-rejected-{t}",
+                    _deny_any_container(StrPred(image, "suffix", f":{t}")),
+                    f"tag '{t}' is rejected",
+                )
+            )
+        if img_allow:
+            rules.append(
+                Rule(
+                    "image-not-allowed",
+                    _deny_any_container(_image_matches_none(img_allow)),
+                    "image is not in the allowed list",
+                )
+            )
+        for pattern in img_reject:
+            rules.append(
+                Rule(
+                    f"image-rejected-{pattern}",
+                    _deny_any_container(matches_glob(image, pattern)),
+                    f"image matches rejected pattern '{pattern}'",
+                )
+            )
+        if not rules:
+            raise SettingsError(
+                "trusted-repos requires at least one of registries/tags/images rules"
+            )
+        return PolicyProgram(rules=tuple(rules))
+
+
+class VerifyImageSignatures(BuiltinPolicy):
+    """Image-signature policy (upstream verify-image-signatures; the
+    ``sigstore_pgp`` / ``sigstore_gh_action`` members of the reference's
+    example group). Settings: signatures: [{image: <glob>, ...}].
+
+    TPU-native semantics: every container image must match at least one
+    configured signature entry's image glob; the cryptographic verification
+    of matched images is delegated to the host-side context-snapshot service
+    (full sigstore verification requires registry egress, which the data
+    path never blocks on — SURVEY.md §2.2 callback_handler row). Images
+    matching no entry are rejected, like upstream."""
+
+    name = "verify-image-signatures"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/verify-image-signatures",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        signatures = settings.get("signatures")
+        if not isinstance(signatures, list) or not signatures:
+            raise SettingsError("setting 'signatures' must be a non-empty list")
+        patterns: list[str] = []
+        for s in signatures:
+            if not isinstance(s, Mapping) or not isinstance(s.get("image"), str):
+                raise SettingsError("each signatures entry must have an 'image' glob")
+            patterns.append(s["image"])
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "unverified-image",
+                    _deny_any_container(
+                        Exists(Elem("image")) & _image_matches_none(patterns)
+                    ),
+                    "image signature verification failed: image matches no signature entry",
+                ),
+            )
+        )
+
+
+class DisallowLatestTag(BuiltinPolicy):
+    """Reject images with no tag or the ``latest`` tag (Gatekeeper
+    disallowed-tags family)."""
+
+    name = "disallow-latest-tag"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        if settings:
+            raise SettingsError("disallow-latest-tag accepts no settings")
+        image = Elem("image")
+        # tagged-or-digested: a ':' after the last '/': regex on full string.
+        untagged = ~StrPred(image, "regex", r"^(?:[^/]*/)*[^/]*[:@][^/]*$")
+        latest = StrPred(image, "suffix", ":latest")
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "latest-tag",
+                    _deny_any_container(Exists(Elem("image")) & (untagged | latest)),
+                    "images must have an explicit, non-latest tag",
+                ),
+            )
+        )
+
+
+class HostNamespaces(BuiltinPolicy):
+    """Control hostNetwork/hostPID/hostIPC usage (upstream
+    host-namespaces-psp)."""
+
+    name = "host-namespaces"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/host-namespaces-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        rules = []
+        for key, flag in (
+            ("allow_host_network", "hostNetwork"),
+            ("allow_host_pid", "hostPID"),
+            ("allow_host_ipc", "hostIPC"),
+        ):
+            if not bool_setting(settings, key, False):
+                rules.append(
+                    Rule(
+                        f"{flag}-not-allowed",
+                        eq(Path(f"object.spec.{flag}", DType.BOOL), True),
+                        f"Pod has {flag} enabled, but this is not allowed",
+                    )
+                )
+        if not rules:
+            rules.append(Rule("never", false(), "unreachable"))
+        return PolicyProgram(rules=tuple(rules))
+
+
+class ReadOnlyRootFilesystem(BuiltinPolicy):
+    """Containers must run with a read-only root filesystem (upstream
+    readonly-root-filesystem-psp)."""
+
+    name = "readonly-root-fs"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/readonly-root-filesystem-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        if settings:
+            raise SettingsError("readonly-root-fs accepts no settings")
+        ok = eq(Elem("securityContext.readOnlyRootFilesystem", DType.BOOL), True)
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "writable-root-fs",
+                    _deny_any_container(~ok),
+                    "containers must set securityContext.readOnlyRootFilesystem to true",
+                ),
+            )
+        )
+
+
+class SafeLabels(BuiltinPolicy):
+    """Mandatory / denied labels (upstream safe-labels). Settings:
+    mandatory_labels, denied_labels."""
+
+    name = "safe-labels"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/safe-labels",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        mandatory = str_list(settings, "mandatory_labels")
+        denied = str_list(settings, "denied_labels")
+        if not mandatory and not denied:
+            raise SettingsError(
+                "safe-labels requires mandatory_labels and/or denied_labels"
+            )
+        labels = Path("object.metadata.labels")
+        rules: list[Rule] = []
+        for lbl in mandatory:
+            rules.append(
+                Rule(
+                    f"missing-label-{lbl}",
+                    ~Exists(Path(("object", "metadata", "labels", lbl))),
+                    f"mandatory label {lbl!r} is missing",
+                )
+            )
+        if denied:
+            rules.append(
+                Rule(
+                    "denied-label",
+                    AnyOf(labels, in_set(Elem("__key__"), denied)),
+                    "a denied label is present",
+                )
+            )
+        return PolicyProgram(rules=tuple(rules))
+
+
+class SafeAnnotations(BuiltinPolicy):
+    """Mandatory / denied annotations (upstream safe-annotations)."""
+
+    name = "safe-annotations"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/safe-annotations",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        mandatory = str_list(settings, "mandatory_annotations")
+        denied = str_list(settings, "denied_annotations")
+        if not mandatory and not denied:
+            raise SettingsError(
+                "safe-annotations requires mandatory_annotations and/or denied_annotations"
+            )
+        annotations = Path("object.metadata.annotations")
+        rules: list[Rule] = []
+        for ann in mandatory:
+            rules.append(
+                Rule(
+                    f"missing-annotation-{ann}",
+                    ~Exists(Path(("object", "metadata", "annotations", ann))),
+                    f"mandatory annotation {ann!r} is missing",
+                )
+            )
+        if denied:
+            rules.append(
+                Rule(
+                    "denied-annotation",
+                    AnyOf(annotations, in_set(Elem("__key__"), denied)),
+                    "a denied annotation is present",
+                )
+            )
+        return PolicyProgram(rules=tuple(rules))
+
+
+class ReplicasMax(BuiltinPolicy):
+    """Cap replica counts on scalable resources."""
+
+    name = "replicas-max"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        max_replicas = number_setting(settings, "max_replicas")
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "too-many-replicas",
+                    gt(Path("object.spec.replicas", DType.F32), max_replicas),
+                    f"spec.replicas must not exceed {int(max_replicas)}",
+                ),
+            )
+        )
+
+
+class RunAsNonRoot(BuiltinPolicy):
+    """Pods must not run as root (upstream user-group-psp simplified:
+    requires runAsNonRoot=true at pod or container level)."""
+
+    name = "run-as-non-root"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/user-group-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        pod_ok = eq(Path("object.spec.securityContext.runAsNonRoot", DType.BOOL), True)
+        container_ok = eq(Elem("securityContext.runAsNonRoot", DType.BOOL), True)
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "may-run-as-root",
+                    ~pod_ok & _deny_any_container(~container_ok),
+                    "pods must set runAsNonRoot at pod or container level",
+                ),
+            )
+        )
+
+
+class AllowedProcMountTypes(BuiltinPolicy):
+    """Restrict procMount types (upstream allowed-proc-mount-types-psp)."""
+
+    name = "allowed-proc-mount-types"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/allowed-proc-mount-types-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        allowed = str_list(settings, "allowed_types", ["Default"])
+        bad = Exists(Elem("securityContext.procMount")) & ~in_set(
+            Elem("securityContext.procMount"), allowed
+        )
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "proc-mount-not-allowed",
+                    _deny_any_container(bad),
+                    f"procMount must be one of {allowed}",
+                ),
+            )
+        )
+
+
+class HostPaths(BuiltinPolicy):
+    """Restrict hostPath volumes (upstream hostpaths-psp). Settings:
+    allowed_host_paths: [{pathPrefix, readOnly?}] — absent list denies all
+    hostPath volumes."""
+
+    name = "hostpaths"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/hostpaths-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        allowed = settings.get("allowed_host_paths") or []
+        if not isinstance(allowed, list):
+            raise SettingsError("allowed_host_paths must be a list")
+        prefixes: list[str] = []
+        for entry in allowed:
+            if not isinstance(entry, Mapping) or not isinstance(entry.get("pathPrefix"), str):
+                raise SettingsError("allowed_host_paths entries need a pathPrefix")
+            prefixes.append(entry["pathPrefix"])
+        volumes = Path("object.spec.volumes")
+        is_hostpath = Exists(Elem("hostPath.path"))
+        if prefixes:
+            ok = Or(tuple(StrPred(Elem("hostPath.path"), "prefix", p) for p in prefixes))
+            bad = is_hostpath & ~ok
+        else:
+            bad = is_hostpath
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "hostpath-not-allowed",
+                    AnyOf(volumes, bad),
+                    "hostPath volume is not allowed",
+                ),
+            )
+        )
+
+
+class EchoOperation(BuiltinPolicy):
+    """Raw-request policy: rejects raw documents whose ``forbidden`` field is
+    true — exercises /validate_raw the way the reference uses its
+    raw-mutation policy (tests/common/mod.rs:40-47). Mutating: adds a
+    ``validated: true`` field via JSONPatch when allowed."""
+
+    name = "raw-mutation"
+    mutating = True
+    upstream_equivalents = ("ghcr.io/kubewarden/tests/raw-mutation-policy",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        def mutator(payload: Any) -> list[dict] | None:
+            if isinstance(payload, Mapping) and "validated" not in payload:
+                return [{"op": "add", "path": "/validated", "value": True}]
+            return None
+
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "forbidden",
+                    eq(Path("forbidden", DType.BOOL), True),
+                    "the request is forbidden",
+                ),
+            ),
+            mutator=mutator,
+        )
+
+
+def _get(payload: Any, *keys: str) -> Any:
+    cur = payload
+    for k in keys:
+        if not isinstance(cur, Mapping):
+            return None
+        cur = cur.get(k)
+    return cur
+
+
+ALL_FAMILIES: tuple[type[BuiltinPolicy], ...] = (
+    AlwaysHappy,
+    AlwaysUnhappy,
+    Sleeping,
+    NamespaceValidate,
+    PodPrivileged,
+    PspCapabilities,
+    PspApparmor,
+    TrustedRepos,
+    VerifyImageSignatures,
+    DisallowLatestTag,
+    HostNamespaces,
+    ReadOnlyRootFilesystem,
+    SafeLabels,
+    SafeAnnotations,
+    ReplicasMax,
+    RunAsNonRoot,
+    AllowedProcMountTypes,
+    HostPaths,
+    EchoOperation,
+)

@@ -1,0 +1,5 @@
+"""Host-side utilities (interning, etc.)."""
+
+from policy_server_tpu.utils.interning import InternTable, MISSING_ID
+
+__all__ = ["InternTable", "MISSING_ID"]

@@ -1,0 +1,314 @@
+"""EvaluationEnvironment tests, mirroring the reference's engine tests
+(src/evaluation/evaluation_environment.rs #[cfg(test)] module): always-happy/
+always-unhappy fixtures, group short-circuit + cause aggregation, init-error
+propagation, digest dedup, settings validation at boot."""
+
+import pytest
+import yaml
+
+from policy_server_tpu.evaluation import (
+    BootstrapFailure,
+    EvaluationEnvironmentBuilder,
+    PolicyInitializationError,
+    PolicyNotFoundError,
+)
+from policy_server_tpu.evaluation.environment import GROUP_MUTATION_MESSAGE
+from policy_server_tpu.evaluation.groups import (
+    ExpressionError,
+    parse_expression,
+    validate_expression,
+)
+from policy_server_tpu.models import ValidateRequest
+from policy_server_tpu.models.policy import parse_policies
+
+from tests.conftest import build_admission_review_dict
+
+
+def build_env(policies_yaml: str, backend: str = "jax", **kwargs):
+    policies = parse_policies(yaml.safe_load(policies_yaml))
+    return EvaluationEnvironmentBuilder(backend=backend, **kwargs).build(policies)
+
+
+def admission_request() -> ValidateRequest:
+    from policy_server_tpu.models import AdmissionRequest
+
+    return ValidateRequest.from_admission(
+        AdmissionRequest.from_dict(build_admission_review_dict()["request"])
+    )
+
+
+HAPPY_UNHAPPY_GROUPS = """
+happy_policy_1:
+  module: builtin://always-happy
+unhappy_policy_1:
+  module: builtin://always-unhappy
+  settings:
+    message: "failing as expected"
+group_all_evaluated:
+  policies:
+    unhappy_policy_1:
+      module: builtin://always-unhappy
+      settings: {message: "failing as expected"}
+    happy_policy_1:
+      module: builtin://always-happy
+    unhappy_policy_2:
+      module: builtin://always-unhappy
+      settings: {message: "failing as expected"}
+  expression: "unhappy_policy_1() || (happy_policy_1() && unhappy_policy_2())"
+  message: "group rejected"
+group_short_circuit:
+  policies:
+    unhappy_policy_1:
+      module: builtin://always-unhappy
+      settings: {message: "failing as expected"}
+    happy_policy_1:
+      module: builtin://always-happy
+    unhappy_policy_2:
+      module: builtin://always-unhappy
+      settings: {message: "failing as expected"}
+  expression: "unhappy_policy_1() || happy_policy_1() || unhappy_policy_2()"
+  message: "group rejected"
+"""
+
+
+@pytest.fixture(scope="module", params=["jax", "oracle"])
+def env(request):
+    return build_env(HAPPY_UNHAPPY_GROUPS, backend=request.param)
+
+
+def test_single_policy_happy(env):
+    resp = env.validate("happy_policy_1", admission_request())
+    assert resp.allowed is True
+    assert resp.uid == "hello"
+    assert resp.status is None
+
+
+def test_single_policy_unhappy(env):
+    resp = env.validate("unhappy_policy_1", admission_request())
+    assert resp.allowed is False
+    assert resp.status.message == "failing as expected"
+
+
+def test_group_all_members_evaluated(env):
+    # reference case all_policies_are_evaluated (rs:981-994): expression
+    # unhappy || (happy && unhappy) is false; both unhappy members were
+    # evaluated and contribute causes.
+    resp = env.validate("group_all_evaluated", admission_request())
+    assert resp.allowed is False
+    assert resp.status.message == "group rejected"
+    causes = {(c.field, c.message) for c in resp.status.details.causes}
+    assert causes == {
+        ("spec.policies.unhappy_policy_1", "failing as expected"),
+        ("spec.policies.unhappy_policy_2", "failing as expected"),
+    }
+
+
+def test_group_short_circuit(env):
+    # reference case not_all_policies_are_evaluated (rs:996-999): unhappy ||
+    # happy || unhappy short-circuits after happy; accepted with no causes.
+    resp = env.validate("group_short_circuit", admission_request())
+    assert resp.allowed is True
+    assert resp.status is None
+    assert resp.warnings is None
+
+
+def test_policy_not_found(env):
+    with pytest.raises(PolicyNotFoundError):
+        env.validate("does-not-exist", admission_request())
+
+
+def test_group_member_addressable(env):
+    # PolicyID group/member form (policy_id.rs:7-49)
+    resp = env.validate("group_all_evaluated/happy_policy_1", admission_request())
+    assert resp.allowed is True
+
+
+def test_digest_dedup():
+    env = build_env(HAPPY_UNHAPPY_GROUPS)
+    # reference avoid_duplicated_instances_of_policy_evaluator (rs:1046-1056):
+    # the three always-unhappy instances with identical settings share one
+    # precompiled program.
+    unhappy = [
+        bp.precompiled
+        for bp in env._bound.values()
+        if bp.precompiled.module.name == "always-unhappy"
+    ]
+    assert len(unhappy) >= 3
+    assert len({id(p) for p in unhappy}) == 1
+
+
+def test_bad_settings_fail_boot():
+    bad = """
+p1:
+  module: builtin://namespace-validate
+  settings: {denied_namespaces: "not-a-list"}
+"""
+    with pytest.raises(BootstrapFailure):
+        build_env(bad)
+
+
+def test_continue_on_errors_in_band_rejection():
+    # reference: --continue-on-errors stores init errors; requests against
+    # the broken policy get PolicyInitialization errors surfaced by the
+    # service as in-band 500s (rs:114-117, 569-571; service.rs:78-94)
+    bad = """
+broken:
+  module: builtin://namespace-validate
+  settings: {denied_namespaces: "not-a-list"}
+ok:
+  module: builtin://always-happy
+"""
+    env = build_env(bad, continue_on_errors=True)
+    assert env.validate("ok", admission_request()).allowed
+    with pytest.raises(PolicyInitializationError):
+        env.validate("broken", admission_request())
+
+
+def test_unknown_member_in_expression_fails_boot():
+    bad = """
+g:
+  policies:
+    a:
+      module: builtin://always-happy
+  expression: "a() && missing()"
+  message: "m"
+"""
+    with pytest.raises(BootstrapFailure):
+        build_env(bad)
+
+
+def test_group_mutation_ban():
+    # reference integration_test.rs:239-251
+    cfg = """
+g:
+  policies:
+    mutator:
+      module: builtin://raw-mutation
+  expression: "mutator()"
+  message: "m"
+"""
+    env = build_env(cfg)
+    resp = env.validate("g", ValidateRequest.from_raw({"uid": "r", "x": 1}))
+    assert resp.allowed is False
+    assert resp.status.message == GROUP_MUTATION_MESSAGE
+
+
+def test_real_policy_verdicts():
+    cfg = """
+no-priv:
+  module: builtin://pod-privileged
+ns-check:
+  module: builtin://namespace-validate
+  settings: {denied_namespaces: [forbidden]}
+"""
+    env = build_env(cfg)
+    pod = {
+        "uid": "u1",
+        "namespace": "ok",
+        "operation": "CREATE",
+        "object": {
+            "spec": {"containers": [{"image": "x", "securityContext": {"privileged": True}}]}
+        },
+    }
+    from policy_server_tpu.models import AdmissionRequest
+
+    req = ValidateRequest.from_admission(AdmissionRequest.from_dict(pod))
+    resp = env.validate("no-priv", req)
+    assert resp.allowed is False
+    assert "Privileged" in resp.status.message
+    assert env.validate("ns-check", req).allowed is True
+
+    pod2 = dict(pod, namespace="forbidden")
+    req2 = ValidateRequest.from_admission(AdmissionRequest.from_dict(pod2))
+    resp2 = env.validate("ns-check", req2)
+    assert resp2.allowed is False
+    assert "'forbidden' is denied" in resp2.status.message
+
+
+def test_mutating_policy_patch():
+    cfg = """
+caps:
+  module: builtin://psp-capabilities
+  allowedToMutate: true
+  settings:
+    allowed_capabilities: ["*"]
+    required_drop_capabilities: ["KILL"]
+"""
+    env = build_env(cfg)
+    pod = {
+        "uid": "u1",
+        "operation": "CREATE",
+        "object": {"spec": {"containers": [{"image": "x"}]}},
+    }
+    from policy_server_tpu.models import AdmissionRequest
+    import base64
+    import json
+
+    resp = env.validate(
+        "caps", ValidateRequest.from_admission(AdmissionRequest.from_dict(pod))
+    )
+    assert resp.allowed is True
+    assert resp.patch_type == "JSONPatch"
+    ops = json.loads(base64.b64decode(resp.patch))
+    assert any(
+        op["path"].endswith("/capabilities/drop") and op["value"] == ["KILL"]
+        for op in ops
+    )
+
+
+def test_schema_overflow_falls_back_to_oracle():
+    cfg = """
+no-priv:
+  module: builtin://pod-privileged
+"""
+    env = build_env(cfg, axis_cap=2)
+    containers = [{"image": f"i{i}"} for i in range(5)]
+    containers.append({"image": "bad", "securityContext": {"privileged": True}})
+    pod = {
+        "uid": "u1",
+        "operation": "CREATE",
+        "object": {"spec": {"containers": containers}},
+    }
+    from policy_server_tpu.models import AdmissionRequest
+
+    resp = env.validate(
+        "no-priv", ValidateRequest.from_admission(AdmissionRequest.from_dict(pod))
+    )
+    assert resp.allowed is False
+    assert env.oracle_fallbacks == 1
+
+
+@pytest.mark.parametrize(
+    "expression,valid",
+    [
+        # reference expression-validity matrix (rs:1075-1112)
+        ("true", True),
+        ("a()", True),
+        ("a() && b()", True),
+        ("a() || (b() && !a())", True),
+        ("!(a() || b())", True),
+        ("", False),
+        ("a", False),
+        ("a() &&", False),
+        ("c()", False),  # unknown member
+        ("a() + b()", False),
+        ("2 > 1", False),
+    ],
+)
+def test_expression_validation_matrix(expression, valid):
+    members = {"a", "b"}
+    if valid:
+        validate_expression(expression, members)
+    else:
+        with pytest.raises(ExpressionError):
+            validate_expression(expression, members)
+
+
+def test_expression_parse_shapes():
+    ast = parse_expression("a() || (b() && !c())")
+    from policy_server_tpu.evaluation.groups import AndExpr, MemberCall, NotExpr, OrExpr
+
+    assert isinstance(ast, OrExpr)
+    assert ast.lhs == MemberCall("a")
+    assert isinstance(ast.rhs, AndExpr)
+    assert isinstance(ast.rhs.rhs, NotExpr)
