@@ -1,0 +1,229 @@
+"""Request evaluation service — policy-server semantics around the raw
+verdict.
+
+Reference parity: src/api/service.rs —
+* ``evaluate()`` (service.rs:30-151): always-accept-namespace short-circuit
+  (40-71), PolicyInitialization errors converted to in-band 500 rejections
+  (78-94), mode/mutation constraints applied for Validate but NOT Audit
+  origin (108-116), metrics recorded from the *vanilla* pre-constraint
+  verdict (118-150).
+* ``validation_response_with_constraints`` (service.rs:160-208): protect mode
+  strips patches from not-allowed-to-mutate policies and rejects; monitor
+  mode always accepts, drops patch and status, and logs the would-be verdict.
+
+The single-request ``evaluate`` here is the synchronous path (batch of one).
+The micro-batching runtime (runtime/batcher.py) reuses the same
+``pre_evaluate`` / ``post_evaluate`` halves around its fused batched
+dispatch, so semantics and metrics stay identical on both paths.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironment
+from policy_server_tpu.evaluation.errors import (
+    EvaluationError,
+    PolicyInitializationError,
+)
+from policy_server_tpu.evaluation.policy_id import PolicyID
+from policy_server_tpu.models import AdmissionResponse, ValidateRequest
+from policy_server_tpu.models.policy import PolicyMode
+from policy_server_tpu.telemetry import metrics as metrics_mod
+from policy_server_tpu.telemetry.tracing import logger
+
+
+class RequestOrigin(str, enum.Enum):
+    """service.rs RequestOrigin: Validate applies constraints, Audit reports
+    the raw verdict (service.rs:108-116)."""
+
+    VALIDATE = "validate"
+    AUDIT = "audit"
+
+    def __str__(self) -> str:  # metric label value
+        return self.value
+
+
+def _registry() -> metrics_mod.MetricsRegistry:
+    return metrics_mod.default_registry()
+
+
+def _evaluation_metric(
+    env: EvaluationEnvironment,
+    policy_id: str,
+    request: ValidateRequest,
+    origin: RequestOrigin,
+    accepted: bool,
+    mutated: bool,
+    error_code: int | None,
+) -> metrics_mod.PolicyEvaluation | metrics_mod.RawPolicyEvaluation:
+    mode = env.get_policy_mode(policy_id).value
+    if request.is_raw:
+        return metrics_mod.RawPolicyEvaluation(
+            policy_name=policy_id,
+            policy_mode=mode,
+            accepted=accepted,
+            mutated=mutated,
+            error_code=error_code,
+        )
+    adm = request.admission_request
+    request_kind = adm.request_kind.kind if adm.request_kind else ""
+    return metrics_mod.PolicyEvaluation(
+        policy_name=policy_id,
+        policy_mode=mode,
+        resource_kind=request_kind,
+        resource_namespace=adm.namespace,
+        resource_request_operation=adm.operation or "",
+        accepted=accepted,
+        mutated=mutated,
+        request_origin=str(origin),
+        error_code=error_code,
+    )
+
+
+def pre_evaluate(
+    env: EvaluationEnvironment,
+    policy_id: str,
+    request: ValidateRequest,
+    origin: RequestOrigin,
+    start_time: float,
+) -> AdmissionResponse | None:
+    """The pre-dispatch half: id parse + always-accept-namespace shortcut
+    (service.rs:37-71). Returns a final response, or None to proceed to
+    evaluation. Raises EvaluationError for invalid/unknown ids."""
+    PolicyID.parse(policy_id)  # raises InvalidPolicyId (service.rs:37)
+    if not request.is_raw:
+        ns = request.admission_request.namespace
+        if ns and env.should_always_accept_requests_made_inside_of_namespace(ns):
+            m = _evaluation_metric(
+                env, policy_id, request, origin,
+                accepted=True, mutated=False, error_code=None,
+            )
+            reg = _registry()
+            reg.record_policy_latency(
+                (time.perf_counter() - start_time) * 1e3, m
+            )
+            reg.add_policy_evaluation(m)
+            return AdmissionResponse(uid=request.uid(), allowed=True)
+    return None
+
+
+def handle_initialization_error(
+    request: ValidateRequest, error: PolicyInitializationError
+) -> AdmissionResponse:
+    """PolicyInitialization → in-band 500 rejection + error-counter metric
+    (service.rs:78-94)."""
+    _registry().add_policy_initialization_error(
+        metrics_mod.PolicyInitializationError(
+            policy_name=error.policy_id,
+            initialization_error=str(error),
+        )
+    )
+    return AdmissionResponse.reject(request.uid(), str(error), 500)
+
+
+def validation_response_with_constraints(
+    policy_id: str,
+    policy_mode: PolicyMode,
+    allowed_to_mutate: bool,
+    response: AdmissionResponse,
+) -> AdmissionResponse:
+    """service.rs:160-208, byte-for-byte message parity."""
+    if policy_mode is PolicyMode.PROTECT:
+        if response.patch is not None and not allowed_to_mutate:
+            out = response.copy()
+            out.allowed = False
+            out.status = _mutation_denied_status(policy_id)
+            # validating webhooks must not carry a patch (service.rs comment)
+            out.patch = None
+            out.patch_type = None
+            return out
+        return response
+    # Monitor mode: always accept, drop patch and status, log the would-be
+    # verdict (service.rs:186-207).
+    logger.info(
+        "policy evaluation (monitor mode)",
+        extra={
+            "span_fields": {
+                "policy_id": policy_id,
+                "allowed_to_mutate": allowed_to_mutate,
+                "response": repr(response.to_dict()),
+            }
+        },
+    )
+    out = response.copy()
+    out.allowed = True
+    out.patch = None
+    out.patch_type = None
+    out.status = None
+    return out
+
+
+def _mutation_denied_status(policy_id: str) -> Any:
+    from policy_server_tpu.models import ValidationStatus
+
+    return ValidationStatus(
+        message=(
+            f"Request rejected by policy {policy_id}. The policy attempted to "
+            "mutate the request, but it is currently configured to not allow "
+            "mutations."
+        ),
+        code=None,
+    )
+
+
+def post_evaluate(
+    env: EvaluationEnvironment,
+    policy_id: str,
+    request: ValidateRequest,
+    origin: RequestOrigin,
+    vanilla: AdmissionResponse,
+    start_time: float,
+) -> AdmissionResponse:
+    """The post-dispatch half: constraints + metrics (service.rs:96-150).
+    Metrics record the vanilla verdict; constraints apply only to the
+    Validate origin."""
+    policy_mode = env.get_policy_mode(policy_id)
+    allowed_to_mutate = env.get_policy_allowed_to_mutate(policy_id)
+
+    accepted = vanilla.allowed
+    mutated = vanilla.patch is not None
+    error_code = vanilla.status.code if vanilla.status else None
+
+    if origin is RequestOrigin.VALIDATE:
+        response = validation_response_with_constraints(
+            policy_id, policy_mode, allowed_to_mutate, vanilla
+        )
+    else:
+        response = vanilla
+
+    m = _evaluation_metric(
+        env, policy_id, request, origin,
+        accepted=accepted, mutated=mutated, error_code=error_code,
+    )
+    reg = _registry()
+    reg.record_policy_latency((time.perf_counter() - start_time) * 1e3, m)
+    reg.add_policy_evaluation(m)
+    return response
+
+
+def evaluate(
+    env: EvaluationEnvironment,
+    policy_id: str,
+    request: ValidateRequest,
+    origin: RequestOrigin,
+) -> AdmissionResponse:
+    """Synchronous single-request evaluation (service.rs:30-151). Raises
+    EvaluationError for InvalidPolicyId / PolicyNotFound (the HTTP layer maps
+    them to 404/500, handlers.rs:321-342)."""
+    start = time.perf_counter()
+    short = pre_evaluate(env, policy_id, request, origin, start)
+    if short is not None:
+        return short
+    try:
+        vanilla = env.validate(policy_id, request)
+    except PolicyInitializationError as e:
+        return handle_initialization_error(request, e)
+    return post_evaluate(env, policy_id, request, origin, vanilla, start)

@@ -214,6 +214,7 @@ class EvaluationEnvironmentBuilder:
             init_errors=init_errors,
             axis_cap=self.axis_cap,
             nested_axis_cap=self.nested_axis_cap,
+            always_accept_namespace=self.always_accept_namespace,
         )
 
 
@@ -232,8 +233,10 @@ class EvaluationEnvironment:
         init_errors: dict[str, str],
         axis_cap: int = DEFAULT_AXIS_CAP,
         nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+        always_accept_namespace: str | None = None,
     ) -> None:
         self.backend = backend
+        self.always_accept_namespace = always_accept_namespace
         self._bound = bound
         self._groups = groups
         self._init_errors = init_errors
@@ -296,6 +299,16 @@ class EvaluationEnvironment:
         if isinstance(target, BoundGroup):
             return PolicyEvaluationSettings(policy_mode=target.policy_mode)
         return target.eval_settings
+
+    def should_always_accept_requests_made_inside_of_namespace(
+        self, namespace: str
+    ) -> bool:
+        """Reference evaluation_environment.rs namespace shortcut predicate
+        (used by src/api/service.rs:40-71)."""
+        return (
+            self.always_accept_namespace is not None
+            and namespace == self.always_accept_namespace
+        )
 
     def has_policy(self, policy_id: str) -> bool:
         try:

@@ -1,0 +1,267 @@
+"""Evaluation metrics — the reference's two instruments, identical names and
+label schema (src/metrics.rs, src/metrics/policy_evaluations_total.rs:7-15,
+src/metrics/policy_evaluations_latency.rs:9-21).
+
+Reference exports via OTLP gRPC push (metrics.rs:14-29). This build exposes
+a Prometheus pull endpoint instead (``GET /metrics`` on the readiness
+server) — the OTLP metrics SDK is not part of the baked environment, and a
+pull endpoint removes a collector hop from the TPU serving path. Instrument
+names, label keys, and units are unchanged, so collector-side scrape configs
+see the reference's schema.
+
+Label structs mirror metrics.rs:
+* ``PolicyEvaluation``   (metrics.rs:34-74)  — policy_name, policy_mode,
+  resource_kind, resource_namespace?, resource_request_operation, accepted,
+  mutated, request_origin, error_code?
+* ``RawPolicyEvaluation`` (metrics.rs:77-102) — policy_name, policy_mode,
+  accepted, mutated, error_code?  (no resource labels: raw requests are not
+  Kubernetes resources)
+* ``PolicyInitializationError`` (metrics.rs:105-120) — policy_name,
+  initialization_error
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+try:  # baked into the environment, but keep the import soft for vendoring
+    import prometheus_client
+    from prometheus_client import CollectorRegistry
+except ImportError:  # pragma: no cover
+    prometheus_client = None
+    CollectorRegistry = None
+
+METER_NAME = "kubewarden"  # metrics.rs:12
+EVALUATIONS_TOTAL = "kubewarden_policy_evaluations_total"
+LATENCY_MILLISECONDS = "kubewarden_policy_evaluation_latency_milliseconds"
+INIT_ERRORS_TOTAL = "kubewarden_policy_initialization_errors_total"
+
+# Prometheus requires a fixed label set per metric family; optional reference
+# labels (resource_namespace, error_code) encode absence as "".
+_EVAL_LABELS = (
+    "policy_name",
+    "policy_mode",
+    "resource_kind",
+    "resource_namespace",
+    "resource_request_operation",
+    "accepted",
+    "mutated",
+    "request_origin",
+    "error_code",
+)
+_INIT_LABELS = ("policy_name", "initialization_error")
+
+# Millisecond buckets sized for the <10ms p99 north star (BASELINE.md) with
+# headroom up to the 2 s policy deadline.
+_LATENCY_BUCKETS_MS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+)
+
+
+def _b(v: bool) -> str:
+    return "true" if v else "false"
+
+
+@dataclass(frozen=True)
+class PolicyEvaluation:
+    policy_name: str
+    policy_mode: str
+    resource_kind: str
+    resource_namespace: str | None
+    resource_request_operation: str
+    accepted: bool
+    mutated: bool
+    request_origin: str
+    error_code: int | None = None
+
+    def labels(self) -> dict[str, str]:
+        return {
+            "policy_name": self.policy_name,
+            "policy_mode": self.policy_mode,
+            "resource_kind": self.resource_kind,
+            "resource_namespace": self.resource_namespace or "",
+            "resource_request_operation": self.resource_request_operation,
+            "accepted": _b(self.accepted),
+            "mutated": _b(self.mutated),
+            "request_origin": self.request_origin,
+            "error_code": "" if self.error_code is None else str(self.error_code),
+        }
+
+
+@dataclass(frozen=True)
+class RawPolicyEvaluation:
+    policy_name: str
+    policy_mode: str
+    accepted: bool
+    mutated: bool
+    error_code: int | None = None
+
+    def labels(self) -> dict[str, str]:
+        return {
+            "policy_name": self.policy_name,
+            "policy_mode": self.policy_mode,
+            "resource_kind": "",
+            "resource_namespace": "",
+            "resource_request_operation": "",
+            "accepted": _b(self.accepted),
+            "mutated": _b(self.mutated),
+            "request_origin": "validate_raw",
+            "error_code": "" if self.error_code is None else str(self.error_code),
+        }
+
+
+@dataclass(frozen=True)
+class PolicyInitializationError:
+    policy_name: str
+    initialization_error: str
+
+    def labels(self) -> dict[str, str]:
+        return {
+            "policy_name": self.policy_name,
+            "initialization_error": self.initialization_error,
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe metrics sink. Always aggregates in-process (snapshot API
+    used by unit tests and the batcher's self-tuning); exposes Prometheus
+    text format when prometheus_client is present."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+        self._latencies: dict[tuple[tuple[str, str], ...], list[float]] = {}
+        if prometheus_client is not None:
+            self.registry = CollectorRegistry()
+            self._prom_total = prometheus_client.Counter(
+                EVALUATIONS_TOTAL,
+                "Number of policy evaluations",
+                _EVAL_LABELS,
+                registry=self.registry,
+            )
+            self._prom_latency = prometheus_client.Histogram(
+                LATENCY_MILLISECONDS,
+                "Policy evaluation latency in milliseconds",
+                _EVAL_LABELS,
+                buckets=_LATENCY_BUCKETS_MS,
+                registry=self.registry,
+            )
+            self._prom_init_errors = prometheus_client.Counter(
+                INIT_ERRORS_TOTAL,
+                "Number of policies that failed to initialize",
+                _INIT_LABELS,
+                registry=self.registry,
+            )
+        else:  # pragma: no cover
+            self.registry = None
+
+    # -- recording (reference add_policy_evaluation / record_policy_latency,
+    #    src/metrics/policy_evaluations_total.rs + _latency.rs) ------------
+
+    def add_policy_evaluation(
+        self, m: PolicyEvaluation | RawPolicyEvaluation
+    ) -> None:
+        labels = m.labels()
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._counters[(EVALUATIONS_TOTAL, key)] = (
+                self._counters.get((EVALUATIONS_TOTAL, key), 0) + 1
+            )
+        if self.registry is not None:
+            self._prom_total.labels(**labels).inc()
+
+    def record_policy_latency(
+        self, milliseconds: float, m: PolicyEvaluation | RawPolicyEvaluation
+    ) -> None:
+        labels = m.labels()
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._latencies.setdefault(key, []).append(milliseconds)
+        if self.registry is not None:
+            self._prom_latency.labels(**labels).observe(milliseconds)
+
+    def add_policy_initialization_error(
+        self, m: PolicyInitializationError
+    ) -> None:
+        labels = m.labels()
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._counters[(INIT_ERRORS_TOTAL, key)] = (
+                self._counters.get((INIT_ERRORS_TOTAL, key), 0) + 1
+            )
+        if self.registry is not None:
+            self._prom_init_errors.labels(**labels).inc()
+
+    # -- exposition ---------------------------------------------------------
+
+    def exposition(self) -> bytes:
+        """Prometheus text format for the /metrics endpoint."""
+        if self.registry is None:  # pragma: no cover
+            return b""
+        return prometheus_client.generate_latest(self.registry)
+
+    # -- test/introspection surface ----------------------------------------
+
+    def counter_value(
+        self, name: str, match: Mapping[str, str] | None = None
+    ) -> float:
+        with self._lock:
+            total = 0.0
+            for (metric, key), v in self._counters.items():
+                if metric != name:
+                    continue
+                labels = dict(key)
+                if match and any(labels.get(k) != v2 for k, v2 in match.items()):
+                    continue
+                total += v
+            return total
+
+    def latency_samples(self, match: Mapping[str, str] | None = None) -> list[float]:
+        with self._lock:
+            out: list[float] = []
+            for key, vals in self._latencies.items():
+                labels = dict(key)
+                if match and any(labels.get(k) != v for k, v in match.items()):
+                    continue
+                out.extend(vals)
+            return out
+
+
+_default: MetricsRegistry | None = None
+_default_lock = threading.Lock()
+
+
+def setup_metrics() -> MetricsRegistry:
+    """Install (or return) the process-wide registry (metrics.rs:14-29)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default
+
+
+def default_registry() -> MetricsRegistry:
+    return setup_metrics()
+
+
+def reset_metrics_for_tests() -> None:
+    global _default
+    with _default_lock:
+        _default = None
+
+
+def add_policy_evaluation(m: PolicyEvaluation | RawPolicyEvaluation) -> None:
+    default_registry().add_policy_evaluation(m)
+
+
+def record_policy_latency(
+    milliseconds: float, m: PolicyEvaluation | RawPolicyEvaluation
+) -> None:
+    default_registry().record_policy_latency(milliseconds, m)
+
+
+def add_policy_initialization_error(m: PolicyInitializationError) -> None:
+    default_registry().add_policy_initialization_error(m)

