@@ -1,0 +1,9 @@
+"""``python -m policy_server_tpu`` — the process entry point
+(reference src/main.rs)."""
+
+import sys
+
+from policy_server_tpu.config.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""HTTP API layer (reference src/api/): handlers, service semantics, state,
+errors, profiling endpoints. See SURVEY.md §2.1 rows api::*."""
+
+from policy_server_tpu.api.service import RequestOrigin, evaluate
+
+__all__ = ["RequestOrigin", "evaluate"]

@@ -1,0 +1,30 @@
+"""JSON API errors (reference src/api/api_error.rs:7-30: ``ApiError{status,
+message}`` rendered as ``{"message": ..., "status": ...}``)."""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+
+def api_error(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"message": message, "status": status}, status=status
+    )
+
+
+def json_body_error(message: str) -> web.Response:
+    """Malformed/undeserializable JSON body → 422 (the axum JsonRejection
+    path, src/api/handlers.rs:30-39; integration_test.rs:155-172 expects
+    UNPROCESSABLE_ENTITY)."""
+    return api_error(422, message)
+
+
+def something_went_wrong() -> web.Response:
+    """Catch-all 500 (handlers.rs:331-341)."""
+    return api_error(500, "Something went wrong")
+
+
+def parse_json(raw: bytes) -> object:
+    return json.loads(raw)

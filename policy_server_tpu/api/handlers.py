@@ -1,0 +1,258 @@
+"""HTTP handlers.
+
+Reference parity: src/api/handlers.rs —
+* POST ``/validate/{policy_id}``     → validate_handler (handlers.rs:120-141)
+* POST ``/validate_raw/{policy_id}`` → validate_raw_handler (143-174)
+* POST ``/audit/{policy_id}``        → audit_handler (69-90)
+* GET  ``/readiness``                → readiness_handler (176-178)
+* GET  ``/debug/pprof/cpu|heap``     → pprof handlers (193-254)
+* error mapping: PolicyNotFound → 404, everything else → 500
+  "Something went wrong" (321-342); malformed JSON body → 422 ApiError
+  (JsonExtractor, 30-39).
+
+Request spans carry the reference's field set (request_uid, host, policy_id,
+resource identifiers, allowed/mutated/response_*, handlers.rs:46-67 and
+288-319). Evaluation itself goes through the micro-batcher: the await on the
+batcher future is the analog of `acquire_semaphore_and_evaluate`'s
+semaphore + spawn_blocking hop (handlers.rs:256-286)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from policy_server_tpu.api import profiling
+from policy_server_tpu.api.api_error import (
+    api_error,
+    json_body_error,
+    something_went_wrong,
+)
+from policy_server_tpu.api.service import RequestOrigin
+from policy_server_tpu.api.state import ApiServerState
+from policy_server_tpu.evaluation.errors import (
+    EvaluationError,
+    PolicyNotFoundError,
+)
+from policy_server_tpu.models import (
+    AdmissionResponse,
+    AdmissionReviewRequest,
+    AdmissionReviewResponse,
+    RawReviewRequest,
+    RawReviewResponse,
+    ValidateRequest,
+)
+from policy_server_tpu.telemetry import default_registry
+from policy_server_tpu.telemetry.tracing import logger, span
+
+STATE_KEY = web.AppKey("state", ApiServerState)
+
+
+def _span_fields_from_admission(review: AdmissionReviewRequest) -> dict:
+    """populate_span_with_admission_request_data (handlers.rs:288-306)."""
+    req = review.request
+    fields = {
+        "request_uid": req.uid,
+        "name": req.name,
+        "namespace": req.namespace,
+        "operation": req.operation,
+        "subresource": req.sub_resource,
+    }
+    if req.kind:
+        fields.update(
+            kind_group=req.kind.group, kind_version=req.kind.version,
+            kind=req.kind.kind,
+        )
+    if req.resource:
+        fields.update(
+            resource_group=req.resource.group,
+            resource_version=req.resource.version,
+            resource=req.resource.resource,
+        )
+    return {k: v for k, v in fields.items() if v not in (None, "")}
+
+
+def _record_response(fields: dict, response: AdmissionResponse) -> None:
+    """populate_span_with_policy_evaluation_results (handlers.rs:308-319)."""
+    fields["allowed"] = response.allowed
+    fields["mutated"] = response.patch is not None
+    if response.status:
+        if response.status.code is not None:
+            fields["response_code"] = response.status.code
+        if response.status.message:
+            fields["response_message"] = response.status.message
+
+
+async def _evaluate(
+    state: ApiServerState,
+    policy_id: str,
+    request: ValidateRequest,
+    origin: RequestOrigin,
+) -> AdmissionResponse | web.Response:
+    """Dispatch through the batcher; map EvaluationError → ApiError
+    responses (handlers.rs:321-342)."""
+    try:
+        future = state.batcher.submit(policy_id, request, origin)
+        return await asyncio.wrap_future(future)
+    except PolicyNotFoundError as e:
+        return api_error(404, str(e))
+    except EvaluationError as e:
+        logger.error("Evaluation error: %s", e)
+        return something_went_wrong()
+    except Exception as e:  # noqa: BLE001 — keep the JSON error contract
+        logger.error("Evaluation error: %s", e)
+        return something_went_wrong()
+
+
+async def _read_admission_review(
+    request: web.Request,
+) -> AdmissionReviewRequest | web.Response:
+    try:
+        body = json.loads(await request.read())
+        return AdmissionReviewRequest.from_dict(body)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        return json_body_error(f"Failed to parse the request body as JSON: {e}")
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        return json_body_error(f"Failed to deserialize the JSON body: {e}")
+
+
+async def validate_handler(request: web.Request) -> web.Response:
+    state = request.app[STATE_KEY]
+    policy_id = request.match_info["policy_id"]
+    review = await _read_admission_review(request)
+    if isinstance(review, web.Response):
+        return review
+    with span(
+        "validation", host=state.hostname, policy_id=policy_id,
+        **_span_fields_from_admission(review),
+    ) as fields:
+        result = await _evaluate(
+            state, policy_id,
+            ValidateRequest.from_admission(review.request),
+            RequestOrigin.VALIDATE,
+        )
+        if isinstance(result, web.Response):
+            return result
+        _record_response(fields, result)
+        return web.json_response(AdmissionReviewResponse(result).to_dict())
+
+
+async def audit_handler(request: web.Request) -> web.Response:
+    state = request.app[STATE_KEY]
+    policy_id = request.match_info["policy_id"]
+    review = await _read_admission_review(request)
+    if isinstance(review, web.Response):
+        return review
+    with span(
+        "audit", host=state.hostname, policy_id=policy_id,
+        **_span_fields_from_admission(review),
+    ) as fields:
+        result = await _evaluate(
+            state, policy_id,
+            ValidateRequest.from_admission(review.request),
+            RequestOrigin.AUDIT,
+        )
+        if isinstance(result, web.Response):
+            return result
+        _record_response(fields, result)
+        return web.json_response(AdmissionReviewResponse(result).to_dict())
+
+
+async def validate_raw_handler(request: web.Request) -> web.Response:
+    state = request.app[STATE_KEY]
+    policy_id = request.match_info["policy_id"]
+    try:
+        body = json.loads(await request.read())
+        raw_review = RawReviewRequest.from_dict(body)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        return json_body_error(f"Failed to parse the request body as JSON: {e}")
+    except (KeyError, TypeError, ValueError) as e:
+        return json_body_error(f"Failed to deserialize the JSON body: {e}")
+    with span(
+        "validation_raw", host=state.hostname, policy_id=policy_id,
+    ) as fields:
+        result = await _evaluate(
+            state, policy_id,
+            ValidateRequest.from_raw(raw_review.request),
+            RequestOrigin.VALIDATE,
+        )
+        if isinstance(result, web.Response):
+            return result
+        _record_response(fields, result)
+        return web.json_response(RawReviewResponse(result).to_dict())
+
+
+async def readiness_handler(request: web.Request) -> web.Response:
+    return web.Response(status=200)
+
+
+async def metrics_handler(request: web.Request) -> web.Response:
+    """Prometheus exposition (this build's pull-based replacement for the
+    reference's OTLP push, see telemetry/metrics.py)."""
+    return web.Response(
+        body=default_registry().exposition(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+async def pprof_cpu_handler(request: web.Request) -> web.Response:
+    """GET /debug/pprof/cpu?interval= (handlers.rs:193-223). Interval is
+    seconds (default 30, profiling.rs:48-51); runs off the event loop."""
+    try:
+        interval = float(
+            request.query.get("interval", profiling.DEFAULT_PROFILING_INTERVAL)
+        )
+    except ValueError:
+        return json_body_error("invalid 'interval' query parameter")
+    try:
+        profile = await asyncio.get_running_loop().run_in_executor(
+            None, profiling.start_one_cpu_profile, interval
+        )
+    except profiling.ProfileInProgress as e:
+        return api_error(409, str(e))
+    except Exception as e:  # noqa: BLE001
+        logger.error("pprof error: %s", e)
+        return something_went_wrong()
+    return web.Response(
+        body=profile.text.encode(),
+        content_type="application/octet-stream",
+        headers={"Content-Disposition": 'attachment; filename="cpu.pprof.txt"'},
+    )
+
+
+async def pprof_heap_handler(request: web.Request) -> web.Response:
+    """GET /debug/pprof/heap (handlers.rs:227-254): host allocations +
+    device HBM stats."""
+    try:
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, profiling.heap_profile
+        )
+    except Exception as e:  # noqa: BLE001
+        logger.error("pprof error: %s", e)
+        return something_went_wrong()
+    return web.Response(body=body, content_type="application/json")
+
+
+def build_router(state: ApiServerState) -> web.Application:
+    """The API application (reference router wiring, src/lib.rs:205-225)."""
+    app = web.Application(client_max_size=8 * 1024**2)
+    app[STATE_KEY] = state
+    app.router.add_post("/validate/{policy_id}", validate_handler)
+    app.router.add_post("/validate_raw/{policy_id}", validate_raw_handler)
+    app.router.add_post("/audit/{policy_id}", audit_handler)
+    if state.enable_pprof:
+        app.router.add_get("/debug/pprof/cpu", pprof_cpu_handler)
+        app.router.add_get("/debug/pprof/heap", pprof_heap_handler)
+    return app
+
+
+def build_readiness_router(state: ApiServerState) -> web.Application:
+    """The plaintext readiness application (lib.rs:225, cli.rs:71-76) —
+    also exposes /metrics (Prometheus pull)."""
+    app = web.Application()
+    app[STATE_KEY] = state
+    app.router.add_get("/readiness", readiness_handler)
+    app.router.add_get("/metrics", metrics_handler)
+    return app

@@ -1,0 +1,106 @@
+"""On-demand profiling endpoints' engine.
+
+Reference parity: src/profiling.rs —
+* ``start_one_cpu_profile`` (profiling.rs:54-98): single-flight, default
+  99 Hz / 30 s (profiling.rs:44-51), google-pprof protobuf output. Here the
+  CPU profile is a host-side cProfile capture (pstats text), plus an
+  optional JAX device trace: TPU "CPU time" lives in XLA, so the device
+  trace (jax.profiler, viewable in TensorBoard/Perfetto) is the TPU-native
+  equivalent of the sampling profiler.
+* heap profile (profiling.rs:160-174, jemalloc_pprof): here
+  ``tracemalloc`` host snapshot + per-device HBM stats from
+  ``jax.Device.memory_stats()`` — the memory that actually matters on TPU.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import json
+import pstats
+import threading
+import time
+import tracemalloc
+from dataclasses import dataclass
+
+import jax
+
+DEFAULT_PROFILING_FREQUENCY = 99  # Hz (profiling.rs:44-47)
+DEFAULT_PROFILING_INTERVAL = 30  # seconds (profiling.rs:48-51)
+
+# single-flight: only one profile at a time (profiling.rs:13-21, 61-63)
+_cpu_lock = threading.Lock()
+
+
+class ProfileInProgress(Exception):
+    pass
+
+
+@dataclass
+class CpuProfile:
+    text: str
+    interval: float
+
+
+def start_one_cpu_profile(interval: float) -> CpuProfile:
+    """Profile the host process for ``interval`` seconds. Single-flight:
+    concurrent calls fail fast like the reference's mutex try_lock."""
+    if not _cpu_lock.acquire(blocking=False):
+        raise ProfileInProgress("a CPU profile is already being generated")
+    try:
+        profiler = cProfile.Profile()
+        profiler.enable()
+        time.sleep(interval)
+        profiler.disable()
+        buf = io.StringIO()
+        stats = pstats.Stats(profiler, stream=buf)
+        stats.sort_stats("cumulative").print_stats(100)
+        return CpuProfile(text=buf.getvalue(), interval=interval)
+    finally:
+        _cpu_lock.release()
+
+
+_memory_profiling_active = False
+
+
+def activate_memory_profiling() -> None:
+    """Lazily start host allocation tracking at boot when --enable-pprof
+    (profiling.rs:160-174)."""
+    global _memory_profiling_active
+    if not _memory_profiling_active:
+        tracemalloc.start()
+        _memory_profiling_active = True
+
+
+def heap_profile() -> bytes:
+    """Host top allocations + per-device HBM stats as JSON."""
+    doc: dict = {"devices": [], "host_top_allocations": []}
+    for dev in jax.devices():
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # pragma: no cover - backend-dependent
+            pass
+        doc["devices"].append(
+            {"id": dev.id, "platform": dev.platform, "memory_stats": stats}
+        )
+    if _memory_profiling_active:
+        snapshot = tracemalloc.take_snapshot()
+        for stat in snapshot.statistics("lineno")[:50]:
+            doc["host_top_allocations"].append(
+                {
+                    "location": str(stat.traceback),
+                    "size_bytes": stat.size,
+                    "count": stat.count,
+                }
+            )
+    return json.dumps(doc, indent=2).encode()
+
+
+def start_device_trace(log_dir: str) -> None:
+    """Begin a JAX/XLA device trace (TensorBoard/Perfetto format)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_device_trace() -> None:
+    jax.profiler.stop_trace()

@@ -1,0 +1,18 @@
+"""Shared API server state (reference src/api/state.rs:6-9 —
+``ApiServerState{semaphore, evaluation_environment}``; here the semaphore's
+role is played by the micro-batcher's bounded queue)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironment
+from policy_server_tpu.runtime.batcher import MicroBatcher
+
+
+@dataclass
+class ApiServerState:
+    evaluation_environment: EvaluationEnvironment
+    batcher: MicroBatcher
+    hostname: str = ""
+    enable_pprof: bool = False

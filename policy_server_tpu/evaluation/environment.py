@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from policy_server_tpu.evaluation import groups as groups_mod
@@ -64,6 +65,7 @@ from policy_server_tpu.models.policy import (
     PolicyOrPolicyGroup,
 )
 from policy_server_tpu.ops.codec import (
+    BATCH_KEY,
     DEFAULT_AXIS_CAP,
     DEFAULT_NESTED_AXIS_CAP,
     FeatureSchema,
@@ -74,6 +76,16 @@ from policy_server_tpu.policies import resolve_builtin
 from policy_server_tpu.utils.interning import InternTable
 
 GROUP_MUTATION_MESSAGE = "mutation is not allowed inside of policy group"
+
+
+def bucket_size(n: int) -> int:
+    """Round a batch length up to the next power of two — bounds the set of
+    shapes the fused program compiles for (SURVEY.md §7.4 hard-part #1:
+    bucketed shapes bound recompilation)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
 
 
 @dataclass
@@ -254,6 +266,14 @@ class EvaluationEnvironment:
             pid: compile_program(bp.precompiled.program, self.schema, self.table)
             for pid, bp in bound.items()
         }
+        # Stable orders for the packed device outputs (host↔device traffic
+        # must be O(1) transfers per batch, not O(#policies): over a remote
+        # device transport each transfer is a full roundtrip).
+        self._policy_order = list(bound)
+        self._group_order = list(groups)
+        self._max_group_members = max(
+            (len(g.members) for g in groups.values()), default=0
+        )
         self._fused = jax.jit(self._forward)
         self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
         self._fallback_lock = threading.Lock()
@@ -321,28 +341,71 @@ class EvaluationEnvironment:
 
     # -- the fused device program -----------------------------------------
 
-    def _forward(self, features: Mapping[str, Any]) -> dict[str, Any]:
+    def _forward(self, features: Mapping[str, Any]) -> tuple[Any, ...]:
         """All policies + group expressions over one feature batch. Pure —
-        jit-compiled once per batch bucket shape."""
-        out: dict[str, Any] = {}
+        jit-compiled once per batch bucket shape.
+
+        Outputs are PACKED into four stacked arrays (policy verdicts (B,P),
+        rule indices (B,P), group verdicts (B,G), group member-evaluated
+        masks (B,G,Mmax)) so the host fetches the whole result in a single
+        device_get — per-key fetches pay one transport roundtrip each."""
+        per_policy: dict[str, tuple[Any, Any]] = {}
         for pid, fn in self._compiled.items():
-            allowed, rule_idx = fn(features)
-            out[f"p:{pid}:allowed"] = allowed
-            out[f"p:{pid}:rule"] = rule_idx
-        for name, group in self._groups.items():
+            per_policy[pid] = fn(features)
+        p_allowed = jnp.stack(
+            [per_policy[pid][0] for pid in self._policy_order], axis=-1
+        ) if self._policy_order else jnp.zeros((0, 0), jnp.bool_)
+        p_rule = jnp.stack(
+            [per_policy[pid][1] for pid in self._policy_order], axis=-1
+        ) if self._policy_order else jnp.zeros((0, 0), jnp.int32)
+
+        g_allowed_cols = []
+        g_eval_cols = []
+        for name in self._group_order:
+            group = self._groups[name]
             member_allowed = {
-                m: out[f"p:{name}/{m}:allowed"] for m in group.members
+                m: per_policy[f"{name}/{m}"][0] for m in group.members
             }
             verdict, evaluated = groups_mod.lower_group(group.ast, member_allowed)
-            out[f"g:{name}:allowed"] = verdict
-            for m, mask in evaluated.items():
-                out[f"g:{name}:eval:{m}"] = mask
+            g_allowed_cols.append(verdict)
+            masks = [evaluated[m] for m in group.members]
+            pad = self._max_group_members - len(masks)
+            masks.extend([jnp.zeros_like(verdict)] * pad)
+            g_eval_cols.append(jnp.stack(masks, axis=-1))  # (B, Mmax)
+        batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))[0]
+        g_allowed = (
+            jnp.stack(g_allowed_cols, axis=-1)
+            if g_allowed_cols
+            else jnp.zeros((batch, 0), jnp.bool_)
+        )
+        g_eval = (
+            jnp.stack(g_eval_cols, axis=1)  # (B, G, Mmax)
+            if g_eval_cols
+            else jnp.zeros((batch, 0, 0), jnp.bool_)
+        )
+        return p_allowed, p_rule, g_allowed, g_eval
+
+    def _unpack(
+        self, packed: tuple[np.ndarray, ...]
+    ) -> dict[str, np.ndarray]:
+        """Packed device outputs → the per-key dict the materializers use."""
+        p_allowed, p_rule, g_allowed, g_eval = packed
+        out: dict[str, np.ndarray] = {}
+        for j, pid in enumerate(self._policy_order):
+            out[f"p:{pid}:allowed"] = p_allowed[..., j]
+            out[f"p:{pid}:rule"] = p_rule[..., j]
+        for gi, name in enumerate(self._group_order):
+            out[f"g:{name}:allowed"] = g_allowed[..., gi]
+            group = self._groups[name]
+            for mi, m in enumerate(group.members):
+                out[f"g:{name}:eval:{m}"] = g_eval[..., gi, mi]
         return out
 
     def run_batch(self, features: Mapping[str, Any]) -> dict[str, np.ndarray]:
-        """Dispatch one encoded feature batch to the device; returns host
-        numpy outputs."""
-        return {k: np.asarray(v) for k, v in self._fused(features).items()}
+        """Dispatch one encoded feature batch to the device; ONE device_get
+        fetches every verdict."""
+        packed = jax.device_get(self._fused(features))
+        return self._unpack(packed)
 
     def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
         """AOT-compile the fused program for the batch buckets so the first
@@ -373,18 +436,28 @@ class EvaluationEnvironment:
         outputs = {k: v[0] for k, v in self.run_batch(batch).items()}
         return self._materialize(target, request, outputs)
 
-    def _run_pre_eval_hooks(
-        self, target: BoundPolicy | BoundGroup, payload: Any
-    ) -> None:
+    def pre_eval_hooks_of(
+        self, target: BoundPolicy | BoundGroup
+    ) -> list[Callable[[Any], None]]:
+        """Host-side pre-eval hooks of a policy/group (latency-fault
+        fixtures); the batcher runs them off-thread under the request
+        deadline (runtime/batcher.py)."""
         targets = (
             list(target.members.values())
             if isinstance(target, BoundGroup)
             else [target]
         )
-        for bp in targets:
-            hook = bp.precompiled.program.pre_eval_hook
-            if hook is not None:
-                hook(payload)
+        return [
+            bp.precompiled.program.pre_eval_hook
+            for bp in targets
+            if bp.precompiled.program.pre_eval_hook is not None
+        ]
+
+    def _run_pre_eval_hooks(
+        self, target: BoundPolicy | BoundGroup, payload: Any
+    ) -> None:
+        for hook in self.pre_eval_hooks_of(target):
+            hook(payload)
 
     def _oracle_outputs(self, payload: Any) -> dict[str, Any]:
         """Host-interpreter evaluation of every policy + group (scalar
@@ -407,6 +480,61 @@ class EvaluationEnvironment:
             for m in group.members:
                 out[f"g:{name}:eval:{m}"] = evaluated.get(m, False)
         return out
+
+    # -- batched evaluation (the micro-batcher's device path) --------------
+
+    def validate_batch(
+        self,
+        items: list[tuple[str, ValidateRequest]],
+        run_hooks: bool = True,
+    ) -> list[AdmissionResponse | Exception]:
+        """Evaluate many (policy_id, request) pairs in ONE device dispatch.
+
+        This is the TPU-native replacement for the reference's
+        one-wasm-instance-per-request loop (evaluation_environment.rs:513-581):
+        the fused program computes every policy's verdict for every row, so
+        requests targeting *different* policies batch together freely — the
+        batcher never needs to partition by policy.
+
+        Per-item failures (unknown id, initialization error) come back as
+        Exception entries rather than failing the batch; SchemaOverflow rows
+        fall back to the host oracle (SURVEY.md §7.4 escape hatch).
+        """
+        results: list[AdmissionResponse | Exception | None] = [None] * len(items)
+        targets: list[Any] = [None] * len(items)
+        encodable: list[int] = []
+        encoded: list[dict[str, np.ndarray]] = []
+        for i, (policy_id, request) in enumerate(items):
+            try:
+                target = self._lookup_top_level(PolicyID.parse(policy_id))
+                targets[i] = target
+                payload = request.payload()
+                if run_hooks:
+                    self._run_pre_eval_hooks(target, payload)
+                if self.backend == "oracle":
+                    results[i] = self._materialize(
+                        target, request, self._oracle_outputs(payload)
+                    )
+                    continue
+                encoded.append(self.schema.encode(payload, self.table))
+                encodable.append(i)
+            except SchemaOverflow:
+                with self._fallback_lock:
+                    self.oracle_fallbacks += 1
+                results[i] = self._materialize(
+                    target, request, self._oracle_outputs(request.payload())
+                )
+            except Exception as e:  # noqa: BLE001 — per-item error channel
+                results[i] = e
+        if encodable:
+            bucket = bucket_size(len(encodable))
+            batch = self.schema.stack(encoded, batch_size=bucket)
+            outputs = self.run_batch(batch)
+            for row, i in enumerate(encodable):
+                per_row = {k: v[row] for k, v in outputs.items()}
+                policy_id, request = items[i]
+                results[i] = self._materialize(targets[i], request, per_row)
+        return results  # type: ignore[return-value]
 
     # -- response materialization (host side) ------------------------------
 

@@ -325,7 +325,7 @@ class ValidateRequest:
     JSON body's ``uid`` key when present, else empty string).
     """
 
-    __slots__ = ("admission_request", "raw")
+    __slots__ = ("admission_request", "raw", "_payload_cache")
 
     def __init__(
         self,
@@ -338,6 +338,7 @@ class ValidateRequest:
             )
         self.admission_request = admission_request
         self.raw = raw
+        self._payload_cache: Any = None
 
     @classmethod
     def from_admission(cls, req: AdmissionRequest) -> "ValidateRequest":
@@ -362,7 +363,10 @@ class ValidateRequest:
 
     def payload(self) -> Any:
         """The JSON value policies inspect: the full request dict for
-        admission requests, the raw value otherwise."""
+        admission requests, the raw value otherwise. Memoized — the batcher
+        and the evaluation layers call this repeatedly on the hot path."""
         if self.admission_request is not None:
-            return self.admission_request.to_dict()
+            if self._payload_cache is None:
+                self._payload_cache = self.admission_request.to_dict()
+            return self._payload_cache
         return self.raw

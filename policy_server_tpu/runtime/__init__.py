@@ -1,0 +1,6 @@
+"""Serving runtime: the micro-batching scheduler that replaces the
+reference's semaphore + spawn_blocking concurrency model (SURVEY.md §2.3)."""
+
+from policy_server_tpu.runtime.batcher import MicroBatcher
+
+__all__ = ["MicroBatcher"]

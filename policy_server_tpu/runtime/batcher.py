@@ -1,0 +1,310 @@
+"""Micro-batching scheduler — the TPU-native replacement for the reference's
+request-level concurrency model.
+
+Reference mapping (SURVEY.md §2.3):
+* ``Semaphore::new(pool_size)`` + ``task::spawn_blocking`` per request
+  (src/api/handlers.rs:256-286) → a bounded submission queue feeding a
+  dispatch thread; backpressure = queue capacity instead of semaphore
+  permits.
+* wasmtime epoch-interruption deadline (src/lib.rs:176-190, default 2 s,
+  src/cli.rs:164-169) → a per-request wall-clock deadline covering queue
+  wait + host hooks + device dispatch; exceeded ⇒ in-band 500 rejection
+  with the reference's message "execution deadline exceeded"
+  (tests/integration_test.rs:417).
+* per-request wasm instance (evaluation_environment.rs:76-84) → nothing to
+  isolate: the fused program is a pure function, one dispatch serves the
+  whole batch.
+
+Scheduling policy: dispatch fires when ``max_batch_size`` requests are
+waiting OR the oldest waiter has aged ``batch_timeout_ms`` — the classic
+size-or-deadline micro-batch rule. Batch shapes are bucketed to powers of
+two (environment.bucket_size) so XLA compiles a bounded set of programs,
+all warmed at boot.
+
+Slow host-side pre-eval hooks (the 'sleeping' builtin — the reference's
+sleeping-policy latency fixture) run on a side thread pool with a bounded
+wait so one pathological request cannot stall the batch: on timeout the
+request is rejected in-band and the batch proceeds (the thread is left to
+finish in the background, exactly like an epoch-interrupted wasm instance
+being torn down).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from dataclasses import dataclass, field
+
+from policy_server_tpu.api import service
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironment,
+    bucket_size,
+)
+from policy_server_tpu.evaluation.errors import PolicyInitializationError
+from policy_server_tpu.evaluation.policy_id import PolicyID
+from policy_server_tpu.models import AdmissionResponse, ValidateRequest
+
+DEADLINE_MESSAGE = "execution deadline exceeded"
+
+
+@dataclass
+class _Pending:
+    policy_id: str
+    request: ValidateRequest
+    origin: service.RequestOrigin
+    future: Future
+    enqueued_at: float = field(default_factory=time.perf_counter)
+
+
+class MicroBatcher:
+    """Thread-safe evaluation front: ``submit()`` returns a Future resolved
+    by the dispatch thread with a final AdmissionResponse (service-layer
+    constraints and metrics applied) or an EvaluationError."""
+
+    def __init__(
+        self,
+        env: EvaluationEnvironment,
+        max_batch_size: int = 128,
+        batch_timeout_ms: float = 1.0,
+        policy_timeout: float | None = 2.0,
+        queue_capacity: int | None = None,
+        hook_workers: int = 8,
+    ) -> None:
+        self.env = env
+        self.max_batch_size = max(1, int(max_batch_size))
+        self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
+        self.policy_timeout = policy_timeout
+        self._queue: queue.Queue[_Pending] = queue.Queue(
+            maxsize=queue_capacity or self.max_batch_size * 8
+        )
+        self._hooks = ThreadPoolExecutor(
+            max_workers=hook_workers, thread_name_prefix="pre-eval-hook"
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.batches_dispatched = 0
+        self.requests_dispatched = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="micro-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._hooks.shutdown(wait=False)
+
+    def warmup(self) -> None:
+        """Compile every batch bucket at boot (reference precompiles all
+        policies via rayon at boot, src/lib.rs:287-307)."""
+        sizes = []
+        b = 1
+        while b < self.max_batch_size:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(bucket_size(self.max_batch_size))
+        self.env.warmup(tuple(sizes))
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        policy_id: str,
+        request: ValidateRequest,
+        origin: service.RequestOrigin,
+    ) -> Future:
+        """Enqueue one evaluation; Future resolves to AdmissionResponse or
+        raises EvaluationError. A full queue rejects immediately in-band
+        (the analog of waiting on the reference's semaphore — but bounded,
+        so overload degrades with a clear signal instead of unbounded
+        latency)."""
+        pending = _Pending(policy_id, request, origin, Future())
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            pending.future.set_result(
+                AdmissionResponse.reject(
+                    request.uid(), "policy server overloaded", 429
+                )
+            )
+        return pending.future
+
+    def evaluate(
+        self,
+        policy_id: str,
+        request: ValidateRequest,
+        origin: service.RequestOrigin,
+        timeout: float | None = None,
+    ) -> AdmissionResponse:
+        """Blocking convenience wrapper around submit()."""
+        return self.submit(policy_id, request, origin).result(timeout=timeout)
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # Backlog drains immediately — the batch-timeout window only
+            # bounds ADDED latency when load is light; it must never shrink
+            # batches when the queue is already deep (that collapses
+            # throughput to batch-of-one under pressure).
+            deadline = first.enqueued_at + self.batch_timeout
+            while len(batch) < self.max_batch_size:
+                try:
+                    batch.append(self._queue.get_nowait())
+                    continue
+                except queue.Empty:
+                    pass
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # noqa: BLE001 — last-resort guard
+                for p in batch:
+                    self._fail(p, e)
+
+    # -- batch evaluation --------------------------------------------------
+
+    def _remaining(self, p: _Pending) -> float | None:
+        if self.policy_timeout is None:
+            return None
+        return self.policy_timeout - (time.perf_counter() - p.enqueued_at)
+
+    @staticmethod
+    def _resolve(p: _Pending, response: AdmissionResponse) -> None:
+        """Complete a future, tolerating a concurrent client-side cancel
+        (the webhook caller timing out mid-batch must never take down the
+        dispatch thread)."""
+        try:
+            p.future.set_result(response)
+        except Exception:  # cancelled/already-done race
+            pass
+
+    @staticmethod
+    def _fail(p: _Pending, exc: BaseException) -> None:
+        try:
+            p.future.set_exception(exc)
+        except Exception:
+            pass
+
+    def _reject_deadline(self, p: _Pending) -> None:
+        self._resolve(
+            p, AdmissionResponse.reject(p.request.uid(), DEADLINE_MESSAGE, 500)
+        )
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        self.batches_dispatched += 1
+        self.requests_dispatched += len(batch)
+
+        # Phase 1 (host): pre-evaluation — id parse, namespace shortcut,
+        # bounded pre-eval hooks. Items that short-circuit or fail resolve
+        # here and drop out of the device batch.
+        runnable: list[_Pending] = []
+        for p in batch:
+            if p.future.cancelled():
+                continue
+            try:
+                short = service.pre_evaluate(
+                    self.env, p.policy_id, p.request, p.origin, p.enqueued_at
+                )
+            except Exception as e:  # EvaluationError → the HTTP error mapper
+                self._fail(p, e)
+                continue
+            if short is not None:
+                self._resolve(p, short)
+                continue
+            if not self._run_hooks_with_deadline(p):
+                continue  # deadline rejection already delivered
+            remaining = self._remaining(p)
+            if remaining is not None and remaining <= 0:
+                self._reject_deadline(p)
+                continue
+            runnable.append(p)
+        if not runnable:
+            return
+
+        # Phase 2 (device): one fused dispatch for every runnable item.
+        # Hooks already ran in phase 1 under the deadline, so skip them here.
+        # A batch-level failure (device error, OOM on a new bucket) must fail
+        # THESE futures, never the dispatch thread.
+        try:
+            results = self.env.validate_batch(
+                [(p.policy_id, p.request) for p in runnable], run_hooks=False
+            )
+        except Exception as e:  # noqa: BLE001
+            for p in runnable:
+                self._fail(p, e)
+            return
+
+        # Phase 3 (host): service-layer constraints + metrics per item.
+        for p, result in zip(runnable, results):
+            try:
+                if isinstance(result, PolicyInitializationError):
+                    self._resolve(
+                        p, service.handle_initialization_error(p.request, result)
+                    )
+                    continue
+                if isinstance(result, Exception):
+                    self._fail(p, result)
+                    continue
+                # No post-dispatch deadline check: the verdict exists, and
+                # discarding completed work protects nothing (the reference's
+                # epoch deadline interrupts *execution*; ours bounds queue
+                # wait + host hooks, and compile stalls are eliminated by
+                # boot-time warmup).
+                self._resolve(
+                    p,
+                    service.post_evaluate(
+                        self.env, p.policy_id, p.request, p.origin,
+                        result, p.enqueued_at,
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — never kill the loop
+                self._fail(p, e)
+
+    def _run_hooks_with_deadline(self, p: _Pending) -> bool:
+        """Run the target's pre-eval hooks (latency-fault fixtures) off the
+        dispatch thread, waiting at most the request's remaining deadline.
+        Returns False when the request was rejected for deadline excess."""
+        try:
+            target = self.env._lookup_top_level(  # noqa: SLF001 — same package
+                PolicyID.parse(p.policy_id)
+            )
+        except Exception:
+            # lookup errors surface in validate_batch with full semantics
+            return True
+        hooks = self.env.pre_eval_hooks_of(target)
+        if not hooks:
+            return True
+        payload = p.request.payload()
+        remaining = self._remaining(p)
+        fut = self._hooks.submit(lambda: [h(payload) for h in hooks])
+        try:
+            fut.result(timeout=remaining)
+            return True
+        except FutureTimeoutError:
+            self._reject_deadline(p)
+            return False
+        except Exception as e:  # noqa: BLE001
+            self._fail(p, e)
+            return False

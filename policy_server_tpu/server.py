@@ -1,0 +1,257 @@
+"""PolicyServer — bootstrap pipeline and run loop.
+
+Reference parity: src/lib.rs —
+* ``PolicyServer::new_from_config`` (lib.rs:75-236): trust root → download →
+  precompile → evaluation environment → state → TLS → routers. Here the
+  pipeline is: fetch/resolve modules → build + typecheck IR programs →
+  fused-program warmup (the rayon precompile analog, lib.rs:287-307) →
+  micro-batcher → aiohttp routers.
+* ``PolicyServer::run`` (lib.rs:238-280): API server and readiness server
+  run concurrently; readiness binds only AFTER the API server is up
+  (Notify handshake, lib.rs:239-268).
+
+The wasmtime epoch ticker (lib.rs:176-190) has no analog here: the batcher
+enforces the request deadline directly (runtime/batcher.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+from typing import Callable
+
+from aiohttp import web
+
+from policy_server_tpu.api import profiling
+from policy_server_tpu.api.handlers import build_readiness_router, build_router
+from policy_server_tpu.api.state import ApiServerState
+from policy_server_tpu.config.config import Config
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironment,
+    EvaluationEnvironmentBuilder,
+)
+from policy_server_tpu.evaluation.precompiled import PolicyModule
+from policy_server_tpu.runtime.batcher import MicroBatcher
+from policy_server_tpu.telemetry import setup_metrics
+from policy_server_tpu.telemetry.tracing import logger
+
+
+class PolicyServer:
+    """The bootstrapped server (reference PolicyServer, lib.rs:64-72)."""
+
+    def __init__(
+        self,
+        config: Config,
+        environment: EvaluationEnvironment,
+        batcher: MicroBatcher,
+        state: ApiServerState,
+        tls_context: ssl.SSLContext | None,
+    ) -> None:
+        self.config = config
+        self.environment = environment
+        self.batcher = batcher
+        self.state = state
+        self.tls_context = tls_context
+        self._ready = asyncio.Event()
+        self._runners: list[web.AppRunner] = []
+        self.api_port: int | None = None
+        self.readiness_port: int | None = None
+
+    # -- bootstrap (lib.rs:75-236) -----------------------------------------
+
+    @classmethod
+    def new_from_config(
+        cls,
+        config: Config,
+        module_resolver: Callable[[str], PolicyModule] | None = None,
+    ) -> "PolicyServer":
+        if config.enable_metrics:
+            setup_metrics()
+        if config.enable_pprof:
+            profiling.activate_memory_profiling()
+
+        resolver = module_resolver
+        if resolver is None and (config.sources or config.verification_config
+                                 or _needs_fetch(config)):
+            try:
+                from policy_server_tpu.fetch import make_module_resolver
+            except ImportError as e:
+                raise RuntimeError(
+                    "this configuration references non-builtin policy modules "
+                    "or fetch settings, but the fetch subsystem is not "
+                    "available"
+                ) from e
+            resolver = make_module_resolver(config)
+
+        builder = EvaluationEnvironmentBuilder(
+            backend=config.evaluation_backend,
+            continue_on_errors=config.continue_on_errors,
+            module_resolver=resolver,
+            always_accept_admission_reviews_on_namespace=(
+                config.always_accept_admission_reviews_on_namespace
+            ),
+        )
+        environment = builder.build(config.policies)
+
+        batcher = MicroBatcher(
+            environment,
+            max_batch_size=config.max_batch_size,
+            batch_timeout_ms=config.batch_timeout_ms,
+            policy_timeout=config.policy_timeout,
+            queue_capacity=config.pool_size * config.max_batch_size,
+        )
+        if config.warmup_at_boot and config.evaluation_backend == "jax":
+            batcher.warmup()
+        batcher.start()
+
+        state = ApiServerState(
+            evaluation_environment=environment,
+            batcher=batcher,
+            hostname=config.hostname,
+            enable_pprof=config.enable_pprof,
+        )
+
+        tls_context = None
+        if config.tls_config.enabled:
+            try:
+                from policy_server_tpu.certs import (
+                    create_tls_config_and_watch_certificate_changes,
+                )
+            except ImportError as e:
+                raise RuntimeError(
+                    "TLS was configured but the certs subsystem is not "
+                    "available"
+                ) from e
+            tls_context = create_tls_config_and_watch_certificate_changes(
+                config.tls_config
+            )
+
+        return cls(config, environment, batcher, state, tls_context)
+
+    # -- routers (lib.rs:282 router(); used directly by in-process tests) --
+
+    def router(self) -> web.Application:
+        return build_router(self.state)
+
+    def readiness_router(self) -> web.Application:
+        return build_readiness_router(self.state)
+
+    # -- run loop (lib.rs:238-280) -----------------------------------------
+
+    async def start(self) -> None:
+        """Bind both servers; returns once serving (used by run() and by
+        socket-based tests, which read the bound ports)."""
+        api_runner = web.AppRunner(self.router())
+        await api_runner.setup()
+        api_site = web.TCPSite(
+            api_runner, self.config.addr, self.config.port,
+            ssl_context=self.tls_context,
+        )
+        await api_site.start()
+        self.api_port = _bound_port(api_runner) or self.config.port
+        self._runners.append(api_runner)
+
+        # readiness server starts only after the API server is bound
+        # (Notify handshake, lib.rs:239-268)
+        ready_runner = web.AppRunner(self.readiness_router())
+        await ready_runner.setup()
+        ready_site = web.TCPSite(
+            ready_runner, self.config.addr, self.config.readiness_probe_port
+        )
+        await ready_site.start()
+        self.readiness_port = _bound_port(ready_runner) or (
+            self.config.readiness_probe_port
+        )
+        self._runners.append(ready_runner)
+
+        self._ready.set()
+        logger.info(
+            "policy server started",
+            extra={
+                "span_fields": {
+                    "addr": self.config.addr,
+                    "port": self.api_port,
+                    "readiness_probe_port": self.readiness_port,
+                    "tls": self.tls_context is not None,
+                    "policies": len(self.environment.policy_ids()),
+                }
+            },
+        )
+
+    async def stop(self) -> None:
+        for runner in self._runners:
+            await runner.cleanup()
+        self._runners.clear()
+        self.batcher.shutdown()
+
+    async def run_async(self) -> None:
+        await self.start()
+        try:
+            while True:  # serve until cancelled
+                await asyncio.sleep(3600)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await self.stop()
+
+    def run(self) -> None:
+        """Blocking entry (reference PolicyServer::run, lib.rs:238)."""
+        asyncio.run(self.run_async())
+
+
+def run_server(args) -> int:
+    """Process entry used by the CLI (reference main.rs:15-65): config →
+    tracing/metrics setup → optional daemonize → bootstrap → run."""
+    from policy_server_tpu.telemetry import setup_tracing
+
+    config = Config.from_args(args)
+    setup_tracing(config.log_level, config.log_fmt, config.log_no_color)
+    if config.daemon:
+        _daemonize(config)
+    server = PolicyServer.new_from_config(config)
+    try:
+        server.run()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _daemonize(config: Config) -> None:
+    """Double-fork daemonization (reference main.rs:35-55, daemonize crate):
+    detach, write the pid file, redirect stdout/stderr."""
+    import os
+    import sys
+
+    if os.fork() > 0:
+        os._exit(0)
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)
+    with open(config.daemon_pid_file, "w", encoding="utf-8") as f:
+        f.write(str(os.getpid()))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    out = open(config.daemon_stdout_file or os.devnull, "ab")
+    err = open(config.daemon_stderr_file or os.devnull, "ab")
+    os.dup2(out.fileno(), sys.stdout.fileno())
+    os.dup2(err.fileno(), sys.stderr.fileno())
+
+
+def _bound_port(runner: web.AppRunner) -> int | None:
+    for site in runner.sites:
+        server = getattr(site, "_server", None)
+        if server and server.sockets:
+            return server.sockets[0].getsockname()[1]
+    return None
+
+
+def _needs_fetch(config: Config) -> bool:
+    """True when any configured module URL is not a builtin."""
+    from policy_server_tpu.policies import resolve_builtin
+
+    urls: list[str] = []
+    for entry in config.policies.values():
+        if hasattr(entry, "module"):
+            urls.append(entry.module)
+        else:
+            urls.extend(m.module for m in entry.policies.values())
+    return any(resolve_builtin(u) is None for u in urls)

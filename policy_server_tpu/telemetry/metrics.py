@@ -22,6 +22,7 @@ Label structs mirror metrics.rs:
 
 from __future__ import annotations
 
+import collections
 import threading
 from dataclasses import dataclass
 from typing import Any, Mapping
@@ -133,7 +134,11 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
-        self._latencies: dict[tuple[tuple[str, str], ...], list[float]] = {}
+        # Bounded recent-sample window per label set (tests/self-tuning);
+        # the Prometheus histogram carries the full aggregation.
+        self._latencies: dict[
+            tuple[tuple[str, str], ...], collections.deque[float]
+        ] = {}
         if prometheus_client is not None:
             self.registry = CollectorRegistry()
             self._prom_total = prometheus_client.Counter(
@@ -179,7 +184,9 @@ class MetricsRegistry:
         labels = m.labels()
         key = tuple(sorted(labels.items()))
         with self._lock:
-            self._latencies.setdefault(key, []).append(milliseconds)
+            self._latencies.setdefault(
+                key, collections.deque(maxlen=4096)
+            ).append(milliseconds)
         if self.registry is not None:
             self._prom_latency.labels(**labels).observe(milliseconds)
 

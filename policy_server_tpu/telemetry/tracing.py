@@ -102,7 +102,7 @@ logger = logging.getLogger(SERVICE_NAME)
 
 
 @contextlib.contextmanager
-def span(name: str, **fields: Any) -> Iterator[dict[str, Any]]:
+def span(span_name: str, **fields: Any) -> Iterator[dict[str, Any]]:
     """A lightweight request span: yields a mutable field dict (handlers
     record verdict fields into it, mirroring
     populate_span_with_policy_evaluation_results, handlers.rs:308-319) and
@@ -113,4 +113,4 @@ def span(name: str, **fields: Any) -> Iterator[dict[str, Any]]:
         yield data
     finally:
         data["elapsed_ms"] = round((time.perf_counter() - start) * 1e3, 3)
-        logger.info(name, extra={"span_fields": data})
+        logger.info(span_name, extra={"span_fields": data})

@@ -1,0 +1,188 @@
+"""Micro-batcher tests: batched verdicts match the single-request path,
+mixed-policy batching, deadline protection (the reference's sleeping-policy
+timeout tests, tests/integration_test.rs:367-423), and overload behavior."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from policy_server_tpu.api.service import RequestOrigin, evaluate
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironmentBuilder,
+    bucket_size,
+)
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.runtime.batcher import DEADLINE_MESSAGE, MicroBatcher
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from conftest import build_admission_review_dict
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+    metrics_mod.reset_metrics_for_tests()
+
+
+def pod_review(namespace: str, privileged: bool) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["namespace"] = namespace
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": namespace},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+@pytest.fixture(scope="module")
+def env():
+    policies = {
+        "priv": parse_policy_entry("priv", {"module": "builtin://pod-privileged"}),
+        "ns": parse_policy_entry(
+            "ns",
+            {
+                "module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": ["blocked"]},
+            },
+        ),
+        "grp": parse_policy_entry(
+            "grp",
+            {
+                "expression": "happy() || priv()",
+                "message": "group denied",
+                "policies": {
+                    "happy": {"module": "builtin://always-happy"},
+                    "priv": {"module": "builtin://pod-privileged"},
+                },
+            },
+        ),
+    }
+    return EvaluationEnvironmentBuilder(backend="jax").build(policies)
+
+
+def test_bucket_size():
+    assert [bucket_size(n) for n in (1, 2, 3, 5, 8, 9, 128)] == [
+        1, 2, 4, 8, 8, 16, 128,
+    ]
+
+
+def test_batched_matches_single_path(env):
+    batcher = MicroBatcher(env, max_batch_size=16, batch_timeout_ms=5.0).start()
+    try:
+        cases = [
+            ("priv", pod_review("default", True)),
+            ("priv", pod_review("default", False)),
+            ("ns", pod_review("blocked", False)),
+            ("ns", pod_review("ok", False)),
+            ("grp", pod_review("default", True)),
+            ("grp", pod_review("default", False)),
+        ]
+        futures = [
+            batcher.submit(pid, req, RequestOrigin.VALIDATE) for pid, req in cases
+        ]
+        batched = [f.result(timeout=30) for f in futures]
+        single = [
+            evaluate(env, pid, req, RequestOrigin.VALIDATE) for pid, req in cases
+        ]
+        for b, s in zip(batched, single):
+            assert b.to_dict() == s.to_dict()
+        # requests for different policies coalesced into few dispatches
+        assert batcher.batches_dispatched <= 2
+    finally:
+        batcher.shutdown()
+
+
+def test_concurrent_submissions_form_batches(env):
+    batcher = MicroBatcher(env, max_batch_size=32, batch_timeout_ms=20.0).start()
+    try:
+        results = [None] * 24
+        def worker(i: int) -> None:
+            req = pod_review("default", i % 2 == 0)
+            results[i] = batcher.evaluate("priv", req, RequestOrigin.VALIDATE, timeout=30)
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, resp in enumerate(results):
+            assert resp.allowed == (i % 2 != 0)
+        assert batcher.requests_dispatched == 24
+        assert batcher.batches_dispatched < 24  # actually batched
+    finally:
+        batcher.shutdown()
+
+
+def test_deadline_protection_sleeping_policy():
+    """integration_test.rs:367-423: 100 ms sleep passes, long sleep exceeds
+    the deadline and rejects in-band with 'execution deadline exceeded'."""
+    env = EvaluationEnvironmentBuilder(backend="jax").build(
+        {
+            "sleep-ok": parse_policy_entry(
+                "sleep-ok",
+                {"module": "builtin://sleeping", "settings": {"sleep_ms": 100}},
+            ),
+            "sleep-long": parse_policy_entry(
+                "sleep-long",
+                {"module": "builtin://sleeping", "settings": {"sleep_ms": 4000}},
+            ),
+        }
+    )
+    batcher = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.5
+    ).start()
+    try:
+        ok = batcher.evaluate(
+            "sleep-ok", pod_review("default", False), RequestOrigin.VALIDATE,
+            timeout=30,
+        )
+        assert ok.allowed
+        slow = batcher.evaluate(
+            "sleep-long", pod_review("default", False), RequestOrigin.VALIDATE,
+            timeout=30,
+        )
+        assert not slow.allowed
+        assert slow.status.message == DEADLINE_MESSAGE
+        assert slow.status.code == 500
+    finally:
+        batcher.shutdown()
+
+
+def test_unknown_policy_raises_through_future(env):
+    batcher = MicroBatcher(env, max_batch_size=4, batch_timeout_ms=1.0).start()
+    try:
+        from policy_server_tpu.evaluation.errors import PolicyNotFoundError
+
+        fut = batcher.submit(
+            "missing", pod_review("default", False), RequestOrigin.VALIDATE
+        )
+        with pytest.raises(PolicyNotFoundError):
+            fut.result(timeout=30)
+    finally:
+        batcher.shutdown()
+
+
+def test_overload_rejects_in_band(env):
+    batcher = MicroBatcher(env, max_batch_size=1, batch_timeout_ms=0.0, queue_capacity=1)
+    # not started: the queue fills immediately
+    first = batcher.submit("priv", pod_review("d", False), RequestOrigin.VALIDATE)
+    second = batcher.submit("priv", pod_review("d", False), RequestOrigin.VALIDATE)
+    assert not first.done()
+    resp = second.result(timeout=1)
+    assert not resp.allowed and resp.status.code == 429
+    batcher.shutdown()

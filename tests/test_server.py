@@ -1,0 +1,269 @@
+"""HTTP integration tests — the analog of tests/integration_test.rs: a real
+PolicyServer bound to port 0 (parallel-safe, tests/common/mod.rs:135-140),
+driven over real sockets with `requests`. Covers accept/reject, groups with
+causes, 404/422 mapping, raw validation + JSONPatch mutation, audit,
+monitor mode, timeout protection, readiness, metrics, and pprof."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import threading
+
+import pytest
+import requests
+
+from policy_server_tpu.config.config import Config, TlsConfig
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.server import PolicyServer
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from conftest import build_admission_review_dict
+
+
+class ServerHandle:
+    """Runs a PolicyServer inside a private event loop thread."""
+
+    def __init__(self, config: Config):
+        self.server = PolicyServer.new_from_config(config)
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        assert self._started.wait(timeout=60), "server failed to start"
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self.server.start())
+        self._started.set()
+        self.loop.run_forever()
+
+    def stop(self) -> None:
+        async def _shutdown():
+            await self.server.stop()
+            self.loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
+        self.thread.join(timeout=10)
+
+    def url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.server.api_port}{path}"
+
+    def readiness_url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.server.readiness_port}{path}"
+
+
+def make_config(**overrides) -> Config:
+    policies = {
+        "pod-privileged": parse_policy_entry(
+            "pod-privileged", {"module": "builtin://pod-privileged"}
+        ),
+        "pod-privileged-monitor": parse_policy_entry(
+            "pod-privileged-monitor",
+            {"module": "builtin://pod-privileged", "policyMode": "monitor"},
+        ),
+        "raw-mutation": parse_policy_entry(
+            "raw-mutation",
+            {"module": "builtin://raw-mutation", "allowedToMutate": True},
+        ),
+        "sleeping": parse_policy_entry(
+            "sleeping",
+            {"module": "builtin://sleeping", "settings": {"sleep_ms": 1500}},
+        ),
+        "group": parse_policy_entry(
+            "group",
+            {
+                "expression": "happy() && priv()",
+                "message": "group rejected the request",
+                "policies": {
+                    "happy": {"module": "builtin://always-happy"},
+                    "priv": {"module": "builtin://pod-privileged"},
+                },
+            },
+        ),
+    }
+    defaults = dict(
+        addr="127.0.0.1",
+        port=0,
+        readiness_probe_port=0,
+        tls_config=TlsConfig(),
+        policies=policies,
+        policy_timeout_seconds=0.5,
+        max_batch_size=8,
+        batch_timeout_ms=1.0,
+        enable_pprof=True,
+        warmup_at_boot=False,  # CPU tests: skip multi-bucket warmup cost
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+@pytest.fixture(scope="module")
+def server():
+    metrics_mod.reset_metrics_for_tests()
+    handle = ServerHandle(make_config())
+    yield handle
+    handle.stop()
+
+
+def pod_review_body(privileged: bool) -> dict:
+    doc = build_admission_review_dict()
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+    return doc
+
+
+def test_validate_accept_and_reject(server):
+    r = requests.post(
+        server.url("/validate/pod-privileged"), json=pod_review_body(False),
+        timeout=30,
+    )
+    assert r.status_code == 200
+    body = r.json()
+    assert body["apiVersion"] == "admission.k8s.io/v1"
+    assert body["kind"] == "AdmissionReview"
+    assert body["response"]["allowed"] is True
+    assert body["response"]["uid"] == "hello"
+
+    r = requests.post(
+        server.url("/validate/pod-privileged"), json=pod_review_body(True),
+        timeout=30,
+    )
+    assert r.status_code == 200
+    resp = r.json()["response"]
+    assert resp["allowed"] is False
+    assert resp["status"]["message"] == "Privileged container is not allowed"
+
+
+def test_validate_policy_group_with_causes(server):
+    r = requests.post(
+        server.url("/validate/group"), json=pod_review_body(True), timeout=30
+    )
+    assert r.status_code == 200
+    resp = r.json()["response"]
+    assert resp["allowed"] is False
+    assert resp["status"]["message"] == "group rejected the request"
+    causes = resp["status"]["details"]["causes"]
+    assert causes == [
+        {
+            "field": "spec.policies.priv",
+            "message": "Privileged container is not allowed",
+        }
+    ]
+
+    r = requests.post(
+        server.url("/validate/group"), json=pod_review_body(False), timeout=30
+    )
+    assert r.json()["response"]["allowed"] is True
+
+
+def test_unknown_policy_404(server):
+    r = requests.post(
+        server.url("/validate/does-not-exist"), json=pod_review_body(False),
+        timeout=30,
+    )
+    assert r.status_code == 404
+    assert "does-not-exist" in r.json()["message"]
+    assert r.json()["status"] == 404
+
+
+def test_malformed_body_422(server):
+    r = requests.post(
+        server.url("/validate/pod-privileged"),
+        data=b"this is not json",
+        headers={"Content-Type": "application/json"},
+        timeout=30,
+    )
+    assert r.status_code == 422
+
+    r = requests.post(
+        server.url("/validate/pod-privileged"), json={"no_request": 1},
+        timeout=30,
+    )
+    assert r.status_code == 422
+
+
+def test_validate_raw_mutation(server):
+    r = requests.post(
+        server.url("/validate_raw/raw-mutation"),
+        json={"request": {"uid": "raw-1", "user": "alice"}},
+        timeout=30,
+    )
+    assert r.status_code == 200
+    resp = r.json()["response"]
+    assert resp["allowed"] is True
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    assert patch == [{"op": "add", "path": "/validated", "value": True}]
+    assert resp["patchType"] == "JSONPatch"
+
+    r = requests.post(
+        server.url("/validate_raw/raw-mutation"),
+        json={"request": {"uid": "raw-2", "forbidden": True}},
+        timeout=30,
+    )
+    resp = r.json()["response"]
+    assert resp["allowed"] is False
+    assert resp["status"]["message"] == "the request is forbidden"
+
+
+def test_audit_reports_raw_verdict(server):
+    r = requests.post(
+        server.url("/audit/pod-privileged-monitor"), json=pod_review_body(True),
+        timeout=30,
+    )
+    assert r.status_code == 200
+    assert r.json()["response"]["allowed"] is False
+
+
+def test_monitor_mode_allows_via_http(server):
+    r = requests.post(
+        server.url("/validate/pod-privileged-monitor"),
+        json=pod_review_body(True),
+        timeout=30,
+    )
+    assert r.status_code == 200
+    assert r.json()["response"]["allowed"] is True
+
+
+def test_timeout_protection(server):
+    """integration_test.rs:367-423: the sleeping policy exceeds the 0.5 s
+    deadline → in-band 500 'execution deadline exceeded'."""
+    r = requests.post(
+        server.url("/validate/sleeping"), json=pod_review_body(False),
+        timeout=30,
+    )
+    assert r.status_code == 200
+    resp = r.json()["response"]
+    assert resp["allowed"] is False
+    assert resp["status"]["message"] == "execution deadline exceeded"
+    assert resp["status"]["code"] == 500
+
+
+def test_readiness_and_metrics(server):
+    r = requests.get(server.readiness_url("/readiness"), timeout=10)
+    assert r.status_code == 200
+    r = requests.get(server.readiness_url("/metrics"), timeout=10)
+    assert r.status_code == 200
+    assert "kubewarden_policy_evaluations_total" in r.text
+
+
+def test_pprof_endpoints(server):
+    r = requests.get(server.url("/debug/pprof/cpu?interval=0.05"), timeout=30)
+    assert r.status_code == 200 and len(r.content) > 0
+    r = requests.get(server.url("/debug/pprof/heap"), timeout=30)
+    assert r.status_code == 200
+    doc = r.json()
+    assert "devices" in doc and len(doc["devices"]) >= 1
