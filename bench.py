@@ -1,0 +1,91 @@
+"""Benchmark: the flagship config — 32 mixed policies, synthetic
+AdmissionReview firehose (BASELINE.md config 4).
+
+Measures the full evaluation pipeline per review (encode → batched fused
+device dispatch → response materialization, i.e. everything the server does
+minus HTTP framing) and prints ONE JSON line:
+
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+``vs_baseline`` is value / 100_000 — the north-star target from
+BASELINE.json (the reference publishes no benchmark numbers; ≥1.0 means the
+target is met on this hardware).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    batch_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironmentBuilder,
+    )
+    from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+    from policy_server_tpu.policies.flagship import (
+        flagship_policies,
+        synthetic_firehose,
+    )
+
+    env = EvaluationEnvironmentBuilder(backend="jax").build(flagship_policies())
+
+    # Pre-parse the firehose into requests (JSON/HTTP framing is out of
+    # scope for this metric; a distinct corpus per request keeps the
+    # encode path honest).
+    docs = synthetic_firehose(n_requests, seed=42)
+    requests = [
+        ValidateRequest.from_admission(
+            AdmissionReviewRequest.from_dict(doc).request
+        )
+        for doc in docs
+    ]
+    policy_id = "pod-security-group"  # the batcher computes ALL verdicts per
+    # dispatch; target choice only affects materialization.
+
+    # Warmup: compile the fused program for the bench bucket.
+    env.warmup((batch_size,))
+
+    latencies: list[float] = []
+    t_start = time.perf_counter()
+    done = 0
+    while done < n_requests:
+        chunk = requests[done : done + batch_size]
+        t0 = time.perf_counter()
+        results = env.validate_batch([(policy_id, r) for r in chunk])
+        dt = time.perf_counter() - t0
+        latencies.append(dt / len(chunk) * 1e3 * len(chunk))  # per-batch ms
+        errors = [r for r in results if isinstance(r, Exception)]
+        if errors:
+            raise RuntimeError(f"bench evaluation error: {errors[0]}")
+        done += len(chunk)
+    wall = time.perf_counter() - t_start
+
+    reviews_per_sec = n_requests / wall
+    latencies.sort()
+    p99_batch_ms = latencies[int(len(latencies) * 0.99) - 1] if latencies else 0.0
+
+    result = {
+        "metric": "admission_reviews_per_sec_32policies",
+        "value": round(reviews_per_sec, 1),
+        "unit": "reviews/s/chip",
+        "vs_baseline": round(reviews_per_sec / 100_000.0, 4),
+        "details": {
+            "n_requests": n_requests,
+            "batch_size": batch_size,
+            "wall_s": round(wall, 3),
+            "p99_batch_latency_ms": round(p99_batch_ms, 2),
+            "n_policies": 32,
+            "oracle_fallbacks": env.oracle_fallbacks,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -204,11 +204,14 @@ async def pprof_cpu_handler(request: web.Request) -> web.Response:
         interval = float(
             request.query.get("interval", profiling.DEFAULT_PROFILING_INTERVAL)
         )
+        frequency = int(
+            request.query.get("frequency", profiling.DEFAULT_PROFILING_FREQUENCY)
+        )
     except ValueError:
-        return json_body_error("invalid 'interval' query parameter")
+        return json_body_error("invalid 'interval'/'frequency' query parameter")
     try:
         profile = await asyncio.get_running_loop().run_in_executor(
-            None, profiling.start_one_cpu_profile, interval
+            None, profiling.start_one_cpu_profile, interval, frequency
         )
     except profiling.ProfileInProgress as e:
         return api_error(409, str(e))

@@ -14,10 +14,9 @@ Reference parity: src/profiling.rs —
 
 from __future__ import annotations
 
-import cProfile
-import io
+import collections
 import json
-import pstats
+import sys
 import threading
 import time
 import tracemalloc
@@ -42,20 +41,41 @@ class CpuProfile:
     interval: float
 
 
-def start_one_cpu_profile(interval: float) -> CpuProfile:
-    """Profile the host process for ``interval`` seconds. Single-flight:
-    concurrent calls fail fast like the reference's mutex try_lock."""
+def start_one_cpu_profile(
+    interval: float, frequency: int = DEFAULT_PROFILING_FREQUENCY
+) -> CpuProfile:
+    """Process-wide sampling profile (the pprof-crate analog): every
+    1/frequency seconds, snapshot ALL thread stacks via
+    ``sys._current_frames`` and aggregate collapsed stacks. Output is
+    flamegraph-collapsed text (``frame;frame;frame count`` lines), sorted by
+    count. Single-flight: concurrent calls fail fast like the reference's
+    mutex try_lock (profiling.rs:61-63)."""
     if not _cpu_lock.acquire(blocking=False):
         raise ProfileInProgress("a CPU profile is already being generated")
     try:
-        profiler = cProfile.Profile()
-        profiler.enable()
-        time.sleep(interval)
-        profiler.disable()
-        buf = io.StringIO()
-        stats = pstats.Stats(profiler, stream=buf)
-        stats.sort_stats("cumulative").print_stats(100)
-        return CpuProfile(text=buf.getvalue(), interval=interval)
+        period = 1.0 / max(1, frequency)
+        stacks: collections.Counter[str] = collections.Counter()
+        own = threading.get_ident()
+        deadline = time.perf_counter() + interval
+        while time.perf_counter() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == own:
+                    continue
+                parts = []
+                f = frame
+                while f is not None and len(parts) < 64:
+                    code = f.f_code
+                    parts.append(f"{code.co_filename}:{code.co_name}")
+                    f = f.f_back
+                stacks[";".join(reversed(parts))] += 1
+            time.sleep(period)
+        lines = [
+            f"{stack} {count}"
+            for stack, count in sorted(
+                stacks.items(), key=lambda kv: -kv[1]
+            )
+        ]
+        return CpuProfile(text="\n".join(lines) + "\n", interval=interval)
     finally:
         _cpu_lock.release()
 

@@ -78,6 +78,21 @@ from policy_server_tpu.utils.interning import InternTable
 GROUP_MUTATION_MESSAGE = "mutation is not allowed inside of policy group"
 
 
+def pre_eval_hooks_of(target: "BoundPolicy | BoundGroup") -> list:
+    """Hooks of a bound policy/group (shared by EvaluationEnvironment and
+    PolicyShardedEvaluator — depends only on the target)."""
+    targets = (
+        list(target.members.values())
+        if isinstance(target, BoundGroup)
+        else [target]
+    )
+    return [
+        bp.precompiled.program.pre_eval_hook
+        for bp in targets
+        if bp.precompiled.program.pre_eval_hook is not None
+    ]
+
+
 def bucket_size(n: int) -> int:
     """Round a batch length up to the next power of two — bounds the set of
     shapes the fused program compiles for (SURVEY.md §7.4 hard-part #1:
@@ -130,6 +145,8 @@ class EvaluationEnvironmentBuilder:
         module_resolver: Callable[[str], PolicyModule] | None = None,
         axis_cap: int = DEFAULT_AXIS_CAP,
         nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+        small_axis_cap: int = 8,
+        small_nested_axis_cap: int = 4,
         always_accept_admission_reviews_on_namespace: str | None = None,
     ) -> None:
         self.backend = backend
@@ -137,6 +154,8 @@ class EvaluationEnvironmentBuilder:
         self.module_resolver = module_resolver or default_module_resolver
         self.axis_cap = axis_cap
         self.nested_axis_cap = nested_axis_cap
+        self.small_axis_cap = small_axis_cap
+        self.small_nested_axis_cap = small_nested_axis_cap
         self.always_accept_namespace = always_accept_admission_reviews_on_namespace
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
@@ -226,6 +245,8 @@ class EvaluationEnvironmentBuilder:
             init_errors=init_errors,
             axis_cap=self.axis_cap,
             nested_axis_cap=self.nested_axis_cap,
+            small_axis_cap=self.small_axis_cap,
+            small_nested_axis_cap=self.small_nested_axis_cap,
             always_accept_namespace=self.always_accept_namespace,
         )
 
@@ -245,6 +266,8 @@ class EvaluationEnvironment:
         init_errors: dict[str, str],
         axis_cap: int = DEFAULT_AXIS_CAP,
         nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+        small_axis_cap: int = 8,
+        small_nested_axis_cap: int = 4,
         always_accept_namespace: str | None = None,
     ) -> None:
         self.backend = backend
@@ -258,10 +281,22 @@ class EvaluationEnvironment:
             for bp in bound.values()
             for rule in bp.precompiled.program.rules
         ]
-        self.schema = FeatureSchema.build(
-            exprs, axis_cap=axis_cap, nested_axis_cap=nested_axis_cap
-        )
-        self.schema.register_preds(self.table)
+        # Element-axis shape buckets (SURVEY.md §7.4 hard-part #1: bucketed
+        # shapes bound recompilation AND host→device bytes — the serving
+        # bottleneck is transfer, not FLOPs). Requests encode into the
+        # smallest schema whose caps fit; the final schema's caps are the
+        # oracle-fallback boundary.
+        cap_buckets: list[tuple[int, int]] = []
+        if small_axis_cap and small_axis_cap < axis_cap:
+            cap_buckets.append((small_axis_cap, small_nested_axis_cap))
+        cap_buckets.append((axis_cap, nested_axis_cap))
+        self.schemas = [
+            FeatureSchema.build(exprs, axis_cap=a, nested_axis_cap=n)
+            for a, n in cap_buckets
+        ]
+        self.schema = self.schemas[-1]  # the widest (legacy name)
+        for schema in self.schemas:
+            schema.register_preds(self.table)
         self._compiled = {
             pid: compile_program(bp.precompiled.program, self.schema, self.table)
             for pid, bp in bound.items()
@@ -277,6 +312,29 @@ class EvaluationEnvironment:
         self._fused = jax.jit(self._forward)
         self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
         self._fallback_lock = threading.Lock()
+        self._mesh = None  # set by attach_mesh
+        self._min_bucket = 1
+
+    # -- mesh attachment (parallel/mesh.py) --------------------------------
+
+    def attach_mesh(self, mesh: Any) -> None:
+        """Switch the fused program to data-parallel dispatch over a device
+        mesh: batch-sharded inputs/outputs, XLA-partitioned predicate
+        program (SURVEY.md §2.3 last row). Batch buckets are forced to
+        multiples of the data-axis size."""
+        from policy_server_tpu.parallel import mesh as mesh_mod
+
+        self._mesh = mesh
+        self._min_bucket = mesh.shape[mesh_mod.DATA_AXIS]
+        self._fused = mesh_mod.jit_data_parallel(self._forward, mesh)
+
+    def bucket_for(self, n: int) -> int:
+        """Power-of-two bucket, rounded up to a multiple of the mesh data
+        axis (batches must divide the axis for P('data') sharding)."""
+        b = max(bucket_size(n), self._min_bucket)
+        if self._min_bucket > 1 and b % self._min_bucket:
+            b = ((b + self._min_bucket - 1) // self._min_bucket) * self._min_bucket
+        return b
 
     # -- registry accessors (reference rs:434-470) ------------------------
 
@@ -368,7 +426,11 @@ class EvaluationEnvironment:
             }
             verdict, evaluated = groups_mod.lower_group(group.ast, member_allowed)
             g_allowed_cols.append(verdict)
-            masks = [evaluated[m] for m in group.members]
+            # a member defined but unreferenced by the expression is never
+            # evaluated → all-False mask
+            masks = [
+                evaluated.get(m, jnp.zeros_like(verdict)) for m in group.members
+            ]
             pad = self._max_group_members - len(masks)
             masks.extend([jnp.zeros_like(verdict)] * pad)
             g_eval_cols.append(jnp.stack(masks, axis=-1))  # (B, Mmax)
@@ -404,15 +466,36 @@ class EvaluationEnvironment:
     def run_batch(self, features: Mapping[str, Any]) -> dict[str, np.ndarray]:
         """Dispatch one encoded feature batch to the device; ONE device_get
         fetches every verdict."""
+        if self._mesh is not None:
+            from policy_server_tpu.parallel import mesh as mesh_mod
+
+            features = mesh_mod.shard_features(features, self._mesh)
         packed = jax.device_get(self._fused(features))
         return self._unpack(packed)
 
     def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
-        """AOT-compile the fused program for the batch buckets so the first
-        request isn't a compile stall (reference precompiles at boot via
-        rayon, lib.rs:287-307; SURVEY.md §7.2 step 6)."""
-        for b in batch_sizes:
-            self.run_batch(self.schema.empty_batch(b))
+        """AOT-compile the fused program for every (shape bucket × batch
+        bucket) so the first request isn't a compile stall (reference
+        precompiles at boot via rayon, lib.rs:287-307; SURVEY.md §7.2
+        step 6)."""
+        for schema in self.schemas:
+            for b in sorted({self.bucket_for(b) for b in batch_sizes}):
+                self.run_batch(schema.empty_batch(b))
+
+    def encode_bucketed(
+        self, payload: Any
+    ) -> tuple[int, dict[str, np.ndarray]]:
+        """Encode into the smallest shape bucket that fits; raises
+        SchemaOverflow when even the widest schema cannot hold the
+        request (→ oracle fallback)."""
+        last_error: SchemaOverflow | None = None
+        for i, schema in enumerate(self.schemas):
+            try:
+                return i, schema.encode(payload, self.table)
+            except SchemaOverflow as e:
+                last_error = e
+        assert last_error is not None
+        raise last_error
 
     # -- single-request evaluation (batch of 1; the batcher uses the
     #    *_from_outputs materializers below for real micro-batches) --------
@@ -427,12 +510,14 @@ class EvaluationEnvironment:
         if self.backend == "oracle":
             return self._materialize(target, request, self._oracle_outputs(payload))
         try:
-            encoded = self.schema.encode(payload, self.table)
+            bucket_idx, encoded = self.encode_bucketed(payload)
         except SchemaOverflow:
             with self._fallback_lock:
                 self.oracle_fallbacks += 1
             return self._materialize(target, request, self._oracle_outputs(payload))
-        batch = self.schema.stack([encoded], batch_size=1)
+        batch = self.schemas[bucket_idx].stack(
+            [encoded], batch_size=self.bucket_for(1)
+        )
         outputs = {k: v[0] for k, v in self.run_batch(batch).items()}
         return self._materialize(target, request, outputs)
 
@@ -442,21 +527,12 @@ class EvaluationEnvironment:
         """Host-side pre-eval hooks of a policy/group (latency-fault
         fixtures); the batcher runs them off-thread under the request
         deadline (runtime/batcher.py)."""
-        targets = (
-            list(target.members.values())
-            if isinstance(target, BoundGroup)
-            else [target]
-        )
-        return [
-            bp.precompiled.program.pre_eval_hook
-            for bp in targets
-            if bp.precompiled.program.pre_eval_hook is not None
-        ]
+        return pre_eval_hooks_of(target)
 
     def _run_pre_eval_hooks(
         self, target: BoundPolicy | BoundGroup, payload: Any
     ) -> None:
-        for hook in self.pre_eval_hooks_of(target):
+        for hook in pre_eval_hooks_of(target):
             hook(payload)
 
     def _oracle_outputs(self, payload: Any) -> dict[str, Any]:
@@ -502,8 +578,9 @@ class EvaluationEnvironment:
         """
         results: list[AdmissionResponse | Exception | None] = [None] * len(items)
         targets: list[Any] = [None] * len(items)
-        encodable: list[int] = []
-        encoded: list[dict[str, np.ndarray]] = []
+        # per shape bucket: (item indices, encodings)
+        encodable: dict[int, list[int]] = {}
+        encoded: dict[int, list[dict[str, np.ndarray]]] = {}
         for i, (policy_id, request) in enumerate(items):
             try:
                 target = self._lookup_top_level(PolicyID.parse(policy_id))
@@ -516,8 +593,9 @@ class EvaluationEnvironment:
                         target, request, self._oracle_outputs(payload)
                     )
                     continue
-                encoded.append(self.schema.encode(payload, self.table))
-                encodable.append(i)
+                bucket_idx, enc = self.encode_bucketed(payload)
+                encodable.setdefault(bucket_idx, []).append(i)
+                encoded.setdefault(bucket_idx, []).append(enc)
             except SchemaOverflow:
                 with self._fallback_lock:
                     self.oracle_fallbacks += 1
@@ -526,11 +604,13 @@ class EvaluationEnvironment:
                 )
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
-        if encodable:
-            bucket = bucket_size(len(encodable))
-            batch = self.schema.stack(encoded, batch_size=bucket)
+        for bucket_idx, indices in encodable.items():
+            bucket = self.bucket_for(len(indices))
+            batch = self.schemas[bucket_idx].stack(
+                encoded[bucket_idx], batch_size=bucket
+            )
             outputs = self.run_batch(batch)
-            for row, i in enumerate(encodable):
+            for row, i in enumerate(indices):
                 per_row = {k: v[row] for k, v in outputs.items()}
                 policy_id, request = items[i]
                 results[i] = self._materialize(targets[i], request, per_row)

@@ -1,0 +1,27 @@
+"""Device-mesh parallelism (SURVEY.md §2.3): data-parallel batch sharding,
+policy sharding across submeshes, ICI collectives for metric reductions,
+multi-host init."""
+
+from policy_server_tpu.parallel.mesh import (
+    DATA_AXIS,
+    POLICY_AXIS,
+    acceptance_psum,
+    initialize_distributed,
+    jit_data_parallel,
+    make_mesh,
+    plan_policy_shards,
+    shard_features,
+)
+from policy_server_tpu.parallel.policy_sharded import PolicyShardedEvaluator
+
+__all__ = [
+    "DATA_AXIS",
+    "POLICY_AXIS",
+    "PolicyShardedEvaluator",
+    "acceptance_psum",
+    "initialize_distributed",
+    "jit_data_parallel",
+    "make_mesh",
+    "plan_policy_shards",
+    "shard_features",
+]

@@ -1,0 +1,172 @@
+"""Device-mesh parallelism: data-parallel batch sharding, policy sharding,
+and the ICI collectives that aggregate verdicts/metrics.
+
+The reference is a single-node thread-parallel server whose only scale-out
+is an HTTP load balancer over replicas (SURVEY.md §2.3 last row). The
+TPU-native design replaces that with sharding over a ``jax.sharding.Mesh``:
+
+* ``data`` axis — requests (the batch dimension) shard across chips; XLA
+  partitions the fused predicate program, elementwise work scales linearly
+  and no collective is needed for the verdicts themselves.
+* ``policy`` axis — very large policy sets split into shards (BASELINE.md
+  config 5); each shard is its OWN fused XLA program (policies are
+  heterogeneous code, so this is MPMD across submeshes: every policy shard
+  owns a data-parallel submesh, dispatches concurrently, and the host
+  concatenates verdict blocks — the TPU analog of the reference's
+  replicas-behind-a-Service, but with deterministic placement).
+* metrics reduction — per-policy acceptance counts are a ``psum`` over the
+  data axis (``shard_map`` + ``lax.psum``), the collective the driver's
+  multi-chip dry-run exercises end to end.
+
+Multi-host: ``jax.distributed.initialize`` + the same mesh spanning all
+processes' devices (ICI within a slice, DCN across slices) — see
+``initialize_distributed``.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from policy_server_tpu.config.config import MeshSpec
+
+DATA_AXIS = "data"
+POLICY_AXIS = "policy"
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up (jax.distributed over DCN). No-op when
+    single-process args are absent."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def resolve_axes(spec: MeshSpec, devices: Sequence[Any] | None = None) -> dict[str, int]:
+    """Concretize a MeshSpec against the available devices (``data: 0`` =
+    auto → all devices not consumed by the policy axis)."""
+    devs = list(devices if devices is not None else jax.devices())
+    policy = spec.policy_size()
+    data = spec.data_size()
+    if policy < 1 or len(devs) % policy != 0:
+        raise ValueError(
+            f"policy axis {policy} does not divide device count {len(devs)}"
+        )
+    if data == 0:  # auto
+        data = len(devs) // policy
+    if data * policy != len(devs):
+        raise ValueError(
+            f"mesh {data}x{policy} does not match device count {len(devs)}"
+        )
+    return {DATA_AXIS: data, POLICY_AXIS: policy}
+
+
+def make_mesh(
+    spec: MeshSpec | None = None, devices: Sequence[Any] | None = None
+) -> Mesh:
+    """Build the (data, policy) mesh. Axis order puts ``data`` innermost on
+    the device list so batch shards ride the fastest ICI links."""
+    devs = np.array(list(devices if devices is not None else jax.devices()))
+    axes = resolve_axes(spec or MeshSpec(), devs.tolist())
+    grid = devs.reshape(axes[POLICY_AXIS], axes[DATA_AXIS])
+    return Mesh(grid, (POLICY_AXIS, DATA_AXIS))
+
+
+@dataclass(frozen=True)
+class SubmeshPlan:
+    """One policy shard: the policy ids it evaluates and its data-parallel
+    submesh."""
+
+    shard_index: int
+    policy_ids: tuple[str, ...]
+    mesh: Mesh
+
+
+def plan_policy_shards(
+    policy_ids: Sequence[str], mesh: Mesh
+) -> list[SubmeshPlan]:
+    """Partition top-level policy ids round-robin over the policy axis; each
+    shard owns one row of the mesh as its data-parallel submesh."""
+    n_shards = mesh.shape[POLICY_AXIS]
+    buckets: list[list[str]] = [[] for _ in range(n_shards)]
+    for i, pid in enumerate(sorted(policy_ids)):
+        buckets[i % n_shards].append(pid)
+    plans = []
+    for s in range(n_shards):
+        row = mesh.devices[s]  # (data,) devices of this shard
+        submesh = Mesh(row.reshape(1, -1), (POLICY_AXIS, DATA_AXIS))
+        plans.append(SubmeshPlan(s, tuple(buckets[s]), submesh))
+    return plans
+
+
+# ---------------------------------------------------------------------------
+# Data-parallel dispatch of a fused forward
+# ---------------------------------------------------------------------------
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dim sharded over the data axis, everything else
+    replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def shard_features(
+    features: Mapping[str, np.ndarray], mesh: Mesh
+) -> dict[str, jax.Array]:
+    """Host → device transfer with the batch axis pre-sharded (one
+    device_put of the whole tree; transfers are the serving bottleneck on
+    remote transports)."""
+    sharding = batch_sharding(mesh)
+    return jax.device_put(dict(features), sharding)
+
+
+def jit_data_parallel(
+    forward: Callable[[Mapping[str, Any]], tuple],
+    mesh: Mesh,
+) -> Callable[[Mapping[str, Any]], tuple]:
+    """jit the fused forward with batch-sharded inputs/outputs. XLA
+    partitions the predicate program over the data axis — verdict tensors
+    stay distributed until the host gathers them in one device_get."""
+    sharding = batch_sharding(mesh)
+    return jax.jit(forward, in_shardings=(sharding,), out_shardings=sharding)
+
+
+def acceptance_psum(mesh: Mesh) -> Callable[[jax.Array], jax.Array]:
+    """(B, P) verdict bits → (P,) global acceptance counts via an ICI psum
+    over the data axis (the serving-metrics collective; SURVEY.md §5
+    'distributed communication backend' row)."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(DATA_AXIS, None),
+        out_specs=P(),
+    )
+    def count(allowed: jax.Array) -> jax.Array:
+        local = allowed.sum(axis=0, dtype=np.int32)
+        return lax.psum(local, axis_name=DATA_AXIS)
+
+    return jax.jit(count)
+
+
+def pad_batch_to(n: int, multiple: int) -> int:
+    """Batches must divide the data axis; pad-rows are all-missing and cost
+    one masked lane each."""
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple

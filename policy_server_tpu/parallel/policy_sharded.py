@@ -1,0 +1,138 @@
+"""Policy-sharded evaluation: split a large policy set across the mesh's
+``policy`` axis, one fused XLA program per shard, data-parallel within.
+
+BASELINE.md config 5 ("8 policies.yml shards pmapped across v5e-8"): very
+large or multi-tenant policy sets do not fit one fused program gracefully —
+compile time and program size grow with the policy count, and tenants churn
+independently. Sharding the *policy* dimension keeps each fused program
+small and recompilation local to the shard that changed (preemption-churn
+resilience: a resize only recompiles affected shards, SURVEY.md §7.2
+step 10).
+
+Policies are heterogeneous code, so this is MPMD: each shard owns a
+data-parallel submesh (one row of the global mesh) and its own jitted fused
+program; shards dispatch concurrently (JAX dispatch is async — the host
+enqueues all shard programs before blocking) and the host routes each
+policy_id to its owning shard. This is the deterministic-placement
+replacement for the reference's replicas-behind-a-Service scale-out
+(SURVEY.md §2.3 last row)."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironment,
+    EvaluationEnvironmentBuilder,
+)
+from policy_server_tpu.evaluation.errors import PolicyNotFoundError
+from policy_server_tpu.models import AdmissionResponse, ValidateRequest
+from policy_server_tpu.models.policy import PolicyOrPolicyGroup
+from policy_server_tpu.parallel import mesh as mesh_mod
+
+
+class PolicyShardedEvaluator:
+    """Routes policy_ids to per-shard EvaluationEnvironments.
+
+    Exposes the same validate/validate_batch surface as a single
+    environment, so the micro-batcher and the service layer work unchanged
+    on top of it."""
+
+    def __init__(
+        self,
+        policies: Mapping[str, PolicyOrPolicyGroup],
+        mesh: Any,
+        backend: str = "jax",
+        continue_on_errors: bool = False,
+        builder_kwargs: dict[str, Any] | None = None,
+    ) -> None:
+        plans = mesh_mod.plan_policy_shards(list(policies), mesh)
+        self.shards: list[EvaluationEnvironment] = []
+        self._owner: dict[str, int] = {}
+        for plan in plans:
+            shard_policies = {pid: policies[pid] for pid in plan.policy_ids}
+            builder = EvaluationEnvironmentBuilder(
+                backend=backend,
+                continue_on_errors=continue_on_errors,
+                **(builder_kwargs or {}),
+            )
+            env = builder.build(shard_policies)
+            if backend == "jax" and plan.mesh.devices.size > 1:
+                env.attach_mesh(plan.mesh)
+            self.shards.append(env)
+            for pid in plan.policy_ids:
+                self._owner[pid] = plan.shard_index
+
+    # -- routing -----------------------------------------------------------
+
+    def _shard_of(self, policy_id: str) -> EvaluationEnvironment:
+        top = policy_id.split("/")[0]
+        idx = self._owner.get(top)
+        if idx is None:
+            raise PolicyNotFoundError(policy_id)
+        return self.shards[idx]
+
+    # -- environment surface ----------------------------------------------
+
+    def policy_ids(self) -> list[str]:
+        out: list[str] = []
+        for env in self.shards:
+            out.extend(env.policy_ids())
+        return sorted(out)
+
+    def get_policy_mode(self, policy_id: str):
+        return self._shard_of(policy_id).get_policy_mode(policy_id)
+
+    def get_policy_allowed_to_mutate(self, policy_id: str) -> bool:
+        return self._shard_of(policy_id).get_policy_allowed_to_mutate(policy_id)
+
+    def should_always_accept_requests_made_inside_of_namespace(
+        self, namespace: str
+    ) -> bool:
+        return any(
+            env.should_always_accept_requests_made_inside_of_namespace(namespace)
+            for env in self.shards
+        )
+
+    def pre_eval_hooks_of(self, target):  # MicroBatcher compatibility
+        from policy_server_tpu.evaluation.environment import pre_eval_hooks_of
+
+        return pre_eval_hooks_of(target)
+
+    def _lookup_top_level(self, pid):
+        return self._shard_of(str(pid))._lookup_top_level(pid)
+
+    def validate(
+        self, policy_id: str, request: ValidateRequest
+    ) -> AdmissionResponse:
+        return self._shard_of(policy_id).validate(policy_id, request)
+
+    def validate_batch(
+        self,
+        items: list[tuple[str, ValidateRequest]],
+        run_hooks: bool = True,
+    ) -> list[AdmissionResponse | Exception]:
+        """Partition the batch by owning shard, dispatch every shard's fused
+        program, merge in submission order. Shard dispatches overlap via
+        JAX async dispatch."""
+        per_shard: dict[int, list[int]] = {}
+        results: list[AdmissionResponse | Exception | None] = [None] * len(items)
+        for i, (pid, _) in enumerate(items):
+            top = pid.split("/")[0]
+            idx = self._owner.get(top)
+            if idx is None:
+                results[i] = PolicyNotFoundError(pid)
+                continue
+            per_shard.setdefault(idx, []).append(i)
+        for idx, indices in per_shard.items():
+            shard_items = [items[i] for i in indices]
+            shard_results = self.shards[idx].validate_batch(
+                shard_items, run_hooks=run_hooks
+            )
+            for i, r in zip(indices, shard_results):
+                results[i] = r
+        return results  # type: ignore[return-value]
+
+    def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
+        for env in self.shards:
+            env.warmup(batch_sizes)

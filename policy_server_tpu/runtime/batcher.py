@@ -34,8 +34,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
-from concurrent.futures import TimeoutError as FutureTimeoutError
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 from policy_server_tpu.api import service
@@ -71,7 +70,6 @@ class MicroBatcher:
         batch_timeout_ms: float = 1.0,
         policy_timeout: float | None = 2.0,
         queue_capacity: int | None = None,
-        hook_workers: int = 8,
     ) -> None:
         self.env = env
         self.max_batch_size = max(1, int(max_batch_size))
@@ -79,9 +77,6 @@ class MicroBatcher:
         self.policy_timeout = policy_timeout
         self._queue: queue.Queue[_Pending] = queue.Queue(
             maxsize=queue_capacity or self.max_batch_size * 8
-        )
-        self._hooks = ThreadPoolExecutor(
-            max_workers=hook_workers, thread_name_prefix="pre-eval-hook"
         )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -103,7 +98,19 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self._hooks.shutdown(wait=False)
+        # Drain: requests still queued must not leave their futures
+        # unresolved (handlers await them).
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._resolve(
+                p,
+                AdmissionResponse.reject(
+                    p.request.uid(), "policy server shutting down", 503
+                ),
+            )
 
     def warmup(self) -> None:
         """Compile every batch bucket at boot (reference precompiles all
@@ -298,13 +305,28 @@ class MicroBatcher:
             return True
         payload = p.request.payload()
         remaining = self._remaining(p)
-        fut = self._hooks.submit(lambda: [h(payload) for h in hooks])
-        try:
-            fut.result(timeout=remaining)
-            return True
-        except FutureTimeoutError:
+        # One daemon thread per hook run (not a fixed pool): a timed-out
+        # hook leaks only its own thread until it finishes — it can never
+        # clog a shared pool and starve other requests' hooks.
+        done = threading.Event()
+        box: dict[str, BaseException] = {}
+
+        def runner() -> None:
+            try:
+                for h in hooks:
+                    h(payload)
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=runner, name="pre-eval-hook", daemon=True
+        ).start()
+        if not done.wait(timeout=remaining):
             self._reject_deadline(p)
             return False
-        except Exception as e:  # noqa: BLE001
-            self._fail(p, e)
+        if "error" in box:
+            self._fail(p, box["error"])
             return False
+        return True

@@ -1,0 +1,198 @@
+"""Mesh/sharding tests on the 8-virtual-device CPU backend (the v5e-8
+stand-in, SURVEY.md §4.2 'Implication for the TPU build'): data-parallel
+verdict parity, the acceptance psum collective, policy-sharded MPMD
+routing, and mesh planning."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from policy_server_tpu.config.config import MeshSpec
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.parallel import (
+    DATA_AXIS,
+    POLICY_AXIS,
+    PolicyShardedEvaluator,
+    acceptance_psum,
+    make_mesh,
+    plan_policy_shards,
+)
+
+from conftest import build_admission_review_dict
+
+
+def pod_request(namespace: str, privileged: bool) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["namespace"] = namespace
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": namespace},
+        "spec": {
+            "containers": [
+                {"name": "c", "image": "nginx",
+                 "securityContext": {"privileged": privileged}}
+            ]
+        },
+    }
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+POLICIES = {
+    "priv": {"module": "builtin://pod-privileged"},
+    "ns": {
+        "module": "builtin://namespace-validate",
+        "settings": {"denied_namespaces": ["blocked"]},
+    },
+    "latest": {"module": "builtin://disallow-latest-tag"},
+    "happy": {"module": "builtin://always-happy"},
+}
+
+
+def parse_all(policies: dict) -> dict:
+    return {k: parse_policy_entry(k, v) for k, v in policies.items()}
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(MeshSpec.parse("data:8"))
+    assert mesh.shape[DATA_AXIS] == 8 and mesh.shape[POLICY_AXIS] == 1
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    assert mesh.shape[DATA_AXIS] == 4 and mesh.shape[POLICY_AXIS] == 2
+    mesh = make_mesh(MeshSpec.parse("auto"))
+    assert mesh.shape[DATA_AXIS] == len(jax.devices())
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec.parse("data:3,policy:2"))
+
+
+def test_data_parallel_matches_single_device():
+    single = EvaluationEnvironmentBuilder(backend="jax").build(parse_all(POLICIES))
+    sharded = EvaluationEnvironmentBuilder(backend="jax").build(parse_all(POLICIES))
+    sharded.attach_mesh(make_mesh(MeshSpec.parse("data:8")))
+    cases = [
+        ("priv", pod_request("default", True)),
+        ("priv", pod_request("default", False)),
+        ("ns", pod_request("blocked", False)),
+        ("ns", pod_request("fine", False)),
+        ("latest", pod_request("default", False)),
+        ("happy", pod_request("default", True)),
+    ]
+    a = single.validate_batch(cases)
+    b = sharded.validate_batch(cases)
+    assert [r.to_dict() for r in a] == [r.to_dict() for r in b]
+    # single-request path also pads to the data-axis bucket
+    r1 = single.validate("priv", pod_request("x", True))
+    r2 = sharded.validate("priv", pod_request("x", True))
+    assert r1.to_dict() == r2.to_dict()
+
+
+def test_acceptance_psum_collective():
+    mesh = make_mesh(MeshSpec.parse("data:8"))
+    count = acceptance_psum(mesh)
+    allowed = np.zeros((16, 3), dtype=bool)
+    allowed[:5, 0] = True
+    allowed[:, 1] = True
+    counts = np.asarray(count(allowed))
+    assert counts.tolist() == [5, 16, 0]
+
+
+def test_plan_policy_shards_partition():
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    plans = plan_policy_shards(list(POLICIES), mesh)
+    assert len(plans) == 2
+    all_ids = sorted(pid for p in plans for pid in p.policy_ids)
+    assert all_ids == sorted(POLICIES)
+    for p in plans:
+        assert p.mesh.shape[DATA_AXIS] == 4
+
+
+def test_policy_sharded_evaluator_matches_single():
+    single = EvaluationEnvironmentBuilder(backend="jax").build(parse_all(POLICIES))
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    sharded = PolicyShardedEvaluator(parse_all(POLICIES), mesh)
+    cases = [
+        ("priv", pod_request("default", True)),
+        ("ns", pod_request("blocked", False)),
+        ("latest", pod_request("default", False)),
+        ("happy", pod_request("default", False)),
+        ("priv", pod_request("default", False)),
+    ]
+    a = single.validate_batch(cases)
+    b = sharded.validate_batch(cases)
+    assert [r.to_dict() for r in a] == [r.to_dict() for r in b]
+
+    from policy_server_tpu.evaluation.errors import PolicyNotFoundError
+
+    out = sharded.validate_batch([("missing", pod_request("d", False))])
+    assert isinstance(out[0], PolicyNotFoundError)
+
+
+def test_policy_sharded_group_routing():
+    policies = dict(POLICIES)
+    policies["grp"] = {
+        "expression": "a() && b()",
+        "message": "denied",
+        "policies": {
+            "a": {"module": "builtin://always-happy"},
+            "b": {"module": "builtin://pod-privileged"},
+        },
+    }
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    sharded = PolicyShardedEvaluator(parse_all(policies), mesh)
+    resp = sharded.validate("grp", pod_request("default", True))
+    assert not resp.allowed
+    assert resp.status.details.causes[0].field == "spec.policies.b"
+
+
+def test_unreferenced_group_member_mask(request):
+    """A member defined but not referenced by the expression is never
+    evaluated (regression: packed outputs raised KeyError at trace time)."""
+    policies = {
+        "g": parse_policy_entry(
+            "g",
+            {
+                "expression": "happy()",
+                "message": "denied",
+                "policies": {
+                    "happy": {"module": "builtin://always-happy"},
+                    "extra": {"module": "builtin://pod-privileged"},
+                },
+            },
+        )
+    }
+    env = EvaluationEnvironmentBuilder(backend="jax").build(policies)
+    resp = env.validate("g", pod_request("default", True))
+    assert resp.allowed
+
+
+def test_bucket_for_non_pow2_data_axis():
+    env = EvaluationEnvironmentBuilder(backend="jax").build(
+        parse_all({"happy": {"module": "builtin://always-happy"}})
+    )
+    env._min_bucket = 6  # simulate a 6-wide data axis
+    assert env.bucket_for(5) % 6 == 0
+    assert env.bucket_for(13) % 6 == 0
+
+
+def test_sharded_evaluator_hooks_through_batcher():
+    """Regression: pre_eval_hooks_of raised NotImplementedError and killed
+    every batched request on a sharded evaluator."""
+    from policy_server_tpu.api.service import RequestOrigin
+    from policy_server_tpu.runtime.batcher import MicroBatcher
+
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    sharded = PolicyShardedEvaluator(parse_all(POLICIES), mesh)
+    batcher = MicroBatcher(sharded, max_batch_size=4, batch_timeout_ms=1.0).start()
+    try:
+        resp = batcher.evaluate(
+            "priv", pod_request("default", True), RequestOrigin.VALIDATE,
+            timeout=30,
+        )
+        assert not resp.allowed
+    finally:
+        batcher.shutdown()
