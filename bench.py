@@ -48,26 +48,37 @@ def main() -> int:
     # dispatch; target choice only affects materialization.
 
     # Warmup: compile the fused program for the bench bucket.
+    env.max_dispatch_batch = batch_size
     env.warmup((batch_size,))
 
-    latencies: list[float] = []
+    # Throughput: the full firehose through ONE validate_batch call — the
+    # environment chunks to `batch_size` dispatches internally and pipelines
+    # host encode of chunk N+1 under device execution of chunk N.
     t_start = time.perf_counter()
-    done = 0
-    while done < n_requests:
-        chunk = requests[done : done + batch_size]
-        t0 = time.perf_counter()
-        results = env.validate_batch([(policy_id, r) for r in chunk])
-        dt = time.perf_counter() - t0
-        latencies.append(dt / len(chunk) * 1e3 * len(chunk))  # per-batch ms
-        errors = [r for r in results if isinstance(r, Exception)]
-        if errors:
-            raise RuntimeError(f"bench evaluation error: {errors[0]}")
-        done += len(chunk)
+    results = env.validate_batch([(policy_id, r) for r in requests])
     wall = time.perf_counter() - t_start
+    errors = [r for r in results if isinstance(r, Exception)]
+    if errors:
+        raise RuntimeError(f"bench evaluation error: {errors[0]}")
+
+    # Serving latency: steady-state per-dispatch latency at a serving-sized
+    # batch (what a micro-batcher user sees, minus queueing). 40 samples
+    # honestly supports a p95, not a p99 — named accordingly.
+    lat_batch = min(256, batch_size)
+    lat_items = [(policy_id, r) for r in requests[:lat_batch]]
+    env.validate_batch(lat_items)  # warm that bucket
+    latencies = []
+    for _ in range(40):
+        t0 = time.perf_counter()
+        env.validate_batch(lat_items)
+        latencies.append((time.perf_counter() - t0) * 1e3)
+    latencies.sort()
 
     reviews_per_sec = n_requests / wall
-    latencies.sort()
-    p99_batch_ms = latencies[int(len(latencies) * 0.99) - 1] if latencies else 0.0
+    import math
+
+    idx = max(0, math.ceil(0.95 * len(latencies)) - 1)
+    p95_dispatch_ms = latencies[idx] if latencies else 0.0
 
     result = {
         "metric": "admission_reviews_per_sec_32policies",
@@ -78,7 +89,8 @@ def main() -> int:
             "n_requests": n_requests,
             "batch_size": batch_size,
             "wall_s": round(wall, 3),
-            "p99_batch_latency_ms": round(p99_batch_ms, 2),
+            "p95_dispatch_latency_ms": round(p95_dispatch_ms, 2),
+            "latency_dispatch_size": lat_batch,
             "n_policies": 32,
             "oracle_fallbacks": env.oracle_fallbacks,
         },

@@ -297,6 +297,18 @@ class EvaluationEnvironment:
         self.schema = self.schemas[-1]  # the widest (legacy name)
         for schema in self.schemas:
             schema.register_preds(self.table)
+        # Native (C++) encoder: JSON bytes → batch arrays in one call per
+        # dispatch (csrc/fastenc.cpp). Soft-fails to the Python trie.
+        self.native_encoding = False
+        if backend == "jax":
+            try:
+                from policy_server_tpu.ops import fastenc
+
+                self.native_encoding = all(
+                    fastenc.attach_native(s) for s in self.schemas
+                )
+            except Exception:  # pragma: no cover - build env dependent
+                self.native_encoding = False
         self._compiled = {
             pid: compile_program(bp.precompiled.program, self.schema, self.table)
             for pid, bp in bound.items()
@@ -576,6 +588,20 @@ class EvaluationEnvironment:
         Exception entries rather than failing the batch; SchemaOverflow rows
         fall back to the host oracle (SURVEY.md §7.4 escape hatch).
         """
+        if self.native_encoding and self.backend == "jax":
+            # chunks to max_dispatch_batch internally, with pipelining
+            return self._validate_batch_native(items, run_hooks)
+        if len(items) > self.max_dispatch_batch:
+            # Python fallback path: bound single-dispatch size here.
+            out: list[AdmissionResponse | Exception] = []
+            for c in range(0, len(items), self.max_dispatch_batch):
+                out.extend(
+                    self.validate_batch(
+                        items[c : c + self.max_dispatch_batch],
+                        run_hooks=run_hooks,
+                    )
+                )
+            return out
         results: list[AdmissionResponse | Exception | None] = [None] * len(items)
         targets: list[Any] = [None] * len(items)
         # per shape bucket: (item indices, encodings)
@@ -615,6 +641,106 @@ class EvaluationEnvironment:
                 policy_id, request = items[i]
                 results[i] = self._materialize(targets[i], request, per_row)
         return results  # type: ignore[return-value]
+
+    def _validate_batch_native(
+        self,
+        items: list[tuple[str, ValidateRequest]],
+        run_hooks: bool,
+    ) -> list[AdmissionResponse | Exception]:
+        """The native fast path: JSON bytes → batch arrays in one C++ call
+        per shape bucket, rows written in place (no per-request arrays, no
+        re-stack). Rows that overflow a bucket cascade to the next; rows
+        failing the widest bucket fall back to the host oracle."""
+        results: list[AdmissionResponse | Exception | None] = [None] * len(items)
+        targets: list[Any] = [None] * len(items)
+        pending: list[int] = []
+        for i, (policy_id, request) in enumerate(items):
+            try:
+                target = self._lookup_top_level(PolicyID.parse(policy_id))
+                targets[i] = target
+                if run_hooks:
+                    self._run_pre_eval_hooks(target, request.payload())
+                pending.append(i)
+            except Exception as e:  # noqa: BLE001 — per-item error channel
+                results[i] = e
+
+        for schema in self.schemas:
+            if not pending:
+                break
+            pending = self._native_schema_pass(
+                schema, items, targets, results, pending
+            )
+
+        for i in pending:  # beyond the widest schema → oracle
+            with self._fallback_lock:
+                self.oracle_fallbacks += 1
+            policy_id, request = items[i]
+            results[i] = self._materialize(
+                targets[i], request, self._oracle_outputs(request.payload())
+            )
+        return results  # type: ignore[return-value]
+
+    # Largest single device dispatch; bigger lists pipeline in chunks so
+    # host encode of chunk N+1 overlaps device transfer+compute of chunk N.
+    max_dispatch_batch = 4096
+
+    def _native_schema_pass(
+        self,
+        schema: FeatureSchema,
+        items: list[tuple[str, ValidateRequest]],
+        targets: list[Any],
+        results: list[AdmissionResponse | Exception | None],
+        pending: list[int],
+    ) -> list[int]:
+        """Encode+dispatch all ``pending`` rows against one schema with a
+        two-deep pipeline (async dispatch, deferred device_get). Returns the
+        rows that overflowed this schema."""
+        chunk_size = min(self.bucket_for(len(pending)), self.max_dispatch_batch)
+        chunks = [
+            pending[c : c + chunk_size]
+            for c in range(0, len(pending), chunk_size)
+        ]
+        overflowed: list[int] = []
+        inflight: tuple[Any, list[tuple[int, int]]] | None = None
+
+        def drain(entry: tuple[Any, list[tuple[int, int]]]) -> None:
+            dev_out, ok_rows = entry
+            outputs = self._unpack(jax.device_get(dev_out))
+            for row, i in ok_rows:
+                per_row = {k: v[row] for k, v in outputs.items()}
+                _, request = items[i]
+                results[i] = self._materialize(targets[i], request, per_row)
+
+        for chunk in chunks:
+            blobs = [items[i][1].payload_json() for i in chunk]
+            try:
+                features, status = schema.native.encode_batch(
+                    blobs, self.bucket_for(len(blobs)), self.table
+                )
+            except ValueError:
+                # arena/records overflow on a pathological chunk: keep
+                # per-item isolation — route the whole chunk to the next
+                # schema / the oracle instead of failing the batch
+                overflowed.extend(chunk)
+                continue
+            ok_rows = [
+                (row, i) for row, i in enumerate(chunk) if status[row] == 0
+            ]
+            overflowed.extend(
+                i for row, i in enumerate(chunk) if status[row] != 0
+            )
+            if ok_rows:
+                if self._mesh is not None:
+                    from policy_server_tpu.parallel import mesh as mesh_mod
+
+                    features = mesh_mod.shard_features(features, self._mesh)
+                dev_out = self._fused(features)  # async dispatch
+                if inflight is not None:
+                    drain(inflight)
+                inflight = (dev_out, ok_rows)
+        if inflight is not None:
+            drain(inflight)
+        return overflowed
 
     # -- response materialization (host side) ------------------------------
 

@@ -18,6 +18,7 @@ snake_case in Python.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from typing import Any, Mapping
 
@@ -325,7 +326,7 @@ class ValidateRequest:
     JSON body's ``uid`` key when present, else empty string).
     """
 
-    __slots__ = ("admission_request", "raw", "_payload_cache")
+    __slots__ = ("admission_request", "raw", "_payload_cache", "_payload_json")
 
     def __init__(
         self,
@@ -339,6 +340,7 @@ class ValidateRequest:
         self.admission_request = admission_request
         self.raw = raw
         self._payload_cache: Any = None
+        self._payload_json: bytes | None = None
 
     @classmethod
     def from_admission(cls, req: AdmissionRequest) -> "ValidateRequest":
@@ -370,3 +372,12 @@ class ValidateRequest:
                 self._payload_cache = self.admission_request.to_dict()
             return self._payload_cache
         return self.raw
+
+    def payload_json(self) -> bytes:
+        """The payload as compact JSON bytes (memoized) — the native
+        encoder's input (ops/fastenc.py)."""
+        if self._payload_json is None:
+            self._payload_json = json.dumps(
+                self.payload(), separators=(",", ":")
+            ).encode()
+        return self._payload_json

@@ -182,6 +182,16 @@ class FeatureSchema:
                     spec.pred_key(), ir.build_str_pred(spec.pred_kind, spec.pred_pattern)
                 )
 
+    def _trie(self) -> "_TrieNode":
+        """Lazily-built single-pass extraction trie over all specs: the
+        payload tree is walked ONCE per request instead of once per spec
+        (the host encode path is serving-throughput critical)."""
+        trie = getattr(self, "_trie_cache", None)
+        if trie is None:
+            trie = _build_trie(self.specs.values())
+            self._trie_cache = trie
+        return trie
+
     def encode(
         self, payload: Any, table: InternTable
     ) -> dict[str, np.ndarray]:
@@ -189,29 +199,10 @@ class FeatureSchema:
         batch dim). Raises SchemaOverflow when an array exceeds its cap."""
         out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros((), dtype=np.bool_)}
         for spec in self.specs.values():
+            out[spec.key] = np.zeros(spec.caps, dtype=spec.np_dtype())
             if spec.kind == "value":
-                val = np.zeros(spec.caps, dtype=spec.np_dtype())
-                mask = np.zeros(spec.caps, dtype=np.bool_)
-                for coords, v in _extract(payload, spec.segments, spec.caps, spec.key):
-                    ok, converted = _convert(v, spec.dtype, table)
-                    if ok:
-                        val[coords] = converted
-                        mask[coords] = True
-                out[spec.key] = val
-                out[_mask_key(spec.key)] = mask
-            elif spec.kind == "present":
-                arr = np.zeros(spec.caps, dtype=np.bool_)
-                for coords, v in _extract(payload, spec.segments, spec.caps, spec.key):
-                    if v is not None:
-                        arr[coords] = True
-                out[spec.key] = arr
-            else:  # pred
-                arr = np.zeros(spec.caps, dtype=np.bool_)
-                pred_key = spec.pred_key()
-                for coords, v in _extract(payload, spec.segments, spec.caps, spec.key):
-                    if isinstance(v, str):
-                        arr[coords] = table.pred_value(pred_key, v)
-                out[spec.key] = arr
+                out[_mask_key(spec.key)] = np.zeros(spec.caps, dtype=np.bool_)
+        _walk_trie(self._trie(), payload, (), out, table)
         return out
 
     def stack(self, encoded: list[dict[str, np.ndarray]], batch_size: int) -> dict[str, np.ndarray]:
@@ -241,6 +232,74 @@ class FeatureSchema:
                     spec.shape(batch_size), dtype=np.bool_
                 )
         return out
+
+
+class _TrieNode:
+    """One node of the single-pass extraction trie."""
+
+    __slots__ = ("children", "star", "terminals", "axis_cap", "repr_key")
+
+    def __init__(self) -> None:
+        self.children: dict[str, _TrieNode] = {}
+        self.star: _TrieNode | None = None
+        self.terminals: list[FeatureSpec] = []
+        self.axis_cap: int = 0  # cap of the star axis rooted here
+        self.repr_key: str = ""  # a spec key for SchemaOverflow reporting
+
+
+def _build_trie(specs) -> _TrieNode:
+    root = _TrieNode()
+    for spec in specs:
+        node = root
+        axis = 0
+        for seg in spec.segments:
+            if seg == STAR:
+                if node.star is None:
+                    node.star = _TrieNode()
+                node.axis_cap = spec.caps[axis] if axis < len(spec.caps) else 0
+                node.repr_key = spec.key
+                node = node.star
+                axis += 1
+            else:
+                node = node.children.setdefault(seg, _TrieNode())
+        node.terminals.append(spec)
+    return root
+
+
+def _walk_trie(
+    node: _TrieNode,
+    value: Any,
+    coords: tuple[int, ...],
+    out: dict[str, np.ndarray],
+    table: InternTable,
+) -> None:
+    for spec in node.terminals:
+        if spec.kind == "value":
+            ok, converted = _convert(value, spec.dtype, table)
+            if ok:
+                out[spec.key][coords] = converted
+                out[_mask_key(spec.key)][coords] = True
+        elif spec.kind == "present":
+            if value is not None:
+                out[spec.key][coords] = True
+        else:  # pred
+            if isinstance(value, str):
+                out[spec.key][coords] = table.pred_value(spec.pred_key(), value)
+    if node.children and isinstance(value, Mapping):
+        for key, child in node.children.items():
+            if key in value:
+                _walk_trie(child, value[key], coords, out, table)
+    if node.star is not None:
+        elems = star_elements(value)
+        if elems is None:
+            return
+        if node.axis_cap and len(elems) > node.axis_cap:
+            raise SchemaOverflow(
+                node.repr_key, len(coords), len(elems), node.axis_cap
+            )
+        star = node.star
+        for i, elem in enumerate(elems):
+            _walk_trie(star, elem, coords + (i,), out, table)
 
 
 def _mask_key(value_key: str) -> str:

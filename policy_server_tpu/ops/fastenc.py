@@ -1,0 +1,315 @@
+"""ctypes bindings + build driver for the native encoder (csrc/fastenc.cpp).
+
+The native encoder is the C++ twin of the codec's extraction trie
+(ops/codec.py): it parses the request's JSON bytes directly (no Python dict
+on the hot path), writes numeric/bool/presence features straight into the
+numpy buffers, and returns the ID/pred strings via an arena that Python
+interns with its memoized tables. The whole encode runs with the GIL
+released, so the batcher can encode on parallel threads.
+
+Build model: compiled on demand with g++ into ``build/fastenc-<py>.so`` and
+cached; any failure (no compiler, unsupported platform) degrades silently to
+the pure-Python trie — behavior is identical, only slower (differential
+tests enforce bit-exactness, tests/test_fastenc.py)."""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import subprocess
+import sys
+import sysconfig
+import threading
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from policy_server_tpu.ops.codec import (
+    BATCH_KEY,
+    FeatureSchema,
+    FeatureSpec,
+    SchemaOverflow,
+    mask_key_for,
+)
+from policy_server_tpu.utils.interning import InternTable
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "csrc" / "fastenc.cpp"
+
+_KIND = {"value": 0, "present": 1, "pred": 2}
+_DTYPE = {"id": 0, "f32": 1, "bool": 2, "i32": 3}
+
+_lib_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _build_library() -> Path | None:
+    out_dir = _REPO_ROOT / "build"
+    out_dir.mkdir(exist_ok=True)
+    tag = sysconfig.get_config_var("SOABI") or f"py{sys.version_info[0]}{sys.version_info[1]}"
+    out = out_dir / f"fastenc-{tag}.so"
+    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        return out
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        str(_SRC), "-o", str(out),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        path = _build_library()
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.fastenc_create.restype = ctypes.c_void_p
+        lib.fastenc_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.fastenc_destroy.argtypes = [ctypes.c_void_p]
+        lib.fastenc_encode.restype = ctypes.c_int64
+        lib.fastenc_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        lib.fastenc_encode_batch.restype = ctypes.c_int64
+        lib.fastenc_encode_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Schema description (mirrors the Python trie, _build_trie in codec.py)
+# ---------------------------------------------------------------------------
+
+
+def _describe_schema(schema: FeatureSchema) -> tuple[str, list[FeatureSpec], list[str]]:
+    """→ (schema JSON for fastenc_create, array-id → spec order, pred keys).
+
+    Array ids: each spec gets one buffer; value specs get a second (mask)
+    buffer appended after all primary buffers."""
+    specs = list(schema.specs.values())
+    array_id = {spec.key: i for i, spec in enumerate(specs)}
+    mask_id: dict[str, int] = {}
+    next_id = len(specs)
+    for spec in specs:
+        if spec.kind == "value":
+            mask_id[spec.key] = next_id
+            next_id += 1
+    pred_keys: list[str] = []
+    pred_id: dict[str, int] = {}
+    for spec in specs:
+        if spec.kind == "pred":
+            pk = spec.pred_key()
+            if pk not in pred_id:
+                pred_id[pk] = len(pred_keys)
+                pred_keys.append(pk)
+
+    def elsize(spec: FeatureSpec) -> int:
+        return 4 if spec.kind == "value" and spec.dtype is not None and spec.dtype.value in ("id", "f32", "i32") else 1
+
+    arrays = [{"caps": list(s.caps), "elsize": elsize(s)} for s in specs]
+    arrays += [{"caps": list(s.caps), "elsize": 1} for s in specs if s.kind == "value"]
+
+    # Serialize the SAME trie the Python encoder walks (codec._build_trie):
+    # one source of truth for traversal order, caps, and overflow reporting.
+    def node_desc(node: Any) -> dict[str, Any]:
+        return {
+            "terminals": [
+                {
+                    "array": array_id[spec.key],
+                    "kind": _KIND[spec.kind],
+                    "dtype": _DTYPE[spec.dtype.value] if spec.dtype else 0,
+                    "mask": mask_id.get(spec.key, -1),
+                    "pred": (
+                        pred_id[spec.pred_key()] if spec.kind == "pred" else -1
+                    ),
+                }
+                for spec in node.terminals
+            ],
+            "children": {
+                seg: node_desc(child) for seg, child in node.children.items()
+            },
+            "star": node_desc(node.star) if node.star is not None else None,
+            "axis_cap": node.axis_cap,
+            "overflow_id": array_id.get(node.repr_key, -1),
+        }
+
+    doc = {"arrays": arrays, "trie": node_desc(schema._trie())}
+    return json.dumps(doc), specs, pred_keys
+
+
+class NativeEncoder:
+    """Per-schema native encoder instance (thread-safe for concurrent
+    encodes — all mutable state is per-call)."""
+
+    ARENA_CAP = 1 << 20
+    RECORDS_CAP = 1 << 16
+
+    def __init__(self, schema: FeatureSchema):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native encoder unavailable")
+        self._lib = lib
+        desc, self._specs, self._pred_keys = _describe_schema(schema)
+        raw = desc.encode()
+        self._handle = lib.fastenc_create(raw, len(raw))
+        if not self._handle:
+            raise RuntimeError("fastenc_create failed (bad schema description)")
+        self._value_specs = [s for s in self._specs if s.kind == "value"]
+
+    def __del__(self) -> None:  # pragma: no cover
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.fastenc_destroy(handle)
+
+    def encode_json(
+        self, payload_json: bytes, table: InternTable
+    ) -> dict[str, np.ndarray]:
+        """Encode raw JSON bytes → feature dict (same layout as
+        FeatureSchema.encode). Raises SchemaOverflow on axis overflow and
+        ValueError on malformed JSON."""
+        out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros((), dtype=np.bool_)}
+        buffers = (ctypes.c_void_p * (len(self._specs) + len(self._value_specs)))()
+        for i, spec in enumerate(self._specs):
+            arr = np.zeros(spec.caps, dtype=spec.np_dtype())
+            out[spec.key] = arr
+            buffers[i] = arr.ctypes.data_as(ctypes.c_void_p)
+        mi = len(self._specs)
+        for spec in self._value_specs:
+            arr = np.zeros(spec.caps, dtype=np.bool_)
+            out[mask_key_for(spec.key)] = arr
+            buffers[mi] = arr.ctypes.data_as(ctypes.c_void_p)
+            mi += 1
+        arena = ctypes.create_string_buffer(self.ARENA_CAP)
+        records = (ctypes.c_int32 * (self.RECORDS_CAP * 6))()
+        n = self._lib.fastenc_encode(
+            self._handle, payload_json, len(payload_json),
+            buffers, arena, self.ARENA_CAP,
+            ctypes.cast(records, ctypes.POINTER(ctypes.c_int32)),
+            self.RECORDS_CAP,
+        )
+        if n == -1:
+            raise ValueError("fastenc: malformed JSON payload")
+        if n == -2:
+            raise ValueError("fastenc: arena overflow")
+        if n < 0:
+            spec = self._specs[-(n + 1000)]
+            raise SchemaOverflow(spec.key, 0, -1, spec.caps[0] if spec.caps else 0)
+        # Python-side interning pass over the collected strings.
+        raw_arena = arena.raw
+        rec = np.frombuffer(records, dtype=np.int32, count=int(n) * 6).reshape(-1, 6)
+        for array_id, flat_off, is_pred, pred_idx, soff, slen in rec:
+            s = raw_arena[soff : soff + slen].decode("utf-8", "surrogatepass")
+            spec = self._specs[array_id]
+            arr = out[spec.key]
+            if is_pred:
+                arr.flat[flat_off] = table.pred_value(
+                    self._pred_keys[pred_idx], s
+                )
+            else:
+                arr.flat[flat_off] = table.intern(s)
+        return out
+
+    def encode(self, payload: Any, table: InternTable) -> dict[str, np.ndarray]:
+        return self.encode_json(
+            json.dumps(payload, separators=(",", ":")).encode(), table
+        )
+
+    def encode_batch(
+        self,
+        payload_jsons: list[bytes],
+        batch_size: int,
+        table: InternTable,
+    ) -> tuple[dict[str, np.ndarray], np.ndarray]:
+        """Encode a whole batch in ONE native call, rows written directly
+        into the stacked batch arrays (no per-request arrays, no re-stack).
+
+        → (features dict with leading batch axis of ``batch_size``,
+           per-row status: 0 ok, <0 failed — failed rows are all-missing
+           in the arrays and must be re-routed by the caller)."""
+        n = len(payload_jsons)
+        assert n <= batch_size
+        out: dict[str, np.ndarray] = {
+            BATCH_KEY: np.zeros(batch_size, dtype=np.bool_)
+        }
+        n_arrays = len(self._specs) + len(self._value_specs)
+        buffers = (ctypes.c_void_p * n_arrays)()
+        for i, spec in enumerate(self._specs):
+            arr = np.zeros((batch_size, *spec.caps), dtype=spec.np_dtype())
+            out[spec.key] = arr
+            buffers[i] = arr.ctypes.data_as(ctypes.c_void_p)
+        mi = len(self._specs)
+        for spec in self._value_specs:
+            arr = np.zeros((batch_size, *spec.caps), dtype=np.bool_)
+            out[mask_key_for(spec.key)] = arr
+            buffers[mi] = arr.ctypes.data_as(ctypes.c_void_p)
+            mi += 1
+        jsons = (ctypes.c_char_p * n)(*payload_jsons)
+        lens = (ctypes.c_int64 * n)(*[len(b) for b in payload_jsons])
+        arena_cap = max(self.ARENA_CAP, sum(len(b) for b in payload_jsons))
+        arena = ctypes.create_string_buffer(arena_cap)
+        records_cap = self.RECORDS_CAP * max(1, (n + 63) // 64)
+        records = (ctypes.c_int32 * (records_cap * 6))()
+        status = (ctypes.c_int32 * n)()
+        n_rec = self._lib.fastenc_encode_batch(
+            self._handle, jsons, lens, n,
+            buffers, arena, arena_cap,
+            ctypes.cast(records, ctypes.POINTER(ctypes.c_int32)), records_cap,
+            status,
+        )
+        if n_rec == -2:
+            raise ValueError("fastenc: arena/records overflow")
+        raw_arena = arena.raw
+        rec = np.frombuffer(
+            records, dtype=np.int32, count=int(n_rec) * 6
+        ).reshape(-1, 6)
+        specs = self._specs
+        pred_keys = self._pred_keys
+        for array_id, flat_off, is_pred, pred_idx, soff, slen in rec:
+            s = raw_arena[soff : soff + slen].decode("utf-8", "surrogatepass")
+            arr = out[specs[array_id].key]
+            if is_pred:
+                arr.flat[flat_off] = table.pred_value(pred_keys[pred_idx], s)
+            else:
+                arr.flat[flat_off] = table.intern(s)
+        return out, np.frombuffer(status, dtype=np.int32).copy()
+
+
+def attach_native(schema: FeatureSchema) -> bool:
+    """Give a FeatureSchema a native encoder (used by the evaluation
+    environment at boot). Returns False when the native path is
+    unavailable."""
+    try:
+        schema.native = NativeEncoder(schema)
+        return True
+    except (RuntimeError, OSError):
+        schema.native = None
+        return False

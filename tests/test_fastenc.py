@@ -1,0 +1,113 @@
+"""Native encoder differential tests: the C++ encoder (csrc/fastenc.cpp)
+must be bit-exact vs the Python trie encoder on every feature array, across
+the synthetic firehose, unicode/escape torture, overflow routing, and the
+batch API. Skipped when no C++ toolchain is available."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.ops import fastenc
+from policy_server_tpu.policies.flagship import flagship_policies, synthetic_firehose
+
+pytestmark = pytest.mark.skipif(
+    not fastenc.native_available(), reason="native encoder unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def env():
+    return EvaluationEnvironmentBuilder(backend="jax").build(flagship_policies())
+
+
+def to_request(doc: dict) -> ValidateRequest:
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def assert_encodings_equal(schema, table, payload) -> None:
+    py = schema.encode(payload, table)
+    nat = schema.native.encode(payload, table)
+    assert py.keys() == nat.keys()
+    for k in py:
+        assert np.array_equal(py[k], nat[k]), k
+
+
+def test_differential_firehose(env):
+    schema = env.schemas[0]
+    for doc in synthetic_firehose(200, seed=9):
+        assert_encodings_equal(schema, env.table, to_request(doc).payload())
+
+
+def test_differential_unicode_and_escapes(env):
+    doc = synthetic_firehose(1, seed=1)[0]
+    doc["request"]["object"]["metadata"]["labels"] = {
+        "app": "café-☃️",
+        'quote"key': "line1\nline2\tend \U0001f600",
+        "backslash\\key": "nul ctrl",
+    }
+    doc["request"]["object"]["metadata"]["annotations"] = {
+        "prod.example.com/debug": "true"
+    }
+    for schema in env.schemas:
+        assert_encodings_equal(schema, env.table, to_request(doc).payload())
+
+
+def test_differential_type_mismatches(env):
+    doc = synthetic_firehose(1, seed=2)[0]
+    pod = doc["request"]["object"]
+    # wrong-typed leaves must read as missing on both paths
+    pod["spec"]["containers"][0]["image"] = 42
+    pod["spec"]["hostNetwork"] = "yes"
+    pod["spec"]["containers"][0]["securityContext"] = {"privileged": "true"}
+    pod["metadata"]["labels"] = None
+    for schema in env.schemas:
+        assert_encodings_equal(schema, env.table, to_request(doc).payload())
+
+
+def test_batch_api_matches_single(env):
+    schema = env.schemas[0]
+    docs = synthetic_firehose(17, seed=5)
+    blobs = [to_request(d).payload_json() for d in docs]
+    batch, status = schema.native.encode_batch(blobs, 32, env.table)
+    assert (status == 0).all()
+    for row, d in enumerate(docs):
+        single = schema.native.encode(to_request(d).payload(), env.table)
+        for k, arr in single.items():
+            assert np.array_equal(batch[k][row], arr), k
+
+
+def test_batch_overflow_rows_flagged_and_zeroed(env):
+    schema = env.schemas[0]  # caps 8/4
+    ok_doc = synthetic_firehose(1, seed=6)[0]
+    big_doc = synthetic_firehose(1, seed=7)[0]
+    big_doc["request"]["object"]["spec"]["containers"] = [
+        {"name": f"c{i}", "image": "nginx"} for i in range(12)  # > cap 8
+    ]
+    blobs = [to_request(ok_doc).payload_json(), to_request(big_doc).payload_json()]
+    batch, status = schema.native.encode_batch(blobs, 2, env.table)
+    assert status[0] == 0 and status[1] < 0
+    # the failed row must read all-missing
+    for k, arr in batch.items():
+        if arr.ndim >= 1 and arr.shape[0] == 2:
+            assert not arr[1].any(), k
+
+
+def test_native_verdicts_match_oracle(env):
+    """End-to-end: native-encoded device verdicts == host oracle verdicts."""
+    oracle_env = EvaluationEnvironmentBuilder(backend="oracle").build(
+        flagship_policies()
+    )
+    docs = synthetic_firehose(64, seed=8)
+    items = [("pod-security-group", to_request(d)) for d in docs]
+    jax_results = env.validate_batch(items)
+    oracle_results = oracle_env.validate_batch(
+        [("pod-security-group", to_request(d)) for d in docs]
+    )
+    for a, b in zip(jax_results, oracle_results):
+        assert a.to_dict() == b.to_dict()
