@@ -241,7 +241,8 @@ struct EncodeState {
   uint8_t** buffers;       // array_id -> destination buffer
   std::string arena;       // collected ID/pred strings
   std::vector<StringRecord> records;
-  int32_t error_array = -1;  // set on axis overflow
+  int32_t error_array = -1;  // set on axis overflow / unencodable value
+  bool unencodable = false;  // out-of-range numeric → oracle fallback
   std::string scratch;
 };
 
@@ -265,7 +266,18 @@ struct Leaf {
   const std::string* s = nullptr;  // points into EncodeState scratch/owned
 };
 
-void emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
+// Out-of-range numerics must NOT silently truncate or read as missing —
+// either would give a different verdict than the oracle (fail-open). They
+// abort the row's encode; the host routes the request to the oracle
+// (mirrors codec.UnencodableValue).
+inline bool fits_i32(int64_t v) {
+  return v >= INT32_MIN && v <= INT32_MAX;
+}
+inline bool fits_f32(double v) {
+  return v == v && v <= 3.4028235677973366e38 && v >= -3.4028235677973366e38;
+}
+
+bool emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
                     const int32_t* coords, int depth) {
   for (const Terminal& t : node.terminals) {
     const ArrayInfo& a = st.schema->arrays[(size_t)t.array_id];
@@ -298,13 +310,24 @@ void emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
             break;
           case DT_F32:
             if (leaf.type == LEAF_INT || leaf.type == LEAF_FLOAT) {
-              ((float*)buf)[off] =
-                  (float)(leaf.type == LEAF_INT ? (double)leaf.inum : leaf.num);
+              double v =
+                  leaf.type == LEAF_INT ? (double)leaf.inum : leaf.num;
+              if (!fits_f32(v)) {
+                st.unencodable = true;
+                st.error_array = t.array_id;
+                return false;
+              }
+              ((float*)buf)[off] = (float)v;
               mask[off] = 1;
             }
             break;
           case DT_I32:
             if (leaf.type == LEAF_INT) {
+              if (!fits_i32(leaf.inum)) {
+                st.unencodable = true;
+                st.error_array = t.array_id;
+                return false;
+              }
               ((int32_t*)buf)[off] = (int32_t)leaf.inum;
               mask[off] = 1;
             }
@@ -320,6 +343,7 @@ void emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
       }
     }
   }
+  return true;
 }
 
 // Forward decl.
@@ -403,14 +427,15 @@ bool walk_star(EncodeState& st, Parser& ps, const Node& node, int32_t* coords,
       // The wrapper "element": terminals on the star node see a container.
       Leaf leaf;
       leaf.type = LEAF_CONTAINER;
-      emit_terminals(st, star, leaf, coords, depth + 1);
+      if (!emit_terminals(st, star, leaf, coords, depth + 1)) return false;
       // __key__ child
       auto kit = star.children.find("__key__");
       if (kit != star.children.end()) {
         Leaf kl;
         kl.type = LEAF_STR;
         kl.s = &e.first;
-        emit_terminals(st, *kit->second, kl, coords, depth + 1);
+        if (!emit_terminals(st, *kit->second, kl, coords, depth + 1))
+          return false;
         // __key__ has no deeper structure (it is a string)
       }
       // __value__ child: re-parse the buffered span
@@ -445,7 +470,7 @@ bool walk(EncodeState& st, Parser& ps, const Node& node, int32_t* coords,
     Leaf leaf;
     leaf.type = LEAF_STR;
     leaf.s = &st.scratch;
-    emit_terminals(st, node, leaf, coords, depth);
+    if (!emit_terminals(st, node, leaf, coords, depth)) return false;
     return true;
   }
   if (c == 't' || c == 'f') {
@@ -453,13 +478,13 @@ bool walk(EncodeState& st, Parser& ps, const Node& node, int32_t* coords,
     leaf.type = LEAF_BOOL;
     leaf.b = (c == 't');
     if (!(leaf.b ? ps.lit("true", 4) : ps.lit("false", 5))) return false;
-    emit_terminals(st, node, leaf, coords, depth);
+    if (!emit_terminals(st, node, leaf, coords, depth)) return false;
     return true;
   }
   if (c == 'n') {
     if (!ps.lit("null", 4)) return false;
     Leaf leaf;  // LEAF_NULL
-    emit_terminals(st, node, leaf, coords, depth);
+    if (!emit_terminals(st, node, leaf, coords, depth)) return false;
     return true;
   }
   if (c == '-' || (c >= '0' && c <= '9')) {
@@ -480,14 +505,14 @@ bool walk(EncodeState& st, Parser& ps, const Node& node, int32_t* coords,
       leaf.type = LEAF_INT;
       leaf.inum = strtoll(num.c_str(), nullptr, 10);
     }
-    emit_terminals(st, node, leaf, coords, depth);
+    if (!emit_terminals(st, node, leaf, coords, depth)) return false;
     return true;
   }
 
   // Containers: presence terminals fire, then children / star.
   Leaf leaf;
   leaf.type = LEAF_CONTAINER;
-  emit_terminals(st, node, leaf, coords, depth);
+  if (!emit_terminals(st, node, leaf, coords, depth)) return false;
 
   if (c == '{') {
     if (node.star) {

@@ -1,0 +1,87 @@
+"""Policy acquisition (reference src/policy_downloader.rs + policy-fetcher):
+downloader, artifact format, supply-chain verification, module resolution."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import TYPE_CHECKING, Callable
+
+from policy_server_tpu.fetch.artifact import (
+    ArtifactError,
+    ArtifactPolicyModule,
+    dump_artifact,
+    load_artifact,
+)
+from policy_server_tpu.fetch.downloader import (
+    Downloader,
+    FetchedPolicies,
+    FetchError,
+    iter_module_urls,
+)
+from policy_server_tpu.fetch.verify import (
+    VerificationError,
+    sign_artifact_bytes,
+    verify_artifact,
+    verify_local_checksum,
+)
+
+if TYPE_CHECKING:
+    from policy_server_tpu.config.config import Config
+    from policy_server_tpu.evaluation.precompiled import PolicyModule
+
+__all__ = [
+    "ArtifactError",
+    "ArtifactPolicyModule",
+    "Downloader",
+    "FetchError",
+    "FetchedPolicies",
+    "VerificationError",
+    "dump_artifact",
+    "iter_module_urls",
+    "load_artifact",
+    "make_module_resolver",
+    "sign_artifact_bytes",
+    "verify_artifact",
+    "verify_local_checksum",
+]
+
+
+def make_module_resolver(config: "Config") -> Callable[[str], "PolicyModule"]:
+    """The server's module resolver (lib.rs:134-143 download step folded
+    into evaluation bootstrap): builtin:// and known upstream refs resolve
+    natively; everything else is fetched into the download dir, verified
+    per verification.yml, and loaded as a `.tpp.json` IR artifact."""
+    from policy_server_tpu.policies import resolve_builtin
+
+    downloader = Downloader(
+        sources=config.sources,
+        verification_config=config.verification_config,
+        docker_config_json_path=config.docker_config_json_path,
+    )
+    dest = Path(config.policies_download_dir)
+    cache: dict[str, "PolicyModule"] = {}
+
+    def resolve(url: str) -> "PolicyModule":
+        if url in cache:
+            return cache[url]
+        builtin = resolve_builtin(url)
+        if builtin is not None:
+            cache[url] = builtin
+            return builtin
+        path = downloader.fetch_policy(url, dest)
+        digest = None
+        if config.verification_config is not None:
+            digest = verify_artifact(path, config.verification_config)
+        module = load_artifact(path)
+        if digest is not None and module.digest != digest:
+            # verify→load TOCTOU guard (the reference's post-download local
+            # checksum, policy_downloader.rs:157-176): the bytes LOADED must
+            # be the bytes VERIFIED
+            raise VerificationError(
+                f"artifact {path} changed between verification and load "
+                f"(verified {digest}, loaded {module.digest})"
+            )
+        cache[url] = module
+        return module
+
+    return resolve

@@ -275,7 +275,12 @@ def _walk_trie(
 ) -> None:
     for spec in node.terminals:
         if spec.kind == "value":
-            ok, converted = _convert(value, spec.dtype, table)
+            try:
+                ok, converted = _convert(value, spec.dtype, table)
+            except UnencodableValue:
+                # fail the whole encode → wider bucket won't help, the
+                # environment routes the request to the oracle
+                raise SchemaOverflow(spec.key, -1, 0, 0) from None
             if ok:
                 out[spec.key][coords] = converted
                 out[_mask_key(spec.key)][coords] = True
@@ -312,9 +317,22 @@ def mask_key_for(value_key: str) -> str:
     return _mask_key(value_key)
 
 
+class UnencodableValue(Exception):
+    """A well-typed value that does not FIT the tensor dtype (out-of-range
+    int32/float32). Treating it as missing would fail OPEN (the oracle sees
+    the real value and may reject); the encoder instead fails the request's
+    encoding so it routes to the host oracle."""
+
+
+_I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
+_F32_MAX = 3.4028235677973366e38
+
+
 def _convert(v: Any, dtype: DType, table: InternTable) -> tuple[bool, Any]:
-    """JSON leaf → typed scalar; type mismatch means missing (mask=0).
-    Mirrored exactly by the oracle interpreter (evaluation/oracle.py)."""
+    """JSON leaf → typed scalar; type mismatch means missing (mask=0);
+    out-of-range numerics raise UnencodableValue (oracle fallback).
+    Mirrored exactly by the oracle interpreter (evaluation/oracle.py) and
+    the native encoder (csrc/fastenc.cpp)."""
     if dtype is DType.ID:
         if isinstance(v, str):
             return True, table.intern(v)
@@ -322,7 +340,10 @@ def _convert(v: Any, dtype: DType, table: InternTable) -> tuple[bool, Any]:
     if dtype is DType.F32:
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             return False, 0.0
-        return True, float(v)
+        f = float(v)
+        if f != f or abs(f) > _F32_MAX:
+            raise UnencodableValue(f"value {v!r} does not fit float32")
+        return True, f
     if dtype is DType.BOOL:
         if isinstance(v, bool):
             return True, v
@@ -330,6 +351,8 @@ def _convert(v: Any, dtype: DType, table: InternTable) -> tuple[bool, Any]:
     if dtype is DType.I32:
         if isinstance(v, bool) or not isinstance(v, int):
             return False, 0
+        if not (_I32_MIN <= v <= _I32_MAX):
+            raise UnencodableValue(f"value {v!r} does not fit int32")
         return True, int(v)
     raise AssertionError(dtype)
 

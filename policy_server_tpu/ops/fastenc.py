@@ -184,6 +184,7 @@ class NativeEncoder:
         if not self._handle:
             raise RuntimeError("fastenc_create failed (bad schema description)")
         self._value_specs = [s for s in self._specs if s.kind == "value"]
+        self._scratch = threading.local()
 
     def __del__(self) -> None:  # pragma: no cover
         lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
@@ -275,22 +276,31 @@ class NativeEncoder:
         jsons = (ctypes.c_char_p * n)(*payload_jsons)
         lens = (ctypes.c_int64 * n)(*[len(b) for b in payload_jsons])
         arena_cap = max(self.ARENA_CAP, sum(len(b) for b in payload_jsons))
-        arena = ctypes.create_string_buffer(arena_cap)
         records_cap = self.RECORDS_CAP * max(1, (n + 63) // 64)
-        records = (ctypes.c_int32 * (records_cap * 6))()
+        # Reusable per-thread scratch: allocating+zeroing tens of MB per
+        # dispatch would dominate the very path this encoder accelerates.
+        scratch = self._scratch
+        arena = getattr(scratch, "arena", None)
+        if arena is None or len(arena) < arena_cap:
+            arena = scratch.arena = ctypes.create_string_buffer(arena_cap)
+        records = getattr(scratch, "records", None)
+        if records is None or len(records) < records_cap * 6:
+            records = scratch.records = (ctypes.c_int32 * (records_cap * 6))()
         status = (ctypes.c_int32 * n)()
         n_rec = self._lib.fastenc_encode_batch(
             self._handle, jsons, lens, n,
-            buffers, arena, arena_cap,
-            ctypes.cast(records, ctypes.POINTER(ctypes.c_int32)), records_cap,
+            buffers, arena, len(arena),
+            ctypes.cast(records, ctypes.POINTER(ctypes.c_int32)),
+            len(records) // 6,
             status,
         )
         if n_rec == -2:
             raise ValueError("fastenc: arena/records overflow")
-        raw_arena = arena.raw
         rec = np.frombuffer(
             records, dtype=np.int32, count=int(n_rec) * 6
         ).reshape(-1, 6)
+        used = int((rec[:, 4] + rec[:, 5]).max()) if n_rec else 0
+        raw_arena = ctypes.string_at(arena, used)
         specs = self._specs
         pred_keys = self._pred_keys
         for array_id, flat_off, is_pred, pred_idx, soff, slen in rec:

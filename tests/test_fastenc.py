@@ -111,3 +111,60 @@ def test_native_verdicts_match_oracle(env):
     )
     for a, b in zip(jax_results, oracle_results):
         assert a.to_dict() == b.to_dict()
+
+
+def test_out_of_range_int_routes_to_oracle(tmp_path):
+    """Regression (fail-open): an int that doesn't fit int32 must not
+    truncate or read as missing — both encoders fail the encode and the
+    environment answers via the oracle, matching oracle semantics."""
+    import json
+
+    from policy_server_tpu.config.config import Config
+    from policy_server_tpu.fetch import dump_artifact, make_module_resolver
+    from policy_server_tpu.ops import ir
+    from policy_server_tpu.ops.codec import SchemaOverflow
+    from policy_server_tpu.ops.compiler import Rule
+    from policy_server_tpu.ops.ir import DType, Path as IRPath
+
+    src = tmp_path / "cap.tpp.json"
+    src.write_text(
+        json.dumps(
+            dump_artifact(
+                "replica-cap",
+                [
+                    Rule(
+                        "cap",
+                        ir.gt(IRPath("object.spec.replicas", DType.I32), 3),
+                        "too many replicas",
+                    )
+                ],
+            )
+        )
+    )
+    policies = {
+        "replica-cap": parse_policy_entry(
+            "replica-cap", {"module": f"file://{src}"}
+        )
+    }
+    config = Config(policies=policies, policies_download_dir=str(tmp_path / "s"))
+    jax_env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=make_module_resolver(config)
+    ).build(policies)
+
+    doc = synthetic_firehose(1, seed=3)[0]
+    doc["request"]["object"]["spec"] = {"replicas": 2**33}  # >> int32
+    req = to_request(doc)
+
+    # python encoder refuses
+    with pytest.raises(SchemaOverflow):
+        jax_env.schemas[-1].encode(req.payload(), jax_env.table)
+    # native batch flags the row
+    _, status = jax_env.schemas[-1].native.encode_batch(
+        [req.payload_json()], 1, jax_env.table
+    )
+    assert status[0] != 0
+    # end to end: verdict comes from the oracle and REJECTS (2**33 > 3)
+    before = jax_env.oracle_fallbacks
+    resp = jax_env.validate_batch([("replica-cap", req)])[0]
+    assert not resp.allowed and resp.status.message == "too many replicas"
+    assert jax_env.oracle_fallbacks == before + 1

@@ -1,0 +1,237 @@
+"""TLS tests over real sockets (reference integration_test.rs:576-794 cert
+rotation with rcgen-generated certs, and the TLS/mTLS matrix at 1017-1144):
+serving with TLS, mTLS accept/reject, hot rotation semantics (both files
+changed → swap; one file changed → keep old identity)."""
+
+from __future__ import annotations
+
+import datetime
+import socket
+import ssl
+
+import pytest
+import requests
+
+from policy_server_tpu import certs as certs_mod
+from policy_server_tpu.config.config import TlsConfig
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from test_server import ServerHandle, make_config, pod_review_body
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def make_cert(cn: str, issuer_key=None, issuer_name=None, is_ca=False):
+    """→ (key, cert). Self-signed when no issuer is given."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    subject = _name(cn)
+    issuer = issuer_name if issuer_name is not None else subject
+    signing_key = issuer_key if issuer_key is not None else key
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(issuer)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=None), critical=True
+        )
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"), x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+    )
+    cert = builder.sign(signing_key, hashes.SHA256())
+    return key, cert
+
+
+def write_pem(tmp_path, name, key, cert):
+    cert_path = tmp_path / f"{name}.crt"
+    key_path = tmp_path / f"{name}.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    return cert_path, key_path
+
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    ca_key, ca_cert = make_cert("test-ca", is_ca=True)
+    srv_key, srv_cert = make_cert(
+        "localhost", issuer_key=ca_key, issuer_name=ca_cert.subject
+    )
+    cert_path, key_path = write_pem(tmp_path, "server", srv_key, srv_cert)
+    ca_path = tmp_path / "ca.crt"
+    ca_path.write_bytes(ca_cert.public_bytes(serialization.Encoding.PEM))
+    config = make_config(
+        tls_config=TlsConfig(cert_file=str(cert_path), key_file=str(key_path))
+    )
+    handle = ServerHandle(config)
+    yield handle, tmp_path, (ca_key, ca_cert), (cert_path, key_path), ca_path
+    handle.stop()
+    rel = getattr(handle.server.tls_context, "_reloadable", None)
+    if rel:
+        rel.stop()
+
+
+def https_url(handle: ServerHandle, path: str) -> str:
+    return f"https://127.0.0.1:{handle.server.api_port}{path}"
+
+
+def serial_of_served_cert(port: int) -> int:
+    raw = ssl.get_server_certificate(("127.0.0.1", port))
+    cert = x509.load_pem_x509_certificate(raw.encode())
+    return cert.serial_number
+
+
+def test_tls_serving_and_verification(tls_server):
+    handle, tmp_path, _, _, ca_path = tls_server
+    r = requests.post(
+        https_url(handle, "/validate/pod-privileged"),
+        json=pod_review_body(False),
+        verify=str(ca_path),
+        timeout=30,
+    )
+    assert r.status_code == 200 and r.json()["response"]["allowed"] is True
+    # wrong CA → TLS failure
+    with pytest.raises(requests.exceptions.SSLError):
+        requests.post(
+            https_url(handle, "/validate/pod-privileged"),
+            json=pod_review_body(False),
+            verify=True,
+            timeout=30,
+        )
+
+
+def test_certificate_hot_rotation_both_files(tls_server):
+    """Both cert+key replaced → the served identity swaps within the watch
+    interval (integration_test.rs:576-722)."""
+    import time
+
+    handle, tmp_path, (ca_key, ca_cert), (cert_path, key_path), ca_path = tls_server
+    before = serial_of_served_cert(handle.server.api_port)
+    new_key, new_cert = make_cert(
+        "localhost", issuer_key=ca_key, issuer_name=ca_cert.subject
+    )
+    cert_path.write_bytes(new_cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        new_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if serial_of_served_cert(handle.server.api_port) == new_cert.serial_number:
+            break
+        time.sleep(0.25)
+    after = serial_of_served_cert(handle.server.api_port)
+    assert after == new_cert.serial_number and after != before
+    # still serves requests with the new identity
+    r = requests.post(
+        https_url(handle, "/validate/pod-privileged"),
+        json=pod_review_body(False),
+        verify=str(ca_path),
+        timeout=30,
+    )
+    assert r.status_code == 200
+
+
+def test_certificate_rotation_single_file_ignored(tls_server):
+    """Only the cert replaced (key unchanged) → identity must NOT swap
+    (integration_test.rs:724-742)."""
+    import time
+
+    handle, tmp_path, (ca_key, ca_cert), (cert_path, key_path), _ = tls_server
+    before = serial_of_served_cert(handle.server.api_port)
+    new_key, new_cert = make_cert(
+        "localhost", issuer_key=ca_key, issuer_name=ca_cert.subject
+    )
+    cert_path.write_bytes(new_cert.public_bytes(serialization.Encoding.PEM))
+    time.sleep(2.5)  # > watch interval
+    assert serial_of_served_cert(handle.server.api_port) == before
+
+
+def test_mtls_requires_client_cert(tmp_path):
+    ca_key, ca_cert = make_cert("test-ca", is_ca=True)
+    client_ca_key, client_ca_cert = make_cert("client-ca", is_ca=True)
+    srv_key, srv_cert = make_cert(
+        "localhost", issuer_key=ca_key, issuer_name=ca_cert.subject
+    )
+    cert_path, key_path = write_pem(tmp_path, "server", srv_key, srv_cert)
+    ca_path = tmp_path / "ca.crt"
+    ca_path.write_bytes(ca_cert.public_bytes(serialization.Encoding.PEM))
+    client_ca_path = tmp_path / "client-ca.crt"
+    client_ca_path.write_bytes(
+        client_ca_cert.public_bytes(serialization.Encoding.PEM)
+    )
+    client_key, client_cert = make_cert(
+        "client", issuer_key=client_ca_key, issuer_name=client_ca_cert.subject
+    )
+    client_cert_path, client_key_path = write_pem(
+        tmp_path, "client", client_key, client_cert
+    )
+    config = make_config(
+        tls_config=TlsConfig(
+            cert_file=str(cert_path),
+            key_file=str(key_path),
+            client_ca_file=(str(client_ca_path),),
+        )
+    )
+    handle = ServerHandle(config)
+    try:
+        # with client cert: accepted
+        r = requests.post(
+            https_url(handle, "/validate/pod-privileged"),
+            json=pod_review_body(False),
+            verify=str(ca_path),
+            cert=(str(client_cert_path), str(client_key_path)),
+            timeout=30,
+        )
+        assert r.status_code == 200
+        # without client cert: TLS-level rejection
+        with pytest.raises(requests.exceptions.SSLError):
+            requests.post(
+                https_url(handle, "/validate/pod-privileged"),
+                json=pod_review_body(False),
+                verify=str(ca_path),
+                timeout=30,
+            )
+    finally:
+        handle.stop()
+
+
+def test_multi_cert_file_rejected(tmp_path):
+    key, cert = make_cert("localhost")
+    pem = cert.public_bytes(serialization.Encoding.PEM)
+    cert_path = tmp_path / "two.crt"
+    cert_path.write_bytes(pem + pem)
+    key_path = tmp_path / "one.key"
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    with pytest.raises(certs_mod.TlsConfigError, match="one certificate"):
+        certs_mod.build_tls_server_config(
+            TlsConfig(cert_file=str(cert_path), key_file=str(key_path))
+        )
