@@ -133,6 +133,11 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
         ("--no-warmup", "KUBEWARDEN_NO_WARMUP",
          dict(action="store_true",
               help="Skip AOT compilation of the policy program at boot")),
+        ("--compilation-cache-dir", "KUBEWARDEN_COMPILATION_CACHE_DIR",
+         dict(default=None, metavar="DIR",
+              help="Persistent XLA compilation cache directory: compiled "
+                   "policy programs survive restarts (the TPU analog of the "
+                   "reference's policies-download store reuse)")),
     ]
 
 

@@ -160,6 +160,7 @@ class Config:
     batch_timeout_ms: float = 1.0
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
+    compilation_cache_dir: str | None = None
 
     def validate(self) -> None:
         self.tls_config.validate()
@@ -240,6 +241,7 @@ class Config:
             batch_timeout_ms=float(args.batch_timeout_ms),
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
+            compilation_cache_dir=args.compilation_cache_dir,
         )
         cfg.validate()
         return cfg

@@ -1,0 +1,208 @@
+"""Cluster-state snapshot service (see package docstring for the design).
+
+``KubeApiFetcher`` is the in-cluster client (reference kube::Client,
+src/lib.rs:96-104): service-account token + CA from the standard pod paths,
+LIST per allowlisted resource. Connection failure at boot is fatal unless
+``--ignore-kubernetes-connection-failure`` (lib.rs:106-123)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Iterable, Mapping
+
+import requests
+
+from policy_server_tpu.models.policy import ContextAwareResource
+from policy_server_tpu.telemetry.tracing import logger
+
+CONTEXT_KEY = "__context__"
+
+SERVICE_ACCOUNT_DIR = Path("/var/run/secrets/kubernetes.io/serviceaccount")
+
+# Core-group kinds → plural list endpoints (the subset Kubewarden's
+# context-aware policies commonly use; anything else goes through the
+# apiVersion path form directly).
+_CORE_PLURALS = {
+    "Namespace": "namespaces",
+    "Pod": "pods",
+    "Service": "services",
+    "ConfigMap": "configmaps",
+    "Secret": "secrets",
+    "ServiceAccount": "serviceaccounts",
+}
+_NAMED_PLURALS = {
+    "Deployment": "deployments",
+    "ReplicaSet": "replicasets",
+    "StatefulSet": "statefulsets",
+    "DaemonSet": "daemonsets",
+    "Ingress": "ingresses",
+    "Job": "jobs",
+    "CronJob": "cronjobs",
+}
+
+
+def resource_key(resource: ContextAwareResource) -> str:
+    """Snapshot key for one allowlisted kind: ``apiVersion/Kind`` (IR paths
+    address it as ``__context__.<apiVersion/Kind>[*]...``)."""
+    return f"{resource.api_version}/{resource.kind}"
+
+
+class KubeConnectionError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class ContextSnapshot:
+    """Immutable view of the allowlisted cluster state."""
+
+    version: int
+    taken_at: float
+    resources: Mapping[str, tuple[Any, ...]]  # key → list of objects
+
+    def view(self, allowlist: Iterable[ContextAwareResource]) -> dict[str, list]:
+        """The capability-filtered slice a single policy may see
+        (EvaluationContext allowlist parity)."""
+        out: dict[str, list] = {}
+        for r in allowlist:
+            key = resource_key(r)
+            out[key] = list(self.resources.get(key, ()))
+        return out
+
+
+EMPTY_SNAPSHOT = ContextSnapshot(version=0, taken_at=0.0, resources={})
+
+
+class StaticContextFetcher:
+    """Test/dev fetcher: serves fixed (mutable) resource collections."""
+
+    def __init__(self, resources: Mapping[str, list] | None = None):
+        self.resources = dict(resources or {})
+
+    def fetch(
+        self, wanted: Iterable[ContextAwareResource]
+    ) -> dict[str, tuple[Any, ...]]:
+        return {
+            resource_key(r): tuple(self.resources.get(resource_key(r), ()))
+            for r in wanted
+        }
+
+
+class KubeApiFetcher:
+    """Minimal in-cluster LIST client over the pod service account."""
+
+    def __init__(
+        self,
+        api_server: str | None = None,
+        token: str | None = None,
+        ca_file: str | None = None,
+    ):
+        self.api_server = api_server or "https://kubernetes.default.svc"
+        token_path = SERVICE_ACCOUNT_DIR / "token"
+        ca_path = SERVICE_ACCOUNT_DIR / "ca.crt"
+        if token is None:
+            if not token_path.exists():
+                raise KubeConnectionError(
+                    "no service-account token found "
+                    f"({token_path}); not running in a cluster?"
+                )
+            token = token_path.read_text().strip()
+        self.token = token
+        self.ca_file = ca_file or (str(ca_path) if ca_path.exists() else None)
+        # probe the API server (kube::Client::try_default analog)
+        try:
+            resp = self._get("/version")
+        except requests.RequestException as e:
+            raise KubeConnectionError(f"cannot reach the Kubernetes API: {e}") from e
+        if resp.status_code >= 500:
+            raise KubeConnectionError(
+                f"Kubernetes API error: HTTP {resp.status_code}"
+            )
+
+    def _get(self, path: str) -> requests.Response:
+        return requests.get(
+            f"{self.api_server}{path}",
+            headers={"Authorization": f"Bearer {self.token}"},
+            verify=self.ca_file if self.ca_file else False,
+            timeout=15,
+        )
+
+    def _list_path(self, resource: ContextAwareResource) -> str:
+        api_version, kind = resource.api_version, resource.kind
+        if api_version == "v1":
+            plural = _CORE_PLURALS.get(kind, kind.lower() + "s")
+            return f"/api/v1/{plural}"
+        plural = _NAMED_PLURALS.get(kind, kind.lower() + "s")
+        return f"/apis/{api_version}/{plural}"
+
+    def fetch(
+        self, wanted: Iterable[ContextAwareResource]
+    ) -> dict[str, tuple[Any, ...]]:
+        out: dict[str, tuple[Any, ...]] = {}
+        for r in wanted:
+            resp = self._get(self._list_path(r))
+            if resp.status_code != 200:
+                logger.error(
+                    "context list %s failed: HTTP %s",
+                    resource_key(r), resp.status_code,
+                )
+                out[resource_key(r)] = ()
+                continue
+            out[resource_key(r)] = tuple(resp.json().get("items") or ())
+        return out
+
+
+class ContextSnapshotService:
+    """Background refresher holding the current immutable snapshot."""
+
+    def __init__(
+        self,
+        fetcher: Any,
+        wanted: Iterable[ContextAwareResource] = (),
+        refresh_seconds: float = 30.0,
+    ):
+        self.fetcher = fetcher
+        self.wanted = frozenset(wanted)
+        self.refresh_seconds = refresh_seconds
+        self._snapshot = EMPTY_SNAPSHOT
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def snapshot(self) -> ContextSnapshot:
+        with self._lock:
+            return self._snapshot
+
+    def refresh(self) -> ContextSnapshot:
+        resources = self.fetcher.fetch(self.wanted)
+        with self._lock:
+            self._snapshot = ContextSnapshot(
+                version=self._snapshot.version + 1,
+                taken_at=time.time(),
+                resources=resources,
+            )
+            return self._snapshot
+
+    def start(self) -> "ContextSnapshotService":
+        self.refresh()  # boot-time prefetch: first request sees real state
+        if self._thread is None and self.wanted:
+            def loop() -> None:
+                while not self._stop.wait(self.refresh_seconds):
+                    try:
+                        self.refresh()
+                    except Exception as e:  # noqa: BLE001 — keep last good
+                        logger.error("context refresh failed: %s", e)
+
+            self._thread = threading.Thread(
+                target=loop, name="context-snapshot", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -64,6 +64,7 @@ from policy_server_tpu.models.policy import (
     PolicyMode,
     PolicyOrPolicyGroup,
 )
+from policy_server_tpu.context.service import CONTEXT_KEY
 from policy_server_tpu.ops.codec import (
     BATCH_KEY,
     DEFAULT_AXIS_CAP,
@@ -112,6 +113,10 @@ class BoundPolicy:
     module_url: str
     precompiled: PrecompiledPolicy
     eval_settings: PolicyEvaluationSettings
+    # per-policy cluster-state capability allowlist (reference
+    # EvaluationContext.ctx_aware_resources_allow_list,
+    # evaluation_environment.rs:243-247)
+    ctx_allowlist: frozenset = frozenset()
 
 
 @dataclass
@@ -148,6 +153,7 @@ class EvaluationEnvironmentBuilder:
         small_axis_cap: int = 8,
         small_nested_axis_cap: int = 4,
         always_accept_admission_reviews_on_namespace: str | None = None,
+        context_service: Any = None,
     ) -> None:
         self.backend = backend
         self.continue_on_errors = continue_on_errors
@@ -157,6 +163,7 @@ class EvaluationEnvironmentBuilder:
         self.small_axis_cap = small_axis_cap
         self.small_nested_axis_cap = small_nested_axis_cap
         self.always_accept_namespace = always_accept_admission_reviews_on_namespace
+        self.context_service = context_service
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
         cache = ProgramCache()
@@ -170,6 +177,7 @@ class EvaluationEnvironmentBuilder:
             settings: Mapping[str, Any] | None,
             policy_mode: PolicyMode,
             allowed_to_mutate: bool,
+            ctx_allowlist: frozenset = frozenset(),
         ) -> BoundPolicy:
             module = self.module_resolver(module_url)
             validation = module.validate_settings(dict(settings or {}))
@@ -188,6 +196,7 @@ class EvaluationEnvironmentBuilder:
                     allowed_to_mutate=allowed_to_mutate,
                     settings=dict(settings or {}),
                 ),
+                ctx_allowlist=ctx_allowlist,
             )
 
         for name, entry in policies.items():
@@ -199,6 +208,7 @@ class EvaluationEnvironmentBuilder:
                         entry.settings,
                         entry.policy_mode,
                         bool(entry.allowed_to_mutate),
+                        entry.context_aware_resources,
                     )
                 elif isinstance(entry, PolicyGroup):
                     ast = groups_mod.validate_expression(
@@ -219,6 +229,7 @@ class EvaluationEnvironmentBuilder:
                             member.settings,
                             entry.policy_mode,
                             False,  # group members never mutate (rs group ban)
+                            member.context_aware_resources,
                         )
                     groups[name] = group
                     for member_name, bp in group.members.items():
@@ -248,6 +259,7 @@ class EvaluationEnvironmentBuilder:
             small_axis_cap=self.small_axis_cap,
             small_nested_axis_cap=self.small_nested_axis_cap,
             always_accept_namespace=self.always_accept_namespace,
+            context_service=self.context_service,
         )
 
 
@@ -269,9 +281,11 @@ class EvaluationEnvironment:
         small_axis_cap: int = 8,
         small_nested_axis_cap: int = 4,
         always_accept_namespace: str | None = None,
+        context_service: Any = None,
     ) -> None:
         self.backend = backend
         self.always_accept_namespace = always_accept_namespace
+        self.context_service = context_service
         self._bound = bound
         self._groups = groups
         self._init_errors = init_errors
@@ -400,6 +414,35 @@ class EvaluationEnvironment:
             and namespace == self.always_accept_namespace
         )
 
+    def _allowlist_of(self, target: "BoundPolicy | BoundGroup") -> frozenset:
+        if isinstance(target, BoundGroup):
+            out: set = set()
+            for bp in target.members.values():
+                out |= bp.ctx_allowlist
+            return frozenset(out)
+        return target.ctx_allowlist
+
+    def payload_for(self, target: "BoundPolicy | BoundGroup", request: ValidateRequest) -> Any:
+        """The evaluation payload: the request document, plus — for
+        context-aware policies — the capability-filtered cluster snapshot
+        under ``__context__`` (context/service.py; the reference's
+        EvaluationContext allowlist, evaluation_environment.rs:243-247)."""
+        payload = request.payload()
+        allowlist = self._allowlist_of(target)
+        if not allowlist or self.context_service is None:
+            return payload
+        snapshot = self.context_service.snapshot()
+        payload = dict(payload)
+        payload[CONTEXT_KEY] = snapshot.view(allowlist)
+        return payload
+
+    def _payload_blob(self, target: "BoundPolicy | BoundGroup", request: ValidateRequest) -> bytes:
+        if self._allowlist_of(target) and self.context_service is not None:
+            return json.dumps(
+                self.payload_for(target, request), separators=(",", ":")
+            ).encode()
+        return request.payload_json()
+
     def has_policy(self, policy_id: str) -> bool:
         try:
             self._lookup_top_level(PolicyID.parse(policy_id))
@@ -516,7 +559,7 @@ class EvaluationEnvironment:
         """Reference EvaluationEnvironment::validate (rs:546-556)."""
         pid = PolicyID.parse(policy_id)
         target = self._lookup_top_level(pid)
-        payload = request.payload()
+        payload = self.payload_for(target, request)
         self._run_pre_eval_hooks(target, payload)
 
         if self.backend == "oracle":
@@ -611,7 +654,7 @@ class EvaluationEnvironment:
             try:
                 target = self._lookup_top_level(PolicyID.parse(policy_id))
                 targets[i] = target
-                payload = request.payload()
+                payload = self.payload_for(target, request)
                 if run_hooks:
                     self._run_pre_eval_hooks(target, payload)
                 if self.backend == "oracle":
@@ -626,7 +669,7 @@ class EvaluationEnvironment:
                 with self._fallback_lock:
                     self.oracle_fallbacks += 1
                 results[i] = self._materialize(
-                    target, request, self._oracle_outputs(request.payload())
+                    target, request, self._oracle_outputs(payload)
                 )
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
@@ -676,7 +719,8 @@ class EvaluationEnvironment:
                 self.oracle_fallbacks += 1
             policy_id, request = items[i]
             results[i] = self._materialize(
-                targets[i], request, self._oracle_outputs(request.payload())
+                targets[i], request,
+                self._oracle_outputs(self.payload_for(targets[i], request)),
             )
         return results  # type: ignore[return-value]
 
@@ -712,7 +756,7 @@ class EvaluationEnvironment:
                 results[i] = self._materialize(targets[i], request, per_row)
 
         for chunk in chunks:
-            blobs = [items[i][1].payload_json() for i in chunk]
+            blobs = [self._payload_blob(targets[i], items[i][1]) for i in chunk]
             try:
                 features, status = schema.native.encode_batch(
                     blobs, self.bucket_for(len(blobs)), self.table

@@ -47,6 +47,13 @@ from policy_server_tpu.utils.interning import MISSING_ID, InternTable
 DEFAULT_AXIS_CAP = 64
 DEFAULT_NESTED_AXIS_CAP = 32
 
+# Cluster-state snapshot paths (__context__.<apiVersion/Kind>[*]...) carry
+# whole resource collections, not per-request arrays — they get their own,
+# larger element-axis caps in every shape bucket.
+CONTEXT_PREFIX = "__context__"
+CONTEXT_AXIS_CAP = 256
+CONTEXT_NESTED_AXIS_CAP = 32
+
 # Reserved feature carrying only the batch dimension — lets constant-only
 # programs (e.g. the always-happy fixture) produce (B,)-shaped outputs.
 BATCH_KEY = "__batch__"
@@ -119,9 +126,12 @@ class FeatureSchema:
             n = sum(1 for s in segs if s == STAR)
             if n == 0:
                 return ()
+            a, na = axis_cap, nested_axis_cap
+            if segs and segs[0] == CONTEXT_PREFIX:
+                a, na = CONTEXT_AXIS_CAP, CONTEXT_NESTED_AXIS_CAP
             if n == 1:
-                return (_pow2_cap(axis_cap),)
-            return (_pow2_cap(axis_cap), _pow2_cap(nested_axis_cap))
+                return (_pow2_cap(a),)
+            return (_pow2_cap(a), _pow2_cap(na))
 
         def add(spec: FeatureSpec) -> None:
             specs.setdefault(spec.key, spec)

@@ -665,7 +665,39 @@ def _get(payload: Any, *keys: str) -> Any:
     return cur
 
 
+class NamespaceExists(BuiltinPolicy):
+    """Context-aware policy: the request's namespace must exist in the
+    cluster snapshot (the TPU-native shape of the reference's context-aware
+    policies — data arrives via the ``__context__`` snapshot injected per
+    the policy's contextAwareResources allowlist, SURVEY.md §2.2
+    callback_handler row). Requires ``contextAwareResources: [{apiVersion:
+    v1, kind: Namespace}]`` in policies.yml; without the capability the
+    snapshot slice is empty and every namespaced request is rejected
+    (fail-closed, like a reference policy whose kube calls are denied)."""
+
+    name = "namespace-exists"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        known = AnyOf(
+            Path("__context__.v1/Namespace"),
+            eq(Elem("metadata.name"), Path("namespace")),
+        )
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "unknown-namespace",
+                    Exists(Path("namespace")) & ~known,
+                    lambda payload: (
+                        f"namespace '{_get(payload, 'namespace')}' does not "
+                        "exist in the cluster"
+                    ),
+                ),
+            )
+        )
+
+
 ALL_FAMILIES: tuple[type[BuiltinPolicy], ...] = (
+    NamespaceExists,
     AlwaysHappy,
     AlwaysUnhappy,
     Sleeping,

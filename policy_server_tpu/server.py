@@ -68,6 +68,15 @@ class PolicyServer:
             setup_metrics()
         if config.enable_pprof:
             profiling.activate_memory_profiling()
+        if config.compilation_cache_dir:
+            # persistent XLA compilation cache: warmed policy programs
+            # survive restarts (SURVEY.md §5 checkpoint/resume row)
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir", config.compilation_cache_dir
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
         resolver = module_resolver
         if resolver is None and (config.sources or config.verification_config
@@ -82,6 +91,8 @@ class PolicyServer:
                 ) from e
             resolver = make_module_resolver(config)
 
+        context_service = _build_context_service(config)
+
         builder = EvaluationEnvironmentBuilder(
             backend=config.evaluation_backend,
             continue_on_errors=config.continue_on_errors,
@@ -89,6 +100,7 @@ class PolicyServer:
             always_accept_admission_reviews_on_namespace=(
                 config.always_accept_admission_reviews_on_namespace
             ),
+            context_service=context_service,
         )
         environment = builder.build(config.policies)
 
@@ -242,6 +254,44 @@ def _bound_port(runner: web.AppRunner) -> int | None:
         if server and server.sockets:
             return server.sockets[0].getsockname()[1]
     return None
+
+
+def _build_context_service(config: Config):
+    """Context-snapshot bring-up (reference kube::Client bootstrap,
+    lib.rs:91-125): only when some policy declares contextAwareResources;
+    connection failure is fatal unless --ignore-kubernetes-connection-failure
+    (lib.rs:106-123), in which case context-aware policies see an empty
+    cluster."""
+    wanted: set = set()
+    for entry in config.policies.values():
+        if hasattr(entry, "context_aware_resources"):
+            wanted |= set(entry.context_aware_resources)
+        elif hasattr(entry, "policies"):
+            for member in entry.policies.values():
+                wanted |= set(member.context_aware_resources)
+    if not wanted:
+        return None
+    from policy_server_tpu.context import (
+        ContextSnapshotService,
+        KubeApiFetcher,
+        KubeConnectionError,
+        StaticContextFetcher,
+    )
+
+    try:
+        fetcher = KubeApiFetcher()
+    except KubeConnectionError as e:
+        if not config.ignore_kubernetes_connection_failure:
+            raise RuntimeError(
+                f"cannot connect to the Kubernetes API: {e} "
+                "(use --ignore-kubernetes-connection-failure to boot anyway)"
+            ) from e
+        logger.error(
+            "Kubernetes connection failed, context-aware policies will see "
+            "an empty cluster: %s", e,
+        )
+        fetcher = StaticContextFetcher()
+    return ContextSnapshotService(fetcher, wanted).start()
 
 
 def _needs_fetch(config: Config) -> bool:

@@ -1,0 +1,112 @@
+"""Context-aware policy tests: snapshot service semantics, per-policy
+capability allowlists (EvaluationContext parity), jax-vs-oracle agreement
+with injected context, snapshot refresh, and the fail-closed empty-cluster
+behavior."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.context import (
+    ContextSnapshotService,
+    StaticContextFetcher,
+)
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+
+from conftest import build_admission_review_dict
+
+NS_ALLOWLIST = [{"apiVersion": "v1", "kind": "Namespace"}]
+
+
+def ns_object(name: str) -> dict:
+    return {"metadata": {"name": name}}
+
+
+def make_service(namespaces: list[str]) -> ContextSnapshotService:
+    fetcher = StaticContextFetcher(
+        {"v1/Namespace": [ns_object(n) for n in namespaces]}
+    )
+    from policy_server_tpu.models.policy import ContextAwareResource
+
+    service = ContextSnapshotService(
+        fetcher,
+        wanted=[ContextAwareResource("v1", "Namespace")],
+    )
+    service.refresh()
+    return service
+
+
+def request_in(namespace: str) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["namespace"] = namespace
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def build_env(backend: str, service, with_allowlist: bool = True):
+    entry = {
+        "module": "builtin://namespace-exists",
+        **({"contextAwareResources": NS_ALLOWLIST} if with_allowlist else {}),
+    }
+    return EvaluationEnvironmentBuilder(
+        backend=backend, context_service=service
+    ).build({"ns-exists": parse_policy_entry("ns-exists", entry)})
+
+
+@pytest.mark.parametrize("backend", ["jax", "oracle"])
+def test_namespace_exists_against_snapshot(backend):
+    service = make_service(["default", "prod"])
+    env = build_env(backend, service)
+    assert env.validate("ns-exists", request_in("prod")).allowed
+    resp = env.validate("ns-exists", request_in("ghost"))
+    assert not resp.allowed
+    assert "ghost" in resp.status.message
+
+
+def test_jax_matches_oracle_with_context():
+    service = make_service(["a", "b", "team-x"])
+    jax_env = build_env("jax", service)
+    oracle_env = build_env("oracle", service)
+    for ns in ("a", "b", "team-x", "nope", "A"):
+        r1 = jax_env.validate("ns-exists", request_in(ns))
+        r2 = oracle_env.validate("ns-exists", request_in(ns))
+        assert r1.to_dict() == r2.to_dict(), ns
+
+
+def test_without_allowlist_policy_sees_empty_cluster():
+    """Capability enforcement: no contextAwareResources declaration → the
+    snapshot slice is empty → fail-closed."""
+    service = make_service(["default"])
+    env = build_env("jax", service, with_allowlist=False)
+    assert not env.validate("ns-exists", request_in("default")).allowed
+
+
+def test_snapshot_refresh_changes_verdicts():
+    fetcher = StaticContextFetcher({"v1/Namespace": [ns_object("old")]})
+    from policy_server_tpu.models.policy import ContextAwareResource
+
+    service = ContextSnapshotService(
+        fetcher, wanted=[ContextAwareResource("v1", "Namespace")]
+    )
+    service.refresh()
+    env = build_env("jax", service)
+    assert not env.validate("ns-exists", request_in("new")).allowed
+    fetcher.resources["v1/Namespace"] = [ns_object("old"), ns_object("new")]
+    service.refresh()
+    assert env.validate("ns-exists", request_in("new")).allowed
+    assert service.snapshot().version == 2
+
+
+def test_batched_context_evaluation():
+    service = make_service(["default", "prod"])
+    env = build_env("jax", service)
+    items = [
+        ("ns-exists", request_in("default")),
+        ("ns-exists", request_in("ghost")),
+        ("ns-exists", request_in("prod")),
+    ]
+    results = env.validate_batch(items)
+    assert [r.allowed for r in results] == [True, False, True]

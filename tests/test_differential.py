@@ -1,0 +1,96 @@
+"""The differential oracle harness (SURVEY.md §7.2 step 11): replay a
+randomized AdmissionReview corpus through the JAX/TPU backend and the host
+oracle and require BIT-EXACT responses — the stand-in for the reference's
+wasm-vs-native verdict equivalence (north star: "bit-exact vs the WASM
+backend", BASELINE.md)."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.policies.flagship import flagship_policies, synthetic_firehose
+
+
+def to_request(doc: dict) -> ValidateRequest:
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+@pytest.fixture(scope="module")
+def envs():
+    jax_env = EvaluationEnvironmentBuilder(backend="jax").build(
+        flagship_policies()
+    )
+    oracle_env = EvaluationEnvironmentBuilder(backend="oracle").build(
+        flagship_policies()
+    )
+    return jax_env, oracle_env
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_firehose_differential_all_policies(envs, seed):
+    """Every synthetic request × every top-level policy id: the two
+    backends must produce byte-identical AdmissionResponses."""
+    jax_env, oracle_env = envs
+    docs = synthetic_firehose(48, seed=seed)
+    policy_ids = [
+        pid for pid in jax_env.policy_ids()
+    ]
+    items = []
+    for i, doc in enumerate(docs):
+        items.append((policy_ids[i % len(policy_ids)], to_request(doc)))
+    jax_results = jax_env.validate_batch(items)
+    oracle_results = oracle_env.validate_batch(
+        [(pid, to_request(docs[i])) for i, (pid, _) in enumerate(items)]
+    )
+    mismatches = []
+    for (pid, _), a, b in zip(items, jax_results, oracle_results):
+        da = a.to_dict() if not isinstance(a, Exception) else repr(a)
+        db = b.to_dict() if not isinstance(b, Exception) else repr(b)
+        if da != db:
+            mismatches.append((pid, da, db))
+    assert not mismatches, mismatches[:3]
+
+
+def test_adversarial_shapes_differential(envs):
+    """Deliberately nasty shapes: empty docs, null subtrees, wrong types,
+    deep arrays at the caps, empty strings, duplicate containers."""
+    jax_env, oracle_env = envs
+    nasty_objects = [
+        {},
+        {"spec": None},
+        {"spec": {"containers": []}},
+        {"spec": {"containers": None}},
+        {"spec": {"containers": [{}] * 8}},
+        {"spec": {"containers": [{"image": ""}]}},
+        {"spec": {"containers": [{"image": None, "securityContext": []}]}},
+        {"metadata": {"labels": {}, "annotations": None}},
+        {"metadata": {"labels": {"owner": "", "cost-center": None}}},
+        {
+            "spec": {
+                "containers": [
+                    {"securityContext": {"capabilities": {"add": ["SYS_ADMIN"] * 4}}}
+                ]
+                * 4
+            }
+        },
+        {"spec": {"hostNetwork": "true"}},  # wrong type
+        {"spec": {"replicas": 3.5}},
+    ]
+    base = synthetic_firehose(1, seed=7)[0]
+    policy_ids = jax_env.policy_ids()
+    for i, obj in enumerate(nasty_objects):
+        doc = {
+            "apiVersion": base["apiVersion"],
+            "kind": base["kind"],
+            "request": dict(base["request"]),
+        }
+        doc["request"]["object"] = obj
+        req_a, req_b = to_request(doc), to_request(doc)
+        for pid in policy_ids[:: max(1, len(policy_ids) // 7)]:
+            a = jax_env.validate(pid, req_a)
+            b = oracle_env.validate(pid, req_b)
+            assert a.to_dict() == b.to_dict(), (i, pid)
