@@ -93,16 +93,14 @@ class PolicyServer:
 
         context_service = _build_context_service(config)
 
-        builder = EvaluationEnvironmentBuilder(
-            backend=config.evaluation_backend,
-            continue_on_errors=config.continue_on_errors,
+        builder_kwargs = dict(
             module_resolver=resolver,
             always_accept_admission_reviews_on_namespace=(
                 config.always_accept_admission_reviews_on_namespace
             ),
             context_service=context_service,
         )
-        environment = builder.build(config.policies)
+        environment = _build_environment(config, builder_kwargs)
 
         batcher = MicroBatcher(
             environment,
@@ -292,6 +290,59 @@ def _build_context_service(config: Config):
         )
         fetcher = StaticContextFetcher()
     return ContextSnapshotService(fetcher, wanted).start()
+
+
+def _build_environment(config: Config, builder_kwargs: dict):
+    """Build the evaluation environment, honoring ``config.mesh``.
+
+    TPU-first serving topology (SURVEY.md §2.3 last row; the reference's
+    scale-out is replicas behind a Service, README.md:21-26):
+
+    * ``policy`` axis > 1 → :class:`PolicyShardedEvaluator` — MPMD over
+      submeshes, each policy shard data-parallel within its row.
+    * otherwise, with >1 device on the mesh → one fused program with
+      batch-sharded (data-parallel) dispatch via ``attach_mesh``.
+    * single device (the default ``auto`` spec on a 1-chip host) → plain
+      single-device environment, unchanged.
+    """
+    mesh = None
+    if config.evaluation_backend == "jax":
+        from policy_server_tpu.parallel import make_mesh
+
+        mesh = make_mesh(config.mesh)
+        if config.mesh.policy_size() > 1:
+            from policy_server_tpu.parallel import PolicyShardedEvaluator
+
+            sharded = PolicyShardedEvaluator(
+                config.policies,
+                mesh,
+                backend=config.evaluation_backend,
+                continue_on_errors=config.continue_on_errors,
+                builder_kwargs=builder_kwargs,
+            )
+            logger.info(
+                "policy-sharded mesh attached",
+                extra={"span_fields": {
+                    "mesh": dict(config.mesh.axes),
+                    "shards": len(sharded.shards),
+                }},
+            )
+            return sharded
+
+    builder = EvaluationEnvironmentBuilder(
+        backend=config.evaluation_backend,
+        continue_on_errors=config.continue_on_errors,
+        **builder_kwargs,
+    )
+    environment = builder.build(config.policies)
+    if mesh is not None and mesh.devices.size > 1:
+        environment.attach_mesh(mesh)
+        logger.info(
+            "data-parallel mesh attached",
+            extra={"span_fields": {"mesh": dict(config.mesh.axes),
+                                   "devices": int(mesh.devices.size)}},
+        )
+    return environment
 
 
 def _needs_fetch(config: Config) -> bool:

@@ -1,0 +1,91 @@
+"""--mesh must change the actual serving path (VERDICT r1 #1b).
+
+The reference's scale-out is replicas behind a Service (README.md:21-26);
+here the equivalent is the device mesh, so the server bootstrap must build
+it and serve through it — not parse the flag and drop it. Runs on the
+8-virtual-CPU-device platform from conftest.py (the v5e-8 stand-in)."""
+
+from __future__ import annotations
+
+import requests
+
+from policy_server_tpu.config.config import MeshSpec
+from policy_server_tpu.parallel import PolicyShardedEvaluator
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from test_server import ServerHandle, make_config, pod_review_body
+
+
+def test_data_mesh_attached_and_serving():
+    """--mesh data:8 → one fused program, batch-sharded over 8 devices."""
+    metrics_mod.reset_metrics_for_tests()
+    handle = ServerHandle(make_config(mesh=MeshSpec.parse("data:8")))
+    try:
+        env = handle.server.environment
+        assert env._mesh is not None, "--mesh did not attach a mesh"
+        assert env._mesh.devices.size == 8
+        assert env._min_bucket == 8  # batches pad to the data-axis size
+
+        r = requests.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(True), timeout=60,
+        )
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is False
+        r = requests.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(False), timeout=60,
+        )
+        assert r.json()["response"]["allowed"] is True
+    finally:
+        handle.stop()
+
+
+def test_policy_sharded_mesh_serving():
+    """--mesh data:4,policy:2 → MPMD PolicyShardedEvaluator in the server."""
+    metrics_mod.reset_metrics_for_tests()
+    handle = ServerHandle(
+        make_config(mesh=MeshSpec.parse("data:4,policy:2"))
+    )
+    try:
+        env = handle.server.environment
+        assert isinstance(env, PolicyShardedEvaluator)
+        assert len(env.shards) == 2
+        # every shard's fused program is data-parallel over its submesh row
+        for shard in env.shards:
+            assert shard._mesh is not None
+            assert shard._mesh.devices.size == 4
+
+        # verdicts route to the owning shard over the real HTTP path
+        for pid, priv, expect in [
+            ("pod-privileged", True, False),
+            ("pod-privileged", False, True),
+            ("group", False, True),
+        ]:
+            r = requests.post(
+                handle.url(f"/validate/{pid}"),
+                json=pod_review_body(priv), timeout=60,
+            )
+            assert r.status_code == 200, (pid, r.text)
+            assert r.json()["response"]["allowed"] is expect, pid
+        # unknown policy still 404s through the sharded router
+        r = requests.post(
+            handle.url("/validate/nope"), json=pod_review_body(False),
+            timeout=60,
+        )
+        assert r.status_code == 404
+    finally:
+        handle.stop()
+
+
+def test_default_auto_mesh_uses_all_devices():
+    """The default 'auto' spec data-parallelizes over every visible device
+    (TPU-first default: no flag needed to use the whole slice)."""
+    metrics_mod.reset_metrics_for_tests()
+    handle = ServerHandle(make_config())
+    try:
+        env = handle.server.environment
+        assert env._mesh is not None
+        assert env._mesh.devices.size == 8
+    finally:
+        handle.stop()
