@@ -93,7 +93,7 @@ async def _evaluate(
     """Dispatch through the batcher; map EvaluationError → ApiError
     responses (handlers.rs:321-342)."""
     try:
-        future = state.batcher.submit(policy_id, request, origin)
+        future = await state.batcher.submit_async(policy_id, request, origin)
         return await asyncio.wrap_future(future)
     except PolicyNotFoundError as e:
         return api_error(404, str(e))

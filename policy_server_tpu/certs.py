@@ -104,10 +104,31 @@ class _WatchedFile:
 
 
 class ReloadableTlsContext:
-    """The wrapper context handed to the listener + the reload machinery."""
+    """The wrapper context handed to the listener + the reload machinery.
+
+    Two independent reload paths (certs.rs:118-150):
+
+    * server identity — applied only when BOTH cert and key changed; the
+      new pair is validated, snapshotted as the last-good identity, the
+      inner context rebuilt, and the OUTER context's cert chain refreshed
+      in place (``load_cert_chain`` on a live context affects new
+      handshakes) so clients whose handshake never reaches the SNI
+      callback — OpenSSL only invokes it when the ClientHello carries the
+      extension — still see the rotated certificate.
+    * client CAs — reloaded independently, against the last-good identity
+      SNAPSHOT (never re-read from disk), so a CA rotation during a
+      half-finished identity rotation neither fails nor silently swaps the
+      server identity.
+    """
 
     def __init__(self, tls_config: TlsConfig):
         self.tls_config = tls_config
+        # last-good identity snapshot: CA-only reloads rebuild from these
+        # bytes, never from (possibly mid-rotation) files on disk
+        self._identity = (
+            _validate_cert_file(tls_config.cert_file),
+            _validate_key_file(tls_config.key_file),
+        )
         self._inner = build_tls_server_config(tls_config)
         self.outer = build_tls_server_config(tls_config)
         self.outer.sni_callback = self._sni_callback
@@ -130,28 +151,35 @@ class ReloadableTlsContext:
 
         def loop() -> None:
             while not self._stop.wait(WATCH_INTERVAL_SECONDS):
-                try:
-                    cert_changed, key_changed = cert.changed(), key.changed()
-                    ca_changed = any(ca.changed() for ca in cas)
-                    if ca_changed or (cert_changed and key_changed):
-                        self._reload()
+                cert_changed, key_changed = cert.changed(), key.changed()
+                if cert_changed and key_changed:
+                    try:
+                        self._reload_identity()
                         cert.refresh()
                         key.refresh()
+                        logger.info(
+                            "TLS server identity reloaded",
+                            extra={"span_fields": {"server_identity": True}},
+                        )
+                    except Exception as e:  # noqa: BLE001 — keep old identity
+                        logger.error(
+                            "TLS identity reload failed, keeping previous: %s", e
+                        )
+                # a single cert-or-key change is ignored until its pair
+                # arrives (certs.rs:135-150)
+                if any(ca.changed() for ca in cas):
+                    try:
+                        self._reload_client_cas()
                         for ca in cas:
                             ca.refresh()
                         logger.info(
-                            "TLS configuration reloaded",
-                            extra={
-                                "span_fields": {
-                                    "server_identity": cert_changed and key_changed,
-                                    "client_cas": ca_changed,
-                                }
-                            },
+                            "TLS client CAs reloaded",
+                            extra={"span_fields": {"client_cas": True}},
                         )
-                    # a single cert-or-key change is ignored until its pair
-                    # arrives (certs.rs:135-150)
-                except Exception as e:  # noqa: BLE001 — keep old identity
-                    logger.error("TLS reload failed, keeping previous: %s", e)
+                    except Exception as e:  # noqa: BLE001 — keep old CAs
+                        logger.error(
+                            "TLS client-CA reload failed, keeping previous: %s", e
+                        )
 
         self._thread = threading.Thread(
             target=loop, name="tls-cert-watcher", daemon=True
@@ -159,10 +187,66 @@ class ReloadableTlsContext:
         self._thread.start()
         return self
 
-    def _reload(self) -> None:
-        new_inner = build_tls_server_config(self.tls_config)
+    def _with_identity_files(self, cert_bytes: bytes, key_bytes: bytes, fn):
+        """Run ``fn(cert_path, key_path)`` against temp files holding the
+        given identity bytes — a single, consistent source for every
+        context (re)construction: disk is read exactly once per reload."""
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+            cf.write(cert_bytes)
+            cf.flush()
+            kf.write(key_bytes)
+            kf.flush()
+            return fn(cf.name, kf.name)
+
+    def _build_inner(self, cert_bytes: bytes, key_bytes: bytes) -> ssl.SSLContext:
+        """One construction path for every inner context:
+        build_tls_server_config over the snapshot bytes, so TLS hardening
+        added to the builder keeps applying after reloads."""
+        from dataclasses import replace
+
+        return self._with_identity_files(
+            cert_bytes, key_bytes,
+            lambda cert, key: build_tls_server_config(
+                replace(self.tls_config, cert_file=cert, key_file=key)
+            ),
+        )
+
+    def _reload_identity(self) -> None:
+        # read + validate exactly once; all contexts below use these bytes
+        new_identity = (
+            _validate_cert_file(self.tls_config.cert_file),
+            _validate_key_file(self.tls_config.key_file),
+        )
+        new_inner = self._build_inner(*new_identity)
+
+        def swap(cert_path: str, key_path: str) -> None:
+            with self._lock:
+                # outer refresh first — it is the fallible step (in-place
+                # load on the live context); only after it succeeds is any
+                # state mutated, so a failure leaves BOTH paths on the old
+                # identity and the 'keeping previous' log is truthful
+                self.outer.load_cert_chain(cert_path, key_path)
+                self._identity = new_identity
+                self._inner = new_inner
+                self.reloads += 1
+
+        self._with_identity_files(*new_identity, swap)
+
+    def _reload_client_cas(self) -> None:
+        """Rebuild trust state from current CA files + the last-good
+        identity snapshot (identity files on disk are NOT consulted)."""
+        cert_bytes, key_bytes = self._identity
+        ctx = self._build_inner(cert_bytes, key_bytes)
         with self._lock:
-            self._inner = new_inner
+            self._inner = ctx
+            # outer: CA additions apply to non-SNI clients too (the ssl
+            # module cannot drop CAs from a live context; removals take
+            # effect for SNI handshakes via the fresh inner context)
+            for ca in self.tls_config.client_ca_file:
+                self.outer.load_verify_locations(cafile=ca)
             self.reloads += 1
 
     def stop(self) -> None:

@@ -85,6 +85,12 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
          dict(action="store_true",
               help="Do not exit with an error if the Kubernetes connection fails; "
                    "context-aware policies will break")),
+        ("--kube-insecure-skip-tls-verify",
+         "KUBEWARDEN_KUBE_INSECURE_SKIP_TLS_VERIFY",
+         dict(action="store_true",
+              help="Skip TLS verification of the Kubernetes API server "
+                   "(explicit opt-in; without it, a missing cluster CA falls "
+                   "back to the system trust store)")),
         ("--always-accept-admission-reviews-on-namespace",
          "KUBEWARDEN_ALWAYS_ACCEPT_ADMISSION_REVIEWS_ON_NAMESPACE",
          dict(default=None, metavar="NAMESPACE",

@@ -140,6 +140,7 @@ class Config:
     policy_timeout_seconds: float = 2.0  # cli.rs:164-169 default 2 s
     disable_timeout_protection: bool = False
     ignore_kubernetes_connection_failure: bool = False
+    kube_insecure_skip_tls_verify: bool = False
     always_accept_admission_reviews_on_namespace: str | None = None
     continue_on_errors: bool = False
     enable_metrics: bool = False
@@ -221,6 +222,7 @@ class Config:
             policy_timeout_seconds=float(args.policy_timeout),
             disable_timeout_protection=args.disable_timeout_protection,
             ignore_kubernetes_connection_failure=args.ignore_kubernetes_connection_failure,
+            kube_insecure_skip_tls_verify=args.kube_insecure_skip_tls_verify,
             always_accept_admission_reviews_on_namespace=(
                 args.always_accept_admission_reviews_on_namespace or None
             ),

@@ -98,8 +98,10 @@ class KubeApiFetcher:
         api_server: str | None = None,
         token: str | None = None,
         ca_file: str | None = None,
+        insecure_skip_tls_verify: bool = False,
     ):
         self.api_server = api_server or "https://kubernetes.default.svc"
+        self.insecure_skip_tls_verify = insecure_skip_tls_verify
         token_path = SERVICE_ACCOUNT_DIR / "token"
         ca_path = SERVICE_ACCOUNT_DIR / "ca.crt"
         if token is None:
@@ -122,10 +124,18 @@ class KubeApiFetcher:
             )
 
     def _get(self, path: str) -> requests.Response:
+        # No silent TLS bypass to the API server: without a cluster CA the
+        # system trust store is used (and fails loudly on self-signed
+        # clusters); verification is skipped ONLY on explicit operator
+        # opt-in (the reference's kube client refuses likewise).
+        if self.insecure_skip_tls_verify:
+            verify: bool | str = False
+        else:
+            verify = self.ca_file if self.ca_file else True
         return requests.get(
             f"{self.api_server}{path}",
             headers={"Authorization": f"Bearer {self.token}"},
-            verify=self.ca_file if self.ca_file else False,
+            verify=verify,
             timeout=15,
         )
 

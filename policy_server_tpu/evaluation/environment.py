@@ -702,7 +702,11 @@ class EvaluationEnvironment:
                 target = self._lookup_top_level(PolicyID.parse(policy_id))
                 targets[i] = target
                 if run_hooks:
-                    self._run_pre_eval_hooks(target, request.payload())
+                    # payload_for, not payload(): hooks must observe the
+                    # same (context-snapshotted) input on every path
+                    self._run_pre_eval_hooks(
+                        target, self.payload_for(target, request)
+                    )
                 pending.append(i)
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e

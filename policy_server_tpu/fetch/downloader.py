@@ -134,21 +134,49 @@ class Downloader:
         """Fetch one module URL into the store; returns the local path.
         Files are stored content-addressed (digest-named) so identical
         modules dedup across URLs and restarts reuse the store
-        (policy_downloader.rs:129-134)."""
+        (policy_downloader.rs:129-134).
+
+        The detached-signature sidecar travels WITH the artifact: verify
+        runs against the stored path, so the sidecar must land at
+        ``<stored>.sig.json`` (for file:// it is copied, for https://
+        downloaded from ``<url>.sig.json``, for registry:// pulled from the
+        cosign-convention tag ``sha256-<digest>.sig``)."""
         parsed = urllib.parse.urlparse(url)
         if parsed.scheme == "file":
             src = Path(parsed.path)
             if not src.exists():
                 raise FetchError(f"file not found: {src}")
-            return self._store(dest_dir, src.read_bytes(), src.suffix)
+            path = self._store(dest_dir, src.read_bytes(), src.suffix)
+            sidecar = Path(str(src) + ".sig.json")
+            if sidecar.exists():
+                self._store_sidecar(path, sidecar.read_bytes())
+            return path
         if parsed.scheme in ("http", "https"):
             data = self._http_get(url, parsed.hostname or "")
             suffix = Path(parsed.path).suffix or ".artifact"
-            return self._store(dest_dir, data, suffix)
+            path = self._store(dest_dir, data, suffix)
+            if self.verification_config is not None:
+                try:
+                    sig = self._http_get(url + ".sig.json", parsed.hostname or "")
+                    self._store_sidecar(path, sig)
+                except FetchError:
+                    pass  # unsigned artifact; verification decides the fate
+            return path
         if parsed.scheme == "registry":
             data, suffix = self._fetch_oci(parsed)
-            return self._store(dest_dir, data, suffix)
+            path = self._store(dest_dir, data, suffix)
+            if self.verification_config is not None:
+                sig = self._fetch_oci_signature(parsed, data)
+                if sig is not None:
+                    self._store_sidecar(path, sig)
+            return path
         raise FetchError(f"unsupported module URL scheme: {url}")
+
+    def _store_sidecar(self, artifact_path: Path, sidecar_bytes: bytes) -> None:
+        sidecar_path = Path(str(artifact_path) + ".sig.json")
+        tmp = sidecar_path.with_suffix(sidecar_path.suffix + ".tmp")
+        tmp.write_bytes(sidecar_bytes)
+        tmp.replace(sidecar_path)
 
     def _store(self, dest_dir: Path, data: bytes, suffix: str) -> Path:
         dest_dir.mkdir(parents=True, exist_ok=True)
@@ -234,6 +262,26 @@ class Downloader:
             ".tpp.json" if "tpp" in media_type or "json" in media_type else ".artifact"
         )
         return blob, suffix
+
+    def _fetch_oci_signature(
+        self, parsed: urllib.parse.ParseResult, artifact_bytes: bytes
+    ) -> bytes | None:
+        """Pull the detached-signature sidecar stored at the
+        cosign-convention tag ``sha256-<digest>.sig`` in the same repo; None
+        when absent (verification then sees zero signatures)."""
+        host = parsed.netloc
+        name, _ = _split_ref(parsed.path.lstrip("/"))
+        digest = hashlib.sha256(artifact_bytes).hexdigest()
+        sig_ref = urllib.parse.ParseResult(
+            scheme="registry", netloc=host,
+            path=f"/{name}:sha256-{digest}.sig",
+            params="", query="", fragment="",
+        )
+        try:
+            blob, _ = self._fetch_oci(sig_ref)
+            return blob
+        except (FetchError, KeyError, ValueError):
+            return None
 
     def _oci_get(
         self,

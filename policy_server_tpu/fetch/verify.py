@@ -8,12 +8,18 @@ The reference's sigstore keyless flow (Fulcio/Rekor over TUF) requires
 network egress to the public good instance; the hermetic TPU build
 implements the ``pubKey`` requirement kind with REAL Ed25519 signature
 verification (`cryptography`), plus digest pinning. An artifact is
-accompanied by a detached signature document ``<artifact>.sig.json``:
+accompanied by a detached signature document ``<artifact>.sig.json``
+holding simplesigning-style entries — the signature covers a canonical
+payload that binds BOTH the artifact digest and the annotations, the way
+sigstore's simplesigning payload does (annotations in the unsigned sidecar
+would otherwise be attacker-editable):
 
 ```json
 {"signatures": [
-  {"keyid": "...", "signature": "<base64 Ed25519 over the artifact bytes>",
-   "annotations": {"env": "prod"}}
+  {"keyid": "...",
+   "payload": "<base64 canonical JSON {critical:{artifact:{sha256-digest},
+               type}, optional:{annotations}}>",
+   "signature": "<base64 Ed25519 over the payload bytes>"}
 ]}
 ```
 
@@ -44,11 +50,30 @@ class VerificationError(Exception):
     pass
 
 
+SIGNATURE_PAYLOAD_TYPE = "tpp-policy-signature"
+
+
 @dataclass(frozen=True)
 class ArtifactSignature:
     keyid: str
     signature: bytes
-    annotations: Mapping[str, str]
+    payload: bytes  # the signed canonical simplesigning-style document
+
+
+def make_signature_payload(
+    digest_hex: str, annotations: Mapping[str, str] | None = None
+) -> bytes:
+    """Canonical signed payload: digest + annotations under one signature
+    (sigstore simplesigning analog — annotations are cryptographically
+    bound, not sidecar metadata)."""
+    doc = {
+        "critical": {
+            "artifact": {"sha256-digest": digest_hex},
+            "type": SIGNATURE_PAYLOAD_TYPE,
+        },
+        "optional": dict(annotations or {}),
+    }
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
 
 
 def load_signatures(artifact_path: str | Path) -> list[ArtifactSignature]:
@@ -63,7 +88,7 @@ def load_signatures(artifact_path: str | Path) -> list[ArtifactSignature]:
                 ArtifactSignature(
                     keyid=str(s.get("keyid", "")),
                     signature=base64.b64decode(s["signature"]),
-                    annotations=dict(s.get("annotations") or {}),
+                    payload=base64.b64decode(s["payload"]),
                 )
             )
         return out
@@ -73,7 +98,7 @@ def load_signatures(artifact_path: str | Path) -> list[ArtifactSignature]:
 
 def _requirement_matches(
     req: SignatureRequirement,
-    artifact_bytes: bytes,
+    artifact_digest: str,
     signatures: list[ArtifactSignature],
 ) -> tuple[bool, str]:
     """→ (matched, reason-if-not)."""
@@ -91,12 +116,27 @@ def _requirement_matches(
         return False, "pubKey must be an Ed25519 public key"
     for sig in signatures:
         try:
-            key.verify(sig.signature, artifact_bytes)
+            key.verify(sig.signature, sig.payload)
         except InvalidSignature:
+            continue
+        # Signature is authentic for this key: now bind it to THIS artifact
+        # and read annotations from the SIGNED payload only.
+        try:
+            payload = json.loads(sig.payload)
+            critical = payload["critical"]
+            signed_digest = critical["artifact"]["sha256-digest"]
+            payload_type = critical["type"]
+            signed_annotations = dict(payload.get("optional") or {})
+        except (ValueError, KeyError, TypeError):
+            continue
+        if payload_type != SIGNATURE_PAYLOAD_TYPE:
+            continue
+        if signed_digest != artifact_digest:
             continue
         if req.annotations:
             if any(
-                sig.annotations.get(k) != v for k, v in req.annotations.items()
+                signed_annotations.get(k) != v
+                for k, v in req.annotations.items()
             ):
                 continue
         return True, ""
@@ -118,14 +158,14 @@ def verify_artifact(
 
     failures: list[str] = []
     for req in config.all_of:
-        ok, why = _requirement_matches(req, data, signatures)
+        ok, why = _requirement_matches(req, digest, signatures)
         if not ok:
             failures.append(f"allOf requirement not satisfied: {why}")
     if config.any_of is not None:
         matched = 0
         reasons: list[str] = []
         for req in config.any_of.signatures:
-            ok, why = _requirement_matches(req, data, signatures)
+            ok, why = _requirement_matches(req, digest, signatures)
             if ok:
                 matched += 1
             else:
@@ -156,8 +196,7 @@ def verify_local_checksum(artifact_path: str | Path, expected_digest: str) -> No
 
 
 def sign_artifact_bytes(private_key_pem: bytes, data: bytes) -> bytes:
-    """Authoring/test helper: Ed25519 detached signature over artifact
-    bytes."""
+    """Authoring/test helper: Ed25519 detached signature over raw bytes."""
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -168,3 +207,21 @@ def sign_artifact_bytes(private_key_pem: bytes, data: bytes) -> bytes:
     key = load_pem_private_key(private_key_pem, password=None)
     assert isinstance(key, Ed25519PrivateKey)
     return key.sign(data)
+
+
+def make_signature_entry(
+    private_key_pem: bytes,
+    artifact_bytes: bytes,
+    keyid: str = "",
+    annotations: Mapping[str, str] | None = None,
+) -> dict[str, str]:
+    """Authoring/test helper: one sidecar ``signatures[]`` entry — canonical
+    payload (digest + annotations) signed with Ed25519."""
+    digest = hashlib.sha256(artifact_bytes).hexdigest()
+    payload = make_signature_payload(digest, annotations)
+    signature = sign_artifact_bytes(private_key_pem, payload)
+    return {
+        "keyid": keyid,
+        "payload": base64.b64encode(payload).decode(),
+        "signature": base64.b64encode(signature).decode(),
+    }

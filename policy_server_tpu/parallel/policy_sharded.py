@@ -99,6 +99,11 @@ class PolicyShardedEvaluator:
 
         return pre_eval_hooks_of(target)
 
+    def payload_for(self, target, request):  # MicroBatcher compatibility
+        # the context service is shared across shard builders, so any shard
+        # produces the same snapshot view
+        return self.shards[0].payload_for(target, request)
+
     def _lookup_top_level(self, pid):
         return self._shard_of(str(pid))._lookup_top_level(pid)
 

@@ -132,20 +132,61 @@ class MicroBatcher:
         origin: service.RequestOrigin,
     ) -> Future:
         """Enqueue one evaluation; Future resolves to AdmissionResponse or
-        raises EvaluationError. A full queue rejects immediately in-band
-        (the analog of waiting on the reference's semaphore — but bounded,
-        so overload degrades with a clear signal instead of unbounded
-        latency)."""
+        raises EvaluationError. A full queue WAITS for space — the analog of
+        the reference waiting on its semaphore (handlers.rs:262-266) — but
+        bounded by the policy timeout, so a burst is absorbed and only
+        sustained overload degrades, with a clear in-band 429."""
+        pending = _Pending(policy_id, request, origin, Future())
+        try:
+            if self.policy_timeout is None:
+                self._queue.put(pending)  # reference parity: unbounded wait
+            else:
+                self._queue.put(pending, timeout=self.policy_timeout)
+        except queue.Full:
+            self._reject_overloaded(pending)
+        return pending.future
+
+    async def submit_async(
+        self,
+        policy_id: str,
+        request: ValidateRequest,
+        origin: service.RequestOrigin,
+    ) -> Future:
+        """submit() for event-loop callers: waits for queue space without
+        blocking the loop. The fast path is a lock-free put; a full queue
+        parks the wait on an executor thread so it reuses the queue's FIFO
+        condition-variable wait — waiters are admitted oldest-first, same
+        as the sync path and the reference's semaphore."""
+        import asyncio
+
         pending = _Pending(policy_id, request, origin, Future())
         try:
             self._queue.put_nowait(pending)
+            return pending.future
         except queue.Full:
-            pending.future.set_result(
-                AdmissionResponse.reject(
-                    request.uid(), "policy server overloaded", 429
-                )
-            )
+            pass
+
+        def blocking_put() -> None:
+            try:
+                if self.policy_timeout is None:
+                    self._queue.put(pending)  # reference parity: unbounded
+                else:
+                    remaining = self.policy_timeout - (
+                        time.perf_counter() - pending.enqueued_at
+                    )
+                    self._queue.put(pending, timeout=max(0.0, remaining))
+            except queue.Full:
+                self._reject_overloaded(pending)
+
+        await asyncio.get_running_loop().run_in_executor(None, blocking_put)
         return pending.future
+
+    def _reject_overloaded(self, pending: _Pending) -> None:
+        pending.future.set_result(
+            AdmissionResponse.reject(
+                pending.request.uid(), "policy server overloaded", 429
+            )
+        )
 
     def evaluate(
         self,
@@ -303,7 +344,9 @@ class MicroBatcher:
         hooks = self.env.pre_eval_hooks_of(target)
         if not hooks:
             return True
-        payload = p.request.payload()
+        # payload_for, not payload(): hook-observable input is identical on
+        # the batcher and direct-validate paths (incl. __context__ snapshot)
+        payload = self.env.payload_for(target, p.request)
         remaining = self._remaining(p)
         # One daemon thread per hook run (not a fixed pool): a timed-out
         # hook leaks only its own thread until it finishes — it can never

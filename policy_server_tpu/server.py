@@ -277,7 +277,9 @@ def _build_context_service(config: Config):
     )
 
     try:
-        fetcher = KubeApiFetcher()
+        fetcher = KubeApiFetcher(
+            insecure_skip_tls_verify=config.kube_insecure_skip_tls_verify
+        )
     except KubeConnectionError as e:
         if not config.ignore_kubernetes_connection_failure:
             raise RuntimeError(

@@ -177,12 +177,67 @@ def test_unknown_policy_raises_through_future(env):
         batcher.shutdown()
 
 
-def test_overload_rejects_in_band(env):
-    batcher = MicroBatcher(env, max_batch_size=1, batch_timeout_ms=0.0, queue_capacity=1)
+def test_overload_waits_then_rejects_in_band(env):
+    """Queue-full behavior is a bounded WAIT (the reference waits on its
+    semaphore, handlers.rs:262-266), then an in-band 429 — not an instant
+    fast-reject that would fail closed on absorbable bursts."""
+    import time as time_mod
+
+    batcher = MicroBatcher(
+        env, max_batch_size=1, batch_timeout_ms=0.0,
+        queue_capacity=1, policy_timeout=0.3,
+    )
     # not started: the queue fills immediately
     first = batcher.submit("priv", pod_review("d", False), RequestOrigin.VALIDATE)
+    t0 = time_mod.perf_counter()
     second = batcher.submit("priv", pod_review("d", False), RequestOrigin.VALIDATE)
+    waited = time_mod.perf_counter() - t0
+    assert waited >= 0.25, f"rejected without waiting ({waited:.3f}s)"
     assert not first.done()
     resp = second.result(timeout=1)
+    assert not resp.allowed and resp.status.code == 429
+    batcher.shutdown()
+
+
+def test_overload_burst_absorbed_when_space_frees(env):
+    """A submit that hits a momentarily-full queue succeeds once the
+    dispatcher drains it (no spurious 429)."""
+    batcher = MicroBatcher(
+        env, max_batch_size=1, batch_timeout_ms=0.0,
+        queue_capacity=1, policy_timeout=2.0,
+    )
+    first = batcher.submit("priv", pod_review("d", False), RequestOrigin.VALIDATE)
+    import threading as threading_mod
+
+    started = threading_mod.Timer(0.05, batcher.start)
+    started.start()
+    # queue is full; the dispatcher starts 50ms in and drains it
+    second = batcher.submit("priv", pod_review("d", True), RequestOrigin.VALIDATE)
+    try:
+        assert first.result(timeout=30).allowed is True
+        assert second.result(timeout=30).allowed is False  # privileged
+    finally:
+        started.join()
+        batcher.shutdown()
+
+
+def test_submit_async_waits_without_blocking_loop(env):
+    """submit_async polls for space on the event loop; a full queue that
+    never drains resolves to 429 at the deadline."""
+    import asyncio
+
+    batcher = MicroBatcher(
+        env, max_batch_size=1, batch_timeout_ms=0.0,
+        queue_capacity=1, policy_timeout=0.2,
+    )
+    batcher.submit("priv", pod_review("d", False), RequestOrigin.VALIDATE)
+
+    async def go():
+        fut = await batcher.submit_async(
+            "priv", pod_review("d", False), RequestOrigin.VALIDATE
+        )
+        return await asyncio.wrap_future(fut)
+
+    resp = asyncio.run(go())
     assert not resp.allowed and resp.status.code == 429
     batcher.shutdown()

@@ -162,21 +162,13 @@ def keypair():
 
 
 def write_signed(tmp_path, data: bytes, priv: bytes, annotations=None):
+    from policy_server_tpu.fetch.verify import make_signature_entry
+
     artifact = tmp_path / "pol.tpp.json"
     artifact.write_bytes(data)
-    sig = sign_artifact_bytes(priv, data)
+    entry = make_signature_entry(priv, data, keyid="k1", annotations=annotations)
     (tmp_path / "pol.tpp.json.sig.json").write_text(
-        json.dumps(
-            {
-                "signatures": [
-                    {
-                        "keyid": "k1",
-                        "signature": base64.b64encode(sig).decode(),
-                        "annotations": annotations or {},
-                    }
-                ]
-            }
-        )
+        json.dumps({"signatures": [entry]})
     )
     return artifact
 
@@ -220,6 +212,68 @@ def test_signature_annotations_must_match(tmp_path):
     verify_artifact(artifact, verification_config(pub, {"env": "prod"}))
     with pytest.raises(VerificationError):
         verify_artifact(artifact, verification_config(pub, {"env": "staging"}))
+
+
+def test_sidecar_annotations_are_signed(tmp_path):
+    """Annotations live inside the SIGNED payload: editing the sidecar to
+    graft a different annotation set onto an authentic signature must not
+    satisfy an annotation requirement (round-1 advisor finding)."""
+    priv, pub = keypair()
+    artifact = write_signed(tmp_path, bundle_bytes(), priv, {"env": "staging"})
+    sidecar = tmp_path / "pol.tpp.json.sig.json"
+    doc = json.loads(sidecar.read_text())
+    # attacker edits the unsigned envelope, claiming env=prod
+    doc["signatures"][0]["annotations"] = {"env": "prod"}
+    sidecar.write_text(json.dumps(doc))
+    with pytest.raises(VerificationError):
+        verify_artifact(artifact, verification_config(pub, {"env": "prod"}))
+
+    # ...and tampering with the payload itself breaks the signature
+    payload = json.loads(base64.b64decode(doc["signatures"][0]["payload"]))
+    payload["optional"] = {"env": "prod"}
+    doc["signatures"][0]["payload"] = base64.b64encode(
+        json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+    ).decode()
+    sidecar.write_text(json.dumps(doc))
+    with pytest.raises(VerificationError):
+        verify_artifact(artifact, verification_config(pub, {"env": "prod"}))
+
+
+def test_signature_bound_to_digest_not_reusable(tmp_path):
+    """A valid signature for artifact A attached to artifact B must fail:
+    the signed payload pins A's digest."""
+    priv, pub = keypair()
+    write_signed(tmp_path, bundle_bytes(), priv)
+    other = tmp_path / "other.tpp.json"
+    other.write_bytes(bundle_bytes() + b"  ")
+    (tmp_path / "other.tpp.json.sig.json").write_text(
+        (tmp_path / "pol.tpp.json.sig.json").read_text()
+    )
+    with pytest.raises(VerificationError):
+        verify_artifact(other, verification_config(pub))
+
+
+def test_downloader_carries_sidecar_to_store(tmp_path):
+    """Round-1 advisor HIGH finding: the sidecar must travel with the
+    artifact into the content-addressed store, so verification of the
+    STORED path sees the signatures."""
+    priv, pub = keypair()
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    artifact = write_signed(src_dir, bundle_bytes(), priv, {"env": "prod"})
+
+    store = tmp_path / "store"
+    dl = Downloader(verification_config=verification_config(pub))
+    fetched = dl.download_policies(
+        {"p": parse_policy_entry("p", {"module": f"file://{artifact}"})},
+        store,
+    )
+    stored = fetched.ok(f"file://{artifact}")
+    assert stored.parent == store
+    assert (store / (stored.name + ".sig.json")).exists()
+    # end-to-end: verify against the STORED path (this was returning [] and
+    # failing every verification-enabled deployment)
+    verify_artifact(stored, verification_config(pub, {"env": "prod"}))
 
 
 def test_keyless_kinds_fail_loudly(tmp_path):
