@@ -59,6 +59,9 @@ struct ArrayInfo {
   int32_t ndim;        // 0..2 element axes
   int32_t caps[2];     // axis capacities
   int32_t elsize;      // bytes per element in the caller buffer
+  int64_t row_stride;  // batch-mode row stride in BYTES; 0 = contiguous
+                       // (elems*elsize). Non-zero when the array is a
+                       // column block of a wider packed batch buffer.
 };
 
 struct Schema {
@@ -675,6 +678,8 @@ void* fastenc_create(const char* schema_json, int64_t len) {
     for (size_t i = 0; i < caps.size() && i < 2; i++)
       info.caps[i] = (int32_t)caps[i]->num;
     info.elsize = (int32_t)a->obj.at("elsize")->num;
+    auto rs = a->obj.find("row_stride");
+    info.row_stride = rs != a->obj.end() ? (int64_t)rs->second->num : 0;
     schema->arrays.push_back(info);
   }
   if (!build_node(*desc->obj.at("trie"), schema->root)) return nullptr;
@@ -731,20 +736,25 @@ int64_t fastenc_encode_batch(void* handle, const char** jsons,
                              int64_t records_cap, int32_t* row_status) {
   Schema* schema = (Schema*)handle;
   size_t n_arrays = schema->arrays.size();
-  std::vector<int64_t> stride_elems(n_arrays), stride_bytes(n_arrays);
+  std::vector<int64_t> stride_elems(n_arrays), block_bytes(n_arrays),
+      row_stride_bytes(n_arrays);
   for (size_t i = 0; i < n_arrays; i++) {
     const ArrayInfo& a = schema->arrays[i];
     int64_t elems = 1;
     for (int d = 0; d < a.ndim; d++) elems *= a.caps[d];
     stride_elems[i] = elems;
-    stride_bytes[i] = elems * a.elsize;
+    block_bytes[i] = elems * a.elsize;
+    row_stride_bytes[i] = a.row_stride ? a.row_stride : block_bytes[i];
   }
   std::vector<uint8_t*> row_buffers(n_arrays);
   std::string arena_acc;
   std::vector<StringRecord> records_acc;
+  // Batch-level string dedup: request corpora repeat names/images/keys
+  // heavily, and the Python-side interning pass is O(#unique) after this.
+  std::unordered_map<std::string, int32_t> interned;
   for (int64_t row = 0; row < n_rows; row++) {
     for (size_t i = 0; i < n_arrays; i++)
-      row_buffers[i] = base_buffers[i] + row * stride_bytes[i];
+      row_buffers[i] = base_buffers[i] + row * row_stride_bytes[i];
     EncodeState st;
     st.schema = schema;
     st.buffers = row_buffers.data();
@@ -757,16 +767,25 @@ int64_t fastenc_encode_batch(void* handle, const char** jsons,
       // wipe partial writes: the row still rides the batch dispatch and
       // must read as all-missing
       for (size_t i = 0; i < n_arrays; i++)
-        memset(row_buffers[i], 0, (size_t)stride_bytes[i]);
+        memset(row_buffers[i], 0, (size_t)block_bytes[i]);
       continue;
     }
     row_status[row] = 0;
     for (StringRecord r : st.records) {
+      std::string s(st.arena.data() + r.str_offset, (size_t)r.str_len);
+      auto it = interned.find(s);
+      int32_t off;
+      if (it == interned.end()) {
+        off = (int32_t)arena_acc.size();
+        arena_acc.append(s);
+        interned.emplace(std::move(s), off);
+      } else {
+        off = it->second;
+      }
+      r.str_offset = off;
       r.flat_offset += (int32_t)(row * stride_elems[(size_t)r.array_id]);
-      r.str_offset += (int32_t)arena_acc.size();
       records_acc.push_back(r);
     }
-    arena_acc.append(st.arena);
   }
   if ((int64_t)arena_acc.size() > arena_cap ||
       (int64_t)records_acc.size() > records_cap)

@@ -239,14 +239,17 @@ class ReloadableTlsContext:
         """Rebuild trust state from current CA files + the last-good
         identity snapshot (identity files on disk are NOT consulted)."""
         cert_bytes, key_bytes = self._identity
-        ctx = self._build_inner(cert_bytes, key_bytes)
+        ctx = self._build_inner(cert_bytes, key_bytes)  # validates CA files
         with self._lock:
-            self._inner = ctx
-            # outer: CA additions apply to non-SNI clients too (the ssl
-            # module cannot drop CAs from a live context; removals take
-            # effect for SNI handshakes via the fresh inner context)
+            # outer first (the fallible in-place mutation; CA additions
+            # apply to non-SNI clients too — the ssl module cannot drop
+            # CAs from a live context; removals take effect for SNI
+            # handshakes via the fresh inner context). Only after it
+            # succeeds is the inner swapped, so a failure keeps both
+            # handshake paths on the previous trust state.
             for ca in self.tls_config.client_ca_file:
                 self.outer.load_verify_locations(cafile=ca)
+            self._inner = ctx
             self.reloads += 1
 
     def stop(self) -> None:

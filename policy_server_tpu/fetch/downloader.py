@@ -156,8 +156,13 @@ class Downloader:
             suffix = Path(parsed.path).suffix or ".artifact"
             path = self._store(dest_dir, data, suffix)
             if self.verification_config is not None:
+                # the .sig.json suffix goes on the PATH — appending to the
+                # full URL would corrupt query-string URLs (presigned S3)
+                sig_url = parsed._replace(path=parsed.path + ".sig.json")
                 try:
-                    sig = self._http_get(url + ".sig.json", parsed.hostname or "")
+                    sig = self._http_get(
+                        urllib.parse.urlunparse(sig_url), parsed.hostname or ""
+                    )
                     self._store_sidecar(path, sig)
                 except FetchError:
                     pass  # unsigned artifact; verification decides the fate

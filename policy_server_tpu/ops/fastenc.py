@@ -135,8 +135,19 @@ def _describe_schema(schema: FeatureSchema) -> tuple[str, list[FeatureSpec], lis
     def elsize(spec: FeatureSpec) -> int:
         return 4 if spec.kind == "value" and spec.dtype is not None and spec.dtype.value in ("id", "f32", "i32") else 1
 
-    arrays = [{"caps": list(s.caps), "elsize": elsize(s)} for s in specs]
-    arrays += [{"caps": list(s.caps), "elsize": 1} for s in specs if s.kind == "value"]
+    # Batch mode writes into column blocks of the single packed buffer
+    # (codec.PackedLayout); every array's row stride is the full packed
+    # row width.
+    layout = schema.packed_layout()
+    arrays = [
+        {"caps": list(s.caps), "elsize": elsize(s),
+         "row_stride": layout.width}
+        for s in specs
+    ]
+    arrays += [
+        {"caps": list(s.caps), "elsize": 1, "row_stride": layout.width}
+        for s in specs if s.kind == "value"
+    ]
 
     # Serialize the SAME trie the Python encoder walks (codec._build_trie):
     # one source of truth for traversal order, caps, and overflow reporting.
@@ -184,6 +195,7 @@ class NativeEncoder:
         if not self._handle:
             raise RuntimeError("fastenc_create failed (bad schema description)")
         self._value_specs = [s for s in self._specs if s.kind == "value"]
+        self._schema = schema
         self._scratch = threading.local()
 
     def __del__(self) -> None:  # pragma: no cover
@@ -251,27 +263,25 @@ class NativeEncoder:
         table: InternTable,
     ) -> tuple[dict[str, np.ndarray], np.ndarray]:
         """Encode a whole batch in ONE native call, rows written directly
-        into the stacked batch arrays (no per-request arrays, no re-stack).
+        into the TWO packed batch buffers (codec.PackedLayout) — a dispatch
+        is O(1) host→device transfers regardless of schema width.
 
-        → (features dict with leading batch axis of ``batch_size``,
+        → ({PACKED32_KEY, PACKED8_KEY} feature dict,
            per-row status: 0 ok, <0 failed — failed rows are all-missing
-           in the arrays and must be re-routed by the caller)."""
+           in the buffers and must be re-routed by the caller)."""
         n = len(payload_jsons)
         assert n <= batch_size
-        out: dict[str, np.ndarray] = {
-            BATCH_KEY: np.zeros(batch_size, dtype=np.bool_)
-        }
+        out = self._schema.empty_batch_packed(batch_size)
+        views = self._schema.packed_views(out)
         n_arrays = len(self._specs) + len(self._value_specs)
         buffers = (ctypes.c_void_p * n_arrays)()
         for i, spec in enumerate(self._specs):
-            arr = np.zeros((batch_size, *spec.caps), dtype=spec.np_dtype())
-            out[spec.key] = arr
-            buffers[i] = arr.ctypes.data_as(ctypes.c_void_p)
+            buffers[i] = views[spec.key].ctypes.data_as(ctypes.c_void_p)
         mi = len(self._specs)
         for spec in self._value_specs:
-            arr = np.zeros((batch_size, *spec.caps), dtype=np.bool_)
-            out[mask_key_for(spec.key)] = arr
-            buffers[mi] = arr.ctypes.data_as(ctypes.c_void_p)
+            buffers[mi] = views[mask_key_for(spec.key)].ctypes.data_as(
+                ctypes.c_void_p
+            )
             mi += 1
         jsons = (ctypes.c_char_p * n)(*payload_jsons)
         lens = (ctypes.c_int64 * n)(*[len(b) for b in payload_jsons])
@@ -296,21 +306,55 @@ class NativeEncoder:
         )
         if n_rec == -2:
             raise ValueError("fastenc: arena/records overflow")
-        rec = np.frombuffer(
-            records, dtype=np.int32, count=int(n_rec) * 6
-        ).reshape(-1, 6)
-        used = int((rec[:, 4] + rec[:, 5]).max()) if n_rec else 0
-        raw_arena = ctypes.string_at(arena, used)
+        if n_rec:
+            self._scatter_strings(
+                np.frombuffer(
+                    records, dtype=np.int32, count=int(n_rec) * 6
+                ).reshape(-1, 6),
+                arena, views, table,
+            )
+        return out, np.frombuffer(status, dtype=np.int32).copy()
+
+    def _scatter_strings(
+        self,
+        rec: np.ndarray,
+        arena,
+        views: dict[str, np.ndarray],
+        table: InternTable,
+    ) -> None:
+        """Vectorized interning: the native encoder dedups strings at the
+        batch level, so Python work is O(#unique strings) + a handful of
+        numpy scatters — not a Python loop over every record."""
         specs = self._specs
         pred_keys = self._pred_keys
-        for array_id, flat_off, is_pred, pred_idx, soff, slen in rec:
+        used = int((rec[:, 4] + rec[:, 5]).max())
+        raw_arena = ctypes.string_at(arena, used)
+        # The native encoder dedups strings, so the arena offset uniquely
+        # identifies a string; a (pred-tag, offset) composite int64 key
+        # makes the unique pass a plain integer sort (np.unique(axis=0)
+        # argsort over rows dominated this function before).
+        tag = np.where(
+            rec[:, 2] == 1, rec[:, 3].astype(np.int64) + 1, 0
+        )
+        keys = (tag << 40) | rec[:, 4].astype(np.int64)
+        uniq, first, inverse = np.unique(
+            keys, return_index=True, return_inverse=True
+        )
+        vals = np.empty(len(uniq), np.int32)
+        for u, ri in enumerate(first):
+            is_pred, pred_idx, soff, slen = rec[ri, 2:6]
             s = raw_arena[soff : soff + slen].decode("utf-8", "surrogatepass")
-            arr = out[specs[array_id].key]
-            if is_pred:
-                arr.flat[flat_off] = table.pred_value(pred_keys[pred_idx], s)
-            else:
-                arr.flat[flat_off] = table.intern(s)
-        return out, np.frombuffer(status, dtype=np.int32).copy()
+            vals[u] = (
+                table.pred_value(pred_keys[pred_idx], s)
+                if is_pred
+                else table.intern(s)
+            )
+        rvals = vals[inverse]
+        aids = rec[:, 0]
+        for aid in np.unique(aids):
+            m = aids == aid
+            arr = views[specs[aid].key]
+            arr.flat[rec[m, 1]] = rvals[m].astype(arr.dtype, copy=False)
 
 
 def attach_native(schema: FeatureSchema) -> bool:

@@ -80,6 +80,11 @@ class MicroBatcher:
         )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._overload_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="overload-wait"
+        )
         self.batches_dispatched = 0
         self.requests_dispatched = 0
 
@@ -98,6 +103,10 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._overload_pool.shutdown(wait=False)
+        close = getattr(self.env, "close", None)
+        if close is not None:
+            close()
         # Drain: requests still queued must not leave their futures
         # unresolved (handlers await them).
         while True:
@@ -154,9 +163,13 @@ class MicroBatcher:
     ) -> Future:
         """submit() for event-loop callers: waits for queue space without
         blocking the loop. The fast path is a lock-free put; a full queue
-        parks the wait on an executor thread so it reuses the queue's FIFO
+        parks the wait on the batcher's OWN overload executor (not the
+        loop's shared default executor — overload waits must never starve
+        unrelated run_in_executor users) and reuses the queue's FIFO
         condition-variable wait — waiters are admitted oldest-first, same
-        as the sync path and the reference's semaphore."""
+        as the sync path and the reference's semaphore. If even the
+        overload executor is saturated, the wait queues inside it, which
+        preserves FIFO and bounds thread count."""
         import asyncio
 
         pending = _Pending(policy_id, request, origin, Future())
@@ -178,7 +191,9 @@ class MicroBatcher:
             except queue.Full:
                 self._reject_overloaded(pending)
 
-        await asyncio.get_running_loop().run_in_executor(None, blocking_put)
+        await asyncio.get_running_loop().run_in_executor(
+            self._overload_pool, blocking_put
+        )
         return pending.future
 
     def _reject_overloaded(self, pending: _Pending) -> None:

@@ -50,9 +50,13 @@ class InternTable:
                 return existing
             new_id = len(self._strings)
             self._strings.append(s)
-            self._ids[s] = new_id
             for fn, bits in self._preds.values():
                 bits.append(self._apply(fn, s))
+            # publish the id LAST: pred_bit's lock-free fast path indexes
+            # the bit lists by any id it can observe, so an id must never
+            # be visible before every predicate's bit exists (parallel
+            # encode threads hit this race otherwise)
+            self._ids[s] = new_id
             return new_id
 
     def lookup(self, s: str) -> int | None:

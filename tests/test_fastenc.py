@@ -74,8 +74,9 @@ def test_batch_api_matches_single(env):
     schema = env.schemas[0]
     docs = synthetic_firehose(17, seed=5)
     blobs = [to_request(d).payload_json() for d in docs]
-    batch, status = schema.native.encode_batch(blobs, 32, env.table)
+    packed, status = schema.native.encode_batch(blobs, 32, env.table)
     assert (status == 0).all()
+    batch = schema.unpack_host(packed)
     for row, d in enumerate(docs):
         single = schema.native.encode(to_request(d).payload(), env.table)
         for k, arr in single.items():
@@ -90,10 +91,10 @@ def test_batch_overflow_rows_flagged_and_zeroed(env):
         {"name": f"c{i}", "image": "nginx"} for i in range(12)  # > cap 8
     ]
     blobs = [to_request(ok_doc).payload_json(), to_request(big_doc).payload_json()]
-    batch, status = schema.native.encode_batch(blobs, 2, env.table)
+    packed, status = schema.native.encode_batch(blobs, 2, env.table)
     assert status[0] == 0 and status[1] < 0
     # the failed row must read all-missing
-    for k, arr in batch.items():
+    for k, arr in schema.unpack_host(packed).items():
         if arr.ndim >= 1 and arr.shape[0] == 2:
             assert not arr[1].any(), k
 
