@@ -333,6 +333,17 @@ class EvaluationEnvironment:
         self.schema = self.schemas[-1]  # the widest (legacy name)
         for schema in self.schemas:
             schema.register_preds(self.table)
+        # The packed device unpack selects its layout by row width
+        # (_unpack_features); widen any colliding bucket so widths are
+        # unique and the selection is total — must happen BEFORE
+        # attach_native captures row_stride.
+        used_widths: set[int] = set()
+        for schema in self.schemas:
+            layout = schema.packed_layout()
+            while layout.width in used_widths:
+                layout = layout.widened(layout.width + 4)
+                schema._packed_layout_cache = layout
+            used_widths.add(layout.width)
         # Native (C++) encoder: JSON bytes → batch arrays in one call per
         # dispatch (csrc/fastenc.cpp). Soft-fails to the Python trie.
         self.native_encoding = False
@@ -362,6 +373,7 @@ class EvaluationEnvironment:
         self._fallback_lock = threading.Lock()
         self._mesh = None  # set by attach_mesh
         self._min_bucket = 1
+        self._closed = False
         # Drain pool: fetching results pays the transport's full sync
         # latency (~100ms on the remote tunnel measured in round 2);
         # overlapping many in-flight device_gets on threads hides it —
@@ -381,8 +393,12 @@ class EvaluationEnvironment:
 
     def close(self) -> None:
         """Release the drain/encode thread pools (idempotent). Called by
-        MicroBatcher.shutdown / server teardown; environments are otherwise
-        immutable and need no other cleanup."""
+        whoever BUILT the environment (the server at teardown, a test
+        fixture at scope exit) — never by a MicroBatcher, which borrows the
+        environment it dispatches into. After close() every dispatch raises
+        RuntimeError("environment closed") rather than failing deep inside
+        the batch path."""
+        self._closed = True
         for pool in (self._drain_pool, self._encode_pool):
             if pool is not None:
                 pool.shutdown(wait=False)
@@ -521,9 +537,13 @@ class EvaluationEnvironment:
         batch = buf.shape[0]
         out: dict[str, Any] = {}
         if layout.total32:
-            # int32 tail region: groups of 4 bytes bitcast to int32
+            # int32 tail region: groups of 4 bytes bitcast to int32 (slice
+            # the exact region — widened layouts carry trailing pad bytes)
             tail = jax.lax.slice_in_dim(
-                buf, layout.off32_bytes, layout.width, axis=1
+                buf,
+                layout.off32_bytes,
+                layout.off32_bytes + layout.total32 * 4,
+                axis=1,
             )
             p32 = jax.lax.bitcast_convert_type(
                 tail.reshape(batch, layout.total32, 4), jnp.int32
@@ -739,6 +759,8 @@ class EvaluationEnvironment:
         Exception entries rather than failing the batch; SchemaOverflow rows
         fall back to the host oracle (SURVEY.md §7.4 escape hatch).
         """
+        if self._closed:
+            raise RuntimeError("environment closed")
         if self.native_encoding and self.backend == "jax":
             # chunks to max_dispatch_batch internally, with pipelining
             return self._validate_batch_native(items, run_hooks)

@@ -379,6 +379,20 @@ class PackedLayout:
         width = off32_bytes + off32 * 4
         return cls(tuple(e32), tuple(e8), off32, off8, off32_bytes, width)
 
+    def widened(self, width: int) -> "PackedLayout":
+        """A copy with trailing pad bytes up to ``width`` (multiple of 4).
+
+        The environment widens colliding layouts so every schema bucket has
+        a UNIQUE row width — the device unpack selects its layout by packed
+        buffer width, and two buckets with coincidentally equal widths but
+        different entry maps would otherwise silently mis-slice features.
+        Pad bytes live after the int32 region and are never read.
+        """
+        assert width >= self.width and width % 4 == 0
+        import dataclasses
+
+        return dataclasses.replace(self, width=width)
+
 
 class _TrieNode:
     """One node of the single-pass extraction trie."""

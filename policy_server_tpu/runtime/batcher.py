@@ -79,6 +79,7 @@ class MicroBatcher:
             maxsize=queue_capacity or self.max_batch_size * 8
         )
         self._stop = threading.Event()
+        self._stopping = False
         self._thread: threading.Thread | None = None
         from concurrent.futures import ThreadPoolExecutor
 
@@ -99,16 +100,32 @@ class MicroBatcher:
         return self
 
     def shutdown(self) -> None:
+        """Stop the dispatch thread and resolve every queued/waiting future.
+
+        The batcher BORROWS its environment — it never closes it. The owner
+        (the server that built it, or a test fixture) calls
+        ``environment.close()`` at its own teardown; two batchers may share
+        one environment, and shutting one down must not disable the other.
+        """
+        # Reject new submissions and wake overload waiters into the reject
+        # path BEFORE draining, so a waiter whose put succeeds after the
+        # drain below cannot strand an unresolved future.
+        self._stopping = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self._overload_pool.shutdown(wait=False)
-        close = getattr(self.env, "close", None)
-        if close is not None:
-            close()
         # Drain: requests still queued must not leave their futures
         # unresolved (handlers await them).
+        self._drain_rejecting()
+        # Overload waiters blocked in queue.put now find space (the drain
+        # freed the whole queue) or observe _stopping; joining the pool
+        # guarantees every waiter either rejected itself or enqueued — and
+        # the second drain resolves anything enqueued post-drain.
+        self._overload_pool.shutdown(wait=True)
+        self._drain_rejecting()
+
+    def _drain_rejecting(self) -> None:
         while True:
             try:
                 p = self._queue.get_nowait()
@@ -146,6 +163,9 @@ class MicroBatcher:
         bounded by the policy timeout, so a burst is absorbed and only
         sustained overload degrades, with a clear in-band 429."""
         pending = _Pending(policy_id, request, origin, Future())
+        if self._stopping:
+            self._reject_stopping(pending)
+            return pending.future
         try:
             if self.policy_timeout is None:
                 self._queue.put(pending)  # reference parity: unbounded wait
@@ -173,6 +193,9 @@ class MicroBatcher:
         import asyncio
 
         pending = _Pending(policy_id, request, origin, Future())
+        if self._stopping:
+            self._reject_stopping(pending)
+            return pending.future
         try:
             self._queue.put_nowait(pending)
             return pending.future
@@ -180,6 +203,9 @@ class MicroBatcher:
             pass
 
         def blocking_put() -> None:
+            if self._stopping:
+                self._reject_stopping(pending)
+                return
             try:
                 if self.policy_timeout is None:
                     self._queue.put(pending)  # reference parity: unbounded
@@ -201,6 +227,14 @@ class MicroBatcher:
             AdmissionResponse.reject(
                 pending.request.uid(), "policy server overloaded", 429
             )
+        )
+
+    def _reject_stopping(self, pending: _Pending) -> None:
+        self._resolve(
+            pending,
+            AdmissionResponse.reject(
+                pending.request.uid(), "policy server shutting down", 503
+            ),
         )
 
     def evaluate(

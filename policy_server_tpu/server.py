@@ -192,6 +192,9 @@ class PolicyServer:
             await runner.cleanup()
         self._runners.clear()
         self.batcher.shutdown()
+        # The server built the environment, so the server closes it — the
+        # batcher only borrows it (two batchers may share one env).
+        self.environment.close()
 
     async def run_async(self) -> None:
         await self.start()
