@@ -65,9 +65,31 @@ def _validate_key_file(path: str) -> bytes:
     return data
 
 
-def build_tls_server_config(tls_config: TlsConfig) -> ssl.SSLContext:
+def read_client_ca_data(paths: list[str]) -> str:
+    """Read every client-CA bundle into one PEM string. A single snapshot
+    shared by inner-context build and live outer-context refresh keeps the
+    two handshake paths on identical trust state (no per-file TOCTOU).
+    Chunks are newline-joined: a file without a trailing newline must not
+    fuse its END marker into the next file's BEGIN marker. A file with no
+    certificate (e.g. truncated mid-rotation) FAILS the whole read so the
+    reload aborts and the previous complete trust set keeps serving —
+    silently dropping one CA would reject its clients with no error."""
+    chunks = []
+    for p in paths:
+        text = Path(p).read_text()
+        if _PEM_CERT_MARKER.decode() not in text:
+            raise TlsConfigError(f"no certificate found in client CA file {p}")
+        chunks.append(text.strip())
+    return "\n".join(chunks) + "\n"
+
+
+def build_tls_server_config(
+    tls_config: TlsConfig, client_ca_data: str | None = None
+) -> ssl.SSLContext:
     """certs.rs:167-181: server config with optional client-cert
-    verification against the configured CA bundles."""
+    verification against the configured CA bundles. ``client_ca_data``
+    (PEM text) overrides re-reading the CA files from disk so reloads can
+    apply one pre-validated snapshot."""
     _validate_cert_file(tls_config.cert_file)
     _validate_key_file(tls_config.key_file)
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -75,8 +97,9 @@ def build_tls_server_config(tls_config: TlsConfig) -> ssl.SSLContext:
     ctx.load_cert_chain(tls_config.cert_file, tls_config.key_file)
     if tls_config.client_ca_file:
         ctx.verify_mode = ssl.CERT_REQUIRED
-        for ca in tls_config.client_ca_file:
-            ctx.load_verify_locations(cafile=ca)
+        if client_ca_data is None:
+            client_ca_data = read_client_ca_data(tls_config.client_ca_file)
+        ctx.load_verify_locations(cadata=client_ca_data)
     return ctx
 
 
@@ -201,7 +224,12 @@ class ReloadableTlsContext:
             kf.flush()
             return fn(cf.name, kf.name)
 
-    def _build_inner(self, cert_bytes: bytes, key_bytes: bytes) -> ssl.SSLContext:
+    def _build_inner(
+        self,
+        cert_bytes: bytes,
+        key_bytes: bytes,
+        client_ca_data: str | None = None,
+    ) -> ssl.SSLContext:
         """One construction path for every inner context:
         build_tls_server_config over the snapshot bytes, so TLS hardening
         added to the builder keeps applying after reloads."""
@@ -210,7 +238,8 @@ class ReloadableTlsContext:
         return self._with_identity_files(
             cert_bytes, key_bytes,
             lambda cert, key: build_tls_server_config(
-                replace(self.tls_config, cert_file=cert, key_file=key)
+                replace(self.tls_config, cert_file=cert, key_file=key),
+                client_ca_data=client_ca_data,
             ),
         )
 
@@ -239,16 +268,19 @@ class ReloadableTlsContext:
         """Rebuild trust state from current CA files + the last-good
         identity snapshot (identity files on disk are NOT consulted)."""
         cert_bytes, key_bytes = self._identity
-        ctx = self._build_inner(cert_bytes, key_bytes)  # validates CA files
+        # one disk read for ALL CA files; validation happens on the inner
+        # build below, so a file that fails to parse aborts BEFORE the live
+        # outer context is touched (no partially-applied CA set)
+        ca_data = read_client_ca_data(self.tls_config.client_ca_file)
+        ctx = self._build_inner(cert_bytes, key_bytes, client_ca_data=ca_data)
         with self._lock:
-            # outer first (the fallible in-place mutation; CA additions
-            # apply to non-SNI clients too — the ssl module cannot drop
-            # CAs from a live context; removals take effect for SNI
-            # handshakes via the fresh inner context). Only after it
-            # succeeds is the inner swapped, so a failure keeps both
-            # handshake paths on the previous trust state.
-            for ca in self.tls_config.client_ca_file:
-                self.outer.load_verify_locations(cafile=ca)
+            # outer refresh is a single load_verify_locations(cadata=...)
+            # over the already-validated snapshot (CA additions apply to
+            # non-SNI clients too — the ssl module cannot drop CAs from a
+            # live context; removals take effect for SNI handshakes via
+            # the fresh inner context). Both handshake paths see the same
+            # snapshot or — on failure — stay on the previous trust state.
+            self.outer.load_verify_locations(cadata=ca_data)
             self._inner = ctx
             self.reloads += 1
 

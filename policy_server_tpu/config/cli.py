@@ -144,6 +144,30 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
               help="Persistent XLA compilation cache directory: compiled "
                    "policy programs survive restarts (the TPU analog of the "
                    "reference's policies-download store reuse)")),
+        ("--context-refresh-seconds", "KUBEWARDEN_CONTEXT_REFRESH_SECONDS",
+         dict(type=float, default=30.0, metavar="SECONDS",
+              help="Context-aware snapshot freshness: the re-LIST period in "
+                   "poll mode; in watch mode (snapshots are event-fresh) "
+                   "the error-backoff cap, with a full re-LIST resync every "
+                   "10x this value (staleness contract: context/service.py)")),
+        ("--context-no-watch", "KUBEWARDEN_CONTEXT_NO_WATCH",
+         dict(action="store_true",
+              help="Disable the Kubernetes watch stream for context-aware "
+                   "snapshots and poll with periodic LISTs instead")),
+        ("--distributed-coordinator", "KUBEWARDEN_DISTRIBUTED_COORDINATOR",
+         dict(default=None, metavar="HOST:PORT",
+              help="jax.distributed coordinator address for multi-host "
+                   "serving; when set, bootstrap initializes the DCN "
+                   "process group before building the device mesh "
+                   "(SURVEY.md §7.2 step 10)")),
+        ("--distributed-num-processes", "KUBEWARDEN_DISTRIBUTED_NUM_PROCESSES",
+         dict(type=int, default=None, metavar="N",
+              help="Total number of policy-server processes in the "
+                   "multi-host group (requires --distributed-coordinator)")),
+        ("--distributed-process-id", "KUBEWARDEN_DISTRIBUTED_PROCESS_ID",
+         dict(type=int, default=None, metavar="ID",
+              help="This process's rank in the multi-host group "
+                   "(requires --distributed-coordinator)")),
     ]
 
 

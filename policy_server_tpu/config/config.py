@@ -162,6 +162,17 @@ class Config:
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
+    # context-aware snapshot freshness (see the staleness contract in
+    # context/service.py): watch keeps snapshots event-fresh; the refresh
+    # period bounds poll-mode staleness and watch-mode backoff/resync
+    context_refresh_seconds: float = 30.0
+    context_watch: bool = True
+    # multi-host bring-up (SURVEY.md §7.2 step 10): when the coordinator is
+    # set, bootstrap calls jax.distributed.initialize before mesh build so
+    # the mesh spans every process's devices (ICI in-slice, DCN across)
+    distributed_coordinator: str | None = None
+    distributed_num_processes: int | None = None
+    distributed_process_id: int | None = None
 
     def validate(self) -> None:
         self.tls_config.validate()
@@ -180,6 +191,37 @@ class Config:
             raise ValueError("--max-batch-size must be >= 1")
         if not (0 <= self.port <= 65535) or not (0 <= self.readiness_probe_port <= 65535):
             raise ValueError("ports must be in [0, 65535]")
+        if self.context_refresh_seconds <= 0:
+            raise ValueError("--context-refresh-seconds must be > 0")
+        if self.distributed_coordinator is None:
+            if (
+                self.distributed_num_processes is not None
+                or self.distributed_process_id is not None
+            ):
+                raise ValueError(
+                    "--distributed-num-processes/--distributed-process-id "
+                    "require --distributed-coordinator"
+                )
+        else:
+            if (self.distributed_num_processes is None) != (
+                self.distributed_process_id is None
+            ):
+                raise ValueError(
+                    "--distributed-num-processes and --distributed-process-id "
+                    "must be set together"
+                )
+            if (
+                self.distributed_num_processes is not None
+                and not (
+                    0
+                    <= self.distributed_process_id
+                    < self.distributed_num_processes
+                )
+            ):
+                raise ValueError(
+                    "--distributed-process-id must be in "
+                    "[0, --distributed-num-processes)"
+                )
 
     @property
     def policy_timeout(self) -> float | None:
@@ -244,6 +286,11 @@ class Config:
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,
+            context_refresh_seconds=float(args.context_refresh_seconds),
+            context_watch=not args.context_no_watch,
+            distributed_coordinator=args.distributed_coordinator,
+            distributed_num_processes=args.distributed_num_processes,
+            distributed_process_id=args.distributed_process_id,
         )
         cfg.validate()
         return cfg

@@ -9,9 +9,9 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
-from typing import Any, Callable, Iterable, Mapping
+from typing import Any, Iterable, Mapping
 
 import requests
 
@@ -123,7 +123,13 @@ class KubeApiFetcher:
                 f"Kubernetes API error: HTTP {resp.status_code}"
             )
 
-    def _get(self, path: str) -> requests.Response:
+    def _request(
+        self,
+        path: str,
+        params: Mapping[str, str] | None = None,
+        stream: bool = False,
+        timeout: Any = 15,
+    ) -> requests.Response:
         # No silent TLS bypass to the API server: without a cluster CA the
         # system trust store is used (and fails loudly on self-signed
         # clusters); verification is skipped ONLY on explicit operator
@@ -134,10 +140,15 @@ class KubeApiFetcher:
             verify = self.ca_file if self.ca_file else True
         return requests.get(
             f"{self.api_server}{path}",
+            params=params,
             headers={"Authorization": f"Bearer {self.token}"},
             verify=verify,
-            timeout=15,
+            stream=stream,
+            timeout=timeout,
         )
+
+    def _get(self, path: str) -> requests.Response:
+        return self._request(path)
 
     def _list_path(self, resource: ContextAwareResource) -> str:
         api_version, kind = resource.api_version, resource.kind
@@ -163,23 +174,113 @@ class KubeApiFetcher:
             out[resource_key(r)] = tuple(resp.json().get("items") or ())
         return out
 
+    # -- watch primitives (list+watch with resourceVersion resume) ---------
+
+    def list_with_version(
+        self, resource: ContextAwareResource
+    ) -> tuple[tuple[Any, ...], str]:
+        """LIST one kind, returning (items, list resourceVersion) — the
+        resume point for a subsequent watch."""
+        resp = self._get(self._list_path(resource))
+        resp.raise_for_status()
+        doc = resp.json()
+        return (
+            tuple(doc.get("items") or ()),
+            str((doc.get("metadata") or {}).get("resourceVersion") or ""),
+        )
+
+    def watch(
+        self, resource: ContextAwareResource, resource_version: str
+    ):
+        """Stream watch events for one kind from ``resource_version``.
+
+        Yields decoded K8s watch event dicts (``{"type": ..., "object":
+        ...}``). Returns normally when the server closes the stream (the
+        caller re-watches from its last seen resourceVersion); raises on
+        transport errors (the caller falls back to a fresh LIST)."""
+        import json
+
+        resp = self._request(
+            self._list_path(resource),
+            params={
+                "watch": "true",
+                "resourceVersion": resource_version,
+                "allowWatchBookmarks": "true",
+            },
+            stream=True,
+            timeout=(15, 305),  # connect, read — servers close ~5 min
+        )
+        resp.raise_for_status()
+        with resp:
+            for line in resp.iter_lines():
+                if line:
+                    yield json.loads(line)
+
+
+def _object_key(obj: Mapping[str, Any]) -> tuple:
+    """Identity of one cluster object inside a kind's collection: uid when
+    present, else (namespace, name)."""
+    meta = obj.get("metadata") or {}
+    uid = meta.get("uid")
+    if uid:
+        return ("uid", uid)
+    return ("nn", meta.get("namespace"), meta.get("name"))
+
 
 class ContextSnapshotService:
-    """Background refresher holding the current immutable snapshot."""
+    """Background refresher holding the current immutable snapshot.
+
+    Staleness contract (SURVEY.md §7.4 #5; replaces the reference's
+    read-through callback_handler, which pays a K8s round-trip per guest
+    call but is always fresh):
+
+    * **watch mode** (default when the fetcher supports list+watch, i.e.
+      the real ``KubeApiFetcher``): a per-kind watcher applies K8s watch
+      events to the snapshot as they arrive — staleness is event-delivery
+      latency (typically milliseconds). The watch resumes from the last
+      seen ``resourceVersion``; an expired version (410 Gone) or transport
+      error falls back to a fresh LIST after an exponentially growing
+      backoff capped at ``refresh_seconds``, during which the last good
+      snapshot keeps serving. As a safety net against silently dropped
+      watch events, a full re-LIST resync runs at the first stream close
+      after ``RESYNC_MULTIPLIER × refresh_seconds`` has elapsed since the
+      last LIST (the API server bounds watch-stream lifetime, so closes
+      arrive regularly).
+    * **poll mode** (fetchers without watch, or ``watch=False``): full
+      re-LIST every ``refresh_seconds`` (``--context-refresh-seconds``),
+      so a policy may observe cluster state up to ``refresh_seconds`` +
+      one LIST older than reality.
+
+    Either way every policy evaluation reads ONE immutable snapshot
+    (``snapshot()``), so all rows of a batch see a consistent cluster
+    view — fresher-but-torn reads are not possible by construction.
+    """
+
+    # watch-mode full re-LIST cadence = RESYNC_MULTIPLIER × refresh_seconds
+    RESYNC_MULTIPLIER = 10
 
     def __init__(
         self,
         fetcher: Any,
         wanted: Iterable[ContextAwareResource] = (),
         refresh_seconds: float = 30.0,
+        watch: bool | None = None,
     ):
         self.fetcher = fetcher
         self.wanted = frozenset(wanted)
         self.refresh_seconds = refresh_seconds
+        self.watch_enabled = (
+            watch
+            if watch is not None
+            else hasattr(fetcher, "watch") and hasattr(fetcher, "list_with_version")
+        )
         self._snapshot = EMPTY_SNAPSHOT
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+        # watch mode: mutable per-kind object maps the watchers fold events
+        # into; every publish snapshots them into immutable tuples
+        self._store: dict[str, dict[tuple, Any]] = {}
 
     def snapshot(self) -> ContextSnapshot:
         with self._lock:
@@ -196,23 +297,146 @@ class ContextSnapshotService:
             return self._snapshot
 
     def start(self) -> "ContextSnapshotService":
-        self.refresh()  # boot-time prefetch: first request sees real state
-        if self._thread is None and self.wanted:
-            def loop() -> None:
-                while not self._stop.wait(self.refresh_seconds):
-                    try:
-                        self.refresh()
-                    except Exception as e:  # noqa: BLE001 — keep last good
-                        logger.error("context refresh failed: %s", e)
-
-            self._thread = threading.Thread(
-                target=loop, name="context-snapshot", daemon=True
+        if self._threads:
+            return self
+        if not self.wanted:
+            self.refresh()
+            return self
+        if self.watch_enabled:
+            # Boot prefetch = ONE LIST per kind, done synchronously so a
+            # failing context fetch still fails the boot (the caller's
+            # --ignore-kubernetes-connection-failure handling stays in
+            # force); each watcher is seeded with the LIST's
+            # resourceVersion and starts with a watch, not a second LIST.
+            seeds: dict[str, str] = {}
+            for r in sorted(self.wanted, key=resource_key):
+                items, rv = self.fetcher.list_with_version(r)
+                self._replace_kind(resource_key(r), items)
+                seeds[resource_key(r)] = rv
+            for r in sorted(self.wanted, key=resource_key):
+                t = threading.Thread(
+                    target=self._watch_loop,
+                    args=(r, seeds[resource_key(r)]),
+                    name=f"context-watch-{resource_key(r)}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        else:
+            self.refresh()  # boot-time prefetch: first request = real state
+            t = threading.Thread(
+                target=self._poll_loop, name="context-snapshot", daemon=True
             )
-            self._thread.start()
+            t.start()
+            self._threads.append(t)
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+    # -- poll mode ----------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.refresh_seconds):
+            try:
+                self.refresh()
+            except Exception as e:  # noqa: BLE001 — keep last good
+                logger.error("context refresh failed: %s", e)
+
+    # -- watch mode ---------------------------------------------------------
+
+    def _watch_loop(
+        self, resource: ContextAwareResource, rv: str | None = None
+    ) -> None:
+        """list+watch with resourceVersion resume for ONE kind. A cleanly
+        closed stream (server-side ~5 min timeout) resumes the watch from
+        the last seen resourceVersion — bookmarks exist precisely so this
+        path never re-LISTs. A 410-Gone-style ERROR event or a transport
+        error drops the rv and restarts from a fresh LIST after an
+        exponentially growing backoff (capped at ``refresh_seconds``); the
+        last good snapshot keeps serving meanwhile."""
+        key = resource_key(resource)
+        base_backoff = min(1.0, self.refresh_seconds)
+        backoff = base_backoff
+        last_list = time.monotonic()  # start() seeded us from a LIST
+        resync_interval = self.refresh_seconds * self.RESYNC_MULTIPLIER
+        while not self._stop.is_set():
+            delivered = False
+            try:
+                if (
+                    rv is None
+                    or time.monotonic() - last_list > resync_interval
+                ):
+                    items, rv = self.fetcher.list_with_version(resource)
+                    self._replace_kind(key, items)
+                    last_list = time.monotonic()
+                for event in self.fetcher.watch(resource, rv):
+                    if self._stop.is_set():
+                        return
+                    etype = event.get("type")
+                    obj = event.get("object") or {}
+                    if etype == "ERROR":
+                        # e.g. 410 Gone: resourceVersion too old → re-list
+                        # (an ERROR does NOT count as healthy delivery — a
+                        # persistently erroring stream must back off, not
+                        # spin LISTs against the control plane)
+                        logger.info(
+                            "context watch %s expired, re-listing", key
+                        )
+                        rv = None
+                        break
+                    # a real event delivered → connection is healthy
+                    delivered = True
+                    backoff = base_backoff
+                    if etype == "BOOKMARK":
+                        rv = str(
+                            (obj.get("metadata") or {}).get("resourceVersion")
+                            or rv
+                        )
+                        continue
+                    self._apply_event(key, etype, obj)
+                    rv = str(
+                        (obj.get("metadata") or {}).get("resourceVersion")
+                        or rv
+                    )
+                # clean close with rv intact → resume watch, no LIST
+            except Exception as e:  # noqa: BLE001 — keep last good snapshot
+                if self._stop.is_set():
+                    return
+                logger.error("context watch %s failed: %s", key, e)
+                rv = None  # transport fault → full re-list on recovery
+            if not delivered and not self._stop.is_set():
+                # ERROR event, exception, or a stream that closed without
+                # delivering anything: wait before hitting the API again,
+                # growing exponentially up to the refresh period
+                self._stop.wait(backoff)
+                backoff = min(
+                    backoff * 2, max(self.refresh_seconds, base_backoff)
+                )
+
+    def _replace_kind(self, key: str, items: Iterable[Any]) -> None:
+        self._store[key] = {_object_key(o): o for o in items}
+        self._publish(key)
+
+    def _apply_event(self, key: str, etype: str, obj: Mapping[str, Any]) -> None:
+        kind_map = self._store.setdefault(key, {})
+        okey = _object_key(obj)
+        if etype == "DELETED":
+            kind_map.pop(okey, None)
+        else:  # ADDED / MODIFIED
+            kind_map[okey] = obj
+        self._publish(key)
+
+    def _publish(self, key: str) -> None:
+        """Fold the mutable store into a new immutable snapshot."""
+        with self._lock:
+            resources = dict(self._snapshot.resources)
+            resources[key] = tuple(self._store.get(key, {}).values())
+            self._snapshot = ContextSnapshot(
+                version=self._snapshot.version + 1,
+                taken_at=time.time(),
+                resources=resources,
+            )

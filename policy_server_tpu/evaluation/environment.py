@@ -73,6 +73,7 @@ from policy_server_tpu.ops.codec import (
     PACKED_KEY,
     FeatureSchema,
     SchemaOverflow,
+    ensure_unique_packed_widths,
 )
 from policy_server_tpu.ops.compiler import compile_program
 from policy_server_tpu.policies import resolve_builtin
@@ -334,16 +335,9 @@ class EvaluationEnvironment:
         for schema in self.schemas:
             schema.register_preds(self.table)
         # The packed device unpack selects its layout by row width
-        # (_unpack_features); widen any colliding bucket so widths are
-        # unique and the selection is total — must happen BEFORE
-        # attach_native captures row_stride.
-        used_widths: set[int] = set()
-        for schema in self.schemas:
-            layout = schema.packed_layout()
-            while layout.width in used_widths:
-                layout = layout.widened(layout.width + 4)
-                schema._packed_layout_cache = layout
-            used_widths.add(layout.width)
+        # (_unpack_features); widths must be unique so the selection is
+        # total — must happen BEFORE attach_native captures row_stride.
+        ensure_unique_packed_widths(self.schemas)
         # Native (C++) encoder: JSON bytes → batch arrays in one call per
         # dispatch (csrc/fastenc.cpp). Soft-fails to the Python trie.
         self.native_encoding = False

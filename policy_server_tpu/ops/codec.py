@@ -261,6 +261,12 @@ class FeatureSchema:
             layout = self._packed_layout_cache = PackedLayout.build(self)
         return layout
 
+    def install_packed_layout(self, layout: "PackedLayout") -> None:
+        """Pin a (widened) layout for this schema — see
+        :func:`ensure_unique_packed_widths`. Must run before any encode or
+        native attach captures the row stride."""
+        self._packed_layout_cache = layout
+
     def empty_batch_packed(self, batch_size: int) -> dict[str, np.ndarray]:
         layout = self.packed_layout()
         return {PACKED_KEY: np.zeros((batch_size, layout.width), np.uint8)}
@@ -405,6 +411,21 @@ class _TrieNode:
         self.terminals: list[FeatureSpec] = []
         self.axis_cap: int = 0  # cap of the star axis rooted here
         self.repr_key: str = ""  # a spec key for SchemaOverflow reporting
+
+
+def ensure_unique_packed_widths(schemas) -> None:
+    """Widen colliding packed layouts so every schema bucket has a UNIQUE
+    row width (the device unpack selects its layout by packed buffer width;
+    equal widths with different entry maps would silently mis-slice
+    features). Must run BEFORE any encode or native attach captures the
+    row stride."""
+    used_widths: set[int] = set()
+    for schema in schemas:
+        layout = schema.packed_layout()
+        while layout.width in used_widths:
+            layout = layout.widened(layout.width + 4)
+            schema.install_packed_layout(layout)
+        used_widths.add(layout.width)
 
 
 def _build_trie(specs) -> _TrieNode:

@@ -81,13 +81,43 @@ class MicroBatcher:
         self._stop = threading.Event()
         self._stopping = False
         self._thread: threading.Thread | None = None
-        from concurrent.futures import ThreadPoolExecutor
+        from policy_server_tpu.runtime.workers import DaemonExecutor
 
-        self._overload_pool = ThreadPoolExecutor(
+        self._overload_pool = DaemonExecutor(
             max_workers=8, thread_name_prefix="overload-wait"
         )
+        # Device-dispatch pool: when a policy timeout is configured, the
+        # device call runs here under the dispatch watchdog instead of on
+        # the dispatch thread, so a compile stall or a hung transport
+        # cannot wedge the batching loop. The pool width bounds leaked
+        # threads under a persistent hang — once every worker is wedged,
+        # later batches never start and their items resolve in-band via
+        # the same watchdog timeout, which is exactly the reference's
+        # behavior when every evaluation hits the epoch deadline
+        # (src/lib.rs:176-190). Daemon threads (workers.py): a wedged call
+        # is abandoned at exit, never joined.
+        self._device_pool = DaemonExecutor(
+            max_workers=4, thread_name_prefix="device-dispatch"
+        )
+        # Batch-pipeline pool: the dispatch loop only FORMS batches; each
+        # batch's host phases + watchdog wait run here, so consecutive
+        # batches overlap (encode of batch N+1 overlaps device time of
+        # batch N) and one wedged batch never serializes its followers.
+        # The semaphore matches the pool width so a formed batch starts
+        # (and its watchdog arms) immediately — a batch is either running
+        # with a live watchdog, or its requests are still in the submission
+        # queue under the bounded-wait overload rules.
+        self._batch_workers = 4
+        self._batch_pool = DaemonExecutor(
+            max_workers=self._batch_workers, thread_name_prefix="batch"
+        )
+        self._inflight = threading.BoundedSemaphore(self._batch_workers)
+        # _dispatch runs on concurrent batch-pool workers: counter updates
+        # must be locked (+= is a racy read-modify-write).
+        self._stats_lock = threading.Lock()
         self.batches_dispatched = 0
         self.requests_dispatched = 0
+        self.deadline_abandoned_batches = 0  # introspection for tests/metrics
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -115,15 +145,22 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # In-flight batches finish resolving their futures (bounded by the
+        # watchdog when a policy timeout is configured).
+        self._batch_pool.shutdown(wait=True)
         # Drain: requests still queued must not leave their futures
         # unresolved (handlers await them).
         self._drain_rejecting()
-        # Overload waiters blocked in queue.put now find space (the drain
-        # freed the whole queue) or observe _stopping; joining the pool
-        # guarantees every waiter either rejected itself or enqueued — and
-        # the second drain resolves anything enqueued post-drain.
+        # Overload waiters sleep in bounded slices (_put_waiting), so every
+        # one observes _stopping within a slice and rejects itself — even
+        # when the queue is still full (waiter count can exceed capacity).
+        # Joining the pool guarantees each waiter either rejected or
+        # enqueued; the second drain resolves anything enqueued post-drain.
         self._overload_pool.shutdown(wait=True)
         self._drain_rejecting()
+        # wait=False: a wedged device call must not block shutdown — its
+        # futures were already resolved by the watchdog.
+        self._device_pool.shutdown(wait=False)
 
     def _drain_rejecting(self) -> None:
         while True:
@@ -166,14 +203,47 @@ class MicroBatcher:
         if self._stopping:
             self._reject_stopping(pending)
             return pending.future
-        try:
-            if self.policy_timeout is None:
-                self._queue.put(pending)  # reference parity: unbounded wait
-            else:
-                self._queue.put(pending, timeout=self.policy_timeout)
-        except queue.Full:
-            self._reject_overloaded(pending)
+        self._put_waiting(pending)
         return pending.future
+
+    # Overload waiters sleep in bounded slices so every blocked enqueue
+    # observes shutdown within one slice — an unbounded queue.put can block
+    # past the drain (capacity < waiter count) and deadlock shutdown's
+    # pool join while stranding its future.
+    _WAIT_SLICE_SECONDS = 0.05
+
+    def _put_waiting(self, pending: _Pending) -> bool:
+        """Blocking enqueue honoring overload semantics: waits for queue
+        space up to the request's remaining deadline (unbounded when the
+        policy timeout is disabled — reference parity with waiting on the
+        semaphore, handlers.rs:262-266), but always observing ``_stopping``.
+        Returns True when enqueued; False when resolved in-band (429/503)."""
+        while True:
+            if self._stopping:
+                self._reject_stopping(pending)
+                return False
+            if self.policy_timeout is None:
+                wait = self._WAIT_SLICE_SECONDS
+            else:
+                remaining = self.policy_timeout - (
+                    time.perf_counter() - pending.enqueued_at
+                )
+                if remaining <= 0:
+                    self._reject_overloaded(pending)
+                    return False
+                wait = min(self._WAIT_SLICE_SECONDS, remaining)
+            try:
+                self._queue.put(pending, timeout=wait)
+            except queue.Full:
+                continue
+            # Close the stranding window: shutdown may have completed BOTH
+            # of its drains between our _stopping check and this put — the
+            # item would then sit in a never-again-drained queue. Re-check
+            # and self-drain; duplicate rejection is harmless (_resolve
+            # tolerates already-done futures).
+            if self._stopping and not pending.future.done():
+                self._drain_rejecting()
+            return True
 
     async def submit_async(
         self,
@@ -181,45 +251,37 @@ class MicroBatcher:
         request: ValidateRequest,
         origin: service.RequestOrigin,
     ) -> Future:
-        """submit() for event-loop callers: waits for queue space without
-        blocking the loop. The fast path is a lock-free put; a full queue
-        parks the wait on the batcher's OWN overload executor (not the
-        loop's shared default executor — overload waits must never starve
-        unrelated run_in_executor users) and reuses the queue's FIFO
-        condition-variable wait — waiters are admitted oldest-first, same
-        as the sync path and the reference's semaphore. If even the
-        overload executor is saturated, the wait queues inside it, which
-        preserves FIFO and bounds thread count."""
-        import asyncio
-
+        """submit() for event-loop callers: never blocks the loop. The fast
+        path is a lock-free put; a full queue parks the wait on the
+        batcher's OWN overload executor (not the loop's shared default
+        executor — overload waits must never starve unrelated
+        run_in_executor users) and returns the Future IMMEDIATELY — the
+        caller awaits the future, which delivers the verdict, the 429
+        after the bounded wait, or the 503 at shutdown. Waiters sleep in
+        bounded slices (_put_waiting) so they observe shutdown; admission
+        under sustained overload is therefore approximately oldest-first
+        (a waiter re-entering after a slice can be leapfrogged within one
+        slice window), not strictly FIFO — the trade accepted for a
+        shutdown that can never strand a blocked waiter. Thread count is
+        bounded by the pool width."""
         pending = _Pending(policy_id, request, origin, Future())
         if self._stopping:
             self._reject_stopping(pending)
             return pending.future
         try:
             self._queue.put_nowait(pending)
+            # same stranding window as the sync path (_put_waiting):
+            # shutdown may have finished both drains between the _stopping
+            # check above and this put — self-drain if so.
+            if self._stopping and not pending.future.done():
+                self._drain_rejecting()
             return pending.future
         except queue.Full:
             pass
-
-        def blocking_put() -> None:
-            if self._stopping:
-                self._reject_stopping(pending)
-                return
-            try:
-                if self.policy_timeout is None:
-                    self._queue.put(pending)  # reference parity: unbounded
-                else:
-                    remaining = self.policy_timeout - (
-                        time.perf_counter() - pending.enqueued_at
-                    )
-                    self._queue.put(pending, timeout=max(0.0, remaining))
-            except queue.Full:
-                self._reject_overloaded(pending)
-
-        await asyncio.get_running_loop().run_in_executor(
-            self._overload_pool, blocking_put
-        )
+        try:
+            self._overload_pool.submit(self._put_waiting, pending)
+        except RuntimeError:  # pool already shut down (stop race)
+            self._reject_stopping(pending)
         return pending.future
 
     def _reject_overloaded(self, pending: _Pending) -> None:
@@ -274,11 +336,32 @@ class MicroBatcher:
                     batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
-            try:
-                self._dispatch(batch)
-            except Exception as e:  # noqa: BLE001 — last-resort guard
+            self._launch_batch(batch)
+
+    def _launch_batch(self, batch: list[_Pending]) -> None:
+        """Hand a formed batch to the pipeline pool (bounded in-flight)."""
+        acquired = False
+        while not acquired:
+            acquired = self._inflight.acquire(timeout=0.05)
+            if not acquired and (self._stopping or self._stop.is_set()):
                 for p in batch:
-                    self._fail(p, e)
+                    self._reject_stopping(p)
+                return
+        try:
+            self._batch_pool.submit(self._process_batch, batch)
+        except RuntimeError:  # pool shut down (stop race)
+            self._inflight.release()
+            for p in batch:
+                self._reject_stopping(p)
+
+    def _process_batch(self, batch: list[_Pending]) -> None:
+        try:
+            self._dispatch(batch)
+        except Exception as e:  # noqa: BLE001 — last-resort guard
+            for p in batch:
+                self._fail(p, e)
+        finally:
+            self._inflight.release()
 
     # -- batch evaluation --------------------------------------------------
 
@@ -310,8 +393,9 @@ class MicroBatcher:
         )
 
     def _dispatch(self, batch: list[_Pending]) -> None:
-        self.batches_dispatched += 1
-        self.requests_dispatched += len(batch)
+        with self._stats_lock:
+            self.batches_dispatched += 1
+            self.requests_dispatched += len(batch)
 
         # Phase 1 (host): pre-evaluation — id parse, namespace shortcut,
         # bounded pre-eval hooks. Items that short-circuit or fail resolve
@@ -343,18 +427,44 @@ class MicroBatcher:
         # Phase 2 (device): one fused dispatch for every runnable item.
         # Hooks already ran in phase 1 under the deadline, so skip them here.
         # A batch-level failure (device error, OOM on a new bucket) must fail
-        # THESE futures, never the dispatch thread.
-        try:
-            results = self.env.validate_batch(
-                [(p.policy_id, p.request) for p in runnable], run_hooks=False
+        # THESE futures, never the dispatch thread. With a policy timeout
+        # configured, the call runs on the device pool under the dispatch
+        # watchdog (below): device execution — compile stall on a cold
+        # (schema × batch) bucket, transport hang on a remote device — is
+        # bounded by the per-request deadline just like queue wait and host
+        # hooks, matching the reference's mid-execution epoch interrupt
+        # (src/lib.rs:176-190, tests/integration_test.rs:417).
+        pairs = [(p.policy_id, p.request) for p in runnable]
+        if self.policy_timeout is None:
+            # reference parity: timeout disabled ⇒ unbounded execution
+            try:
+                results = self.env.validate_batch(pairs, run_hooks=False)
+            except Exception as e:  # noqa: BLE001
+                for p in runnable:
+                    self._fail(p, e)
+                return
+            live = runnable
+        else:
+            dev_future = self._device_pool.submit(
+                self.env.validate_batch, pairs, run_hooks=False
             )
-        except Exception as e:  # noqa: BLE001
-            for p in runnable:
-                self._fail(p, e)
-            return
+            try:
+                results, live = self._watchdog_wait(dev_future, runnable)
+            except Exception as e:  # noqa: BLE001 — validate_batch raised
+                for p in runnable:
+                    self._fail(p, e)
+                return
+            if results is None:
+                return  # every item deadline-rejected; device work abandoned
 
         # Phase 3 (host): service-layer constraints + metrics per item.
+        # Items the watchdog already rejected are skipped — their verdicts
+        # arrived too late to be observable and must not double-count
+        # metrics.
+        live_ids = {id(p) for p in live}
         for p, result in zip(runnable, results):
+            if id(p) not in live_ids:
+                continue
             try:
                 if isinstance(result, PolicyInitializationError):
                     self._resolve(
@@ -364,11 +474,9 @@ class MicroBatcher:
                 if isinstance(result, Exception):
                     self._fail(p, result)
                     continue
-                # No post-dispatch deadline check: the verdict exists, and
-                # discarding completed work protects nothing (the reference's
-                # epoch deadline interrupts *execution*; ours bounds queue
-                # wait + host hooks, and compile stalls are eliminated by
-                # boot-time warmup).
+                # No further deadline check: the watchdog guaranteed this
+                # item's verdict arrived inside its deadline, and discarding
+                # completed work protects nothing.
                 self._resolve(
                     p,
                     service.post_evaluate(
@@ -378,6 +486,62 @@ class MicroBatcher:
                 )
             except Exception as e:  # noqa: BLE001 — never kill the loop
                 self._fail(p, e)
+
+    def _watchdog_wait(
+        self, dev_future: Future, runnable: list[_Pending]
+    ) -> tuple[list | None, list[_Pending]]:
+        """Dispatch watchdog: wait for the device batch, but never past any
+        item's deadline. Items whose deadline passes while the device call
+        is still running resolve in-band with "execution deadline exceeded"
+        (500) — the batched analog of the reference interrupting a running
+        wasm instance at its epoch deadline (src/lib.rs:176-190,
+        src/cli.rs:164-169). Returns ``(results, live_items)``; when every
+        item expired, returns ``(None, [])`` and leaves the device work to
+        finish (and be discarded) in the background, so no request future
+        can outlive ``policy_timeout`` unresolved."""
+        from concurrent.futures import TimeoutError as FutureTimeout
+
+        live = list(runnable)
+        while True:
+            next_deadline = min(
+                p.enqueued_at + self.policy_timeout for p in live
+            )
+            wait = max(0.0, next_deadline - time.perf_counter())
+            try:
+                return dev_future.result(timeout=wait), live
+            except FutureTimeout:
+                now = time.perf_counter()
+                expired = [
+                    p for p in live
+                    if now >= p.enqueued_at + self.policy_timeout
+                ]
+                for p in expired:
+                    self._reject_deadline(p)
+                if expired:
+                    live = [
+                        p for p in live
+                        if now < p.enqueued_at + self.policy_timeout
+                    ]
+                if not live:
+                    with self._stats_lock:
+                        self.deadline_abandoned_batches += 1
+                    dev_future.add_done_callback(self._discard_late_batch)
+                    return None, []
+
+    @staticmethod
+    def _discard_late_batch(dev_future: Future) -> None:
+        """Completion sink for an abandoned device batch: surface the error
+        (if any) in logs, never raise."""
+        from policy_server_tpu.telemetry.tracing import logger
+
+        exc = dev_future.exception()
+        if exc is not None:
+            logger.warning("abandoned device batch failed late: %s", exc)
+        else:
+            logger.info(
+                "abandoned device batch completed after deadline; "
+                "verdicts discarded"
+            )
 
     def _run_hooks_with_deadline(self, p: _Pending) -> bool:
         """Run the target's pre-eval hooks (latency-fault fixtures) off the

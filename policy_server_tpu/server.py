@@ -77,6 +77,25 @@ class PolicyServer:
                 "jax_compilation_cache_dir", config.compilation_cache_dir
             )
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        if config.distributed_coordinator:
+            # Multi-host bring-up BEFORE any device enumeration: the mesh
+            # built below must span every process's devices (SURVEY.md §7.2
+            # step 10; ICI within a slice, DCN across slices).
+            from policy_server_tpu.parallel.mesh import initialize_distributed
+
+            initialize_distributed(
+                coordinator_address=config.distributed_coordinator,
+                num_processes=config.distributed_num_processes,
+                process_id=config.distributed_process_id,
+            )
+            logger.info(
+                "jax.distributed initialized",
+                extra={"span_fields": {
+                    "coordinator": config.distributed_coordinator,
+                    "process_id": config.distributed_process_id,
+                    "num_processes": config.distributed_num_processes,
+                }},
+            )
 
         resolver = module_resolver
         if resolver is None and (config.sources or config.verification_config
@@ -294,7 +313,14 @@ def _build_context_service(config: Config):
             "an empty cluster: %s", e,
         )
         fetcher = StaticContextFetcher()
-    return ContextSnapshotService(fetcher, wanted).start()
+    return ContextSnapshotService(
+        fetcher,
+        wanted,
+        refresh_seconds=config.context_refresh_seconds,
+        # None = auto (watch when the fetcher supports it); False = forced
+        # poll mode via --context-no-watch
+        watch=None if config.context_watch else False,
+    ).start()
 
 
 def _build_environment(config: Config, builder_kwargs: dict):

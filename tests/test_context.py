@@ -112,6 +112,179 @@ def test_batched_context_evaluation():
     assert [r.allowed for r in results] == [True, False, True]
 
 
+# -- watch-based freshness (staleness contract, context/service.py) ---------
+
+
+class FakeWatchFetcher:
+    """list+watch double: LIST serves ``self.items``; watch() yields events
+    pushed through a queue (None = close the stream cleanly)."""
+
+    def __init__(self, items: list[dict]):
+        import queue as _q
+
+        self.items = list(items)
+        self.events: "_q.Queue" = _q.Queue()
+        self.lists = 0
+        self.watches = 0
+        self.watch_versions: list[str] = []
+
+    # poll-mode API (boot prefetch uses it)
+    def fetch(self, wanted):
+        from policy_server_tpu.context.service import resource_key
+
+        return {resource_key(r): tuple(self.items) for r in wanted}
+
+    def list_with_version(self, resource):
+        self.lists += 1
+        return tuple(self.items), f"rv-{self.lists}"
+
+    def watch(self, resource, resource_version):
+        self.watches += 1
+        self.watch_versions.append(resource_version)
+        while True:
+            ev = self.events.get(timeout=10)
+            if ev is None:  # clean server-side stream close
+                return
+            if isinstance(ev, Exception):
+                raise ev
+            yield ev
+
+
+def wait_for(predicate, timeout=5.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def watch_event(etype: str, name: str, rv: str = "1") -> dict:
+    # name-only metadata, matching ns_object() fixtures: identity falls
+    # back to (namespace, name) when uid is absent (_object_key)
+    return {
+        "type": etype,
+        "object": {"metadata": {"name": name, "resourceVersion": rv}},
+    }
+
+
+@pytest.fixture()
+def watch_service():
+    from policy_server_tpu.models.policy import ContextAwareResource
+
+    fetcher = FakeWatchFetcher([ns_object("seed")])
+    # refresh_seconds=0.5: periodic resync (10x = 5s) stays outside the
+    # test window so LIST counts are deterministic
+    service = ContextSnapshotService(
+        fetcher,
+        wanted=[ContextAwareResource("v1", "Namespace")],
+        refresh_seconds=0.5,
+    ).start()
+    yield fetcher, service
+    service._stop.set()  # noqa: SLF001 — stop BEFORE waking the watcher so
+    fetcher.events.put(None)  # it exits instead of re-listing
+    service.stop()
+
+
+def names(service) -> set:
+    return {
+        (o.get("metadata") or {}).get("name")
+        for o in service.snapshot().resources.get("v1/Namespace", ())
+    }
+
+
+def test_watch_mode_applies_events(watch_service):
+    """ADDED/MODIFIED/DELETED events update the snapshot without re-LIST:
+    freshness = event latency, not the refresh period."""
+    fetcher, service = watch_service
+    assert service.watch_enabled
+    assert wait_for(lambda: fetcher.watches == 1)
+    baseline_lists = fetcher.lists
+
+    fetcher.events.put(watch_event("ADDED", "fresh"))
+    assert wait_for(lambda: "fresh" in names(service))
+    fetcher.events.put(watch_event("DELETED", "seed"))
+    assert wait_for(lambda: "seed" not in names(service))
+    assert fetcher.lists == baseline_lists  # no re-list needed
+    assert service.snapshot().version >= 3
+
+
+def test_watch_error_event_triggers_relist(watch_service):
+    """A 410-Gone-style ERROR event falls back to a fresh LIST and resumes
+    watching from the new resourceVersion."""
+    fetcher, service = watch_service
+    assert wait_for(lambda: fetcher.watches == 1)
+    fetcher.items.append(ns_object("recovered"))
+    fetcher.events.put({"type": "ERROR", "object": {"code": 410}})
+    assert wait_for(lambda: fetcher.watches == 2)
+    assert wait_for(lambda: "recovered" in names(service))
+    assert fetcher.watch_versions == ["rv-1", "rv-2"]
+
+
+def test_watch_transport_error_backs_off_and_recovers(watch_service):
+    """A transport failure keeps the last good snapshot serving and
+    re-establishes list+watch after the backoff."""
+    fetcher, service = watch_service
+    assert wait_for(lambda: fetcher.watches == 1)
+    assert "seed" in names(service)  # last good stays visible
+    fetcher.items.append(ns_object("after-crash"))
+    fetcher.events.put(ConnectionError("stream reset"))
+    assert wait_for(lambda: fetcher.watches == 2)
+    assert wait_for(lambda: "after-crash" in names(service))
+    assert "seed" in names(service)
+
+
+def test_watch_resync_relists_after_interval():
+    """The periodic resync safety net: a watch event silently dropped by
+    the stream is repaired by the next post-interval re-LIST."""
+    from policy_server_tpu.models.policy import ContextAwareResource
+
+    fetcher = FakeWatchFetcher([ns_object("a")])
+    service = ContextSnapshotService(
+        fetcher,
+        wanted=[ContextAwareResource("v1", "Namespace")],
+        refresh_seconds=0.01,
+    )
+    service.RESYNC_MULTIPLIER = 1  # resync due 10ms after the boot LIST
+    service.start()
+    try:
+        assert wait_for(lambda: fetcher.watches == 1)
+        # an object appears but its watch event is "lost" (never pushed)
+        fetcher.items.append(ns_object("missed"))
+        import time as _time
+
+        _time.sleep(0.05)  # let the resync interval elapse
+        fetcher.events.put(None)  # stream close → resync due → re-LIST
+        assert wait_for(lambda: "missed" in names(service))
+        assert fetcher.lists >= 2
+    finally:
+        service._stop.set()  # noqa: SLF001
+        fetcher.events.put(None)
+        service.stop()
+
+
+def test_poll_mode_when_watch_disabled():
+    """--context-no-watch forces periodic LIST refresh."""
+    from policy_server_tpu.models.policy import ContextAwareResource
+
+    fetcher = FakeWatchFetcher([ns_object("a")])
+    service = ContextSnapshotService(
+        fetcher,
+        wanted=[ContextAwareResource("v1", "Namespace")],
+        refresh_seconds=0.05,
+        watch=False,
+    ).start()
+    try:
+        assert not service.watch_enabled
+        fetcher.items.append(ns_object("b"))
+        assert wait_for(lambda: "b" in names(service))
+        assert fetcher.watches == 0
+    finally:
+        service.stop()
+
+
 # -- kube client TLS semantics ----------------------------------------------
 
 
@@ -129,7 +302,7 @@ def test_kube_client_never_silently_skips_tls(monkeypatch, tmp_path):
         def json(self):
             return {}
 
-    def fake_get(url, headers=None, verify=None, timeout=None):
+    def fake_get(url, headers=None, verify=None, timeout=None, **kwargs):
         captured.append(verify)
         return _Resp()
 

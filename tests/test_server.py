@@ -93,7 +93,10 @@ def make_config(**overrides) -> Config:
         max_batch_size=8,
         batch_timeout_ms=1.0,
         enable_pprof=True,
-        warmup_at_boot=False,  # CPU tests: skip multi-bucket warmup cost
+        # Warmup is required with a tight deadline: the dispatch watchdog
+        # bounds device execution, so an un-warmed bucket's compile stall
+        # is (correctly) rejected as "execution deadline exceeded".
+        warmup_at_boot=True,
     )
     defaults.update(overrides)
     return Config(**defaults)
