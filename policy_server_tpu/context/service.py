@@ -308,11 +308,24 @@ class ContextSnapshotService:
             # --ignore-kubernetes-connection-failure handling stays in
             # force); each watcher is seeded with the LIST's
             # resourceVersion and starts with a watch, not a second LIST.
-            seeds: dict[str, str] = {}
+            seeds: dict[str, str | None] = {}
             for r in sorted(self.wanted, key=resource_key):
-                items, rv = self.fetcher.list_with_version(r)
-                self._replace_kind(resource_key(r), items)
-                seeds[resource_key(r)] = rv
+                key = resource_key(r)
+                try:
+                    items, rv = self.fetcher.list_with_version(r)
+                except requests.HTTPError as e:
+                    # Non-2xx (e.g. RBAC denies list on one kind): same
+                    # tolerance as poll-mode fetch() — that kind serves an
+                    # empty view and its watcher keeps retrying with
+                    # backoff. Transport errors still propagate: boot
+                    # fails unless --ignore-kubernetes-connection-failure
+                    # chose a StaticContextFetcher instead.
+                    logger.error("context boot list %s failed: %s", key, e)
+                    self._replace_kind(key, ())
+                    seeds[key] = None
+                    continue
+                self._replace_kind(key, items)
+                seeds[key] = rv
             for r in sorted(self.wanted, key=resource_key):
                 t = threading.Thread(
                     target=self._watch_loop,

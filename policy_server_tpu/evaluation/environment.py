@@ -479,22 +479,47 @@ class EvaluationEnvironment:
             return frozenset(out)
         return target.ctx_allowlist
 
+    def _providers_of(self, target: "BoundPolicy | BoundGroup") -> list:
+        """Host-side context providers of a target's program(s)
+        (PolicyProgram.context_provider — cached host-capability results
+        fed to the device at encode time)."""
+        bps = (
+            list(target.members.values())
+            if isinstance(target, BoundGroup)
+            else [target]
+        )
+        return [
+            bp.precompiled.program.context_provider
+            for bp in bps
+            if bp.precompiled.program.context_provider is not None
+        ]
+
     def payload_for(self, target: "BoundPolicy | BoundGroup", request: ValidateRequest) -> Any:
-        """The evaluation payload: the request document, plus — for
-        context-aware policies — the capability-filtered cluster snapshot
-        under ``__context__`` (context/service.py; the reference's
-        EvaluationContext allowlist, evaluation_environment.rs:243-247)."""
+        """The evaluation payload: the request document, plus — under
+        ``__context__`` — the capability-filtered cluster snapshot for
+        context-aware policies (context/service.py; the reference's
+        EvaluationContext allowlist, evaluation_environment.rs:243-247)
+        and any program context-provider output (cached host capabilities
+        such as image-signature verification)."""
         payload = request.payload()
         allowlist = self._allowlist_of(target)
-        if not allowlist or self.context_service is None:
+        providers = self._providers_of(target)
+        has_snapshot = bool(allowlist) and self.context_service is not None
+        if not has_snapshot and not providers:
             return payload
-        snapshot = self.context_service.snapshot()
         payload = dict(payload)
-        payload[CONTEXT_KEY] = snapshot.view(allowlist)
+        ctx: dict = {}
+        if has_snapshot:
+            ctx.update(self.context_service.snapshot().view(allowlist))
+        for provider in providers:
+            ctx.update(provider(payload))
+        payload[CONTEXT_KEY] = ctx
         return payload
 
     def _payload_blob(self, target: "BoundPolicy | BoundGroup", request: ValidateRequest) -> bytes:
-        if self._allowlist_of(target) and self.context_service is not None:
+        if (
+            self._allowlist_of(target) and self.context_service is not None
+        ) or self._providers_of(target):
             return json.dumps(
                 self.payload_for(target, request), separators=(",", ":")
             ).encode()
@@ -681,7 +706,11 @@ class EvaluationEnvironment:
         pid = PolicyID.parse(policy_id)
         target = self._lookup_top_level(pid)
         payload = self.payload_for(target, request)
-        self._run_pre_eval_hooks(target, payload)
+        if pre_eval_hooks_of(target):
+            self._run_pre_eval_hooks(target, payload)
+            # rebuild: context providers must observe hook results (e.g.
+            # image verification caching happens in the hook)
+            payload = self.payload_for(target, request)
 
         if self.backend == "oracle":
             return self._materialize(target, request, self._oracle_outputs(payload))
@@ -779,8 +808,10 @@ class EvaluationEnvironment:
                 target = self._lookup_top_level(PolicyID.parse(policy_id))
                 targets[i] = target
                 payload = self.payload_for(target, request)
-                if run_hooks:
+                if run_hooks and pre_eval_hooks_of(target):
                     self._run_pre_eval_hooks(target, payload)
+                    # rebuild: providers must observe hook results
+                    payload = self.payload_for(target, request)
                 if self.backend == "oracle":
                     results[i] = self._materialize(
                         target, request, self._oracle_outputs(payload)

@@ -234,6 +234,14 @@ class PolicyProgram:
     # tests/integration_test.rs:367-423). Runs before encoding; subject to
     # the policy-timeout deadline.
     pre_eval_hook: Callable[[Any], None] | None = None
+    # host-side context provider: fn(payload) -> {context_key: [objects]}
+    # merged into the payload's __context__ slice at encode time. This is
+    # how host capabilities with per-request inputs (image-signature
+    # verification — the reference's sigstore callback,
+    # SURVEY.md §2.2 callback_handler row) feed their CACHED results to
+    # the device program: the pre_eval_hook does the blocking work under
+    # the request deadline, the provider is a pure cache read.
+    context_provider: Callable[[Any], Mapping[str, list]] | None = None
 
     def typecheck(self) -> None:
         if not self.rules:

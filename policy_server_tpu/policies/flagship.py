@@ -9,7 +9,9 @@ policy groups with boolean expressions."""
 
 from __future__ import annotations
 
+import functools
 import random
+import tempfile
 from typing import Any
 
 from policy_server_tpu.models.policy import (
@@ -18,8 +20,51 @@ from policy_server_tpu.models.policy import (
 )
 
 
+@functools.lru_cache(maxsize=1)
+def _signature_fixture() -> tuple[str, str]:
+    """(store_dir, pub_pem): process-local signature store for the
+    verify-image-signatures entries — the provenance-relevant firehose
+    images are signed with a deterministic Ed25519 key so the benchmark
+    exercises the REAL verification pipeline (hook → cached crypto →
+    context provider → device gate), with some images left unsigned to
+    exercise the rejection path."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    from policy_server_tpu.policies.images import (
+        sign_image,
+        write_signature_bundle,
+    )
+
+    key = Ed25519PrivateKey.from_private_bytes(bytes(range(32)))
+    priv_pem = key.private_bytes(
+        Encoding.PEM, PrivateFormat.PKCS8, NoEncryption()
+    )
+    pub_pem = key.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    ).decode()
+    store = tempfile.mkdtemp(prefix="flagship-image-sigs-")
+    for image in (
+        "registry.prod.example.com/api/server:v1.4.2",
+        "registry.prod.example.com/web/frontend:2024.1",
+        "docker.io/library/nginx:1.25",
+        # docker.io/library/redis:latest matches the glob but stays
+        # UNSIGNED: the unverified-rejection path sees real traffic
+    ):
+        write_signature_bundle(store, image, sign_image(priv_pem, image))
+    return store, pub_pem
+
+
 def flagship_policy_specs() -> dict[str, dict[str, Any]]:
     """32 top-level entries (30 singles + 2 groups)."""
+    sig_store, sig_pub = _signature_fixture()
     specs: dict[str, dict[str, Any]] = {
         "pod-privileged": {"module": "builtin://pod-privileged"},
         "pod-privileged-monitor": {
@@ -63,9 +108,11 @@ def flagship_policy_specs() -> dict[str, dict[str, Any]]:
             "module": "builtin://verify-image-signatures",
             "settings": {
                 "signatures": [
-                    {"image": "registry.prod.example.com/*"},
-                    {"image": "docker.io/library/*"},
-                ]
+                    {"image": "registry.prod.example.com/*",
+                     "pubKeys": [sig_pub]},
+                    {"image": "docker.io/library/*", "pubKeys": [sig_pub]},
+                ],
+                "signatureStore": sig_store,
             },
         },
         "raw-gate": {"module": "builtin://raw-mutation", "allowedToMutate": True},
@@ -104,7 +151,13 @@ def flagship_policy_specs() -> dict[str, dict[str, Any]]:
         "policies": {
             "signed": {
                 "module": "builtin://verify-image-signatures",
-                "settings": {"signatures": [{"image": "registry.prod.example.com/*"}]},
+                "settings": {
+                    "signatures": [
+                        {"image": "registry.prod.example.com/*",
+                         "pubKeys": [sig_pub]},
+                    ],
+                    "signatureStore": sig_store,
+                },
             },
             "trusted": {
                 "module": "builtin://trusted-repos",

@@ -16,6 +16,7 @@ matching what the reference hands to WASM guests.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Any, Mapping
 
@@ -356,37 +357,124 @@ class TrustedRepos(BuiltinPolicy):
 class VerifyImageSignatures(BuiltinPolicy):
     """Image-signature policy (upstream verify-image-signatures; the
     ``sigstore_pgp`` / ``sigstore_gh_action`` members of the reference's
-    example group). Settings: signatures: [{image: <glob>, ...}].
+    example group). Settings: ``signatures: [{image: <glob>, pubKeys:
+    [<PEM>...], annotations?: {...}}]``, plus the hermetic ``signatureStore``
+    directory (see policies/images.py for the transport).
 
-    TPU-native semantics: every container image must match at least one
-    configured signature entry's image glob; the cryptographic verification
-    of matched images is delegated to the host-side context-snapshot service
-    (full sigstore verification requires registry egress, which the data
-    path never blocks on — SURVEY.md §2.2 callback_handler row). Images
-    matching no entry are rejected, like upstream."""
+    TPU-native split (SURVEY.md §2.2 callback_handler/sigstore rows): the
+    device keeps the glob pre-filter batched; REAL Ed25519 verification of
+    matched images runs host-side in the pre-eval hook (cached per image
+    ref, bounded by the request deadline), and a context provider feeds the
+    cached result count to the device program — so a
+    matching-glob-but-unsigned image is rejected, unlike a pure glob
+    filter. Keyless entry kinds (githubActions / keyless certificates)
+    need Fulcio/Rekor egress and FAIL settings validation loudly."""
 
     name = "verify-image-signatures"
     upstream_equivalents = ("ghcr.io/kubewarden/policies/verify-image-signatures",)
 
     def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        from policy_server_tpu.policies.images import (
+            ImageSignatureVerifier,
+            SignatureEntry,
+            extract_container_images,
+            file_bundle_source,
+        )
+
         signatures = settings.get("signatures")
         if not isinstance(signatures, list) or not signatures:
             raise SettingsError("setting 'signatures' must be a non-empty list")
-        patterns: list[str] = []
+        entries: list[SignatureEntry] = []
         for s in signatures:
             if not isinstance(s, Mapping) or not isinstance(s.get("image"), str):
                 raise SettingsError("each signatures entry must have an 'image' glob")
-            patterns.append(s["image"])
+            if any(k in s for k in ("githubActions", "keylessPrefix", "keyless")):
+                raise SettingsError(
+                    "signature entry kind requires sigstore keyless "
+                    "verification (Fulcio/Rekor egress), which this build "
+                    "does not support"
+                )
+            pub_keys = s.get("pubKeys")
+            if not isinstance(pub_keys, list) or not all(
+                isinstance(k, str) for k in pub_keys
+            ) or not pub_keys:
+                raise SettingsError(
+                    "each signatures entry must have a non-empty 'pubKeys' "
+                    "list of PEM Ed25519 public keys"
+                )
+            annotations = s.get("annotations") or {}
+            if not isinstance(annotations, Mapping):
+                raise SettingsError("signatures entry 'annotations' must be a map")
+            entries.append(
+                SignatureEntry(
+                    image_glob=s["image"],
+                    pub_keys=tuple(pub_keys),
+                    annotations=dict(annotations),
+                )
+            )
+        store = settings.get("signatureStore")
+        if store is not None and not isinstance(store, str):
+            raise SettingsError("setting 'signatureStore' must be a directory path")
+        verifier = ImageSignatureVerifier(
+            entries, file_bundle_source(store) if store else None
+        )
+        patterns = [e.image_glob for e in entries]
+        # Unique per distinct settings: two group members with different
+        # keys (the reference's sigstore_pgp/sigstore_gh_action example)
+        # must not share one context slot.
+        digest = hashlib.sha256(
+            repr([(e.image_glob, e.pub_keys, sorted(e.annotations.items()))
+                  for e in entries]).encode()
+        ).hexdigest()[:8]
+        # dot-free: IR paths split segments on '.', context keys must be
+        # single segments (same convention as "v1/Namespace")
+        ctx_key = f"kubewarden-io/ImageVerification-{digest}"
+
+        def hook(payload: Any) -> None:
+            verifier.ensure(extract_container_images(payload))
+
+        # Warm-path escape hatch for the batcher's hook-deadline machinery:
+        # when every image is already cached the hook would do no blocking
+        # work, so no hook thread is needed (steady-state = dict lookups).
+        hook.skip_if = lambda payload: verifier.all_cached(  # type: ignore[attr-defined]
+            extract_container_images(payload)
+        )
+
+        def provider(payload: Any) -> Mapping[str, Any]:
+            images = extract_container_images(payload)
+            return {ctx_key: {"unverified_count": len(verifier.unverified(images))}}
+
+        def unverified_message(payload: Any) -> str:
+            bad = verifier.unverified(extract_container_images(payload))
+            return (
+                "image signature verification failed for: "
+                + ", ".join(f"'{i}'" for i in bad)
+            )
+
         return PolicyProgram(
             rules=(
                 Rule(
-                    "unverified-image",
+                    "unmatched-image",
                     _deny_any_container(
                         Exists(Elem("image")) & _image_matches_none(patterns)
                     ),
-                    "image signature verification failed: image matches no signature entry",
+                    "image signature verification failed: image matches no "
+                    "signature entry",
                 ),
-            )
+                Rule(
+                    "unverified-image",
+                    gt(
+                        Path(
+                            f"__context__.{ctx_key}.unverified_count",
+                            DType.I32,
+                        ),
+                        0,
+                    ),
+                    unverified_message,
+                ),
+            ),
+            pre_eval_hook=hook,
+            context_provider=provider,
         )
 
 

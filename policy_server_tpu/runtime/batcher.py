@@ -414,8 +414,14 @@ class MicroBatcher:
             if short is not None:
                 self._resolve(p, short)
                 continue
-            if not self._run_hooks_with_deadline(p):
-                continue  # deadline rejection already delivered
+            try:
+                if not self._run_hooks_with_deadline(p):
+                    continue  # deadline rejection already delivered
+            except Exception as e:  # noqa: BLE001 — per-item isolation: a
+                # payload that breaks its own hook setup must not fail the
+                # whole batch
+                self._fail(p, e)
+                continue
             remaining = self._remaining(p)
             if remaining is not None and remaining <= 0:
                 self._reject_deadline(p)
@@ -560,6 +566,15 @@ class MicroBatcher:
         # payload_for, not payload(): hook-observable input is identical on
         # the batcher and direct-validate paths (incl. __context__ snapshot)
         payload = self.env.payload_for(target, p.request)
+        # Warm fast path: a hook may advertise (via .skip_if) that it would
+        # do no blocking work for this payload — e.g. the image-signature
+        # verifier with every image cached. All hooks skippable ⇒ no
+        # thread, no handoff; production hooks stay off the hot path.
+        if all(
+            getattr(h, "skip_if", None) is not None and h.skip_if(payload)
+            for h in hooks
+        ):
+            return True
         remaining = self._remaining(p)
         # One daemon thread per hook run (not a fixed pool): a timed-out
         # hook leaks only its own thread until it finishes — it can never

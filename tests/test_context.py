@@ -265,6 +265,34 @@ def test_watch_resync_relists_after_interval():
         service.stop()
 
 
+def test_boot_list_http_error_serves_empty_view_for_that_kind():
+    """RBAC denying list on one kind (HTTP 403) must not crash boot: the
+    kind serves an empty view and its watcher keeps retrying."""
+    import requests as _requests
+
+    from policy_server_tpu.models.policy import ContextAwareResource
+
+    class DeniedFetcher(FakeWatchFetcher):
+        def list_with_version(self, resource):
+            self.lists += 1
+            resp = _requests.Response()
+            resp.status_code = 403
+            raise _requests.HTTPError("403 Forbidden", response=resp)
+
+    fetcher = DeniedFetcher([ns_object("hidden")])
+    service = ContextSnapshotService(
+        fetcher,
+        wanted=[ContextAwareResource("v1", "Namespace")],
+        refresh_seconds=0.5,
+    ).start()  # must not raise
+    try:
+        assert service.snapshot().resources.get("v1/Namespace") == ()
+    finally:
+        service._stop.set()  # noqa: SLF001
+        fetcher.events.put(None)
+        service.stop()
+
+
 def test_poll_mode_when_watch_disabled():
     """--context-no-watch forces periodic LIST refresh."""
     from policy_server_tpu.models.policy import ContextAwareResource

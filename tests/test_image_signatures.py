@@ -1,0 +1,240 @@
+"""verify-image-signatures tests: REAL Ed25519 verification of container
+images through the host hook + context-provider pipeline (SURVEY.md §2.2
+callback_handler/sigstore rows; round-2 VERDICT weak #4 — a
+matching-glob-but-unsigned image must be REJECTED, not glob-accepted)."""
+
+from __future__ import annotations
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    NoEncryption,
+    PrivateFormat,
+    PublicFormat,
+)
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.evaluation.errors import BootstrapFailure
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.policies.images import sign_image, write_signature_bundle
+
+from conftest import build_admission_review_dict
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    key = Ed25519PrivateKey.generate()
+    priv = key.private_bytes(
+        Encoding.PEM, PrivateFormat.PKCS8, NoEncryption()
+    )
+    pub = key.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    )
+    return priv, pub.decode()
+
+
+@pytest.fixture(scope="module")
+def other_keypair():
+    key = Ed25519PrivateKey.generate()
+    priv = key.private_bytes(Encoding.PEM, PrivateFormat.PKCS8, NoEncryption())
+    return priv
+
+
+def pod_with_images(*images: str) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "containers": [
+                {"name": f"c{i}", "image": img} for i, img in enumerate(images)
+            ]
+        },
+    }
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def build_env(store_dir: str, pub_pem: str, backend: str = "jax"):
+    entry = parse_policy_entry(
+        "sig",
+        {
+            "module": "builtin://verify-image-signatures",
+            "settings": {
+                "signatures": [
+                    {"image": "registry.example/trusted/*", "pubKeys": [pub_pem]}
+                ],
+                "signatureStore": store_dir,
+            },
+        },
+    )
+    return EvaluationEnvironmentBuilder(backend=backend).build({"sig": entry})
+
+
+SIGNED = "registry.example/trusted/app:1.0"
+UNSIGNED = "registry.example/trusted/evil:1.0"  # matches the glob, no signature
+OUTSIDE = "docker.io/library/nginx:latest"  # matches no glob
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory, keypair):
+    priv, _pub = keypair
+    d = tmp_path_factory.mktemp("sigstore")
+    write_signature_bundle(str(d), SIGNED, sign_image(priv, SIGNED))
+    return str(d)
+
+
+@pytest.mark.parametrize("backend", ["jax", "oracle"])
+def test_signed_image_accepted(store, keypair, backend):
+    env = build_env(store, keypair[1], backend)
+    assert env.validate("sig", pod_with_images(SIGNED)).allowed
+
+
+@pytest.mark.parametrize("backend", ["jax", "oracle"])
+def test_glob_matching_but_unsigned_image_rejected(store, keypair, backend):
+    """THE round-2 gap: matching the glob must not be enough — without a
+    valid signature the image is rejected."""
+    env = build_env(store, keypair[1], backend)
+    resp = env.validate("sig", pod_with_images(UNSIGNED))
+    assert not resp.allowed
+    assert "signature verification failed" in resp.status.message
+    assert UNSIGNED in resp.status.message
+
+
+def test_image_outside_all_globs_rejected(store, keypair):
+    env = build_env(store, keypair[1])
+    resp = env.validate("sig", pod_with_images(OUTSIDE))
+    assert not resp.allowed
+    assert "matches no signature entry" in resp.status.message
+
+
+def test_signature_by_wrong_key_rejected(tmp_path, keypair, other_keypair):
+    """A bundle signed by a DIFFERENT key than the configured pubKey is
+    not authentic — crypto, not presence, decides."""
+    image = "registry.example/trusted/forged:1"
+    write_signature_bundle(
+        str(tmp_path), image, sign_image(other_keypair, image)
+    )
+    env = build_env(str(tmp_path), keypair[1])
+    resp = env.validate("sig", pod_with_images(image))
+    assert not resp.allowed
+    assert "signature verification failed" in resp.status.message
+
+
+def test_replayed_bundle_for_other_image_rejected(tmp_path, keypair):
+    """A valid bundle for image A stored under image B's slot must fail:
+    the signed payload binds the docker-reference."""
+    a = "registry.example/trusted/a:1"
+    b = "registry.example/trusted/b:1"
+    write_signature_bundle(str(tmp_path), b, sign_image(keypair[0], a))
+    env = build_env(str(tmp_path), keypair[1])
+    resp = env.validate("sig", pod_with_images(b))
+    assert not resp.allowed
+
+
+def test_annotation_requirements_bound_to_signature(tmp_path, keypair):
+    """Entry annotations must match the SIGNED annotations."""
+    image = "registry.example/trusted/ann:1"
+    write_signature_bundle(
+        str(tmp_path), image,
+        sign_image(keypair[0], image, annotations={"env": "prod"}),
+    )
+    entry = parse_policy_entry(
+        "sig",
+        {
+            "module": "builtin://verify-image-signatures",
+            "settings": {
+                "signatures": [
+                    {
+                        "image": "registry.example/trusted/*",
+                        "pubKeys": [keypair[1]],
+                        "annotations": {"env": "staging"},  # mismatch
+                    }
+                ],
+                "signatureStore": str(tmp_path),
+            },
+        },
+    )
+    env = EvaluationEnvironmentBuilder(backend="jax").build({"sig": entry})
+    assert not env.validate("sig", pod_with_images(image)).allowed
+
+
+def test_mixed_batch_signed_and_unsigned(store, keypair):
+    """Batched evaluation: per-row verdicts stay independent."""
+    env = build_env(store, keypair[1])
+    results = env.validate_batch(
+        [
+            ("sig", pod_with_images(SIGNED)),
+            ("sig", pod_with_images(UNSIGNED)),
+            ("sig", pod_with_images(SIGNED)),
+        ]
+    )
+    assert [r.allowed for r in results] == [True, False, True]
+
+
+def test_signature_published_after_first_sight_honored(tmp_path, keypair, monkeypatch):
+    """Negative results expire (NEGATIVE_TTL_SECONDS): publishing a bundle
+    after an image was first rejected takes effect without a restart."""
+    from policy_server_tpu.policies.images import ImageSignatureVerifier
+
+    monkeypatch.setattr(ImageSignatureVerifier, "NEGATIVE_TTL_SECONDS", 0.0)
+    image = "registry.example/trusted/late:1"
+    env = build_env(str(tmp_path), keypair[1])
+    assert not env.validate("sig", pod_with_images(image)).allowed
+    write_signature_bundle(str(tmp_path), image, sign_image(keypair[0], image))
+    assert env.validate("sig", pod_with_images(image)).allowed
+
+
+def test_non_mapping_object_rejected_not_crashing(store, keypair):
+    """A crafted request whose object is not a pod-shaped mapping must not
+    raise — it has no containers, so no glob matches and no crypto runs;
+    the policy's structural rules decide."""
+    doc = build_admission_review_dict()
+    doc["request"]["object"] = "not-a-pod"
+    req = ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+    env = build_env(store, keypair[1])
+    resp = env.validate("sig", req)  # no exception
+    assert resp.allowed in (True, False)
+
+
+def test_keyless_entries_fail_settings_validation():
+    with pytest.raises(BootstrapFailure, match="keyless"):
+        EvaluationEnvironmentBuilder(backend="jax").build(
+            {
+                "sig": parse_policy_entry(
+                    "sig",
+                    {
+                        "module": "builtin://verify-image-signatures",
+                        "settings": {
+                            "signatures": [
+                                {
+                                    "image": "x/*",
+                                    "githubActions": {"owner": "kubewarden"},
+                                }
+                            ]
+                        },
+                    },
+                )
+            }
+        )
+
+
+def test_missing_pubkeys_fail_settings_validation():
+    with pytest.raises(BootstrapFailure, match="pubKeys"):
+        EvaluationEnvironmentBuilder(backend="jax").build(
+            {
+                "sig": parse_policy_entry(
+                    "sig",
+                    {
+                        "module": "builtin://verify-image-signatures",
+                        "settings": {"signatures": [{"image": "x/*"}]},
+                    },
+                )
+            }
+        )
