@@ -45,6 +45,7 @@ from policy_server_tpu.evaluation.environment import (
 from policy_server_tpu.evaluation.errors import PolicyInitializationError
 from policy_server_tpu.evaluation.policy_id import PolicyID
 from policy_server_tpu.models import AdmissionResponse, ValidateRequest
+from policy_server_tpu.telemetry import otlp
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
 
@@ -56,6 +57,11 @@ class _Pending:
     origin: service.RequestOrigin
     future: Future
     enqueued_at: float = field(default_factory=time.perf_counter)
+    # captured at submission on the handler's thread; worker threads parent
+    # their child spans to it (trace-id propagation through the batcher)
+    trace_ctx: "otlp.SpanContext | None" = field(
+        default_factory=otlp.current_span_context
+    )
 
 
 class MicroBatcher:
@@ -391,6 +397,13 @@ class MicroBatcher:
         self._resolve(
             p, AdmissionResponse.reject(p.request.uid(), DEADLINE_MESSAGE, 500)
         )
+        otlp.emit_span(
+            "policy_evaluation",
+            p.trace_ctx,
+            None,
+            {"policy_id": p.policy_id},
+            error=DEADLINE_MESSAGE,
+        )
 
     def _dispatch(self, batch: list[_Pending]) -> None:
         with self._stats_lock:
@@ -441,6 +454,7 @@ class MicroBatcher:
         # hooks, matching the reference's mid-execution epoch interrupt
         # (src/lib.rs:176-190, tests/integration_test.rs:417).
         pairs = [(p.policy_id, p.request) for p in runnable]
+        dispatch_start_ns = time.time_ns()
         if self.policy_timeout is None:
             # reference parity: timeout disabled ⇒ unbounded execution
             try:
@@ -483,12 +497,20 @@ class MicroBatcher:
                 # No further deadline check: the watchdog guaranteed this
                 # item's verdict arrived inside its deadline, and discarding
                 # completed work protects nothing.
-                self._resolve(
-                    p,
-                    service.post_evaluate(
-                        self.env, p.policy_id, p.request, p.origin,
-                        result, p.enqueued_at,
-                    ),
+                response = service.post_evaluate(
+                    self.env, p.policy_id, p.request, p.origin,
+                    result, p.enqueued_at,
+                )
+                self._resolve(p, response)
+                otlp.emit_span(
+                    "policy_evaluation",
+                    p.trace_ctx,
+                    dispatch_start_ns,
+                    {
+                        "policy_id": p.policy_id,
+                        "batch_size": len(runnable),
+                        "allowed": response.allowed,
+                    },
                 )
             except Exception as e:  # noqa: BLE001 — never kill the loop
                 self._fail(p, e)

@@ -65,7 +65,17 @@ class PolicyServer:
         module_resolver: Callable[[str], PolicyModule] | None = None,
     ) -> "PolicyServer":
         if config.enable_metrics:
-            setup_metrics()
+            registry = setup_metrics()
+            # Reference pushes metrics over OTLP gRPC (metrics.rs:14-29).
+            # Here push activates when a collector endpoint is configured;
+            # the Prometheus pull endpoint stays on either way (fallback
+            # that also removes a collector hop from the serving path).
+            import os as _os
+
+            from policy_server_tpu.telemetry import otlp as _otlp
+
+            if _os.environ.get(_otlp.ENDPOINT_ENV):
+                _otlp.install_metrics_pusher(registry)
         if config.enable_pprof:
             profiling.activate_memory_profiling()
         if config.compilation_cache_dir:
@@ -214,6 +224,12 @@ class PolicyServer:
         # The server built the environment, so the server closes it — the
         # batcher only borrows it (two batchers may share one env).
         self.environment.close()
+        # Flush buffered spans / final metric state to the collector (the
+        # reference flushes its OTEL providers on shutdown). No-op when the
+        # OTLP pipeline was never installed.
+        from policy_server_tpu.telemetry import otlp
+
+        otlp.shutdown_pipeline()
 
     async def run_async(self) -> None:
         await self.start()

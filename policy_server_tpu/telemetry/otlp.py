@@ -1,0 +1,607 @@
+"""Real OTLP gRPC export: spans and metrics pushed to a collector.
+
+Reference parity:
+* src/tracing.rs:58-76 — OTLP gRPC SpanExporter with batching, service
+  name ``kubewarden-policy-server``; enabled by ``--log-fmt otlp``.
+* src/metrics.rs:14-29 — OTLP gRPC periodic MetricExporter pushing the
+  ``kubewarden`` meter; enabled by ``--enable-metrics``.
+* src/config.rs:458-496 — exporter client TLS from the
+  ``OTEL_EXPORTER_OTLP_*`` env vars (CA / client cert+key), handled by
+  config.build_client_tls_config_from_env.
+
+Transport: grpcio's generic ``unary_unary`` API against hand-written
+method paths (no generated service stubs needed); message bytes come from
+the committed minimal OTLP schema (protos/otlp.proto → otlp_pb2 — field
+numbers match the public opentelemetry-proto v1, which is all the wire
+cares about). Endpoint resolution follows the OTel convention:
+``OTEL_EXPORTER_OTLP_ENDPOINT`` (default ``http://localhost:4317``),
+scheme ``https`` ⇒ TLS.
+
+Metrics are converted straight from the Prometheus registry's cumulative
+state (counters → monotonic Sum, histograms → cumulative Histogram), so
+pull (/metrics) and push (OTLP) expose one source of truth."""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import queue
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping
+
+try:  # optional: core serving (text/json logs, Prometheus pull) must not
+    # require the gRPC export stack
+    import grpc
+    from policy_server_tpu.telemetry import otlp_pb2 as pb
+except ImportError:  # pragma: no cover - environment dependent
+    grpc = None  # type: ignore[assignment]
+    pb = None  # type: ignore[assignment]
+
+from policy_server_tpu.telemetry.tracing import SERVICE_NAME, logger
+
+AVAILABLE = grpc is not None and pb is not None
+
+# pb.Status codes (import-safe copies: the pb module may be absent)
+STATUS_CODE_UNSET = 0
+STATUS_CODE_OK = 1
+STATUS_CODE_ERROR = 2
+
+TRACE_EXPORT_METHOD = (
+    "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
+)
+METRICS_EXPORT_METHOD = (
+    "/opentelemetry.proto.collector.metrics.v1.MetricsService/Export"
+)
+ENDPOINT_ENV = "OTEL_EXPORTER_OTLP_ENDPOINT"
+DEFAULT_ENDPOINT = "http://localhost:4317"
+SCOPE_NAME = "policy-server-tpu"
+
+
+def configured_endpoint() -> str:
+    return os.environ.get(ENDPOINT_ENV) or DEFAULT_ENDPOINT
+
+
+def _any_value(v: Any) -> pb.AnyValue:
+    if isinstance(v, bool):
+        return pb.AnyValue(bool_value=v)
+    if isinstance(v, int):
+        return pb.AnyValue(int_value=v)
+    if isinstance(v, float):
+        return pb.AnyValue(double_value=v)
+    return pb.AnyValue(string_value=str(v))
+
+
+def _key_values(attrs: Mapping[str, Any]) -> list[pb.KeyValue]:
+    return [
+        pb.KeyValue(key=k, value=_any_value(v))
+        for k, v in attrs.items()
+        if v is not None
+    ]
+
+
+def _resource() -> pb.Resource:
+    return pb.Resource(
+        attributes=_key_values({"service.name": SERVICE_NAME})
+    )
+
+
+# ---------------------------------------------------------------------------
+# Span model + tracer
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SpanData:
+    """One finished span, ready for export."""
+
+    name: str
+    trace_id: bytes
+    span_id: bytes
+    parent_span_id: bytes
+    start_unix_nano: int
+    end_unix_nano: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status_code: int = 0  # STATUS_CODE_UNSET
+    status_message: str = ""
+
+    def to_proto(self) -> pb.Span:
+        return pb.Span(
+            trace_id=self.trace_id,
+            span_id=self.span_id,
+            parent_span_id=self.parent_span_id,
+            name=self.name,
+            kind=pb.Span.SPAN_KIND_SERVER
+            if not self.parent_span_id
+            else pb.Span.SPAN_KIND_INTERNAL,
+            start_time_unix_nano=self.start_unix_nano,
+            end_time_unix_nano=self.end_unix_nano,
+            attributes=_key_values(self.attributes),
+            status=pb.Status(
+                code=self.status_code, message=self.status_message
+            ),
+        )
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagation-safe identity of a live span — hand this across
+    threads (e.g. into the micro-batcher) to parent child spans."""
+
+    trace_id: bytes
+    span_id: bytes
+
+
+_current_span: contextvars.ContextVar[SpanContext | None] = (
+    contextvars.ContextVar("otlp_current_span", default=None)
+)
+
+
+def current_span_context() -> SpanContext | None:
+    return _current_span.get()
+
+
+class Tracer:
+    """Produces spans and hands finished ones to the batch processor."""
+
+    def __init__(self, processor: "BatchSpanProcessor"):
+        self.processor = processor
+
+    def start_span(
+        self,
+        name: str,
+        attributes: Mapping[str, Any] | None = None,
+        parent: SpanContext | None = None,
+    ) -> "ActiveSpan":
+        if parent is None:
+            parent = _current_span.get()
+        trace_id = parent.trace_id if parent else secrets.token_bytes(16)
+        return ActiveSpan(
+            tracer=self,
+            data=SpanData(
+                name=name,
+                trace_id=trace_id,
+                span_id=secrets.token_bytes(8),
+                parent_span_id=parent.span_id if parent else b"",
+                start_unix_nano=time.time_ns(),
+                attributes=dict(attributes or {}),
+            ),
+        )
+
+
+class ActiveSpan:
+    """Context manager for one span; exposes the SpanContext for
+    cross-thread propagation and a mutable attribute dict."""
+
+    def __init__(self, tracer: Tracer, data: SpanData):
+        self.tracer = tracer
+        self.data = data
+        self._token: contextvars.Token | None = None
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.data.trace_id, self.data.span_id)
+
+    def set_attributes(self, attrs: Mapping[str, Any]) -> None:
+        self.data.attributes.update(
+            {k: v for k, v in attrs.items() if v is not None}
+        )
+
+    def set_error(self, message: str) -> None:
+        self.data.status_code = STATUS_CODE_ERROR
+        self.data.status_message = message
+
+    def __enter__(self) -> "ActiveSpan":
+        self._token = _current_span.set(self.context)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _current_span.reset(self._token)
+        if exc is not None and self.data.status_code == 0:
+            self.set_error(str(exc))
+        self.data.end_unix_nano = time.time_ns()
+        self.tracer.processor.on_end(self.data)
+
+
+class BatchSpanProcessor:
+    """Queue + background flusher (the reference's opentelemetry batch
+    exporter analog): spans export in batches of ``max_batch`` or every
+    ``interval_seconds``, off the request path."""
+
+    def __init__(
+        self,
+        exporter: "OtlpExporter",
+        interval_seconds: float = 2.0,
+        max_batch: int = 512,
+        max_queue: int = 4096,
+    ):
+        self.exporter = exporter
+        self.interval = interval_seconds
+        self.max_batch = max_batch
+        self._queue: queue.Queue[SpanData] = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self.dropped = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="otlp-span-export", daemon=True
+        )
+        self._thread.start()
+
+    def on_end(self, span: SpanData) -> None:
+        try:
+            self._queue.put_nowait(span)
+        except queue.Full:
+            self.dropped += 1
+        if self._queue.qsize() >= self.max_batch:
+            self._wake.set()
+
+    def _drain(self) -> list[SpanData]:
+        out: list[SpanData] = []
+        while len(out) < self.max_batch:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.interval)
+            self._wake.clear()
+            batch = self._drain()
+            if batch:
+                self.exporter.export_spans(batch)
+
+    def force_flush(self, timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self._queue.empty() and time.monotonic() < deadline:
+            batch = self._drain()
+            if batch:
+                self.exporter.export_spans(batch)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.force_flush()
+
+
+# ---------------------------------------------------------------------------
+# Exporter (gRPC transport)
+# ---------------------------------------------------------------------------
+
+
+class OtlpExporter:
+    """Thin gRPC client for the two collector Export methods."""
+
+    def __init__(self, endpoint: str | None = None, timeout: float = 10.0):
+        endpoint = endpoint or configured_endpoint()
+        self.timeout = timeout
+        target, use_tls = self._parse(endpoint)
+        if use_tls:
+            creds = self._tls_credentials()
+            self._channel = grpc.secure_channel(target, creds)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        self._export_traces = self._channel.unary_unary(
+            TRACE_EXPORT_METHOD,
+            request_serializer=pb.ExportTraceServiceRequest.SerializeToString,
+            response_deserializer=pb.ExportTraceServiceResponse.FromString,
+        )
+        self._export_metrics = self._channel.unary_unary(
+            METRICS_EXPORT_METHOD,
+            request_serializer=pb.ExportMetricsServiceRequest.SerializeToString,
+            response_deserializer=pb.ExportMetricsServiceResponse.FromString,
+        )
+
+    @staticmethod
+    def _parse(endpoint: str) -> tuple[str, bool]:
+        if endpoint.startswith("https://"):
+            return endpoint[len("https://") :], True
+        if endpoint.startswith("http://"):
+            return endpoint[len("http://") :], False
+        return endpoint, False
+
+    @staticmethod
+    def _tls_credentials() -> grpc.ChannelCredentials:
+        """config.rs:458-496: CA + optional mutual TLS from
+        OTEL_EXPORTER_OTLP_* env vars."""
+        from policy_server_tpu.config.config import (
+            build_client_tls_config_from_env,
+        )
+
+        files = build_client_tls_config_from_env()
+
+        def read(key: str) -> bytes | None:
+            path = files.get(key)
+            return open(path, "rb").read() if path else None
+
+        return grpc.ssl_channel_credentials(
+            root_certificates=read("ca_file"),
+            private_key=read("key_file"),
+            certificate_chain=read("cert_file"),
+        )
+
+    def export_spans(self, spans: Iterable[SpanData]) -> bool:
+        req = pb.ExportTraceServiceRequest(
+            resource_spans=[
+                pb.ResourceSpans(
+                    resource=_resource(),
+                    scope_spans=[
+                        pb.ScopeSpans(
+                            scope=pb.InstrumentationScope(name=SCOPE_NAME),
+                            spans=[s.to_proto() for s in spans],
+                        )
+                    ],
+                )
+            ]
+        )
+        try:
+            self._export_traces(req, timeout=self.timeout)
+            return True
+        except grpc.RpcError as e:
+            logger.warning("OTLP trace export failed: %s", e)
+            return False
+
+    def export_metrics(self, metrics: list[pb.Metric]) -> bool:
+        req = pb.ExportMetricsServiceRequest(
+            resource_metrics=[
+                pb.ResourceMetrics(
+                    resource=_resource(),
+                    scope_metrics=[
+                        pb.ScopeMetrics(
+                            scope=pb.InstrumentationScope(name=SCOPE_NAME),
+                            metrics=metrics,
+                        )
+                    ],
+                )
+            ]
+        )
+        try:
+            self._export_metrics(req, timeout=self.timeout)
+            return True
+        except grpc.RpcError as e:
+            logger.warning("OTLP metrics export failed: %s", e)
+            return False
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus registry → OTLP metrics conversion
+# ---------------------------------------------------------------------------
+
+
+def prometheus_to_otlp(
+    registry: Any, start_unix_nano: int, now_unix_nano: int
+) -> list[pb.Metric]:
+    """Convert the cumulative state of a prometheus CollectorRegistry into
+    OTLP metrics: counters → monotonic cumulative Sum; histograms →
+    cumulative Histogram with explicit bounds. One source of truth for
+    pull and push."""
+    out: list[pb.Metric] = []
+    for family in registry.collect():
+        if family.type == "counter":
+            points = []
+            for s in family.samples:
+                if not s.name.endswith("_total"):
+                    continue
+                points.append(
+                    pb.NumberDataPoint(
+                        start_time_unix_nano=start_unix_nano,
+                        time_unix_nano=now_unix_nano,
+                        as_double=s.value,
+                        attributes=_key_values(s.labels),
+                    )
+                )
+            if points:
+                out.append(
+                    pb.Metric(
+                        name=family.name + "_total"
+                        if not family.name.endswith("_total")
+                        else family.name,
+                        description=family.documentation,
+                        sum=pb.Sum(
+                            data_points=points,
+                            aggregation_temporality=(
+                                pb.AGGREGATION_TEMPORALITY_CUMULATIVE
+                            ),
+                            is_monotonic=True,
+                        ),
+                    )
+                )
+        elif family.type == "histogram":
+            # prometheus exposes per-label-set series: _bucket{le}, _sum,
+            # _count — regroup by label set
+            grouped: dict[tuple, dict[str, Any]] = {}
+            for s in family.samples:
+                labels = {k: v for k, v in s.labels.items() if k != "le"}
+                key = tuple(sorted(labels.items()))
+                g = grouped.setdefault(
+                    key, {"labels": labels, "buckets": [], "sum": 0.0, "count": 0}
+                )
+                if s.name.endswith("_bucket"):
+                    g["buckets"].append((float(s.labels["le"]), s.value))
+                elif s.name.endswith("_sum"):
+                    g["sum"] = s.value
+                elif s.name.endswith("_count"):
+                    g["count"] = s.value
+            points = []
+            for g in grouped.values():
+                buckets = sorted(g["buckets"], key=lambda b: b[0])
+                bounds = [b for b, _ in buckets if b != float("inf")]
+                cumulative = [int(v) for _, v in buckets]
+                # OTLP bucket_counts are per-bucket (not cumulative like
+                # prometheus le-counts) and include the overflow bucket
+                counts, prev = [], 0
+                for c in cumulative:
+                    counts.append(c - prev)
+                    prev = c
+                points.append(
+                    pb.HistogramDataPoint(
+                        start_time_unix_nano=start_unix_nano,
+                        time_unix_nano=now_unix_nano,
+                        count=int(g["count"]),
+                        sum=g["sum"],
+                        bucket_counts=counts,
+                        explicit_bounds=bounds,
+                        attributes=_key_values(g["labels"]),
+                    )
+                )
+            if points:
+                out.append(
+                    pb.Metric(
+                        name=family.name,
+                        description=family.documentation,
+                        unit="ms" if family.name.endswith("_milliseconds") else "",
+                        histogram=pb.Histogram(
+                            data_points=points,
+                            aggregation_temporality=(
+                                pb.AGGREGATION_TEMPORALITY_CUMULATIVE
+                            ),
+                        ),
+                    )
+                )
+    return out
+
+
+class OtlpMetricsPusher:
+    """Periodic push of the metrics registry over OTLP gRPC (the
+    reference's PeriodicReader analog, metrics.rs:14-29)."""
+
+    def __init__(
+        self,
+        registry: Any,  # telemetry.metrics.MetricsRegistry
+        exporter: OtlpExporter,
+        interval_seconds: float = 10.0,
+    ):
+        self.registry = registry
+        self.exporter = exporter
+        self.interval = interval_seconds
+        self.start_unix_nano = time.time_ns()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="otlp-metrics-push", daemon=True
+        )
+        self._thread.start()
+
+    def push_once(self) -> bool:
+        if self.registry.registry is None:  # pragma: no cover
+            return False
+        metrics = prometheus_to_otlp(
+            self.registry.registry, self.start_unix_nano, time.time_ns()
+        )
+        if not metrics:
+            return True
+        return self.exporter.export_metrics(metrics)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.push_once()
+            except Exception as e:  # noqa: BLE001 — export must never kill
+                logger.warning("OTLP metrics push failed: %s", e)
+
+    def shutdown(self) -> None:
+        import contextlib
+
+        self._stop.set()
+        self._thread.join(timeout=5)
+        with contextlib.suppress(Exception):
+            self.push_once()  # final flush
+
+
+# ---------------------------------------------------------------------------
+# Global pipeline wiring (used by setup_tracing / setup_metrics)
+# ---------------------------------------------------------------------------
+
+_tracer: Tracer | None = None
+_processor: BatchSpanProcessor | None = None
+_pusher: OtlpMetricsPusher | None = None
+_lock = threading.Lock()
+
+
+def install_tracer(endpoint: str | None = None) -> Tracer | None:
+    """Build the span pipeline (exporter → batch processor → tracer) and
+    install it globally. Called by setup_tracing for --log-fmt otlp.
+    Returns None (JSON-lines logging continues alone) when the gRPC export
+    stack is not importable."""
+    global _tracer, _processor
+    if not AVAILABLE:
+        logger.error(
+            "--log-fmt otlp requested but grpcio/protobuf are not "
+            "available; spans stay on JSON-lines logging only"
+        )
+        return None
+    with _lock:
+        if _tracer is None:
+            exporter = OtlpExporter(endpoint)
+            _processor = BatchSpanProcessor(exporter)
+            _tracer = Tracer(_processor)
+        return _tracer
+
+
+def tracer() -> Tracer | None:
+    return _tracer
+
+
+def emit_span(
+    name: str,
+    parent: SpanContext | None,
+    start_unix_nano: int | None,
+    attributes: Mapping[str, Any],
+    error: str | None = None,
+) -> None:
+    """Fire-and-forget child span from a worker thread (no contextvar
+    manipulation — the parent context travels explicitly, which is how the
+    micro-batcher propagates trace ids across its thread boundary)."""
+    tr = tracer()
+    if tr is None or parent is None:
+        return
+    now = time.time_ns()
+    data = SpanData(
+        name=name,
+        trace_id=parent.trace_id,
+        span_id=secrets.token_bytes(8),
+        parent_span_id=parent.span_id,
+        start_unix_nano=start_unix_nano or now,
+        end_unix_nano=now,
+        attributes={k: v for k, v in attributes.items() if v is not None},
+    )
+    if error is not None:
+        data.status_code = STATUS_CODE_ERROR
+        data.status_message = error
+    tr.processor.on_end(data)
+
+
+def install_metrics_pusher(
+    registry: Any, endpoint: str | None = None, interval_seconds: float = 10.0
+) -> OtlpMetricsPusher:
+    global _pusher
+    with _lock:
+        if _pusher is None:
+            _pusher = OtlpMetricsPusher(
+                registry, OtlpExporter(endpoint), interval_seconds
+            )
+        return _pusher
+
+
+def shutdown_pipeline() -> None:
+    """Flush and tear down the global span/metrics pipeline (called from
+    PolicyServer.stop(): buffered spans and the final metric state must
+    reach the collector before the process exits)."""
+    global _tracer, _processor, _pusher
+    with _lock:
+        if _processor is not None:
+            _processor.shutdown()
+        if _pusher is not None:
+            _pusher.shutdown()
+        _tracer = _processor = _pusher = None
+
+
+def shutdown_for_tests() -> None:
+    shutdown_pipeline()

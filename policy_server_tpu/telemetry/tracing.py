@@ -7,11 +7,13 @@ equivalents are jax/absl/aiohttp internals); per-request spans with explicit
 fields are emitted by the API handlers (api/handlers.py), matching the
 reference's ``#[tracing::instrument]`` field lists (src/api/handlers.rs:46-67).
 
-``otlp`` falls back to JSON lines on stdout when no OpenTelemetry span SDK is
-importable (not baked into this environment) — span structure and field names
-are preserved so a collector-side ingestion of the JSON stream sees the same
-schema. Service name matches the reference: ``kubewarden-policy-server``
-(tracing.rs:58-76).
+``otlp`` exports REAL spans over OTLP gRPC (telemetry/otlp.py — batch span
+processor, service name ``kubewarden-policy-server``, endpoint from
+``OTEL_EXPORTER_OTLP_ENDPOINT``) while also logging the span fields as JSON
+lines with the trace id for log↔trace correlation. Trace ids propagate
+across the micro-batcher (runtime/batcher.py emits child
+``policy_evaluation`` spans). Service name matches the reference:
+``kubewarden-policy-server`` (tracing.rs:58-76).
 """
 
 from __future__ import annotations
@@ -89,9 +91,15 @@ def setup_tracing(
     handler = logging.StreamHandler(sys.stderr)
     if log_fmt == "text":
         handler.setFormatter(_TextFormatter(color=not no_color))
-    else:  # json and the otlp fallback share the JSON-lines structure
+    else:  # json and otlp share the JSON-lines log structure
         handler.setFormatter(_JsonFormatter())
     root.addHandler(handler)
+    if log_fmt == "otlp":
+        # real span pipeline: exporter → batch processor → tracer
+        # (tracing.rs:58-76); logging above stays on for correlation
+        from policy_server_tpu.telemetry import otlp
+
+        otlp.install_tracer()
     # EnvFilter analog (tracing.rs:22-30): dependencies stay at WARN+.
     for name in _NOISY_LOGGERS:
         logging.getLogger(name).setLevel(max(level, logging.WARNING))
@@ -103,14 +111,24 @@ logger = logging.getLogger(SERVICE_NAME)
 
 @contextlib.contextmanager
 def span(span_name: str, **fields: Any) -> Iterator[dict[str, Any]]:
-    """A lightweight request span: yields a mutable field dict (handlers
-    record verdict fields into it, mirroring
-    populate_span_with_policy_evaluation_results, handlers.rs:308-319) and
-    logs one structured line on exit with the elapsed time."""
+    """A request span: yields a mutable field dict (handlers record verdict
+    fields into it, mirroring populate_span_with_policy_evaluation_results,
+    handlers.rs:308-319) and logs one structured line on exit with the
+    elapsed time. When the OTLP pipeline is installed (--log-fmt otlp), a
+    REAL span with the same name/fields is exported and its trace id is
+    added to the log line."""
+    from policy_server_tpu.telemetry import otlp
+
     start = time.perf_counter()
     data = dict(fields)
-    try:
-        yield data
-    finally:
-        data["elapsed_ms"] = round((time.perf_counter() - start) * 1e3, 3)
-        logger.info(span_name, extra={"span_fields": data})
+    tr = otlp.tracer()
+    active = tr.start_span(span_name) if tr is not None else None
+    with active if active is not None else contextlib.nullcontext():
+        try:
+            yield data
+        finally:
+            data["elapsed_ms"] = round((time.perf_counter() - start) * 1e3, 3)
+            if active is not None:
+                active.set_attributes(data)
+                data["trace_id"] = active.context.trace_id.hex()
+            logger.info(span_name, extra={"span_fields": data})

@@ -1,0 +1,262 @@
+"""OTLP export tests against a live in-process gRPC collector fixture —
+the analog of the reference's testcontainers OTEL pipeline test
+(tests/integration_test.rs:798-973): spans arrive under service
+``kubewarden-policy-server`` with the reference field set, trace ids
+propagate through the micro-batcher, and both metrics instruments
+(``kubewarden_policy_evaluations_total`` + the latency histogram) arrive
+over OTLP gRPC."""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+import pytest
+
+from policy_server_tpu.telemetry import metrics as metrics_mod
+from policy_server_tpu.telemetry import otlp
+from policy_server_tpu.telemetry import otlp_pb2 as pb
+
+
+class CollectorFixture:
+    """In-process OTLP gRPC collector: records every Export request."""
+
+    def __init__(self):
+        self.trace_requests: list[pb.ExportTraceServiceRequest] = []
+        self.metrics_requests: list[pb.ExportMetricsServiceRequest] = []
+        self._event = threading.Event()
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=2))
+
+        def export_traces(request, context):
+            self.trace_requests.append(request)
+            self._event.set()
+            return pb.ExportTraceServiceResponse()
+
+        def export_metrics(request, context):
+            self.metrics_requests.append(request)
+            self._event.set()
+            return pb.ExportMetricsServiceResponse()
+
+        self._server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "opentelemetry.proto.collector.trace.v1.TraceService",
+                    {
+                        "Export": grpc.unary_unary_rpc_method_handler(
+                            export_traces,
+                            request_deserializer=(
+                                pb.ExportTraceServiceRequest.FromString
+                            ),
+                            response_serializer=(
+                                pb.ExportTraceServiceResponse.SerializeToString
+                            ),
+                        )
+                    },
+                ),
+                grpc.method_handlers_generic_handler(
+                    "opentelemetry.proto.collector.metrics.v1.MetricsService",
+                    {
+                        "Export": grpc.unary_unary_rpc_method_handler(
+                            export_metrics,
+                            request_deserializer=(
+                                pb.ExportMetricsServiceRequest.FromString
+                            ),
+                            response_serializer=(
+                                pb.ExportMetricsServiceResponse.SerializeToString
+                            ),
+                        )
+                    },
+                ),
+            )
+        )
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def wait(self, timeout: float = 10.0) -> bool:
+        ok = self._event.wait(timeout)
+        self._event.clear()
+        return ok
+
+    def spans(self) -> list[pb.Span]:
+        out = []
+        for req in self.trace_requests:
+            for rs in req.resource_spans:
+                for ss in rs.scope_spans:
+                    out.extend(ss.spans)
+        return out
+
+    def metric_names(self) -> set[str]:
+        return {
+            m.name
+            for req in self.metrics_requests
+            for rm in req.resource_metrics
+            for sm in rm.scope_metrics
+            for m in sm.metrics
+        }
+
+    def metric(self, name: str) -> pb.Metric | None:
+        for req in self.metrics_requests:
+            for rm in req.resource_metrics:
+                for sm in rm.scope_metrics:
+                    for m in sm.metrics:
+                        if m.name == name:
+                            return m
+        return None
+
+    def resource_service_names(self) -> set[str]:
+        out = set()
+        for req in list(self.trace_requests) + list(self.metrics_requests):
+            containers = getattr(req, "resource_spans", None) or getattr(
+                req, "resource_metrics"
+            )
+            for r in containers:
+                for kv in r.resource.attributes:
+                    if kv.key == "service.name":
+                        out.add(kv.value.string_value)
+        return out
+
+    def stop(self):
+        self._server.stop(grace=None)
+
+
+@pytest.fixture()
+def collector():
+    c = CollectorFixture()
+    yield c
+    c.stop()
+    otlp.shutdown_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+    metrics_mod.reset_metrics_for_tests()
+
+
+def test_span_pipeline_exports_to_collector(collector):
+    tracer = otlp.install_tracer(collector.endpoint)
+    with tracer.start_span("validation") as sp:
+        sp.set_attributes(
+            {
+                "policy_id": "priv",
+                "request_uid": "uid-1",
+                "allowed": False,
+                "response_code": 500,
+            }
+        )
+        parent_ctx = sp.context
+        # child span on another thread, parented explicitly — the batcher
+        # propagation pattern
+        t = threading.Thread(
+            target=otlp.emit_span,
+            args=(
+                "policy_evaluation",
+                parent_ctx,
+                None,
+                {"policy_id": "priv", "batch_size": 4},
+            ),
+        )
+        t.start()
+        t.join()
+    otlp._processor.force_flush()  # noqa: SLF001 — test drives the flush
+    assert collector.wait()
+
+    spans = collector.spans()
+    names = {s.name for s in spans}
+    assert {"validation", "policy_evaluation"} <= names
+    assert collector.resource_service_names() == {"kubewarden-policy-server"}
+    val = next(s for s in spans if s.name == "validation")
+    child = next(s for s in spans if s.name == "policy_evaluation")
+    # trace-id propagation: same trace, parented on the validation span
+    assert child.trace_id == val.trace_id
+    assert child.parent_span_id == val.span_id
+    attrs = {kv.key: kv.value for kv in val.attributes}
+    assert attrs["policy_id"].string_value == "priv"
+    assert attrs["allowed"].bool_value is False
+    assert attrs["response_code"].int_value == 500
+
+
+def test_metrics_push_delivers_both_instruments(collector):
+    registry = metrics_mod.setup_metrics()
+    m = metrics_mod.PolicyEvaluation(
+        policy_name="priv",
+        policy_mode="protect",
+        resource_kind="Pod",
+        resource_namespace="default",
+        resource_request_operation="CREATE",
+        accepted=True,
+        mutated=False,
+        request_origin="validate",
+    )
+    registry.add_policy_evaluation(m)
+    registry.record_policy_latency(3.5, m)
+
+    pusher = otlp.OtlpMetricsPusher(
+        registry, otlp.OtlpExporter(collector.endpoint), interval_seconds=3600
+    )
+    try:
+        assert pusher.push_once()
+        assert collector.wait()
+        names = collector.metric_names()
+        assert metrics_mod.EVALUATIONS_TOTAL in names
+        assert metrics_mod.LATENCY_MILLISECONDS in names
+
+        total = collector.metric(metrics_mod.EVALUATIONS_TOTAL)
+        assert total.sum.is_monotonic
+        point = total.sum.data_points[0]
+        assert point.as_double == 1.0
+        labels = {kv.key: kv.value.string_value for kv in point.attributes}
+        assert labels["policy_name"] == "priv"
+        assert labels["accepted"] == "true"
+
+        hist = collector.metric(metrics_mod.LATENCY_MILLISECONDS)
+        dp = hist.histogram.data_points[0]
+        assert dp.count == 1
+        assert dp.sum == pytest.approx(3.5)
+        assert len(dp.bucket_counts) == len(dp.explicit_bounds) + 1
+        assert sum(dp.bucket_counts) == dp.count
+    finally:
+        pusher.shutdown()
+
+
+def test_batcher_emits_child_spans_with_propagated_trace_id(collector):
+    """End-to-end: a span opened around batcher submission yields an
+    exported child policy_evaluation span in the same trace."""
+    from policy_server_tpu.api.service import RequestOrigin
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironmentBuilder,
+    )
+    from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+    from policy_server_tpu.models.policy import parse_policy_entry
+    from policy_server_tpu.runtime.batcher import MicroBatcher
+
+    from conftest import build_admission_review_dict
+
+    tracer = otlp.install_tracer(collector.endpoint)
+    env = EvaluationEnvironmentBuilder(backend="jax").build(
+        {"priv": parse_policy_entry("priv", {"module": "builtin://pod-privileged"})}
+    )
+    batcher = MicroBatcher(env, max_batch_size=4, batch_timeout_ms=1.0).start()
+    try:
+        req = ValidateRequest.from_admission(
+            AdmissionReviewRequest.from_dict(build_admission_review_dict()).request
+        )
+        with tracer.start_span("validation") as sp:
+            fut = batcher.submit("priv", req, RequestOrigin.VALIDATE)
+            fut.result(timeout=30)
+            trace_id = sp.context.trace_id
+    finally:
+        batcher.shutdown()
+        env.close()
+    otlp._processor.force_flush()  # noqa: SLF001
+    assert collector.wait()
+    children = [
+        s for s in collector.spans() if s.name == "policy_evaluation"
+    ]
+    assert children and children[0].trace_id == trace_id
