@@ -246,7 +246,7 @@ class EvaluationEnvironmentBuilder:
                     )
                     for member_name, member in entry.policies.items():
                         member_pid = f"{name}/{member_name}"
-                        group.members[member_name] = bootstrap_policy(
+                        member_bp = bootstrap_policy(
                             member_pid,
                             member.module,
                             member.settings,
@@ -254,6 +254,18 @@ class EvaluationEnvironmentBuilder:
                             False,  # group members never mutate (rs group ban)
                             member.context_aware_resources,
                         )
+                        if member_bp.precompiled.program.host_evaluator is not None:
+                            # group verdicts fuse on-device from member
+                            # bits; host-executed (wasm) members have no
+                            # device bits — unsupported in this build
+                            raise PolicyInitializationError(
+                                member_pid,
+                                "wasm-executed policies cannot be members "
+                                "of a policy group (their verdicts are "
+                                "host-side; group expressions fuse on the "
+                                "device)",
+                            )
+                        group.members[member_name] = member_bp
                     groups[name] = group
                     for member_name, bp in group.members.items():
                         bound[bp.policy_id] = bp
@@ -478,6 +490,15 @@ class EvaluationEnvironment:
                 out |= bp.ctx_allowlist
             return frozenset(out)
         return target.ctx_allowlist
+
+    @staticmethod
+    def _host_executed(target: "BoundPolicy | BoundGroup") -> bool:
+        """True when the target's verdict comes from host-side wasm
+        execution (evaluation/wasm_policy.py), bypassing the device."""
+        return (
+            not isinstance(target, BoundGroup)
+            and target.precompiled.program.host_evaluator is not None
+        )
 
     def _providers_of(self, target: "BoundPolicy | BoundGroup") -> list:
         """Host-side context providers of a target's program(s)
@@ -712,6 +733,10 @@ class EvaluationEnvironment:
             # image verification caching happens in the hook)
             payload = self.payload_for(target, request)
 
+        if self._host_executed(target):
+            # pass the context-bearing payload (payload_for output), not
+            # the raw request: wasm policies get __context__ too
+            return self._materialize_single(target, request.uid(), payload, {})
         if self.backend == "oracle":
             return self._materialize(target, request, self._oracle_outputs(payload))
         try:
@@ -812,6 +837,11 @@ class EvaluationEnvironment:
                     self._run_pre_eval_hooks(target, payload)
                     # rebuild: providers must observe hook results
                     payload = self.payload_for(target, request)
+                if self._host_executed(target):
+                    results[i] = self._materialize_single(
+                        target, request.uid(), payload, {}
+                    )
+                    continue
                 if self.backend == "oracle":
                     results[i] = self._materialize(
                         target, request, self._oracle_outputs(payload)
@@ -864,6 +894,17 @@ class EvaluationEnvironment:
                     self._run_pre_eval_hooks(
                         target, self.payload_for(target, request)
                     )
+                if self._host_executed(target):
+                    # wasm-backed rows never enter the device batch; the
+                    # payload carries the __context__ snapshot like every
+                    # other path
+                    results[i] = self._materialize_single(
+                        target,
+                        request.uid(),
+                        self.payload_for(target, request),
+                        {},
+                    )
+                    continue
                 pending.append(i)
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
@@ -991,6 +1032,34 @@ class EvaluationEnvironment:
         payload: Any,
         outputs: Mapping[str, Any],
     ) -> AdmissionResponse:
+        host_eval = bp.precompiled.program.host_evaluator
+        if host_eval is not None:
+            # wasm-backed policy: the verdict comes from host-side wasm
+            # execution (evaluation/wasm_policy.py); device outputs are
+            # inert for these rows
+            verdict = host_eval(payload)
+            if bool(verdict.get("accepted")):
+                response = AdmissionResponse(uid=uid, allowed=True)
+                mutated = verdict.get("mutated_object")
+                if mutated is not None:
+                    # whole-object replacement patch (waPC mutation shape)
+                    response.patch = base64.b64encode(
+                        json.dumps(
+                            [{"op": "replace", "path": "", "value": mutated}]
+                        ).encode()
+                    ).decode()
+                    response.patch_type = JSON_PATCH
+                return response
+            return AdmissionResponse(
+                uid=uid,
+                allowed=False,
+                status=ValidationStatus(
+                    message=str(
+                        verdict.get("message") or "rejected by policy"
+                    ),
+                    code=int(verdict.get("code") or 400),
+                ),
+            )
         allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
         if not allowed:
             rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])

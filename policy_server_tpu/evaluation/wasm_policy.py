@@ -1,0 +1,109 @@
+"""Wasm policy modules — multi-ABI policy execution (SURVEY.md §2.2).
+
+The reference executes every policy as wasm under wasmtime, speaking one
+of several ABIs (PolicyExecutionMode: Kubewarden waPC, OPA, OPA-Gatekeeper
+— precompiled_policy.rs:46-64). This module is the TPU build's
+counterpart: a fetched ``.wasm`` artifact becomes a
+:class:`WasmPolicyModule` whose bound program carries a
+``host_evaluator`` — the evaluation environment routes such policies
+through host-side wasm execution (wasm/interp.py) instead of the fused
+device program. Wasm policies are the generality escape hatch; the
+predicate-IR path remains the TPU fast path.
+
+ABI detection is by exports: ``__guest_call`` ⇒ waPC (Kubewarden
+protocol, wasm/wapc.py); ``opa_eval_ctx_new`` ⇒ OPA/Gatekeeper
+(wasm/opa.py). A runaway module exhausts its interpreter fuel and is
+rejected in-band with the reference's "execution deadline exceeded"
+message (the epoch-interruption analog, src/lib.rs:176-190)."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from policy_server_tpu.ops.compiler import PolicyProgram, Rule
+from policy_server_tpu.ops.ir import false
+from policy_server_tpu.policies.base import SettingsValidationResponse
+from policy_server_tpu.wasm.binary import decode_module
+from policy_server_tpu.wasm.interp import WasmFuelExhausted, WasmTrap
+from policy_server_tpu.wasm.opa import OpaPolicy, gatekeeper_validate
+from policy_server_tpu.wasm.wapc import KubewardenWapcPolicy, WapcError
+
+DEADLINE_MESSAGE = "execution deadline exceeded"
+
+
+class WasmPolicyModule:
+    """PolicyModule protocol over a wasm payload (multi-ABI)."""
+
+    def __init__(
+        self,
+        wasm_bytes: bytes,
+        name: str,
+        digest: str,
+        fuel: int | None = 50_000_000,
+    ):
+        self.name = name
+        self.digest = digest
+        self._bytes = wasm_bytes
+        exports = {e.name for e in decode_module(wasm_bytes).exports}
+        if "__guest_call" in exports:
+            self.abi = "wapc"
+            self._wapc = KubewardenWapcPolicy(wasm_bytes, fuel=fuel)
+        elif "opa_eval_ctx_new" in exports:
+            self.abi = "opa-gatekeeper"
+            self._opa = OpaPolicy(wasm_bytes, fuel=fuel)
+        else:
+            raise WasmTrap(
+                f"wasm module {name!r} speaks no supported policy ABI "
+                "(expected waPC __guest_call or OPA opa_eval_ctx_new exports)"
+            )
+        # waPC guests may return a mutated object; whether the operator
+        # permits it is gated by allowedToMutate exactly like any policy
+        self.mutating = self.abi == "wapc"
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        bound_settings = dict(settings or {})
+
+        def evaluate(payload: Any) -> Mapping[str, Any]:
+            try:
+                if self.abi == "wapc":
+                    return self._wapc.validate(payload, bound_settings)
+                allowed, message = gatekeeper_validate(
+                    self._opa, payload, parameters=bound_settings
+                )
+                return {"accepted": allowed, "message": message}
+            except WasmFuelExhausted:
+                return {
+                    "accepted": False,
+                    "message": DEADLINE_MESSAGE,
+                    "code": 500,
+                }
+            except (WasmTrap, WapcError) as e:
+                # guest crash → in-band rejection, mirroring the reference
+                # surfacing wasm errors as 500 responses
+                return {
+                    "accepted": False,
+                    "message": f"wasm policy execution failed: {e}",
+                    "code": 500,
+                }
+
+        return PolicyProgram(
+            # the device program never decides for wasm policies; the
+            # false() rule keeps the fused-program machinery total
+            rules=(Rule("wasm-host-executed", false(), "unreachable"),),
+            host_evaluator=evaluate,
+        )
+
+    def validate_settings(
+        self, settings: Mapping[str, Any]
+    ) -> SettingsValidationResponse:
+        if self.abi == "wapc":
+            try:
+                doc = self._wapc.validate_settings(dict(settings or {}))
+            except (WasmTrap, WapcError) as e:
+                return SettingsValidationResponse(
+                    valid=False, message=f"settings validation failed: {e}"
+                )
+            return SettingsValidationResponse(
+                valid=bool(doc.get("valid")), message=doc.get("message")
+            )
+        return SettingsValidationResponse(valid=True, message=None)

@@ -21,11 +21,11 @@ This framework's native artifact is a JSON bundle of serialized predicate IR
 }
 ```
 
-``.wasm`` artifacts cannot execute on TPU; fetched wasm modules are mapped
-to their native re-implementation when the URL is a known upstream policy
-(policies.resolve_builtin) — the equivalent of burrego's builtins registry —
-and otherwise fail policy initialization with a clear error (surfacing
-through the reference's --continue-on-errors path)."""
+``.wasm`` artifacts execute host-side through the wasm substrate
+(wasm/ + evaluation/wasm_policy.py — waPC and OPA/Gatekeeper ABIs), the
+multi-ABI escape hatch; known upstream URLs still prefer the native
+re-implementation (policies.resolve_builtin, the burrego-builtins
+equivalent) because the predicate-IR path is the TPU fast path."""
 
 from __future__ import annotations
 
@@ -108,19 +108,31 @@ class ArtifactPolicyModule:
         return SettingsValidationResponse.ok()
 
 
-def load_artifact(path: str | Path) -> ArtifactPolicyModule:
+def load_artifact(path: str | Path):
     """Parse a downloaded artifact file → PolicyModule.
 
-    ``.wasm`` payloads have no TPU execution path: they resolve only via the
-    upstream→builtin map (handled by the resolver before download); reaching
-    here with wasm bytes is an initialization error."""
+    ``.tpp.json`` bundles compile to device predicate programs (the TPU
+    fast path). ``.wasm`` payloads execute host-side through the wasm
+    substrate (evaluation/wasm_policy.py: waPC or OPA/Gatekeeper ABI) —
+    the multi-ABI escape hatch matching the reference's wasmtime
+    execution (precompiled_policy.rs:46-64); an unsupported ABI surfaces
+    as a policy initialization error."""
     data = Path(path).read_bytes()
     digest = hashlib.sha256(data).hexdigest()
     if data[:4] == b"\x00asm":
-        raise ArtifactError(
-            "artifact is a WASM module with no native equivalent; "
-            "WASM execution is not supported on the TPU backend"
-        )
+        from policy_server_tpu.evaluation.wasm_policy import WasmPolicyModule
+        from policy_server_tpu.wasm.binary import WasmDecodeError
+        from policy_server_tpu.wasm.interp import WasmTrap
+        from policy_server_tpu.wasm.opa import OpaError
+        from policy_server_tpu.wasm.wapc import WapcError
+
+        try:
+            return WasmPolicyModule(data, name=Path(path).stem, digest=digest)
+        except (WasmTrap, WasmDecodeError, OpaError, WapcError) as e:
+            # ArtifactError is a ValueError: a bad wasm artifact surfaces
+            # as a per-policy initialization error (and through
+            # --continue-on-errors), never a bootstrap crash
+            raise ArtifactError(f"unusable wasm artifact: {e}") from e
     try:
         doc = json.loads(data)
     except json.JSONDecodeError as e:

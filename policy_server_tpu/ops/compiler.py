@@ -242,6 +242,11 @@ class PolicyProgram:
     # the device program: the pre_eval_hook does the blocking work under
     # the request deadline, the provider is a pure cache read.
     context_provider: Callable[[Any], Mapping[str, list]] | None = None
+    # host-executed policies (wasm modules, evaluation/wasm_policy.py):
+    # fn(payload) -> {"accepted": bool, "message"?, "code"?,
+    # "mutated_object"?}. When set, the environment routes this policy's
+    # rows through host-side wasm execution; the device rules are inert.
+    host_evaluator: Callable[[Any], Mapping[str, Any]] | None = None
 
     def typecheck(self) -> None:
         if not self.rules:

@@ -580,8 +580,16 @@ def emit_span(
 
 def install_metrics_pusher(
     registry: Any, endpoint: str | None = None, interval_seconds: float = 10.0
-) -> OtlpMetricsPusher:
+) -> OtlpMetricsPusher | None:
+    """Returns None (Prometheus pull keeps serving alone) when the gRPC
+    export stack is not importable."""
     global _pusher
+    if not AVAILABLE:
+        logger.error(
+            "OTLP metrics push requested but grpcio/protobuf are not "
+            "available; metrics stay on the Prometheus pull endpoint"
+        )
+        return None
     with _lock:
         if _pusher is None:
             _pusher = OtlpMetricsPusher(

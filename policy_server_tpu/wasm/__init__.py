@@ -1,0 +1,27 @@
+"""WebAssembly execution substrate (host-side).
+
+The reference's entire evaluation engine is per-request wasm under
+wasmtime (src/evaluation/precompiled_policy.rs:46-64,
+src/evaluation/evaluation_environment.rs:513-543). This package provides
+the TPU build's host-side counterpart — an independent wasm MVP
+interpreter plus the policy ABI hosts (OPA/Gatekeeper, waPC) — serving
+two roles:
+
+* **multi-ABI policy execution**: ``.wasm`` policy payloads run host-side
+  per request (the escape hatch the device path falls back to, and the
+  execution path for policies outside the predicate IR);
+* **non-circular correctness oracle**: differential tests run REAL wasm
+  modules (including upstream-compiled Gatekeeper policies) against the
+  JAX backend — the oracle no longer interprets the same IR the device
+  compiles, so a shared lowering bug cannot pass silently.
+
+No wasmtime/compiler exists in this environment; execution is a pure
+Python stack interpreter (wasm/interp.py). Throughput is irrelevant for
+both roles — correctness and isolation are what count (the interpreter
+enforces memory bounds, type-checked indirect calls, and a fuel limit as
+the epoch-interruption analog, src/lib.rs:176-190)."""
+
+from policy_server_tpu.wasm.binary import WasmModule, decode_module
+from policy_server_tpu.wasm.interp import Instance, WasmTrap
+
+__all__ = ["WasmModule", "decode_module", "Instance", "WasmTrap"]

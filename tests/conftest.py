@@ -69,3 +69,18 @@ def build_admission_review_dict() -> dict:
             "options": None,
         },
     }
+
+
+@pytest.fixture(scope="session")
+def reference_gatekeeper_fixtures():
+    """Upstream-compiled Gatekeeper wasm test policies (the reference's
+    embedded fixtures). Skip when the reference snapshot isn't present —
+    the repo's own WAT-authored wasm policies cover the hermetic case."""
+    from pathlib import Path
+
+    base = Path("/root/reference/tests/data")
+    happy = base / "gatekeeper_always_happy_policy.wasm"
+    unhappy = base / "gatekeeper_always_unhappy_policy.wasm"
+    if not (happy.exists() and unhappy.exists()):
+        pytest.skip("upstream gatekeeper wasm fixtures not available")
+    return happy.read_bytes(), unhappy.read_bytes()

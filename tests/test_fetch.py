@@ -129,11 +129,22 @@ def test_artifact_required_settings(tmp_path):
     assert module.validate_settings({"denied": ["a"]}).valid
 
 
-def test_artifact_rejects_wasm(tmp_path):
+def test_artifact_accepts_wasm_with_known_abi(tmp_path):
+    """Wasm payloads load as host-executed policy modules (multi-ABI,
+    evaluation/wasm_policy.py); an empty module with no policy ABI is
+    still a clear initialization error."""
+    from policy_server_tpu.policies.wasm_oracle import oracle_wasm
+
     p = tmp_path / "pol.wasm"
-    p.write_bytes(b"\x00asm\x01\x00\x00\x00")
-    with pytest.raises(ArtifactError, match="WASM"):
-        load_artifact(p)
+    p.write_bytes(oracle_wasm("always-happy"))
+    module = load_artifact(p)
+    assert module.abi == "wapc"
+    assert module.name == "pol"
+
+    bare = tmp_path / "bare.wasm"
+    bare.write_bytes(b"\x00asm\x01\x00\x00\x00")
+    with pytest.raises(ArtifactError, match="ABI"):
+        load_artifact(bare)
 
 
 def test_artifact_minimum_version(tmp_path):

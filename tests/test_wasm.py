@@ -1,0 +1,184 @@
+"""Wasm substrate unit tests: binary decoder, interpreter semantics
+(control flow, arithmetic edge cases, traps, fuel), the WAT assembler
+round-trip, the waPC protocol host, and the OPA ABI host against the
+upstream-compiled Gatekeeper fixtures."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.wasm.binary import decode_module
+from policy_server_tpu.wasm.interp import Instance, WasmFuelExhausted, WasmTrap
+from policy_server_tpu.wasm.wapc import WapcGuest, flatten_payload
+from policy_server_tpu.wasm.wat import assemble
+
+
+def instantiate(src: str, **kwargs) -> Instance:
+    return Instance(decode_module(assemble(src)), **kwargs)
+
+
+def test_arith_and_control_flow():
+    inst = instantiate(r"""
+    (module
+      (func $fib (export "fib") (param $n i32) (result i32)
+        local.get $n
+        i32.const 2
+        i32.lt_s
+        if (result i32)
+          local.get $n
+        else
+          local.get $n
+          i32.const 1
+          i32.sub
+          call $fib
+          local.get $n
+          i32.const 2
+          i32.sub
+          call $fib
+          i32.add
+        end)
+      (func (export "wrap") (result i32)
+        i32.const 0x7fffffff
+        i32.const 1
+        i32.add)
+      (func (export "sum_to") (param $n i32) (result i32)
+        (local $i i32) (local $acc i32)
+        block $done
+          loop $next
+            local.get $i
+            local.get $n
+            i32.ge_s
+            br_if $done
+            local.get $acc
+            local.get $i
+            i32.add
+            local.set $acc
+            local.get $i
+            i32.const 1
+            i32.add
+            local.set $i
+            br $next
+          end
+        end
+        local.get $acc)
+    )""")
+    assert inst.invoke("fib", 10) == [55]
+    assert inst.invoke("wrap") == [-0x80000000]  # two's-complement wrap
+    assert inst.invoke("sum_to", 100) == [4950]
+
+
+def test_memory_data_and_traps():
+    inst = instantiate(r"""
+    (module
+      (memory (export "memory") 1)
+      (data (i32.const 8) "wasm")
+      (func (export "peek") (param $p i32) (result i32)
+        local.get $p
+        i32.load8_u)
+      (func (export "oob") (result i32)
+        i32.const 70000
+        i32.load)
+      (func (export "div0") (result i32)
+        i32.const 1
+        i32.const 0
+        i32.div_s)
+      (func (export "boom")
+        unreachable)
+    )""")
+    assert inst.invoke("peek", 8) == [ord("w")]
+    with pytest.raises(WasmTrap, match="out of bounds"):
+        inst.invoke("oob")
+    with pytest.raises(WasmTrap, match="divide by zero"):
+        inst.invoke("div0")
+    with pytest.raises(WasmTrap, match="unreachable"):
+        inst.invoke("boom")
+
+
+def test_fuel_limit_bounds_infinite_loop():
+    inst = instantiate(r"""
+    (module
+      (func (export "spin")
+        loop $forever
+          br $forever
+        end)
+    )""", fuel=10_000)
+    with pytest.raises(WasmFuelExhausted):
+        inst.invoke("spin")
+
+
+def test_br_table_and_globals():
+    inst = instantiate(r"""
+    (module
+      (global $acc (mut i32) (i32.const 0))
+      (func (export "pick") (param $i i32) (result i32)
+        block $c
+          block $b
+            block $a
+              local.get $i
+              br_table $a $b $c
+            end
+            i32.const 10
+            return
+          end
+          i32.const 20
+          return
+        end
+        i32.const 30)
+      (func (export "bump") (result i32)
+        global.get $acc
+        i32.const 1
+        i32.add
+        global.set $acc
+        global.get $acc)
+    )""")
+    assert [inst.invoke("pick", i)[0] for i in (0, 1, 2, 9)] == [10, 20, 30, 30]
+    assert inst.invoke("bump") == [1]
+    assert inst.invoke("bump") == [2]
+
+
+def test_flatten_payload_deterministic():
+    doc = {"b": [1, {"x": True}], "a": None, "s": "txt"}
+    flat = flatten_payload(doc)
+    assert flat == b"a\x00null\x00b.0\x001\x00b.1.x\x00true\x00s\x00txt\x00"
+
+
+def test_wapc_missing_export_rejected():
+    with pytest.raises(Exception, match="__guest_call"):
+        WapcGuest(assemble("(module (memory (export \"memory\") 1))"))
+
+
+def test_opa_host_runs_upstream_gatekeeper(reference_gatekeeper_fixtures):
+    from policy_server_tpu.wasm.opa import OpaPolicy, gatekeeper_validate
+
+    happy_bytes, unhappy_bytes = reference_gatekeeper_fixtures
+    happy = OpaPolicy(happy_bytes)
+    assert happy.entrypoints() == {"policy/violation": 0}
+    req = {"uid": "u", "operation": "CREATE", "object": {"metadata": {"name": "p"}}}
+    assert gatekeeper_validate(happy, req) == (True, None)
+    allowed, msg = gatekeeper_validate(OpaPolicy(unhappy_bytes), req)
+    assert allowed is False and msg == "failing as expected"
+
+
+def test_wasm_fuel_maps_to_deadline_rejection(tmp_path):
+    """A runaway wasm policy is rejected in-band with the reference's
+    'execution deadline exceeded' (epoch-interruption analog)."""
+    from policy_server_tpu.evaluation.wasm_policy import WasmPolicyModule
+
+    spin = assemble(r"""
+    (module
+      (import "wapc" "__guest_request" (func $gr (param i32 i32)))
+      (import "wapc" "__guest_response" (func $resp (param i32 i32)))
+      (memory (export "memory") 1)
+      (global $flat (mut i32) (i32.const 1))
+      (export "__flat_abi" (global $flat))
+      (func (export "__guest_call") (param i32 i32) (result i32)
+        loop $forever
+          br $forever
+        end
+        i32.const 1)
+    )""")
+    module = WasmPolicyModule(spin, name="spin", digest="x", fuel=100_000)
+    program = module.build({})
+    verdict = program.host_evaluator({"uid": "u"})
+    assert verdict["accepted"] is False
+    assert verdict["message"] == "execution deadline exceeded"
