@@ -1,0 +1,47 @@
+# policy-server-tpu container image.
+#
+# Build args select the JAX backend wheel: the default CPU wheel serves
+# the in-process test/dev loop; TPU pods install the libtpu wheel
+# (requires the TPU runtime on the node, e.g. a GKE TPU nodepool).
+#
+# Runtime surface (reference Dockerfile parity: ports 3000/8081, non-root
+# uid): API on 3000 (TLS when --cert-file/--key-file mounted), readiness +
+# Prometheus /metrics on 8081.
+
+FROM python:3.12-slim AS build
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+ARG JAX_WHEEL="jax[cpu]"
+RUN pip install --no-cache-dir \
+    "${JAX_WHEEL}" aiohttp pyyaml requests cryptography prometheus_client \
+    grpcio protobuf numpy
+
+WORKDIR /src
+COPY policy_server_tpu/ policy_server_tpu/
+COPY csrc/ csrc/
+COPY protos/ protos/
+# native host encoder (ops/fastenc.py soft-fails to the Python trie if
+# the extension is absent, so a failed build degrades, not breaks)
+RUN g++ -O3 -shared -fPIC -std=c++17 \
+      -o policy_server_tpu/../build/fastenc-cpython-312-x86_64-linux-gnu.so \
+      csrc/fastenc.cpp -I/usr/local/include/python3.12 2>/dev/null \
+    || mkdir -p build
+
+FROM python:3.12-slim
+
+COPY --from=build /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
+COPY --from=build /src/policy_server_tpu /app/policy_server_tpu
+COPY --from=build /src/build /app/build
+
+WORKDIR /app
+# non-root (reference runs uid 65533)
+USER 65533:65533
+
+EXPOSE 3000 8081
+
+ENTRYPOINT ["python", "-m", "policy_server_tpu"]
+CMD ["--policies", "/config/policies.yml", \
+     "--policies-download-dir", "/data/policies", \
+     "--compilation-cache-dir", "/data/xla-cache"]

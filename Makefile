@@ -1,0 +1,52 @@
+# Developer entrypoints (reference Makefile parity: build/test/coverage +
+# docs freshness; bench/dryrun are TPU-build additions).
+
+IMG ?= policy-server-tpu:latest
+
+.PHONY: all test unit-tests integration-tests bench docs docs-check \
+        fastenc image dev-stack dev-stack-down dryrun-multichip clean
+
+all: test
+
+# full suite on the 8-virtual-device CPU backend (tests/conftest.py)
+test:
+	python -m pytest tests/ -q
+
+unit-tests:
+	python -m pytest tests/ -q -k "not test_server and not test_tls"
+
+integration-tests:
+	python -m pytest tests/test_server.py tests/test_server_mesh.py tests/test_tls.py -q
+
+# the 5 BASELINE configs + HTTP-path percentiles (one JSON line each)
+bench:
+	python bench.py
+
+# native host encoder (ops/fastenc.py compiles on demand into build/)
+fastenc:
+	python -c "from policy_server_tpu.ops import fastenc; print(fastenc._build_library())"
+
+docs:
+	python -m policy_server_tpu docs --output cli-docs.md
+
+# CI freshness gate (reference ci.yml docs job)
+docs-check: docs
+	git diff --exit-code cli-docs.md
+
+image:
+	docker build -t $(IMG) .
+
+# local observability stack: otel-collector + jaeger + prometheus + grafana
+dev-stack:
+	docker compose -f hack/docker-compose.yml up -d
+
+dev-stack-down:
+	docker compose -f hack/docker-compose.yml down
+
+# the driver's multi-chip compile check on N virtual CPU devices
+dryrun-multichip:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+clean:
+	rm -rf .pytest_cache build/*.o __pycache__

@@ -25,7 +25,7 @@ from policy_server_tpu.ops.ir import false
 from policy_server_tpu.policies.base import SettingsValidationResponse
 from policy_server_tpu.wasm.binary import decode_module
 from policy_server_tpu.wasm.interp import WasmFuelExhausted, WasmTrap
-from policy_server_tpu.wasm.opa import OpaPolicy, gatekeeper_validate
+from policy_server_tpu.wasm.opa import OpaError, OpaPolicy, gatekeeper_validate
 from policy_server_tpu.wasm.wapc import KubewardenWapcPolicy, WapcError
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
@@ -43,14 +43,14 @@ class WasmPolicyModule:
     ):
         self.name = name
         self.digest = digest
-        self._bytes = wasm_bytes
-        exports = {e.name for e in decode_module(wasm_bytes).exports}
+        module = decode_module(wasm_bytes)  # decoded ONCE, shared by hosts
+        exports = {e.name for e in module.exports}
         if "__guest_call" in exports:
             self.abi = "wapc"
-            self._wapc = KubewardenWapcPolicy(wasm_bytes, fuel=fuel)
+            self._wapc = KubewardenWapcPolicy(module, fuel=fuel)
         elif "opa_eval_ctx_new" in exports:
             self.abi = "opa-gatekeeper"
-            self._opa = OpaPolicy(wasm_bytes, fuel=fuel)
+            self._opa = OpaPolicy(module, fuel=fuel)
         else:
             raise WasmTrap(
                 f"wasm module {name!r} speaks no supported policy ABI "
@@ -77,7 +77,7 @@ class WasmPolicyModule:
                     "message": DEADLINE_MESSAGE,
                     "code": 500,
                 }
-            except (WasmTrap, WapcError) as e:
+            except (WasmTrap, WapcError, OpaError) as e:
                 # guest crash → in-band rejection, mirroring the reference
                 # surfacing wasm errors as 500 responses
                 return {
@@ -99,7 +99,7 @@ class WasmPolicyModule:
         if self.abi == "wapc":
             try:
                 doc = self._wapc.validate_settings(dict(settings or {}))
-            except (WasmTrap, WapcError) as e:
+            except (WasmTrap, WapcError, OpaError) as e:
                 return SettingsValidationResponse(
                     valid=False, message=f"settings validation failed: {e}"
                 )

@@ -121,17 +121,16 @@ def load_artifact(path: str | Path):
     digest = hashlib.sha256(data).hexdigest()
     if data[:4] == b"\x00asm":
         from policy_server_tpu.evaluation.wasm_policy import WasmPolicyModule
-        from policy_server_tpu.wasm.binary import WasmDecodeError
-        from policy_server_tpu.wasm.interp import WasmTrap
-        from policy_server_tpu.wasm.opa import OpaError
-        from policy_server_tpu.wasm.wapc import WapcError
 
         try:
             return WasmPolicyModule(data, name=Path(path).stem, digest=digest)
-        except (WasmTrap, WasmDecodeError, OpaError, WapcError) as e:
-            # ArtifactError is a ValueError: a bad wasm artifact surfaces
-            # as a per-policy initialization error (and through
-            # --continue-on-errors), never a bootstrap crash
+        except Exception as e:  # noqa: BLE001 — arbitrary fetched bytes can
+            # break the decoder in arbitrary ways (IndexError on truncated
+            # sections, KeyError on bad kinds, ...); EVERY failure is the
+            # same outcome: an unusable artifact. ArtifactError is a
+            # ValueError, so it surfaces as a per-policy initialization
+            # error (and through --continue-on-errors), never a bootstrap
+            # crash.
             raise ArtifactError(f"unusable wasm artifact: {e}") from e
     try:
         doc = json.loads(data)

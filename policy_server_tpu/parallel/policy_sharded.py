@@ -46,31 +46,89 @@ class PolicyShardedEvaluator:
         continue_on_errors: bool = False,
         builder_kwargs: dict[str, Any] | None = None,
     ) -> None:
-        plans = mesh_mod.plan_policy_shards(list(policies), mesh)
-        self.shards: list[EvaluationEnvironment] = []
-        self._owner: dict[str, int] = {}
+        import threading
+
+        self._policies = dict(policies)
+        self._backend = backend
+        self._continue_on_errors = continue_on_errors
+        self._builder_kwargs = dict(builder_kwargs or {})
+        self._resize_lock = threading.Lock()
+        self.mesh = mesh
+        # the operator-configured policy parallelism: resize() re-factors
+        # toward this cap, so a transient shrink can grow back
+        self._configured_policy_axis = mesh.shape[mesh_mod.POLICY_AXIS]
+        self.resizes = 0  # introspection for tests/metrics
+        # (shards, owner) swap as ONE tuple so routing always reads a
+        # consistent pair across a concurrent resize
+        self._routing: tuple[list[EvaluationEnvironment], dict[str, int]] = (
+            self._build_shards(mesh)
+        )
+
+    def _build_shards(
+        self, mesh: Any
+    ) -> tuple[list[EvaluationEnvironment], dict[str, int]]:
+        plans = mesh_mod.plan_policy_shards(list(self._policies), mesh)
+        shards: list[EvaluationEnvironment] = []
+        owner: dict[str, int] = {}
         for plan in plans:
-            shard_policies = {pid: policies[pid] for pid in plan.policy_ids}
+            shard_policies = {
+                pid: self._policies[pid] for pid in plan.policy_ids
+            }
             builder = EvaluationEnvironmentBuilder(
-                backend=backend,
-                continue_on_errors=continue_on_errors,
-                **(builder_kwargs or {}),
+                backend=self._backend,
+                continue_on_errors=self._continue_on_errors,
+                **self._builder_kwargs,
             )
             env = builder.build(shard_policies)
-            if backend == "jax" and plan.mesh.devices.size > 1:
+            if self._backend == "jax" and plan.mesh.devices.size > 1:
                 env.attach_mesh(plan.mesh)
-            self.shards.append(env)
+            shards.append(env)
             for pid in plan.policy_ids:
-                self._owner[pid] = plan.shard_index
+                owner[pid] = plan.shard_index
+        return shards, owner
+
+    # -- preemption churn (BASELINE.md config 5) ---------------------------
+
+    def resize(self, devices: list[Any]) -> None:
+        """Rebuild/rebalance the shard set over a changed device set — the
+        preemption-churn path: a preempted/lost chip shrinks the mesh, the
+        policy axis re-factors over the survivors, and every shard
+        recompiles (cheap when the persistent XLA compilation cache is
+        configured — programs unchanged by the rebalance hit the cache).
+        Serving continues on the OLD shards until the new set is fully
+        built; the swap is one atomic attribute assignment."""
+        if not devices:
+            raise ValueError("cannot resize to an empty device set")
+        with self._resize_lock:
+            new_policy_axis = min(self._configured_policy_axis, len(devices))
+            while len(devices) % new_policy_axis:
+                new_policy_axis -= 1
+            from policy_server_tpu.config.config import MeshSpec
+
+            spec = MeshSpec.parse(
+                f"data:{len(devices) // new_policy_axis},"
+                f"policy:{new_policy_axis}"
+            )
+            new_mesh = mesh_mod.make_mesh(spec, devices)
+            # atomic swap: in-flight validate_batch calls finish on the
+            # old shard environments; new calls route through the new set
+            self._routing = self._build_shards(new_mesh)
+            self.mesh = new_mesh
+            self.resizes += 1
 
     # -- routing -----------------------------------------------------------
 
+    @property
+    def shards(self) -> list[EvaluationEnvironment]:
+        return self._routing[0]
+
     def _shard_of(self, policy_id: str) -> EvaluationEnvironment:
+        shards, owner = self._routing
         top = policy_id.split("/")[0]
-        idx = self._owner.get(top)
+        idx = owner.get(top)
         if idx is None:
             raise PolicyNotFoundError(policy_id)
-        return self.shards[idx]
+        return shards[idx]
 
     # -- environment surface ----------------------------------------------
 
@@ -120,18 +178,19 @@ class PolicyShardedEvaluator:
         """Partition the batch by owning shard, dispatch every shard's fused
         program, merge in submission order. Shard dispatches overlap via
         JAX async dispatch."""
+        shards, owner = self._routing  # one consistent routing snapshot
         per_shard: dict[int, list[int]] = {}
         results: list[AdmissionResponse | Exception | None] = [None] * len(items)
         for i, (pid, _) in enumerate(items):
             top = pid.split("/")[0]
-            idx = self._owner.get(top)
+            idx = owner.get(top)
             if idx is None:
                 results[i] = PolicyNotFoundError(pid)
                 continue
             per_shard.setdefault(idx, []).append(i)
         for idx, indices in per_shard.items():
             shard_items = [items[i] for i in indices]
-            shard_results = self.shards[idx].validate_batch(
+            shard_results = shards[idx].validate_batch(
                 shard_items, run_hooks=run_hooks
             )
             for i, r in zip(indices, shard_results):

@@ -185,24 +185,6 @@ def _decode_blocktype(r: _Reader) -> Any:
     return r.s32()  # type index (multi-value)
 
 
-def decode_expr(r: _Reader, until: tuple[int, ...] = (END,)) -> list:
-    """Decode instructions until one of ``until`` opcodes (consumed).
-    Returns the flat instruction list WITHOUT resolved targets."""
-    out: list = []
-    while True:
-        op = r.byte()
-        if op in until and _depth_zero(out):
-            out.append((op, None))
-            return out
-        out.append(_decode_instr(op, r))
-
-
-def _depth_zero(out: list) -> bool:
-    # decode_expr tracks nesting implicitly: delegated to decode_body's
-    # full pass; for const exprs nesting never occurs
-    return True
-
-
 def _decode_instr(op: int, r: _Reader):
     if op in _BLOCK_OPS:
         return (op, _decode_blocktype(r))

@@ -243,8 +243,8 @@ class Instance:
         ft = self.module.types[bt]
         return len(ft.params), len(ft.results)
 
-    def _exec(self, fn: _Func, args: list) -> list:  # noqa: C901 — the
-        # dispatch loop is one deliberate monolith: a function call per
+    def _exec(self, fn: _Func, args: list) -> list:  # noqa: C901 —
+        # the dispatch loop is one deliberate monolith: a function call per
         # opcode would dominate runtime
         module = self.module
         mem = self.memories[0] if self.memories else None
@@ -314,11 +314,13 @@ class Instance:
                 else:
                     ft = fn.functype
                     n = len(ft.results)
-                    return stack[-n:] if n else []
+                    self.fuel = fuel  # writeback: consumed fuel must not
+                    return stack[-n:] if n else []  # refund to the caller
             elif op == 0x0C:  # br
                 npc = self._branch(imm, ctrl, stack)
                 if npc is None:  # br targeting the function body = return
                     n = len(fn.functype.results)
+                    self.fuel = fuel
                     return stack[-n:] if n else []
                 pc = npc
                 continue
@@ -327,6 +329,7 @@ class Instance:
                     npc = self._branch(imm, ctrl, stack)
                     if npc is None:
                         n = len(fn.functype.results)
+                        self.fuel = fuel
                         return stack[-n:] if n else []
                     pc = npc
                     continue
@@ -337,12 +340,14 @@ class Instance:
                 npc = self._branch(label, ctrl, stack)
                 if npc is None:
                     n = len(fn.functype.results)
+                    self.fuel = fuel
                     return stack[-n:] if n else []
                 pc = npc
                 continue
             elif op == 0x0F:  # return
                 ft = fn.functype
                 n = len(ft.results)
+                self.fuel = fuel
                 return stack[-n:] if n else []
             elif op == 0x10:  # call
                 callee = self.funcs[imm]
@@ -838,9 +843,9 @@ class Instance:
                             (_i64 if to64 else _i32)(int(t) & ((1 << bits) - 1))
                         )
                 elif sub == 8:  # memory.init
-                    n = stack.pop()
-                    src = stack.pop()
-                    dst = stack.pop()
+                    n = _u32(stack.pop())
+                    src = _u32(stack.pop())
+                    dst = _u32(stack.pop())
                     seg = module.data[imm]
                     if imm in self.dropped_data:
                         if n:
@@ -852,13 +857,13 @@ class Instance:
                 elif sub == 9:  # data.drop
                     self.dropped_data.add(imm)
                 elif sub == 10:  # memory.copy
-                    n = stack.pop()
+                    n = _u32(stack.pop())
                     src = _u32(stack.pop())
                     dst = _u32(stack.pop())
                     chunk = mem.read(src, n)
                     mem.write(dst, chunk)
                 elif sub == 11:  # memory.fill
-                    n = stack.pop()
+                    n = _u32(stack.pop())
                     val = stack.pop() & 0xFF
                     dst = _u32(stack.pop())
                     mem.write(dst, bytes([val]) * n)

@@ -44,8 +44,12 @@ def _read_cstring(instance: Instance, addr: int) -> bytes:
 class OpaPolicy:
     """A decoded OPA wasm policy; instantiate_and_eval per request."""
 
-    def __init__(self, wasm_bytes: bytes, fuel: int | None = 50_000_000):
-        self.module: WasmModule = decode_module(wasm_bytes)
+    def __init__(self, wasm_bytes: bytes | WasmModule, fuel: int | None = 50_000_000):
+        self.module: WasmModule = (
+            wasm_bytes
+            if isinstance(wasm_bytes, WasmModule)
+            else decode_module(wasm_bytes)
+        )
         self.fuel = fuel
         exports = {e.name for e in self.module.exports}
         required = {"opa_malloc", "opa_json_parse", "opa_json_dump", "eval",

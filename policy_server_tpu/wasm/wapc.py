@@ -30,7 +30,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
-from policy_server_tpu.wasm.binary import decode_module
+from policy_server_tpu.wasm.binary import WasmModule, decode_module
 from policy_server_tpu.wasm.interp import Instance, WasmTrap
 
 HostCapability = Callable[[bytes], bytes]
@@ -64,6 +64,14 @@ def flatten_payload(doc: Any, prefix: str = "") -> bytes:
                 text = node
             else:
                 text = json.dumps(node)
+            if "\x00" in path or "\x00" in text:
+                # NUL is legal inside JSON strings but is this ABI's entry
+                # framing: letting it through would let a request string
+                # forge extra key/value entries (policy bypass)
+                raise WapcError(
+                    "NUL byte in payload key or value cannot be framed in "
+                    "the flat ABI"
+                )
             entries.append((path, text))
 
     walk(doc, prefix)
@@ -83,7 +91,11 @@ class WapcGuest:
         host_capabilities: Mapping[tuple[str, str], HostCapability] | None = None,
         fuel: int | None = 50_000_000,
     ):
-        self.module = decode_module(wasm_bytes)
+        self.module = (
+            wasm_bytes
+            if isinstance(wasm_bytes, WasmModule)
+            else decode_module(wasm_bytes)
+        )
         self.host_capabilities = dict(host_capabilities or {})
         self.fuel = fuel
         exports = self.module.export_map()
@@ -183,11 +195,23 @@ class KubewardenWapcPolicy:
             payload = json.dumps(
                 {"request": dict(request), "settings": dict(settings or {})}
             ).encode()
-        return json.loads(self.guest.call("validate", payload))
+        return _json_object(self.guest.call("validate", payload))
 
     def validate_settings(self, settings: Mapping[str, Any] | None) -> dict:
         if self.guest.flat_abi:
             payload = flatten_payload(dict(settings or {}))
         else:
             payload = json.dumps(dict(settings or {})).encode()
-        return json.loads(self.guest.call("validate_settings", payload))
+        return _json_object(self.guest.call("validate_settings", payload))
+
+
+def _json_object(raw: bytes) -> dict:
+    """Guest responses must be JSON objects; anything else is a guest
+    protocol error (mapped to an in-band 500 upstream)."""
+    try:
+        doc = json.loads(raw)
+    except ValueError as e:
+        raise WapcError(f"guest response is not valid JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise WapcError("guest response is not a JSON object")
+    return doc

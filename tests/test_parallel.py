@@ -132,6 +132,78 @@ def test_policy_sharded_evaluator_matches_single():
     assert isinstance(out[0], PolicyNotFoundError)
 
 
+def test_policy_sharded_preemption_churn_resize():
+    """BASELINE config 5 preemption churn: dropping devices between
+    batches rebuilds/rebalances the shard set over the survivors and
+    serving continues with identical verdicts."""
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    sharded = PolicyShardedEvaluator(parse_all(POLICIES), mesh)
+    cases = [
+        ("priv", pod_request("default", True)),
+        ("ns", pod_request("blocked", False)),
+        ("latest", pod_request("default", False)),
+        ("happy", pod_request("default", False)),
+    ]
+    before = [r.to_dict() for r in sharded.validate_batch(cases)]
+    assert len(sharded.shards) == 2
+
+    # two chips preempted: 8 → 6 devices; policy axis re-factors (2 | 6)
+    survivors = list(jax.devices())[:6]
+    sharded.resize(survivors)
+    assert sharded.resizes == 1
+    assert sharded.mesh.devices.size == 6
+    assert sharded.mesh.shape[POLICY_AXIS] == 2
+    assert sharded.mesh.shape[DATA_AXIS] == 3
+    after = [r.to_dict() for r in sharded.validate_batch(cases)]
+    assert after == before
+    assert sorted(sharded.policy_ids()) == sorted(POLICIES)
+
+    # a second shrink to a device count the policy axis does not divide:
+    # 6 → 5 devices forces a single-shard layout
+    sharded.resize(list(jax.devices())[:5])
+    assert sharded.mesh.shape[POLICY_AXIS] == 1
+    assert [r.to_dict() for r in sharded.validate_batch(cases)] == before
+
+    with pytest.raises(ValueError, match="empty device set"):
+        sharded.resize([])
+
+
+def test_policy_sharded_resize_during_inflight_batch():
+    """A resize concurrent with serving: in-flight batches finish on the
+    old shards; new batches route through the new set — no torn routing."""
+    import threading
+
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    sharded = PolicyShardedEvaluator(parse_all(POLICIES), mesh)
+    cases = [("priv", pod_request("default", True)),
+             ("ns", pod_request("blocked", False))] * 8
+    expected = [r.to_dict() for r in sharded.validate_batch(cases)]
+
+    stop = threading.Event()
+    failures: list = []
+
+    def serve() -> None:
+        while not stop.is_set():
+            try:
+                got = [r.to_dict() for r in sharded.validate_batch(cases)]
+                if got != expected:
+                    failures.append("verdict drift")
+                    return
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+                return
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        sharded.resize(list(jax.devices())[:4])
+        sharded.resize(list(jax.devices()))
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not failures, failures
+
+
 def test_policy_sharded_group_routing():
     policies = dict(POLICIES)
     policies["grp"] = {

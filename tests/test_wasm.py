@@ -106,6 +106,62 @@ def test_fuel_limit_bounds_infinite_loop():
         inst.invoke("spin")
 
 
+def test_fuel_not_refunded_across_calls():
+    """Regression: fuel consumed by a callee must not refund to the caller
+    on return — a loop-over-calls module must still exhaust."""
+    inst = instantiate(r"""
+    (module
+      (func $burn (result i32)
+        (local $i i32)
+        block $done
+          loop $next
+            local.get $i
+            i32.const 200
+            i32.ge_s
+            br_if $done
+            local.get $i
+            i32.const 1
+            i32.add
+            local.set $i
+            br $next
+          end
+        end
+        local.get $i)
+      (func (export "spin_calls")
+        loop $forever
+          call $burn
+          drop
+          br $forever
+        end)
+    )""", fuel=100_000)
+    with pytest.raises(WasmFuelExhausted):
+        inst.invoke("spin_calls")
+
+
+def test_bulk_memory_negative_length_traps():
+    """memory.fill with n in [2^31, 2^32) must trap out-of-bounds, not
+    silently no-op (the oracle must not diverge from real engines)."""
+    inst = instantiate(r"""
+    (module
+      (memory (export "memory") 1)
+      (func (export "fill_huge")
+        i32.const 0
+        i32.const 65
+        i32.const -1
+        memory.fill)
+    )""")
+    with pytest.raises(WasmTrap, match="out of bounds"):
+        inst.invoke("fill_huge")
+
+
+def test_flat_abi_rejects_nul_injection():
+    """A request string embedding NUL must not forge flat-ABI entries."""
+    from policy_server_tpu.wasm.wapc import WapcError
+
+    with pytest.raises(WapcError, match="NUL"):
+        flatten_payload({"image": "x\x00request.evil\x00true"})
+
+
 def test_br_table_and_globals():
     inst = instantiate(r"""
     (module
