@@ -23,11 +23,13 @@ COPY policy_server_tpu/ policy_server_tpu/
 COPY csrc/ csrc/
 COPY protos/ protos/
 # native host encoder (ops/fastenc.py soft-fails to the Python trie if
-# the extension is absent, so a failed build degrades, not breaks)
-RUN g++ -O3 -shared -fPIC -std=c++17 \
-      -o policy_server_tpu/../build/fastenc-cpython-312-x86_64-linux-gnu.so \
-      csrc/fastenc.cpp -I/usr/local/include/python3.12 2>/dev/null \
-    || mkdir -p build
+# the extension is absent, so a failed build degrades, not breaks —
+# but the failure must be VISIBLE in the build log, not swallowed)
+RUN mkdir -p build && \
+    { g++ -O3 -shared -fPIC -std=c++17 \
+        -o build/fastenc-cpython-312-x86_64-linux-gnu.so \
+        csrc/fastenc.cpp -I/usr/local/include/python3.12 \
+      || echo "WARNING: fastenc build failed; Python encoder fallback"; }
 
 FROM python:3.12-slim
 

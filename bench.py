@@ -1,62 +1,462 @@
-"""Benchmark: the flagship config — 32 mixed policies, synthetic
-AdmissionReview firehose (BASELINE.md config 4).
+"""Benchmark suite: the five BASELINE.md configs + the HTTP serving path.
 
-Measures the full evaluation pipeline per review (encode → batched fused
-device dispatch → response materialization, i.e. everything the server does
-minus HTTP framing) and prints ONE JSON line:
+Prints one JSON line per benchmark, the HEADLINE line LAST (config 4, the
+32-policy firehose — the driver's recorded metric):
 
-    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
-``vs_baseline`` is value / 100_000 — the north-star target from
-BASELINE.json (the reference publishes no benchmark numbers; ≥1.0 means the
-target is met on this hardware).
+``vs_baseline`` is value / 100_000 on throughput metrics — the north-star
+target from BASELINE.json (the reference publishes no numbers; ≥1.0 means
+the target is met on this hardware). Latency-only lines use the <10 ms
+p99 target instead (vs_baseline = 10 / p99, ≥1.0 means met).
+
+Configs (BASELINE.md:34-40):
+1. namespace-validate — single policy, batch=1 (the CPU-reference shape);
+2. psp-capabilities + psp-apparmor — 2 policies, 1k-request replay;
+3. pod-image-signatures group — OR/AND expression tree over 3 members;
+4. 32 mixed policies, synthetic firehose (headline);
+5. multi-tenant 8-shard policy-sharded mesh incl. preemption churn — runs
+   in a subprocess on the 8-virtual-device CPU mesh (multi-chip hardware
+   is not present; the virtual mesh measures routing/rebalance overheads,
+   clearly labeled);
+plus an HTTP line driving the REAL server (aiohttp, concurrent clients)
+through the micro-batcher, reporting p50/p99 of end-to-end request
+latency.
 """
 
 from __future__ import annotations
 
 import json
+import math
+import os
+import statistics
+import subprocess
 import sys
 import time
 
+NORTH_STAR_RPS = 100_000.0
+NORTH_STAR_P99_MS = 10.0
 
-def main() -> int:
-    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    batch_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
 
+def pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, math.ceil(q * len(sorted_vals)) - 1)
+    return sorted_vals[idx]
+
+
+def emit(metric: str, value: float, unit: str, vs: float, **details) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 2),
+                "unit": unit,
+                "vs_baseline": round(vs, 4),
+                "details": details,
+            }
+        ),
+        flush=True,
+    )
+
+
+def build_requests(n: int, seed: int = 42):
+    from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+    from policy_server_tpu.policies.flagship import synthetic_firehose
+
+    return [
+        ValidateRequest.from_admission(
+            AdmissionReviewRequest.from_dict(doc).request
+        )
+        for doc in synthetic_firehose(n, seed=seed)
+    ]
+
+
+def build_env(policies: dict):
     from policy_server_tpu.evaluation.environment import (
         EvaluationEnvironmentBuilder,
     )
-    from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+    from policy_server_tpu.models.policy import parse_policy_entry
+
+    return EvaluationEnvironmentBuilder(backend="jax").build(
+        {k: parse_policy_entry(k, v) for k, v in policies.items()}
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config 1: namespace-validate, single request (batch=1)
+# ---------------------------------------------------------------------------
+
+
+def bench_config1(requests) -> None:
+    env = build_env(
+        {
+            "namespace-validate": {
+                "module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": ["kube-system"]},
+            }
+        }
+    )
+    env.warmup((1,))
+    reqs = requests[:256]
+    for r in reqs[:8]:
+        env.validate("namespace-validate", r)  # prime
+    lats = []
+    t0 = time.perf_counter()
+    for r in reqs:
+        t1 = time.perf_counter()
+        env.validate("namespace-validate", r)
+        lats.append((time.perf_counter() - t1) * 1e3)
+    wall = time.perf_counter() - t0
+    lats.sort()
+    emit(
+        "config1_namespace_validate_single",
+        len(reqs) / wall,
+        "reviews/s/chip",
+        (len(reqs) / wall) / NORTH_STAR_RPS,
+        p50_ms=round(pct(lats, 0.5), 2),
+        p99_ms=round(pct(lats, 0.99), 2),
+        batch_size=1,
+        n_requests=len(reqs),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config 2: psp-capabilities + psp-apparmor, 1k replay
+# ---------------------------------------------------------------------------
+
+
+def bench_config2(requests) -> None:
+    env = build_env(
+        {
+            "psp-capabilities": {
+                "module": "builtin://psp-capabilities",
+                "allowedToMutate": True,
+                "settings": {
+                    "allowed_capabilities": ["NET_BIND_SERVICE", "CHOWN"],
+                    "required_drop_capabilities": ["NET_ADMIN"],
+                    "default_add_capabilities": ["CHOWN"],
+                },
+            },
+            "psp-apparmor": {
+                "module": "builtin://psp-apparmor",
+                "settings": {"allowed_profiles": ["runtime/default"]},
+            },
+        }
+    )
+    corpus = requests[:1000]
+    items = [
+        ("psp-capabilities" if i % 2 else "psp-apparmor", r)
+        for i, r in enumerate(corpus)
+    ]
+    env.max_dispatch_batch = 512
+    env.warmup((512,))
+    env.validate_batch(items)  # prime
+    repeats = 5
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        env.validate_batch(items)
+    wall = time.perf_counter() - t0
+    rps = len(items) * repeats / wall
+    emit(
+        "config2_psp_pair_1k_replay",
+        rps,
+        "reviews/s/chip",
+        rps / NORTH_STAR_RPS,
+        n_requests=len(items) * repeats,
+        replay_size=len(items),
+        n_policies=2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config 3: pod-image-signatures policy group (OR/AND tree)
+# ---------------------------------------------------------------------------
+
+
+def bench_config3(requests) -> None:
+    from policy_server_tpu.policies.flagship import _signature_fixture
+
+    store, pub = _signature_fixture()
+    env = build_env(
+        {
+            "pod-image-signatures": {
+                "expression": "signed() || (trusted() && not_latest())",
+                "message": "image provenance cannot be established",
+                "policies": {
+                    "signed": {
+                        "module": "builtin://verify-image-signatures",
+                        "settings": {
+                            "signatures": [
+                                {
+                                    "image": "registry.prod.example.com/*",
+                                    "pubKeys": [pub],
+                                }
+                            ],
+                            "signatureStore": store,
+                        },
+                    },
+                    "trusted": {
+                        "module": "builtin://trusted-repos",
+                        "settings": {"registries": {"allow": ["docker.io"]}},
+                    },
+                    "not_latest": {"module": "builtin://disallow-latest-tag"},
+                },
+            }
+        }
+    )
+    corpus = requests[:4096]
+    items = [("pod-image-signatures", r) for r in corpus]
+    env.max_dispatch_batch = 1024
+    env.warmup((1024,))
+    env.validate_batch(items[:1024])  # prime
+    t0 = time.perf_counter()
+    env.validate_batch(items)
+    wall = time.perf_counter() - t0
+    rps = len(items) / wall
+    emit(
+        "config3_image_signatures_group",
+        rps,
+        "reviews/s/chip",
+        rps / NORTH_STAR_RPS,
+        n_requests=len(items),
+        group_members=3,
+        expression="signed() || (trusted() && not_latest())",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config 5: 8-shard multi-tenant + preemption churn (virtual CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def bench_config5_child() -> None:
+    """Runs in a subprocess with JAX_PLATFORMS=cpu and 8 virtual devices."""
+    import jax
+
+    # the axon site package pins jax_platforms to the real TPU regardless
+    # of JAX_PLATFORMS (see tests/conftest.py); override before backend init
+    jax.config.update("jax_platforms", "cpu")
+
+    from policy_server_tpu.config.config import MeshSpec
+    from policy_server_tpu.parallel import PolicyShardedEvaluator, make_mesh
+    from policy_server_tpu.models.policy import parse_policy_entry
+
+    # 8 tenants × namespace fence + shared pod-security = 16 policies over
+    # a policy:8 mesh (each shard data-parallel over 1 device)
+    policies = {}
+    for t in range(8):
+        policies[f"tenant{t}-fence"] = parse_policy_entry(
+            f"tenant{t}-fence",
+            {
+                "module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": [f"tenant-{t}-restricted"]},
+            },
+        )
+        policies[f"tenant{t}-priv"] = parse_policy_entry(
+            f"tenant{t}-priv", {"module": "builtin://pod-privileged"}
+        )
+    mesh = make_mesh(MeshSpec.parse("data:1,policy:8"))
+    sharded = PolicyShardedEvaluator(policies, mesh)
+    requests = build_requests(2048, seed=9)
+    pids = list(policies)
+    items = [(pids[i % len(pids)], r) for i, r in enumerate(requests)]
+    sharded.validate_batch(items[:256])  # prime every shard
+    t0 = time.perf_counter()
+    sharded.validate_batch(items)
+    wall = time.perf_counter() - t0
+
+    # preemption churn: drop 2 of 8 devices, measure the rebuild, and
+    # verify serving continues
+    t1 = time.perf_counter()
+    sharded.resize(list(jax.devices())[:6])
+    churn_s = time.perf_counter() - t1
+    t2 = time.perf_counter()
+    sharded.validate_batch(items[:512])
+    post_wall = time.perf_counter() - t2
+
+    print(
+        json.dumps(
+            {
+                "rps": len(items) / wall,
+                "churn_rebuild_s": churn_s,
+                "post_churn_rps": 512 / post_wall,
+                "shards_before": 8,
+                "shards_after": sharded.mesh.shape["policy"],
+            }
+        )
+    )
+
+
+def bench_config5() -> None:
+    child_env = dict(os.environ)
+    child_env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(
+            child_env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--config5-child"],
+        capture_output=True,
+        text=True,
+        env=child_env,
+        timeout=1800,
+        check=False,
+    )
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    try:
+        doc = json.loads(line)
+    except (ValueError, IndexError):
+        emit(
+            "config5_multitenant_8shards_virtual",
+            0.0,
+            "reviews/s (8 virtual cpu devices)",
+            0.0,
+            error=(out.stderr or "no output")[-400:],
+        )
+        return
+    emit(
+        "config5_multitenant_8shards_virtual",
+        doc["rps"],
+        "reviews/s (8 virtual cpu devices)",
+        doc["rps"] / NORTH_STAR_RPS,
+        churn_rebuild_s=round(doc["churn_rebuild_s"], 2),
+        post_churn_rps=round(doc["post_churn_rps"], 1),
+        shards_before=doc["shards_before"],
+        shards_after=doc["shards_after"],
+        note="virtual CPU mesh: multi-chip hardware not present; measures "
+        "MPMD routing + churn rebuild, not TPU throughput",
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP serving path: real server, concurrent clients, p50/p99
+# ---------------------------------------------------------------------------
+
+
+def bench_http(n_requests: int = 2000, concurrency: int = 64) -> None:
+    import asyncio
+    import threading
+
+    import aiohttp
+
+    from policy_server_tpu.config.config import Config
     from policy_server_tpu.policies.flagship import (
         flagship_policies,
         synthetic_firehose,
     )
+    from policy_server_tpu.server import PolicyServer
+
+    config = Config(
+        addr="127.0.0.1",
+        port=0,
+        readiness_probe_port=0,
+        policies=flagship_policies(),
+        max_batch_size=256,
+        batch_timeout_ms=1.0,
+        policy_timeout_seconds=30.0,  # bench must measure, not clip
+    )
+    server = PolicyServer.new_from_config(config)
+
+    loop_box: dict = {}
+    started = threading.Event()
+
+    def run_server() -> None:
+        loop = asyncio.new_event_loop()
+        loop_box["loop"] = loop
+        asyncio.set_event_loop(loop)
+
+        async def main() -> None:
+            await server.start()
+            started.set()
+            while not loop_box.get("stop"):
+                await asyncio.sleep(0.05)
+            await server.stop()
+
+        loop.run_until_complete(main())
+
+    t = threading.Thread(target=run_server, daemon=True)
+    t.start()
+    if not started.wait(timeout=600):
+        raise RuntimeError("bench server failed to start")
+    port = server.api_port
+
+    docs = synthetic_firehose(n_requests, seed=77)
+    bodies = [
+        json.dumps(
+            {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+             "request": d["request"]}
+        ).encode()
+        for d in docs
+    ]
+    url = f"http://127.0.0.1:{port}/validate/pod-security-group"
+    lats: list[float] = []
+
+    async def client() -> None:
+        connector = aiohttp.TCPConnector(limit=concurrency)
+        async with aiohttp.ClientSession(connector=connector) as session:
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one(body: bytes) -> None:
+                async with sem:
+                    t0 = time.perf_counter()
+                    async with session.post(
+                        url, data=body,
+                        headers={"Content-Type": "application/json"},
+                    ) as resp:
+                        await resp.read()
+                        assert resp.status == 200, resp.status
+                    lats.append((time.perf_counter() - t0) * 1e3)
+
+            # prime compile/caches with one wave (untimed)
+            await asyncio.gather(*(one(b) for b in bodies[:concurrency]))
+            lats.clear()
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(b) for b in bodies))
+            wall_box["wall"] = time.perf_counter() - t0
+
+    wall_box: dict = {}
+    asyncio.run(client())
+    wall = wall_box["wall"]
+    loop_box["stop"] = True
+    t.join(timeout=30)
+
+    lats.sort()
+    p99 = pct(lats, 0.99)
+    emit(
+        "http_validate_latency_p99",
+        p99,
+        "ms",
+        NORTH_STAR_P99_MS / p99 if p99 else 0.0,
+        p50_ms=round(pct(lats, 0.5), 2),
+        p95_ms=round(pct(lats, 0.95), 2),
+        throughput_rps=round(len(bodies) / wall, 1),
+        concurrency=concurrency,
+        n_requests=len(bodies),
+        note="end-to-end HTTP through the micro-batcher on the real server",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config 4 (headline): 32-policy firehose
+# ---------------------------------------------------------------------------
+
+
+def bench_config4(n_requests: int, batch_size: int) -> None:
+    from policy_server_tpu.policies.flagship import flagship_policies
+
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironmentBuilder,
+    )
 
     env = EvaluationEnvironmentBuilder(backend="jax").build(flagship_policies())
+    requests = build_requests(n_requests, seed=42)
+    policy_id = "pod-security-group"  # every dispatch computes ALL verdicts
 
-    # Pre-parse the firehose into requests (JSON/HTTP framing is out of
-    # scope for this metric; a distinct corpus per request keeps the
-    # encode path honest).
-    docs = synthetic_firehose(n_requests, seed=42)
-    requests = [
-        ValidateRequest.from_admission(
-            AdmissionReviewRequest.from_dict(doc).request
-        )
-        for doc in docs
-    ]
-    policy_id = "pod-security-group"  # the batcher computes ALL verdicts per
-    # dispatch; target choice only affects materialization.
-
-    # Warmup: compile the fused program for the bench bucket.
     env.max_dispatch_batch = batch_size
     env.warmup((batch_size,))
-
-    # Throughput: the full firehose through ONE validate_batch call — the
-    # environment chunks to `batch_size` dispatches internally, encodes on
-    # a GIL-free thread pool, and drains results on a fetch pool (see
-    # PROFILE.md for the transport profile this shape optimizes). A short
-    # priming pass first: the remote relay's first chunks include
-    # warm-path artifacts that are not steady-state.
     env.validate_batch([(policy_id, r) for r in requests[:batch_size]])
     t_start = time.perf_counter()
     results = env.validate_batch([(policy_id, r) for r in requests])
@@ -65,41 +465,73 @@ def main() -> int:
     if errors:
         raise RuntimeError(f"bench evaluation error: {errors[0]}")
 
-    # Serving latency: steady-state per-dispatch latency at a serving-sized
-    # batch (what a micro-batcher user sees, minus queueing). 40 samples
-    # honestly supports a p95, not a p99 — named accordingly.
+    # steady-state per-dispatch latency at a serving-sized batch; 100
+    # samples supports an honest p99 of the DISPATCH (the HTTP line above
+    # reports the end-to-end request percentile)
     lat_batch = min(256, batch_size)
     lat_items = [(policy_id, r) for r in requests[:lat_batch]]
-    env.validate_batch(lat_items)  # warm that bucket
-    latencies = []
-    for _ in range(40):
+    env.validate_batch(lat_items)
+    lats = []
+    for _ in range(100):
         t0 = time.perf_counter()
         env.validate_batch(lat_items)
-        latencies.append((time.perf_counter() - t0) * 1e3)
-    latencies.sort()
+        lats.append((time.perf_counter() - t0) * 1e3)
+    lats.sort()
 
     reviews_per_sec = n_requests / wall
-    import math
+    emit(
+        "admission_reviews_per_sec_32policies",
+        reviews_per_sec,
+        "reviews/s/chip",
+        reviews_per_sec / NORTH_STAR_RPS,
+        n_requests=n_requests,
+        batch_size=batch_size,
+        wall_s=round(wall, 3),
+        p50_dispatch_latency_ms=round(pct(lats, 0.5), 2),
+        p95_dispatch_latency_ms=round(pct(lats, 0.95), 2),
+        p99_dispatch_latency_ms=round(pct(lats, 0.99), 2),
+        dispatch_latency_samples=len(lats),
+        latency_dispatch_size=lat_batch,
+        n_policies=32,
+        oracle_fallbacks=env.oracle_fallbacks,
+    )
 
-    idx = max(0, math.ceil(0.95 * len(latencies)) - 1)
-    p95_dispatch_ms = latencies[idx] if latencies else 0.0
 
-    result = {
-        "metric": "admission_reviews_per_sec_32policies",
-        "value": round(reviews_per_sec, 1),
-        "unit": "reviews/s/chip",
-        "vs_baseline": round(reviews_per_sec / 100_000.0, 4),
-        "details": {
-            "n_requests": n_requests,
-            "batch_size": batch_size,
-            "wall_s": round(wall, 3),
-            "p95_dispatch_latency_ms": round(p95_dispatch_ms, 2),
-            "latency_dispatch_size": lat_batch,
-            "n_policies": 32,
-            "oracle_fallbacks": env.oracle_fallbacks,
-        },
-    }
-    print(json.dumps(result))
+def main() -> int:
+    if "--config5-child" in sys.argv:
+        bench_config5_child()
+        return 0
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    batch_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    quick = os.environ.get("BENCH_QUICK") == "1"
+    if quick:
+        n_requests = min(n_requests, 8192)
+
+    requests = build_requests(max(4096, min(n_requests, 8192)), seed=42)
+    for fn in (bench_config1, bench_config2, bench_config3):
+        try:
+            fn(requests)
+        except Exception as e:  # noqa: BLE001 — one config must not kill the run
+            emit(fn.__name__.replace("bench_", ""), 0.0, "error", 0.0,
+                 error=repr(e)[:300])
+    try:
+        bench_config5()
+    except Exception as e:  # noqa: BLE001
+        emit("config5_multitenant_8shards_virtual", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    try:
+        # concurrency 256 ≈ the knee of this transport's throughput curve
+        # (739 rps @ p99 459 ms; 1024 concurrent only adds queue wait —
+        # the Python asyncio HTTP framing caps ~950 rps/loop, PROFILE.md)
+        bench_http(
+            n_requests=512 if quick else 4000,
+            concurrency=64 if quick else 256,
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("http_validate_latency_p99", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    # headline LAST: the driver records the final JSON line
+    bench_config4(n_requests, batch_size)
     return 0
 
 

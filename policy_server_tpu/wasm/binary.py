@@ -443,6 +443,12 @@ def decode_module(data: bytes) -> WasmModule:
     return m
 
 
+def ensure_module(wasm: "bytes | WasmModule") -> WasmModule:
+    """bytes→decode, WasmModule→passthrough: the one definition of the
+    polymorphism every ABI host accepts."""
+    return wasm if isinstance(wasm, WasmModule) else decode_module(wasm)
+
+
 def _limits(r: _Reader) -> Limits:
     flags = r.byte()
     minimum = r.u32()

@@ -866,7 +866,11 @@ class Instance:
                     n = _u32(stack.pop())
                     val = stack.pop() & 0xFF
                     dst = _u32(stack.pop())
-                    mem.write(dst, bytes([val]) * n)
+                    # bounds-trap BEFORE building the fill buffer: n can be
+                    # ~4 GiB and hostile wasm must not force that allocation
+                    if dst + n > len(mem.data):
+                        raise WasmTrap("out of bounds memory access")
+                    mem.data[dst : dst + n] = bytes([val]) * n
                 else:
                     raise WasmTrap(f"unsupported extended op {sub}")
             else:

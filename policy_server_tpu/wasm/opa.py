@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
-from policy_server_tpu.wasm.binary import WasmModule, decode_module
+from policy_server_tpu.wasm.binary import WasmModule, ensure_module
 from policy_server_tpu.wasm.interp import Instance, Memory, WasmTrap
 
 
@@ -45,11 +45,7 @@ class OpaPolicy:
     """A decoded OPA wasm policy; instantiate_and_eval per request."""
 
     def __init__(self, wasm_bytes: bytes | WasmModule, fuel: int | None = 50_000_000):
-        self.module: WasmModule = (
-            wasm_bytes
-            if isinstance(wasm_bytes, WasmModule)
-            else decode_module(wasm_bytes)
-        )
+        self.module: WasmModule = ensure_module(wasm_bytes)
         self.fuel = fuel
         exports = {e.name for e in self.module.exports}
         required = {"opa_malloc", "opa_json_parse", "opa_json_dump", "eval",

@@ -30,7 +30,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
-from policy_server_tpu.wasm.binary import WasmModule, decode_module
+from policy_server_tpu.wasm.binary import ensure_module
 from policy_server_tpu.wasm.interp import Instance, WasmTrap
 
 HostCapability = Callable[[bytes], bytes]
@@ -91,11 +91,7 @@ class WapcGuest:
         host_capabilities: Mapping[tuple[str, str], HostCapability] | None = None,
         fuel: int | None = 50_000_000,
     ):
-        self.module = (
-            wasm_bytes
-            if isinstance(wasm_bytes, WasmModule)
-            else decode_module(wasm_bytes)
-        )
+        self.module = ensure_module(wasm_bytes)
         self.host_capabilities = dict(host_capabilities or {})
         self.fuel = fuel
         exports = self.module.export_map()
