@@ -36,6 +36,9 @@ FROM python:3.12-slim
 COPY --from=build /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
 COPY --from=build /src/policy_server_tpu /app/policy_server_tpu
 COPY --from=build /src/build /app/build
+# csrc must ship too: ops/fastenc.py compares the .so's mtime against the
+# source before loading it (missing source would disable the native path)
+COPY --from=build /src/csrc /app/csrc
 
 WORKDIR /app
 # non-root (reference runs uid 65533)

@@ -418,10 +418,14 @@ def bench_http(n_requests: int = 2000, concurrency: int = 64) -> None:
             wall_box["wall"] = time.perf_counter() - t0
 
     wall_box: dict = {}
-    asyncio.run(client())
+    try:
+        asyncio.run(client())
+    finally:
+        # the server must die even when a client assert trips — a live
+        # second environment would skew every benchmark that follows
+        loop_box["stop"] = True
+        t.join(timeout=30)
     wall = wall_box["wall"]
-    loop_box["stop"] = True
-    t.join(timeout=30)
 
     lats.sort()
     p99 = pct(lats, 0.99)
@@ -508,12 +512,18 @@ def main() -> int:
         n_requests = min(n_requests, 8192)
 
     requests = build_requests(max(4096, min(n_requests, 8192)), seed=42)
-    for fn in (bench_config1, bench_config2, bench_config3):
+    # error lines reuse the SUCCESS metric names so consumers keyed on the
+    # documented names see value 0 + error, not a vanished line
+    config_metrics = {
+        bench_config1: "config1_namespace_validate_single",
+        bench_config2: "config2_psp_pair_1k_replay",
+        bench_config3: "config3_image_signatures_group",
+    }
+    for fn, metric in config_metrics.items():
         try:
             fn(requests)
         except Exception as e:  # noqa: BLE001 — one config must not kill the run
-            emit(fn.__name__.replace("bench_", ""), 0.0, "error", 0.0,
-                 error=repr(e)[:300])
+            emit(metric, 0.0, "error", 0.0, error=repr(e)[:300])
     try:
         bench_config5()
     except Exception as e:  # noqa: BLE001

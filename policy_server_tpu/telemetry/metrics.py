@@ -139,6 +139,9 @@ class MetricsRegistry:
         self._latencies: dict[
             tuple[tuple[str, str], ...], collections.deque[float]
         ] = {}
+        # label-set → (counter child, histogram child); dict assignment is
+        # atomic under the GIL, racing builders produce identical children
+        self._prom_children: dict[tuple, tuple] = {}
         if prometheus_client is not None:
             self.registry = CollectorRegistry()
             self._prom_total = prometheus_client.Counter(
@@ -166,6 +169,21 @@ class MetricsRegistry:
     # -- recording (reference add_policy_evaluation / record_policy_latency,
     #    src/metrics/policy_evaluations_total.rs + _latency.rs) ------------
 
+    def _children(self, key: tuple, labels: dict[str, str]) -> tuple:
+        """Cached (counter_child, histogram_child) per label set:
+        ``labels(**kw)`` re-resolves the child through prometheus_client's
+        internal lock on every call — with the per-request metric pair that
+        lookup showed up in the serving profile. Label cardinality is
+        bounded (policy set × verdict space), so the cache is too."""
+        hit = self._prom_children.get(key)
+        if hit is None:
+            hit = (
+                self._prom_total.labels(**labels),
+                self._prom_latency.labels(**labels),
+            )
+            self._prom_children[key] = hit
+        return hit
+
     def add_policy_evaluation(
         self, m: PolicyEvaluation | RawPolicyEvaluation
     ) -> None:
@@ -176,7 +194,7 @@ class MetricsRegistry:
                 self._counters.get((EVALUATIONS_TOTAL, key), 0) + 1
             )
         if self.registry is not None:
-            self._prom_total.labels(**labels).inc()
+            self._children(key, labels)[0].inc()
 
     def record_policy_latency(
         self, milliseconds: float, m: PolicyEvaluation | RawPolicyEvaluation
@@ -188,7 +206,7 @@ class MetricsRegistry:
                 key, collections.deque(maxlen=4096)
             ).append(milliseconds)
         if self.registry is not None:
-            self._prom_latency.labels(**labels).observe(milliseconds)
+            self._children(key, labels)[1].observe(milliseconds)
 
     def add_policy_initialization_error(
         self, m: PolicyInitializationError
