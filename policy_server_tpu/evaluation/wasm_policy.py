@@ -61,12 +61,51 @@ class WasmPolicyModule:
         self.mutating = self.abi == "wapc"
 
     def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        from policy_server_tpu.context.service import CONTEXT_KEY
+        from policy_server_tpu.policies.base import SettingsError
+        from policy_server_tpu.wasm.capabilities import (
+            kubernetes_capabilities,
+            static_capabilities,
+        )
+
         bound_settings = dict(settings or {})
+        # optional signature store: backs the sigstore host capability
+        # (kubewarden/v1/verify) the way the policy's own settings back
+        # verify-image-signatures; fail fast on a non-string value rather
+        # than surfacing a misleading "no store configured" per request
+        bundle_source = None
+        store = bound_settings.get("signatureStore")
+        if store is not None:
+            if not isinstance(store, str):
+                raise SettingsError(
+                    "setting 'signatureStore' must be a directory path"
+                )
+            from policy_server_tpu.policies.images import file_bundle_source
+
+            bundle_source = file_bundle_source(store)
+        allow_network = bool(bound_settings.get("allowNetworkCapabilities"))
+        # payload-independent capability entries: built ONCE per policy
+        statics = static_capabilities(bundle_source, allow_network)
 
         def evaluate(payload: Any) -> Mapping[str, Any]:
             try:
                 if self.abi == "wapc":
-                    return self._wapc.validate(payload, bound_settings)
+                    # the guest gets the REQUEST; cluster state is served
+                    # on demand through the kubernetes capabilities from
+                    # the same snapshot slice (no bulk context in-payload)
+                    request_doc = (
+                        {k: v for k, v in payload.items() if k != CONTEXT_KEY}
+                        if isinstance(payload, Mapping)
+                        else payload
+                    )
+                    return self._wapc.validate(
+                        request_doc,
+                        bound_settings,
+                        host_capabilities={
+                            **statics,
+                            **kubernetes_capabilities(payload),
+                        },
+                    )
                 allowed, message = gatekeeper_validate(
                     self._opa, payload, parameters=bound_settings
                 )

@@ -99,7 +99,17 @@ class WapcGuest:
             raise WapcError("not a waPC module (missing __guest_call)")
         self.flat_abi = "__flat_abi" in exports
 
-    def call(self, operation: str, payload: bytes) -> bytes:
+    def call(
+        self,
+        operation: str,
+        payload: bytes,
+        host_capabilities: Mapping[tuple[str, str], HostCapability] | None = None,
+    ) -> bytes:
+        capabilities = (
+            self.host_capabilities
+            if host_capabilities is None
+            else {**self.host_capabilities, **host_capabilities}
+        )
         op_bytes = operation.encode()
         state: dict[str, Any] = {"response": None, "error": None,
                                  "host_response": b"", "host_error": b""}
@@ -118,7 +128,7 @@ class WapcGuest:
                       ptr, length):
             ns = inst.memory.read(ns_ptr, ns_len).decode()
             op = inst.memory.read(op_ptr, op_len).decode()
-            fn = self.host_capabilities.get((ns, op))
+            fn = capabilities.get((ns, op))
             if fn is None:
                 state["host_error"] = (
                     f"host capability {ns}/{op} not available".encode()
@@ -181,7 +191,10 @@ class KubewardenWapcPolicy:
         self.guest = WapcGuest(wasm_bytes, host_capabilities, fuel=fuel)
 
     def validate(
-        self, request: Mapping[str, Any], settings: Mapping[str, Any] | None
+        self,
+        request: Mapping[str, Any],
+        settings: Mapping[str, Any] | None,
+        host_capabilities: Mapping[tuple[str, str], HostCapability] | None = None,
     ) -> dict:
         if self.guest.flat_abi:
             payload = flatten_payload(
@@ -191,7 +204,9 @@ class KubewardenWapcPolicy:
             payload = json.dumps(
                 {"request": dict(request), "settings": dict(settings or {})}
             ).encode()
-        return _json_object(self.guest.call("validate", payload))
+        return _json_object(
+            self.guest.call("validate", payload, host_capabilities)
+        )
 
     def validate_settings(self, settings: Mapping[str, Any] | None) -> dict:
         if self.guest.flat_abi:

@@ -1,0 +1,227 @@
+"""waPC host-capability tests (SURVEY.md §2.2 callback_handler row): the
+guest→host surface — Kubernetes lookups answered from the capability-
+filtered context snapshot, sigstore pub-key verification from the local
+signature store, crypto certificate checks, and loud errors for
+capabilities that need egress. One test drives ``__host_call`` end to end
+from a WAT guest through the interpreter."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from policy_server_tpu.context.service import CONTEXT_KEY
+from policy_server_tpu.wasm.capabilities import build_default_capabilities
+
+
+def payload_with_context() -> dict:
+    return {
+        "namespace": "default",
+        CONTEXT_KEY: {
+            "v1/Namespace": [
+                {"metadata": {"name": "default", "labels": {"env": "prod"}}},
+                {"metadata": {"name": "dev", "labels": {"env": "dev"}}},
+            ],
+            "v1/Service": [
+                {"metadata": {"name": "api", "namespace": "default"}},
+                {"metadata": {"name": "api", "namespace": "other"}},
+            ],
+        },
+    }
+
+
+def call(caps, ns, op, doc):
+    return json.loads(caps[(ns, op)](json.dumps(doc).encode()))
+
+
+def test_kubernetes_lookups_from_snapshot():
+    caps = build_default_capabilities(payload_with_context())
+    out = call(caps, "kubernetes", "list_all_resources",
+               {"api_version": "v1", "kind": "Namespace"})
+    assert [i["metadata"]["name"] for i in out["items"]] == ["default", "dev"]
+
+    out = call(caps, "kubernetes", "list_all_resources",
+               {"api_version": "v1", "kind": "Namespace",
+                "label_selector": "env=prod"})
+    assert [i["metadata"]["name"] for i in out["items"]] == ["default"]
+
+    out = call(caps, "kubernetes", "list_resources_by_namespace",
+               {"api_version": "v1", "kind": "Service", "namespace": "default"})
+    assert len(out["items"]) == 1
+
+    out = call(caps, "kubernetes", "get_resource",
+               {"api_version": "v1", "kind": "Service",
+                "name": "api", "namespace": "other"})
+    assert out["metadata"]["namespace"] == "other"
+
+
+def test_kubernetes_lookup_outside_allowlist_fails():
+    """A kind absent from the snapshot (not in contextAwareResources) is
+    a loud lookup failure, never fabricated-empty success for get."""
+    caps = build_default_capabilities(payload_with_context())
+    with pytest.raises(LookupError, match="allowlist"):
+        call(caps, "kubernetes", "get_resource",
+             {"api_version": "v1", "kind": "Secret", "name": "x",
+              "namespace": "default"})
+    # list of an absent kind is empty (upstream list semantics)
+    out = call(caps, "kubernetes", "list_all_resources",
+               {"api_version": "v1", "kind": "Secret"})
+    assert out["items"] == []
+
+
+def test_sigstore_pub_key_capability(tmp_path):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, NoEncryption, PrivateFormat, PublicFormat,
+    )
+
+    from policy_server_tpu.policies.images import (
+        file_bundle_source,
+        sign_image,
+        write_signature_bundle,
+    )
+
+    key = Ed25519PrivateKey.generate()
+    priv = key.private_bytes(Encoding.PEM, PrivateFormat.PKCS8, NoEncryption())
+    pub = key.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    ).decode()
+    image = "reg.example/signed:1"
+    write_signature_bundle(str(tmp_path), image, sign_image(priv, image))
+    caps = build_default_capabilities(
+        {}, signature_bundle_source=file_bundle_source(str(tmp_path))
+    )
+
+    out = call(caps, "kubewarden", "v1/verify",
+               {"image": image, "pub_keys": [pub]})
+    assert out["is_trusted"] is True
+    out = call(caps, "kubewarden", "v1/verify",
+               {"image": "reg.example/unsigned:1", "pub_keys": [pub]})
+    assert out["is_trusted"] is False
+    with pytest.raises(RuntimeError, match="keyless"):
+        call(caps, "kubewarden", "v2/verify", {"image": image})
+
+
+def test_crypto_certificate_capability():
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    def make_cert(subject, issuer_name, issuer_key, key, ca=False):
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, subject)]))
+            .issuer_name(x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, issuer_name)]))
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(
+                x509.BasicConstraints(ca=ca, path_length=None), critical=True)
+            .sign(issuer_key, hashes.SHA256())
+        )
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    leaf_key = ec.generate_private_key(ec.SECP256R1())
+    ca = make_cert("ca", "ca", ca_key, ca_key, ca=True)
+    leaf = make_cert("leaf", "ca", ca_key, leaf_key)
+
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    def pem_doc(cert):
+        return {"encoding": "Pem",
+                "data": list(cert.public_bytes(Encoding.PEM))}
+
+    caps = build_default_capabilities({})
+    out = call(caps, "crypto", "v1/is_certificate_trusted",
+               {"cert": pem_doc(leaf), "cert_chain": [pem_doc(ca)]})
+    assert out["trusted"] is True
+    # wrong issuer: leaf presented with an unrelated "chain"
+    other_key = ec.generate_private_key(ec.SECP256R1())
+    other = make_cert("other", "other", other_key, other_key, ca=True)
+    out = call(caps, "crypto", "v1/is_certificate_trusted",
+               {"cert": pem_doc(leaf), "cert_chain": [pem_doc(other)]})
+    assert out["trusted"] is False
+
+
+def test_network_capabilities_require_opt_in():
+    """DNS/OCI are egress: guests only get them when the policy settings
+    opted in (allowNetworkCapabilities) — blocking network calls are
+    invisible to the wasm fuel meter."""
+    caps = build_default_capabilities({})
+    with pytest.raises(RuntimeError, match="allowNetworkCapabilities"):
+        call(caps, "net", "v1/dns_lookup_host", {"host": "example.com"})
+    with pytest.raises(RuntimeError, match="allowNetworkCapabilities"):
+        call(caps, "oci", "v1/manifest_digest", {"image": "x"})
+    opted = build_default_capabilities({}, allow_network=True)
+    with pytest.raises(RuntimeError, match="egress"):
+        call(opted, "oci", "v1/manifest_digest", {"image": "x"})
+
+
+def test_host_call_end_to_end_from_wat_guest():
+    """A WAT guest invokes __host_call(kubernetes/list_all_resources) and
+    accepts iff the host served the capability — the full guest→host→guest
+    protocol through the interpreter."""
+    from policy_server_tpu.wasm.wapc import WapcGuest, flatten_payload
+    from policy_server_tpu.wasm.wat import assemble
+
+    # data layout: 8 ns "kubernetes" (10), 32 op "list_all_resources" (18),
+    # 64 payload json (43), 128 responses
+    req = '{"api_version":"v1","kind":"Namespace"}'
+    src = f"""
+(module
+  (import "wapc" "__guest_request" (func $greq (param i32 i32)))
+  (import "wapc" "__guest_response" (func $gresp (param i32 i32)))
+  (import "wapc" "__host_call"
+    (func $hcall (param i32 i32 i32 i32 i32 i32 i32 i32) (result i32)))
+  (memory (export "memory") 2)
+  (data (i32.const 8) "kubernetes")
+  (data (i32.const 32) "list_all_resources")
+  (data (i32.const 64) "{req.replace('"', chr(92) + chr(34))}")
+  (data (i32.const 192) "{{\\"accepted\\":true}}")
+  (data (i32.const 224) "{{\\"accepted\\":false}}")
+  (global $flat (mut i32) (i32.const 1))
+  (export "__flat_abi" (global $flat))
+  (func (export "__guest_call") (param $op_len i32) (param $plen i32) (result i32)
+    ;; buffers for op+payload the host writes into (we ignore them)
+    i32.const 4096
+    i32.const 8192
+    call $greq
+    ;; host_call(bd="", ns="kubernetes", op="list_all_resources", req)
+    i32.const 0
+    i32.const 0
+    i32.const 8
+    i32.const 10
+    i32.const 32
+    i32.const 18
+    i32.const 64
+    i32.const {len(req)}
+    call $hcall
+    if
+      i32.const 192
+      i32.const 17
+      call $gresp
+    else
+      i32.const 224
+      i32.const 18
+      call $gresp
+    end
+    i32.const 1)
+)
+"""
+    guest = WapcGuest(assemble(src))
+    caps = build_default_capabilities(payload_with_context())
+    doc = json.loads(guest.call("validate", flatten_payload({}), caps))
+    assert doc == {"accepted": True}
+    # without the capability table, the same guest is refused by the host
+    doc = json.loads(guest.call("validate", flatten_payload({})))
+    assert doc == {"accepted": False}
