@@ -33,8 +33,10 @@ from policy_server_tpu.ops.ir import (
     StrPred,
     eq,
     false,
+    ge,
     gt,
     in_set,
+    le,
     matches_glob,
     ne,
     true,
@@ -784,6 +786,238 @@ class NamespaceExists(BuiltinPolicy):
         )
 
 
+class UserGroupPsp(BuiltinPolicy):
+    """Constrain runAsUser / runAsGroup ids (upstream user-group-psp).
+
+    Settings (simplified upstream schema)::
+
+        run_as_user:  {rule: MustRunAs|MustRunAsNonRoot|RunAsAny,
+                       ranges: [{min: N, max: N}, ...]}
+        run_as_group: {rule: MustRunAs|RunAsAny, ranges: [...]}
+
+    Semantics: with ``MustRunAs``, an explicitly set id (pod or container
+    level) must fall inside one of the ranges; with ``MustRunAsNonRoot``
+    the id must not be 0. Absent ids are left to the admission defaulting
+    chain (run-as-non-root covers the must-be-set flavor)."""
+
+    name = "user-group-psp"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/user-group-psp",)
+
+    @staticmethod
+    def _parse(settings: Mapping[str, Any], key: str) -> tuple[str, list]:
+        doc = settings.get(key) or {}
+        if not isinstance(doc, Mapping):
+            raise SettingsError(f"setting '{key}' must be a map")
+        rule = doc.get("rule", "RunAsAny")
+        if rule not in ("MustRunAs", "MustRunAsNonRoot", "RunAsAny"):
+            raise SettingsError(f"{key}.rule must be MustRunAs[NonRoot]/RunAsAny")
+        ranges = doc.get("ranges") or []
+        if rule == "MustRunAs" and not ranges:
+            raise SettingsError(f"{key}.rule MustRunAs requires ranges")
+        for r in ranges:
+            if (
+                not isinstance(r, Mapping)
+                or not isinstance(r.get("min"), (int, float))
+                or not isinstance(r.get("max"), (int, float))
+                or isinstance(r.get("min"), bool)
+                or isinstance(r.get("max"), bool)
+            ):
+                raise SettingsError(
+                    f"each {key}.ranges entry needs numeric min and max"
+                )
+            if r["min"] > r["max"]:
+                raise SettingsError(f"{key}.ranges entry has min > max")
+        return rule, list(ranges)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        rules: list[Rule] = []
+        for key, field in (("run_as_user", "runAsUser"),
+                           ("run_as_group", "runAsGroup")):
+            rule, ranges = self._parse(settings, key)
+            if rule == "RunAsAny":
+                continue
+            # I32, not F32: float32 can't represent ids above 2^24 exactly
+            # and a UID admitted past a range bound is a security bug; ids
+            # beyond int32 (legal up to 2^32-2) overflow the encoding and
+            # route to the exact host oracle via SchemaOverflow
+            pod_id = Path(f"object.spec.securityContext.{field}", DType.I32)
+            elem_id = Elem(f"securityContext.{field}", DType.I32)
+
+            def out_of_ranges(operand: Expr) -> Expr:
+                in_any: Expr = false()
+                for r in ranges:
+                    in_any = in_any | (
+                        ge(operand, int(r["min"])) & le(operand, int(r["max"]))
+                    )
+                return ~in_any
+
+            if rule == "MustRunAsNonRoot":
+                bad_pod = Exists(pod_id) & eq(pod_id, 0)
+                bad_elem = Exists(elem_id) & eq(elem_id, 0)
+                message = f"{field} must not be 0 (root)"
+            else:  # MustRunAs
+                bad_pod = Exists(pod_id) & out_of_ranges(pod_id)
+                bad_elem = Exists(elem_id) & out_of_ranges(elem_id)
+                message = f"{field} is outside the allowed ranges"
+            rules.append(Rule(f"{field}-pod", bad_pod, message))
+            rules.append(
+                Rule(f"{field}-container", _deny_any_container(bad_elem), message)
+            )
+        if not rules:
+            rules.append(Rule("never", false(), "unreachable"))
+        return PolicyProgram(rules=tuple(rules))
+
+
+class SysctlPsp(BuiltinPolicy):
+    """Forbid unsafe sysctls (upstream sysctl-psp). Settings:
+    ``forbidden_sysctls`` (names or prefix globs like ``net.*``),
+    ``allowed_unsafe_sysctls`` (exact names exempted)."""
+
+    name = "sysctl-psp"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/sysctl-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        forbidden = str_list(settings, "forbidden_sysctls", default=[])
+        allowed = str_list(settings, "allowed_unsafe_sysctls", default=[])
+        if not forbidden:
+            raise SettingsError(
+                "setting 'forbidden_sysctls' must be a non-empty list"
+            )
+        name = Elem("name")
+        hit: Expr = false()
+        for pattern in forbidden:
+            hit = hit | matches_glob(name, pattern)
+        if allowed:
+            hit = hit & ~in_set(name, allowed)
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "forbidden-sysctl",
+                    AnyOf(
+                        Path("object.spec.securityContext.sysctls"),
+                        Exists(name) & hit,
+                    ),
+                    "pod sets a forbidden sysctl",
+                ),
+            )
+        )
+
+
+class ContainersResourceLimits(BuiltinPolicy):
+    """Every container must declare cpu and memory limits (upstream
+    containers-resource-limits presence semantics)."""
+
+    name = "containers-resource-limits"
+    upstream_equivalents = (
+        "ghcr.io/kubewarden/policies/containers-resource-limits",
+    )
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        if settings and set(settings) - {"require_cpu", "require_memory"}:
+            raise SettingsError(
+                "containers-resource-limits accepts require_cpu/require_memory"
+            )
+        rules = []
+        if bool_setting(settings, "require_cpu", True):
+            rules.append(
+                Rule(
+                    "missing-cpu-limit",
+                    _deny_any_container(~Exists(Elem("resources.limits.cpu"))),
+                    "every container must declare a cpu limit",
+                )
+            )
+        if bool_setting(settings, "require_memory", True):
+            rules.append(
+                Rule(
+                    "missing-memory-limit",
+                    _deny_any_container(
+                        ~Exists(Elem("resources.limits.memory"))
+                    ),
+                    "every container must declare a memory limit",
+                )
+            )
+        if not rules:
+            rules.append(Rule("never", false(), "unreachable"))
+        return PolicyProgram(rules=tuple(rules))
+
+
+class EnvironmentVariablePolicy(BuiltinPolicy):
+    """Deny containers that set named environment variables (upstream
+    environment-variable-policy, the deny-list rule). Settings:
+    ``denied_names`` (exact env var names)."""
+
+    name = "environment-variable-policy"
+    upstream_equivalents = (
+        "ghcr.io/kubewarden/policies/environment-variable-policy",
+    )
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        denied = str_list(settings, "denied_names")
+        if not denied:
+            raise SettingsError("setting 'denied_names' must be a non-empty list")
+        # nested quantifier: any container with any env entry whose name
+        # is denied (containers[*].env[*])
+        has_denied_env = AnyOf(Elem("env"), in_set(Elem("name"), denied))
+        return PolicyProgram(
+            rules=(
+                Rule(
+                    "denied-env-var",
+                    _deny_any_container(has_denied_env),
+                    f"containers must not set: {', '.join(sorted(denied))}",
+                ),
+            )
+        )
+
+
+class SelinuxPsp(BuiltinPolicy):
+    """Constrain seLinuxOptions (upstream selinux-psp). Settings:
+    ``rule: MustRunAs|RunAsAny`` with the expected ``level``/``role``/
+    ``type``/``user`` values for MustRunAs: any explicitly-set field that
+    differs from the expectation rejects (pod and container level)."""
+
+    name = "selinux-psp"
+    upstream_equivalents = ("ghcr.io/kubewarden/policies/selinux-psp",)
+
+    def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
+        rule = settings.get("rule", "RunAsAny")
+        if rule not in ("MustRunAs", "RunAsAny"):
+            raise SettingsError("setting 'rule' must be MustRunAs or RunAsAny")
+        if rule == "RunAsAny":
+            if set(settings) - {"rule"}:
+                raise SettingsError("RunAsAny accepts no field expectations")
+            return PolicyProgram(
+                rules=(Rule("never", false(), "unreachable"),)
+            )
+        fields = {
+            k: settings[k]
+            for k in ("level", "role", "type", "user")
+            if k in settings
+        }
+        if not fields:
+            raise SettingsError("MustRunAs requires at least one expected field")
+        rules = []
+        for field, expected in fields.items():
+            if not isinstance(expected, str):
+                raise SettingsError(f"setting '{field}' must be a string")
+            pod = Path(f"object.spec.securityContext.seLinuxOptions.{field}")
+            elem = Elem(f"securityContext.seLinuxOptions.{field}")
+            rules.append(
+                Rule(
+                    f"selinux-{field}-pod",
+                    Exists(pod) & ne(pod, expected),
+                    f"seLinuxOptions.{field} must be '{expected}'",
+                )
+            )
+            rules.append(
+                Rule(
+                    f"selinux-{field}-container",
+                    _deny_any_container(Exists(elem) & ne(elem, expected)),
+                    f"seLinuxOptions.{field} must be '{expected}'",
+                )
+            )
+        return PolicyProgram(rules=tuple(rules))
+
+
 ALL_FAMILIES: tuple[type[BuiltinPolicy], ...] = (
     NamespaceExists,
     AlwaysHappy,
@@ -805,4 +1039,9 @@ ALL_FAMILIES: tuple[type[BuiltinPolicy], ...] = (
     AllowedProcMountTypes,
     HostPaths,
     EchoOperation,
+    UserGroupPsp,
+    SysctlPsp,
+    ContainersResourceLimits,
+    EnvironmentVariablePolicy,
+    SelinuxPsp,
 )

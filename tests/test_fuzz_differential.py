@@ -1,0 +1,206 @@
+"""Property-based differential fuzzing (hypothesis): arbitrary pod-shaped
+and adversarial JSON AdmissionReviews must produce BIT-EXACT responses
+from the device (jax) backend and the host IR oracle, and verdict-equal
+results from the wasm oracle where one exists.
+
+This is the generative extension of tests/test_differential.py's fixed
+corpora — the tensorization codec (SURVEY.md §7.4 hard-part #1) is the
+hardest correctness surface, and random structure is what breaks codecs."""
+
+from __future__ import annotations
+
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+
+from conftest import build_admission_review_dict
+
+POLICIES = {
+    "priv": {"module": "builtin://pod-privileged"},
+    "ns": {
+        "module": "builtin://namespace-validate",
+        "settings": {"denied_namespaces": ["blocked", "kube-system"]},
+    },
+    "latest": {"module": "builtin://disallow-latest-tag"},
+    "hostns": {"module": "builtin://host-namespaces"},
+    "caps": {
+        "module": "builtin://psp-capabilities",
+        "settings": {
+            "allowed_capabilities": ["CHOWN"],
+            "required_drop_capabilities": ["NET_ADMIN"],
+        },
+    },
+    "grp": {
+        "expression": "unpriv() && tagged()",
+        "message": "group denied",
+        "policies": {
+            "unpriv": {"module": "builtin://pod-privileged"},
+            "tagged": {"module": "builtin://disallow-latest-tag"},
+        },
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def envs():
+    entries = {k: parse_policy_entry(k, v) for k, v in POLICIES.items()}
+    return (
+        EvaluationEnvironmentBuilder(backend="jax").build(entries),
+        EvaluationEnvironmentBuilder(backend="oracle").build(entries),
+    )
+
+
+# -- strategies --------------------------------------------------------------
+
+_names = st.text(
+    alphabet="abcdefghijklmnopqrstuvwxyz0123456789-.", min_size=0, max_size=12
+)
+_images = st.one_of(
+    st.just(""),
+    _names,
+    st.builds(
+        lambda reg, repo, tag: f"{reg}/{repo}{tag}",
+        st.sampled_from(["docker.io", "ghcr.io/x", "localhost:5000", "r"]),
+        _names,
+        st.sampled_from(["", ":latest", ":1.2", "@sha256:abc", ":"]),
+    ),
+)
+_scalar = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2**31), max_value=2**31 - 1),
+    st.floats(allow_nan=False, allow_infinity=False, width=32),
+    _names,
+)
+
+
+def _security_context():
+    return st.fixed_dictionaries(
+        {},
+        optional={
+            "privileged": st.one_of(st.booleans(), st.none(), _names),
+            "runAsNonRoot": st.booleans(),
+            "readOnlyRootFilesystem": st.booleans(),
+            "capabilities": st.fixed_dictionaries(
+                {},
+                optional={
+                    "add": st.lists(
+                        st.sampled_from(
+                            ["CHOWN", "NET_ADMIN", "SYS_ADMIN", "KILL"]
+                        ),
+                        max_size=4,
+                    ),
+                    "drop": st.lists(
+                        st.sampled_from(["NET_ADMIN", "ALL"]), max_size=3
+                    ),
+                },
+            ),
+        },
+    )
+
+
+def _container():
+    return st.fixed_dictionaries(
+        {},
+        optional={
+            "name": _names,
+            "image": _images,
+            "securityContext": st.one_of(_security_context(), st.none()),
+        },
+    )
+
+
+def _pod_object():
+    return st.one_of(
+        st.none(),
+        _scalar,  # adversarial: object is not even a mapping
+        st.fixed_dictionaries(
+            {},
+            optional={
+                "metadata": st.fixed_dictionaries(
+                    {},
+                    optional={
+                        "name": _names,
+                        "labels": st.dictionaries(_names, _scalar, max_size=3),
+                    },
+                ),
+                "spec": st.one_of(
+                    st.none(),
+                    st.fixed_dictionaries(
+                        {},
+                        optional={
+                            "containers": st.one_of(
+                                st.none(),
+                                st.lists(_container(), max_size=5),
+                            ),
+                            "initContainers": st.lists(_container(), max_size=2),
+                            "hostNetwork": st.one_of(st.booleans(), _names),
+                            "hostPID": st.booleans(),
+                            "hostIPC": st.booleans(),
+                        },
+                    ),
+                ),
+            },
+        ),
+    )
+
+
+def _review(namespace: str, obj) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["namespace"] = namespace
+    doc["request"]["object"] = obj
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+@settings(
+    max_examples=60,
+    deadline=None,
+    suppress_health_check=[HealthCheck.function_scoped_fixture],
+)
+@given(
+    namespace=st.sampled_from(["default", "blocked", "kube-system", "", "x"]),
+    obj=_pod_object(),
+    policy=st.sampled_from(sorted(POLICIES)),
+)
+def test_device_matches_oracle_on_random_reviews(envs, namespace, obj, policy):
+    jax_env, oracle_env = envs
+    a = jax_env.validate(policy, _review(namespace, obj))
+    b = oracle_env.validate(policy, _review(namespace, obj))
+    assert a.to_dict() == b.to_dict(), (policy, namespace, obj)
+
+
+@settings(
+    max_examples=30,
+    deadline=None,
+    suppress_health_check=[HealthCheck.function_scoped_fixture],
+)
+@given(namespace=st.sampled_from(["default", "blocked"]), obj=_pod_object())
+def test_device_matches_wasm_oracle_on_random_reviews(envs, namespace, obj):
+    """Three-way: the WAT wasm policies agree with the device on verdicts
+    for randomly structured pods."""
+    from policy_server_tpu.policies.wasm_oracle import oracle_policy
+
+    jax_env, _ = envs
+    req = _review(namespace, obj)
+    raw = req.payload()
+    for name, pid in (
+        ("pod-privileged", "priv"),
+        ("namespace-validate", "ns"),
+        ("disallow-latest-tag", "latest"),
+        ("host-namespaces", "hostns"),
+    ):
+        dev = jax_env.validate(pid, _review(namespace, obj))
+        wasm = oracle_policy(name).validate(
+            raw, POLICIES[pid].get("settings", {})
+        )
+        assert bool(wasm.get("accepted")) == bool(dev.allowed), (
+            name,
+            namespace,
+            obj,
+        )

@@ -1,0 +1,151 @@
+"""Tests for the round-3 builtin additions (user-group-psp, sysctl-psp,
+containers-resource-limits, environment-variable-policy, selinux-psp):
+verdict semantics on both backends must agree (the per-family
+mini-differential), plus settings validation."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.evaluation.errors import BootstrapFailure
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+
+from conftest import build_admission_review_dict
+
+
+def review_with(obj: dict) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["object"] = obj
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def build_pair(name: str, module: str, settings: dict):
+    entry = {"module": module, **({"settings": settings} if settings else {})}
+    envs = []
+    for backend in ("jax", "oracle"):
+        envs.append(
+            EvaluationEnvironmentBuilder(backend=backend).build(
+                {name: parse_policy_entry(name, entry)}
+            )
+        )
+    return envs
+
+
+def check(name: str, module: str, settings: dict, cases: list[tuple[dict, bool]]):
+    jax_env, oracle_env = build_pair(name, module, settings)
+    for obj, expect_allowed in cases:
+        a = jax_env.validate(name, review_with(obj))
+        b = oracle_env.validate(name, review_with(obj))
+        assert a.to_dict() == b.to_dict(), obj
+        assert a.allowed is expect_allowed, (obj, a.status and a.status.message)
+
+
+def test_user_group_psp_ranges():
+    settings = {
+        "run_as_user": {"rule": "MustRunAs",
+                        "ranges": [{"min": 1000, "max": 2000}]},
+        "run_as_group": {"rule": "MustRunAsNonRoot"},
+    }
+    check("ug", "builtin://user-group-psp", settings, [
+        ({"spec": {"securityContext": {"runAsUser": 1500}}}, True),
+        ({"spec": {"securityContext": {"runAsUser": 999}}}, False),
+        ({"spec": {"containers": [
+            {"securityContext": {"runAsUser": 2001}}]}}, False),
+        ({"spec": {"securityContext": {"runAsGroup": 0}}}, False),
+        ({"spec": {"securityContext": {"runAsGroup": 5}}}, True),
+        ({"spec": {}}, True),  # absent ids pass (defaulting chain's job)
+    ])
+
+
+def test_user_group_psp_settings_validation():
+    for bad in (
+        {"run_as_user": {"rule": "MustRunAs"}},  # no ranges
+        {"run_as_user": {"rule": "MustRunAs",
+                         "ranges": [{"min": None, "max": 10}]}},
+        {"run_as_user": {"rule": "MustRunAs",
+                         "ranges": [{"min": 10, "max": 1}]}},
+    ):
+        with pytest.raises(BootstrapFailure):
+            EvaluationEnvironmentBuilder(backend="jax").build(
+                {"ug": parse_policy_entry("ug", {
+                    "module": "builtin://user-group-psp", "settings": bad,
+                })}
+            )
+
+
+def test_user_group_psp_large_uid_precision():
+    """UIDs above 2^24 must classify exactly (float32 would collapse
+    16777217 onto 16777216 and admit an out-of-range id)."""
+    settings = {"run_as_user": {"rule": "MustRunAs",
+                                "ranges": [{"min": 1000, "max": 16777216}]}}
+    check("ug-precision", "builtin://user-group-psp", settings, [
+        ({"spec": {"securityContext": {"runAsUser": 16777216}}}, True),
+        ({"spec": {"securityContext": {"runAsUser": 16777217}}}, False),
+    ])
+
+
+def test_sysctl_psp():
+    settings = {
+        "forbidden_sysctls": ["kernel.msg*", "net.ipv4.ip_forward"],
+        "allowed_unsafe_sysctls": ["kernel.msgmax"],
+    }
+    sysctl = lambda name: {"spec": {"securityContext": {"sysctls": [
+        {"name": name, "value": "1"}]}}}
+    check("sys", "builtin://sysctl-psp", settings, [
+        (sysctl("net.ipv4.ip_forward"), False),
+        (sysctl("kernel.msgmnb"), False),     # matches the glob
+        (sysctl("kernel.msgmax"), True),      # explicitly allowed
+        (sysctl("vm.swappiness"), True),
+        ({"spec": {}}, True),
+    ])
+
+
+def test_containers_resource_limits():
+    check("lim", "builtin://containers-resource-limits", {}, [
+        ({"spec": {"containers": [
+            {"resources": {"limits": {"cpu": "1", "memory": "1Gi"}}}]}}, True),
+        ({"spec": {"containers": [
+            {"resources": {"limits": {"cpu": "1"}}}]}}, False),
+        ({"spec": {"containers": [{}]}}, False),
+        ({"spec": {"containers": []}}, True),
+    ])
+    check("lim2", "builtin://containers-resource-limits",
+          {"require_memory": False}, [
+        ({"spec": {"containers": [
+            {"resources": {"limits": {"cpu": "1"}}}]}}, True),
+    ])
+
+
+def test_environment_variable_policy():
+    settings = {"denied_names": ["AWS_SECRET_ACCESS_KEY", "DEBUG"]}
+    check("env", "builtin://environment-variable-policy", settings, [
+        ({"spec": {"containers": [
+            {"env": [{"name": "PATH", "value": "/bin"}]}]}}, True),
+        ({"spec": {"containers": [
+            {"env": [{"name": "DEBUG", "value": "1"}]}]}}, False),
+        ({"spec": {"containers": [
+            {"env": [{"name": "A"}]},
+            {"env": [{"name": "AWS_SECRET_ACCESS_KEY"}]}]}}, False),
+        ({"spec": {"containers": [{}]}}, True),
+    ])
+
+
+def test_selinux_psp():
+    settings = {"rule": "MustRunAs", "level": "s0:c123,c456", "type": "spc_t"}
+    check("se", "builtin://selinux-psp", settings, [
+        ({"spec": {"securityContext": {"seLinuxOptions": {
+            "level": "s0:c123,c456", "type": "spc_t"}}}}, True),
+        ({"spec": {"securityContext": {"seLinuxOptions": {
+            "level": "s0:c1,c2"}}}}, False),
+        ({"spec": {"containers": [{"securityContext": {"seLinuxOptions": {
+            "type": "other_t"}}}]}}, False),
+        ({"spec": {}}, True),  # nothing set → nothing to contradict
+    ])
+    check("se2", "builtin://selinux-psp", {"rule": "RunAsAny"}, [
+        ({"spec": {"securityContext": {"seLinuxOptions": {
+            "level": "anything"}}}}, True),
+    ])
