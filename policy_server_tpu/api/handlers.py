@@ -189,7 +189,10 @@ async def readiness_handler(request: web.Request) -> web.Response:
 
 async def metrics_handler(request: web.Request) -> web.Response:
     """Prometheus exposition (this build's pull-based replacement for the
-    reference's OTLP push, see telemetry/metrics.py)."""
+    reference's OTLP push, see telemetry/metrics.py). Serving-runtime
+    introspection (dispatch counts, watchdog abandonments, queue depth,
+    oracle fallbacks) rides the same registry via the runtime-stats
+    collector the server attaches at bootstrap."""
     return web.Response(
         body=default_registry().exposition(),
         content_type="text/plain",

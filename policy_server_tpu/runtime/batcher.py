@@ -181,6 +181,11 @@ class MicroBatcher:
                 ),
             )
 
+    def queue_depth(self) -> int:
+        """Requests currently waiting for batch formation (introspection
+        for the /metrics runtime gauges)."""
+        return self._queue.qsize()
+
     def warmup(self) -> None:
         """Compile every batch bucket at boot (reference precompiles all
         policies via rayon at boot, src/lib.rs:287-307)."""

@@ -149,6 +149,37 @@ class PolicyServer:
             enable_pprof=config.enable_pprof,
         )
 
+        def runtime_stats():
+            yield (
+                "policy_server_batches_dispatched", "counter",
+                "Micro-batches dispatched to the device",
+                batcher.batches_dispatched,
+            )
+            yield (
+                "policy_server_requests_dispatched", "counter",
+                "Requests dispatched through the micro-batcher",
+                batcher.requests_dispatched,
+            )
+            yield (
+                "policy_server_deadline_abandoned_batches", "counter",
+                "Device batches abandoned by the dispatch watchdog",
+                batcher.deadline_abandoned_batches,
+            )
+            yield (
+                "policy_server_queue_depth", "gauge",
+                "Requests waiting for batch formation",
+                batcher.queue_depth(),
+            )
+            yield (
+                "policy_server_oracle_fallbacks", "counter",
+                "Requests routed to the host oracle (schema overflow)",
+                getattr(environment, "oracle_fallbacks", 0) or 0,
+            )
+
+        from policy_server_tpu.telemetry import default_registry
+
+        default_registry().attach_runtime_stats(runtime_stats)
+
         tls_context = None
         if config.tls_config.enabled:
             try:

@@ -126,6 +126,31 @@ class PolicyInitializationError:
         }
 
 
+class _RuntimeStatsCollector:
+    """Custom collector exposing serving-runtime introspection (batcher
+    dispatch counts, watchdog abandonments, queue depth, oracle
+    fallbacks) through the SAME registry as the reference instruments —
+    no hand-assembled exposition text, no duplicate-family risk."""
+
+    def __init__(self, owner: "MetricsRegistry"):
+        self._owner = owner
+
+    def collect(self):
+        fn = self._owner._runtime_stats_fn
+        if fn is None:
+            return
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        for name, kind, help_text, value in fn():
+            family = (
+                CounterMetricFamily if kind == "counter" else GaugeMetricFamily
+            )(name, help_text, value=value)
+            yield family
+
+
 class MetricsRegistry:
     """Thread-safe metrics sink. Always aggregates in-process (snapshot API
     used by unit tests and the batcher's self-tuning); exposes Prometheus
@@ -142,8 +167,14 @@ class MetricsRegistry:
         # label-set → (counter child, histogram child); dict assignment is
         # atomic under the GIL, racing builders produce identical children
         self._prom_children: dict[tuple, tuple] = {}
+        # serving-runtime stats provider (attach_runtime_stats): yields
+        # (name, kind, help, value) tuples scraped on collect — ONE
+        # collector registered here, so re-attachment can never produce
+        # duplicate metric families
+        self._runtime_stats_fn = None
         if prometheus_client is not None:
             self.registry = CollectorRegistry()
+            self.registry.register(_RuntimeStatsCollector(self))
             self._prom_total = prometheus_client.Counter(
                 EVALUATIONS_TOTAL,
                 "Number of policy evaluations",
@@ -219,6 +250,13 @@ class MetricsRegistry:
             )
         if self.registry is not None:
             self._prom_init_errors.labels(**labels).inc()
+
+    def attach_runtime_stats(self, snapshot_fn) -> None:
+        """Install (or replace) the serving-runtime stats provider:
+        ``snapshot_fn() -> [(name, 'counter'|'gauge', help, value), ...]``.
+        Called by the server at bootstrap with a closure over its batcher
+        and evaluation environment."""
+        self._runtime_stats_fn = snapshot_fn
 
     # -- exposition ---------------------------------------------------------
 

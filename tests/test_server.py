@@ -261,6 +261,10 @@ def test_readiness_and_metrics(server):
     r = requests.get(server.readiness_url("/metrics"), timeout=10)
     assert r.status_code == 200
     assert "kubewarden_policy_evaluations_total" in r.text
+    # serving-runtime introspection gauges ride the same exposition
+    assert "policy_server_batches_dispatched_total" in r.text
+    assert "policy_server_queue_depth" in r.text
+    assert "policy_server_oracle_fallbacks_total" in r.text
 
 
 def test_pprof_endpoints(server):

@@ -206,8 +206,11 @@ def test_mtls_requires_client_cert(tmp_path):
             timeout=30,
         )
         assert r.status_code == 200
-        # without client cert: TLS-level rejection
-        with pytest.raises(requests.exceptions.SSLError):
+        # without client cert: TLS-level rejection. Depending on whether
+        # the server's alert or the socket reset wins the race, requests
+        # surfaces SSLError or its ConnectionError parent — match the
+        # parent, which covers both.
+        with pytest.raises(requests.exceptions.ConnectionError):
             requests.post(
                 https_url(handle, "/validate/pod-privileged"),
                 json=pod_review_body(False),
