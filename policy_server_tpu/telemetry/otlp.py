@@ -414,6 +414,24 @@ def prometheus_to_otlp(
                         ),
                     )
                 )
+        elif family.type == "gauge":
+            points = [
+                pb.NumberDataPoint(
+                    start_time_unix_nano=start_unix_nano,
+                    time_unix_nano=now_unix_nano,
+                    as_double=s.value,
+                    attributes=_key_values(s.labels),
+                )
+                for s in family.samples
+            ]
+            if points:
+                out.append(
+                    pb.Metric(
+                        name=family.name,
+                        description=family.documentation,
+                        gauge=pb.Gauge(data_points=points),
+                    )
+                )
         elif family.type == "histogram":
             # prometheus exposes per-label-set series: _bucket{le}, _sum,
             # _count — regroup by label set
