@@ -531,8 +531,9 @@ def main() -> int:
              error=repr(e)[:300])
     try:
         # concurrency 256 ≈ the knee of this transport's throughput curve
-        # (739 rps @ p99 459 ms; 1024 concurrent only adds queue wait —
-        # the Python asyncio HTTP framing caps ~950 rps/loop, PROFILE.md)
+        # (890 rps @ p99 492 ms after the async-logging/metrics-cache
+        # work; 1024 concurrent only adds queue wait — the Python asyncio
+        # HTTP framing caps ~1.3k rps/loop, PROFILE.md)
         bench_http(
             n_requests=512 if quick else 4000,
             concurrency=64 if quick else 256,

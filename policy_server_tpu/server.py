@@ -263,13 +263,30 @@ class PolicyServer:
         otlp.shutdown_pipeline()
 
     async def run_async(self) -> None:
+        """Serve until cancelled or signalled. SIGTERM/SIGINT trigger the
+        same graceful stop (drain batcher futures, close the environment,
+        flush OTLP) — a pod rolling update must not drop buffered spans or
+        strand in-flight webhook calls."""
+        import signal
+
         await self.start()
+        stop_requested = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        registered: list[int] = []
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop_requested.set)
+                registered.append(sig)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-main thread / platform without signal support
         try:
-            while True:  # serve until cancelled
-                await asyncio.sleep(3600)
+            await stop_requested.wait()
+            logger.info("shutdown signal received, stopping gracefully")
         except asyncio.CancelledError:
             pass
         finally:
+            for sig in registered:
+                loop.remove_signal_handler(sig)
             await self.stop()
 
     def run(self) -> None:
