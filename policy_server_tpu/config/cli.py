@@ -144,6 +144,13 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
               help="Persistent XLA compilation cache directory: compiled "
                    "policy programs survive restarts (the TPU analog of the "
                    "reference's policies-download store reuse)")),
+        ("--http-workers", "KUBEWARDEN_HTTP_WORKERS",
+         dict(type=int, default=1, metavar="N",
+              help="HTTP frontend processes sharing the API port via "
+                   "SO_REUSEPORT, forwarding to the evaluation process "
+                   "over a unix socket (1 = serve in-process; raises the "
+                   "~1.3k req/s per-event-loop framing ceiling, see "
+                   "PROFILE.md)")),
         ("--context-refresh-seconds", "KUBEWARDEN_CONTEXT_REFRESH_SECONDS",
          dict(type=float, default=30.0, metavar="SECONDS",
               help="Context-aware snapshot freshness: the re-LIST period in "

@@ -162,6 +162,9 @@ class Config:
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
+    # prefork HTTP frontend (runtime/frontend.py): worker processes
+    # sharing the API port via SO_REUSEPORT; 1 = in-process serving
+    http_workers: int = 1
     # context-aware snapshot freshness (see the staleness contract in
     # context/service.py): watch keeps snapshots event-fresh; the refresh
     # period bounds poll-mode staleness and watch-mode backoff/resync
@@ -193,6 +196,8 @@ class Config:
             raise ValueError("ports must be in [0, 65535]")
         if self.context_refresh_seconds <= 0:
             raise ValueError("--context-refresh-seconds must be > 0")
+        if self.http_workers < 1:
+            raise ValueError("--http-workers must be >= 1")
         if self.distributed_coordinator is None:
             if (
                 self.distributed_num_processes is not None
@@ -286,6 +291,7 @@ class Config:
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,
+            http_workers=int(args.http_workers),
             context_refresh_seconds=float(args.context_refresh_seconds),
             context_watch=not args.context_no_watch,
             distributed_coordinator=args.distributed_coordinator,

@@ -376,6 +376,10 @@ class EvaluationEnvironment:
         )
         self._fused = jax.jit(self._forward)
         self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
+        # memoized service-layer lookups (immutable registry; unknown ids
+        # still raise through the uncached path)
+        self._mode_cache: dict[str, PolicyMode] = {}
+        self._mutate_cache: dict[str, bool] = {}
         self._fallback_lock = threading.Lock()
         self._mesh = None  # set by attach_mesh
         self._min_bucket = 1
@@ -456,16 +460,33 @@ class EvaluationEnvironment:
         return bp
 
     def get_policy_mode(self, policy_id: str) -> PolicyMode:
+        # memoized: the registry is immutable after boot and the service
+        # layer asks per REQUEST (the lookup+parse showed up in the
+        # serving profile at batch sizes)
+        hit = self._mode_cache.get(policy_id)
+        if hit is not None:
+            return hit
         target = self._lookup_top_level(PolicyID.parse(policy_id))
-        if isinstance(target, BoundGroup):
-            return target.policy_mode
-        return target.eval_settings.policy_mode
+        mode = (
+            target.policy_mode
+            if isinstance(target, BoundGroup)
+            else target.eval_settings.policy_mode
+        )
+        self._mode_cache[policy_id] = mode
+        return mode
 
     def get_policy_allowed_to_mutate(self, policy_id: str) -> bool:
+        hit = self._mutate_cache.get(policy_id)
+        if hit is not None:
+            return hit
         target = self._lookup_top_level(PolicyID.parse(policy_id))
-        if isinstance(target, BoundGroup):
-            return False
-        return target.eval_settings.allowed_to_mutate
+        allowed = (
+            False
+            if isinstance(target, BoundGroup)
+            else target.eval_settings.allowed_to_mutate
+        )
+        self._mutate_cache[policy_id] = allowed
+        return allowed
 
     def get_policy_settings(self, policy_id: str) -> PolicyEvaluationSettings:
         target = self._lookup_top_level(PolicyID.parse(policy_id))
@@ -1020,24 +1041,28 @@ class EvaluationEnvironment:
         outputs: Mapping[str, Any],
     ) -> AdmissionResponse:
         uid = request.uid()
-        payload = request.payload()
+        # payload materializes LAZILY: most verdicts (allowed, or rejected
+        # with a static message) never need the parsed document, and for
+        # wire requests from the prefork frontend payload() costs a JSON
+        # parse the hot path should skip
         if isinstance(target, BoundGroup):
-            return self._materialize_group(target, uid, payload, outputs)
-        return self._materialize_single(target, uid, payload, outputs)
+            return self._materialize_group(target, uid, request.payload, outputs)
+        return self._materialize_single(target, uid, request.payload, outputs)
 
     def _materialize_single(
         self,
         bp: BoundPolicy,
         uid: str,
-        payload: Any,
+        payload_fn: Any,  # zero-arg callable OR a pre-built payload value
         outputs: Mapping[str, Any],
     ) -> AdmissionResponse:
+        payload_of = payload_fn if callable(payload_fn) else (lambda: payload_fn)
         host_eval = bp.precompiled.program.host_evaluator
         if host_eval is not None:
             # wasm-backed policy: the verdict comes from host-side wasm
             # execution (evaluation/wasm_policy.py); device outputs are
             # inert for these rows
-            verdict = host_eval(payload)
+            verdict = host_eval(payload_of())
             if bool(verdict.get("accepted")):
                 response = AdmissionResponse(uid=uid, allowed=True)
                 mutated = verdict.get("mutated_object")
@@ -1065,7 +1090,9 @@ class EvaluationEnvironment:
             rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
             rule = bp.precompiled.program.rules[rule_idx]
             message = (
-                rule.message if isinstance(rule.message, str) else rule.message(payload)
+                rule.message
+                if isinstance(rule.message, str)
+                else rule.message(payload_of())
             )
             return AdmissionResponse(
                 uid=uid,
@@ -1075,7 +1102,7 @@ class EvaluationEnvironment:
         response = AdmissionResponse(uid=uid, allowed=True)
         mutator = bp.precompiled.program.mutator
         if mutator is not None:
-            ops = mutator(payload)
+            ops = mutator(payload_of())
             if ops:
                 response.patch = base64.b64encode(
                     json.dumps(ops).encode()
@@ -1087,9 +1114,10 @@ class EvaluationEnvironment:
         self,
         group: BoundGroup,
         uid: str,
-        payload: Any,
+        payload_fn: Any,  # zero-arg callable OR a pre-built payload value
         outputs: Mapping[str, Any],
     ) -> AdmissionResponse:
+        payload_of = payload_fn if callable(payload_fn) else (lambda: payload_fn)
         allowed = bool(outputs[f"g:{group.name}:allowed"])
         # group-member mutation ban (reference integration_test.rs:239-251):
         # an evaluated member that *would* mutate rejects the whole group.
@@ -1098,7 +1126,7 @@ class EvaluationEnvironment:
             member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
             mutator = bp.precompiled.program.mutator
             if evaluated and member_allowed and mutator is not None:
-                if mutator(payload):
+                if mutator(payload_of()):
                     return AdmissionResponse(
                         uid=uid,
                         allowed=False,
@@ -1118,7 +1146,7 @@ class EvaluationEnvironment:
                 message = (
                     rule.message
                     if isinstance(rule.message, str)
-                    else rule.message(payload)
+                    else rule.message(payload_of())
                 )
                 causes.append(
                     StatusCause(

@@ -1,0 +1,547 @@
+"""Prefork HTTP frontend — scaling past the one-event-loop framing wall.
+
+PROFILE.md measures the Python asyncio HTTP layer saturating ≈1.3k
+requests/s per process while the device path idles at 11k+/s. The
+reference's answer to frontend limits is replicas behind a Service
+(README.md:21-26); this module is the in-box equivalent:
+
+* ``--http-workers N`` (N>1) spawns N lightweight worker PROCESSES that
+  bind the SAME API port with ``SO_REUSEPORT`` (kernel load-balances
+  accepted connections) and run the full request handling — HTTP framing,
+  JSON parse/422 mapping, span logging, response serialization;
+* each worker forwards ``(origin, policy_id, request-json)`` over a
+  length-prefixed unix-socket frame to the ONE evaluation process that
+  owns the device, and relays the ``(status, body)`` answer;
+* the evaluation process keeps everything stateful: the environment, the
+  micro-batcher, metrics (scraped from its readiness port), OTLP.
+
+Workers import no JAX — boot is milliseconds, memory is a few tens of
+MB, and a worker crash loses nothing but its in-flight sockets.
+
+Frame wire format (little-endian):
+
+    request:  u32 frame_len | u64 req_id | u8 origin | u16 policy_id_len
+              | policy_id utf-8 | payload json bytes
+    response: u32 frame_len | u64 req_id | u16 http_status | body bytes
+
+``origin``: 0 = validate, 1 = validate_raw, 2 = audit."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any, Mapping
+
+_REQ_HEADER = struct.Struct("<QBH")
+_PARSED_EXTRA = struct.Struct("<I")  # header-json length, parsed frames only
+_RESP_HEADER = struct.Struct("<QH")
+_LEN = struct.Struct("<I")
+
+ORIGIN_VALIDATE, ORIGIN_RAW, ORIGIN_AUDIT = 0, 1, 2
+# worker-parsed frames: the WORKER validated/parsed the AdmissionReview and
+# ships (header json, payload json bytes); the evaluation process builds a
+# zero-parse WireValidateRequest — the whole point of the prefork split
+ORIGIN_VALIDATE_PARSED, ORIGIN_AUDIT_PARSED = 3, 4
+
+MAX_FRAME = 32 * 1024 * 1024  # bridge frames (body + header + framing)
+# HTTP body cap — MUST match api/handlers.build_router's client_max_size so
+# request-size limits are identical whichever process accepts the socket
+MAX_BODY = 8 * 1024**2
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes | None:
+    try:
+        raw_len = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(raw_len)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds the limit")
+    try:
+        return await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+
+
+def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)) + payload)
+
+
+# ---------------------------------------------------------------------------
+# Zero-parse wire request (evaluation-process side of parsed frames)
+# ---------------------------------------------------------------------------
+
+
+class _WireKind:
+    __slots__ = ("kind",)
+
+    def __init__(self, kind: str):
+        self.kind = kind
+
+
+class _WireAdmission:
+    """The slice of AdmissionRequest the service layer reads (namespace
+    shortcut + metric labels); everything else lives in the payload
+    bytes."""
+
+    __slots__ = ("uid", "namespace", "operation", "request_kind")
+
+    def __init__(self, header: Mapping[str, Any]):
+        self.uid = str(header.get("uid") or "")
+        self.namespace = header.get("namespace")
+        self.operation = header.get("operation")
+        kind = header.get("kind")
+        self.request_kind = _WireKind(str(kind)) if kind else None
+
+
+class WireValidateRequest:
+    """ValidateRequest-compatible object whose payload stays as the wire
+    JSON bytes: the native encoder consumes ``payload_json()`` directly
+    (no Python parse on the evaluation side); ``payload()`` materializes
+    lazily only for host-side consumers (oracle, hooks, rule-message
+    callables, mutators)."""
+
+    __slots__ = ("admission_request", "_payload_bytes", "_payload_cache")
+
+    is_raw = False
+    raw = None
+
+    def __init__(self, header: Mapping[str, Any], payload_bytes: bytes):
+        self.admission_request = _WireAdmission(header)
+        self._payload_bytes = payload_bytes
+        self._payload_cache = None
+
+    def uid(self) -> str:
+        return self.admission_request.uid
+
+    def payload(self) -> Any:
+        if self._payload_cache is None:
+            self._payload_cache = json.loads(self._payload_bytes)
+        return self._payload_cache
+
+    def payload_json(self) -> bytes:
+        return self._payload_bytes
+
+
+# ---------------------------------------------------------------------------
+# Evaluation-process side: the bridge
+# ---------------------------------------------------------------------------
+
+
+class EvaluationBridge:
+    """Unix-socket server inside the evaluation process: decodes request
+    frames, drives the same evaluation path as the in-process handlers,
+    answers with (status, body) frames. One task per frame — ordering
+    across a connection is NOT preserved (req_id correlates)."""
+
+    def __init__(self, state: Any, socket_path: str):
+        self.state = state
+        self.socket_path = socket_path
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_unix_server(
+            self._serve_connection, path=self.socket_path
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        lock = asyncio.Lock()  # frame writes must not interleave
+        tasks: set[asyncio.Task] = set()
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                task = asyncio.ensure_future(
+                    self._handle_frame(frame, writer, lock)
+                )
+                tasks.add(task)
+                task.add_done_callback(tasks.discard)
+        finally:
+            for t in tasks:
+                t.cancel()
+            writer.close()
+
+    async def _handle_frame(
+        self, frame: bytes, writer: asyncio.StreamWriter, lock: asyncio.Lock
+    ) -> None:
+        # req_id first: once we have it, EVERY failure mode must still
+        # answer the worker (an unanswered frame hangs an HTTP request)
+        req_id, origin_code, pid_len = _REQ_HEADER.unpack_from(frame)
+        try:
+            offset = _REQ_HEADER.size
+            policy_id = frame[offset : offset + pid_len].decode()
+            rest = frame[offset + pid_len :]
+            if origin_code in (ORIGIN_VALIDATE_PARSED, ORIGIN_AUDIT_PARSED):
+                (hlen,) = _PARSED_EXTRA.unpack_from(rest)
+                header = json.loads(
+                    rest[_PARSED_EXTRA.size : _PARSED_EXTRA.size + hlen]
+                )
+                payload = rest[_PARSED_EXTRA.size + hlen :]
+                status, response_body = await self._evaluate_parsed(
+                    origin_code, policy_id, header, payload
+                )
+            else:
+                status, response_body = await self._evaluate(
+                    origin_code, policy_id, rest
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — same contract as the
+            # in-process handlers: every failure maps to a JSON 500
+            from policy_server_tpu.telemetry.tracing import logger
+
+            logger.error("bridge frame handling failed: %s", e)
+            status = 500
+            response_body = json.dumps(
+                {"message": "Something went wrong"}
+            ).encode()
+        async with lock:
+            _write_frame(
+                writer, _RESP_HEADER.pack(req_id, status) + response_body
+            )
+            await writer.drain()
+
+    async def _evaluate_parsed(
+        self,
+        origin_code: int,
+        policy_id: str,
+        header: Mapping[str, Any],
+        payload: bytes,
+    ) -> tuple[int, bytes]:
+        from policy_server_tpu.api import handlers
+        from policy_server_tpu.api.service import RequestOrigin
+        from policy_server_tpu.models import AdmissionReviewResponse
+
+        request = WireValidateRequest(header, payload)
+        origin = (
+            RequestOrigin.AUDIT
+            if origin_code == ORIGIN_AUDIT_PARSED
+            else RequestOrigin.VALIDATE
+        )
+        result = await handlers._evaluate(  # noqa: SLF001 — same package
+            self.state, policy_id, request, origin
+        )
+        if hasattr(result, "status") and hasattr(result, "body"):
+            return result.status, result.body or b""  # mapped error
+        body_out = json.dumps(AdmissionReviewResponse(result).to_dict())
+        return 200, body_out.encode()
+
+    async def _evaluate(
+        self, origin_code: int, policy_id: str, body: bytes
+    ) -> tuple[int, bytes]:
+        # mirror api/handlers semantics exactly — same parse errors, same
+        # error mapping, same span-less core (the WORKER owns the span)
+        from policy_server_tpu.api import handlers
+        from policy_server_tpu.api.api_error import json_body_error
+        from policy_server_tpu.api.service import RequestOrigin
+        from policy_server_tpu.models import (
+            AdmissionReviewRequest,
+            AdmissionReviewResponse,
+            RawReviewRequest,
+            RawReviewResponse,
+            ValidateRequest,
+        )
+
+        try:
+            doc = json.loads(body)
+            if origin_code == ORIGIN_RAW:
+                raw_review = RawReviewRequest.from_dict(doc)
+                request = ValidateRequest.from_raw(raw_review.request)
+            else:
+                review = AdmissionReviewRequest.from_dict(doc)
+                request = ValidateRequest.from_admission(review.request)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            resp = json_body_error(
+                f"Failed to parse the request body as JSON: {e}"
+            )
+            return resp.status, resp.body or b""
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            resp = json_body_error(
+                f"Failed to deserialize the JSON body: {e}"
+            )
+            return resp.status, resp.body or b""
+
+        # raw requests evaluate under the VALIDATE origin like the native
+        # handler (validate_raw_handler); AUDIT reports the raw verdict
+        origin = (
+            RequestOrigin.AUDIT
+            if origin_code == ORIGIN_AUDIT
+            else RequestOrigin.VALIDATE
+        )
+        result = await handlers._evaluate(  # noqa: SLF001 — same package
+            self.state, policy_id, request, origin
+        )
+        if hasattr(result, "status") and hasattr(result, "body"):
+            return result.status, result.body or b""  # mapped error
+        if origin_code == ORIGIN_RAW:
+            body_out = json.dumps(RawReviewResponse(result).to_dict())
+        else:
+            body_out = json.dumps(AdmissionReviewResponse(result).to_dict())
+        return 200, body_out.encode()
+
+
+# ---------------------------------------------------------------------------
+# Worker-process side
+# ---------------------------------------------------------------------------
+
+
+class BridgeClient:
+    """Multiplexing client over one unix-socket connection."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock = asyncio.Lock()
+        self._read_task: asyncio.Task | None = None  # strong ref: the loop
+        # holds only weak refs and a collected reader would hang every
+        # in-flight request
+        self._dead = True
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_unix_connection(
+            self.socket_path
+        )
+        self._dead = False
+        self._read_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await _read_frame(self._reader)
+                if frame is None:
+                    break
+                req_id, status = _RESP_HEADER.unpack_from(frame)
+                fut = self._pending.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result((status, frame[_RESP_HEADER.size :]))
+        finally:
+            # ANY exit — clean close, oversized frame, decode error — must
+            # fail everything in flight and mark the client for reconnect;
+            # leaving futures pending would hang their HTTP requests
+            self._dead = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError("evaluation bridge closed")
+                    )
+            self._pending.clear()
+
+    async def _ensure_connected(self) -> None:
+        if self._dead or self._writer is None or self._writer.is_closing():
+            await self.connect()
+
+    async def call(
+        self, origin_code: int, policy_id: str, body: bytes
+    ) -> tuple[int, bytes]:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        pid = policy_id.encode()
+        async with self._lock:
+            await self._ensure_connected()
+            self._next_id += 1
+            req_id = self._next_id
+            self._pending[req_id] = fut
+            _write_frame(
+                self._writer,
+                _REQ_HEADER.pack(req_id, origin_code, len(pid)) + pid + body,
+            )
+            await self._writer.drain()
+        return await fut
+
+    async def call_parsed(
+        self,
+        origin_code: int,
+        policy_id: str,
+        header: bytes,
+        payload: bytes,
+    ) -> tuple[int, bytes]:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        pid = policy_id.encode()
+        async with self._lock:
+            await self._ensure_connected()
+            self._next_id += 1
+            req_id = self._next_id
+            self._pending[req_id] = fut
+            _write_frame(
+                self._writer,
+                _REQ_HEADER.pack(req_id, origin_code, len(pid))
+                + pid
+                + _PARSED_EXTRA.pack(len(header))
+                + header
+                + payload,
+            )
+            await self._writer.drain()
+        return await fut
+
+
+def build_worker_app(bridge: BridgeClient, hostname: str):
+    """The worker's aiohttp app: the three evaluation endpoints with the
+    reference span fields; everything stateful proxies to the bridge."""
+    from aiohttp import web
+
+    from policy_server_tpu.telemetry.tracing import span
+
+    def extract_span_fields(doc: Any) -> dict:
+        if not isinstance(doc, Mapping):
+            return {}
+        req = doc.get("request")
+        if not isinstance(req, Mapping):
+            return {}
+        kind = req.get("kind") or {}
+        resource = req.get("resource") or {}
+        return {
+            "request_uid": req.get("uid"),
+            "name": req.get("name"),
+            "namespace": req.get("namespace"),
+            "operation": req.get("operation"),
+            "kind_version": (kind.get("version") if isinstance(kind, Mapping) else None),
+            "kind": (kind.get("kind") if isinstance(kind, Mapping) else None),
+            "resource": (resource.get("resource") if isinstance(resource, Mapping) else None),
+        }
+
+    def make_admission_handler(parsed_origin: int, span_name: str):
+        """validate/audit: the WORKER parses and validates the review
+        (422s never cross the bridge) and ships a parsed frame the
+        evaluation process consumes without re-parsing."""
+        from policy_server_tpu.api.api_error import json_body_error
+        from policy_server_tpu.models import AdmissionReviewRequest
+
+        async def handler(request: web.Request) -> web.Response:
+            policy_id = request.match_info["policy_id"]
+            body = await request.read()
+            try:
+                doc = json.loads(body)
+                review = AdmissionReviewRequest.from_dict(doc)
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                return json_body_error(
+                    f"Failed to parse the request body as JSON: {e}"
+                )
+            except (KeyError, TypeError, ValueError, AttributeError) as e:
+                return json_body_error(
+                    f"Failed to deserialize the JSON body: {e}"
+                )
+            adm = review.request
+            with span(
+                span_name, host=hostname, policy_id=policy_id,
+                **extract_span_fields(doc),
+            ) as fields:
+                header = json.dumps(
+                    {
+                        "uid": adm.uid,
+                        "namespace": adm.namespace,
+                        "operation": adm.operation,
+                        "kind": adm.request_kind.kind
+                        if adm.request_kind
+                        else None,
+                    }
+                ).encode()
+                # to_dict(), NOT the raw body slice: the payload root must
+                # be byte-identical to the in-process path (from_dict may
+                # normalize fields, and Exists() semantics depend on it)
+                payload_bytes = json.dumps(
+                    adm.to_dict(), separators=(",", ":")
+                ).encode()
+                try:
+                    status, payload = await bridge.call_parsed(
+                        parsed_origin, policy_id, header, payload_bytes
+                    )
+                except ConnectionError:
+                    return web.json_response(
+                        {"message": "evaluation backend unavailable"},
+                        status=503,
+                    )
+                fields["response_code"] = status
+                return web.Response(
+                    status=status,
+                    body=payload,
+                    content_type="application/json",
+                )
+
+        return handler
+
+    async def raw_handler(request: web.Request) -> web.Response:
+        policy_id = request.match_info["policy_id"]
+        body = await request.read()
+        with span(
+            "validation_raw", host=hostname, policy_id=policy_id
+        ) as fields:
+            try:
+                status, payload = await bridge.call(
+                    ORIGIN_RAW, policy_id, body
+                )
+            except ConnectionError:
+                return web.json_response(
+                    {"message": "evaluation backend unavailable"}, status=503
+                )
+            fields["response_code"] = status
+            return web.Response(
+                status=status, body=payload, content_type="application/json"
+            )
+
+    app = web.Application(client_max_size=MAX_BODY)
+    app.router.add_post(
+        "/validate/{policy_id}",
+        make_admission_handler(ORIGIN_VALIDATE_PARSED, "validation"),
+    )
+    app.router.add_post("/validate_raw/{policy_id}", raw_handler)
+    app.router.add_post(
+        "/audit/{policy_id}",
+        make_admission_handler(ORIGIN_AUDIT_PARSED, "audit"),
+    )
+    return app
+
+
+async def worker_main(
+    socket_path: str, addr: str, port: int, hostname: str
+) -> None:
+    from aiohttp import web
+
+    bridge = BridgeClient(socket_path)
+    await bridge.connect()
+    app = build_worker_app(bridge, hostname)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, addr, port, reuse_port=True)
+    await site.start()
+    while True:  # serve until the parent terminates us
+        await asyncio.sleep(3600)
+
+
+def main() -> int:
+    """Worker-process entry: python -m policy_server_tpu.runtime.frontend"""
+    import argparse
+
+    from policy_server_tpu.telemetry import setup_tracing
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--hostname", default="worker")
+    parser.add_argument("--log-level", default="info")
+    parser.add_argument("--log-fmt", default="text")
+    args = parser.parse_args()
+    setup_tracing(args.log_level, args.log_fmt)
+    try:
+        asyncio.run(
+            worker_main(args.socket, args.addr, args.port, args.hostname)
+        )
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,10 @@ class PolicyServer:
         self._runners: list[web.AppRunner] = []
         self.api_port: int | None = None
         self.readiness_port: int | None = None
+        # prefork HTTP frontend state (runtime/frontend.py)
+        self._bridge = None
+        self._worker_procs: list = []
+        self._bridge_socket: str | None = None
 
     # -- bootstrap (lib.rs:75-236) -----------------------------------------
 
@@ -78,6 +82,14 @@ class PolicyServer:
                 _otlp.install_metrics_pusher(registry)
         if config.enable_pprof:
             profiling.activate_memory_profiling()
+            if config.http_workers > 1:
+                logger.warning(
+                    "--enable-pprof with --http-workers: the pprof routes "
+                    "are served by the main process only; a fraction of "
+                    "connections on the shared port land on workers and "
+                    "404 — hit the endpoint repeatedly or set "
+                    "--http-workers 1 when profiling"
+                )
         if config.compilation_cache_dir:
             # persistent XLA compilation cache: warmed policy programs
             # survive restarts (SURVEY.md §5 checkpoint/resume row)
@@ -210,15 +222,24 @@ class PolicyServer:
     async def start(self) -> None:
         """Bind both servers; returns once serving (used by run() and by
         socket-based tests, which read the bound ports)."""
+        prefork = self.config.http_workers > 1 and self.tls_context is None
+        if self.config.http_workers > 1 and self.tls_context is not None:
+            logger.warning(
+                "--http-workers is not supported with TLS yet (workers "
+                "would each need the cert material); serving in-process"
+            )
         api_runner = web.AppRunner(self.router())
         await api_runner.setup()
         api_site = web.TCPSite(
             api_runner, self.config.addr, self.config.port,
             ssl_context=self.tls_context,
+            reuse_port=prefork or None,
         )
         await api_site.start()
         self.api_port = _bound_port(api_runner) or self.config.port
         self._runners.append(api_runner)
+        if prefork:
+            await self._start_frontend_workers()
 
         # readiness server starts only after the API server is bound
         # (Notify handshake, lib.rs:239-268)
@@ -247,7 +268,84 @@ class PolicyServer:
             },
         )
 
+    async def _start_frontend_workers(self) -> None:
+        """Spawn the prefork HTTP workers (runtime/frontend.py): the
+        evaluation bridge on a unix socket, then N lightweight processes
+        binding the already-bound API port with SO_REUSEPORT."""
+        import os as _os
+        import subprocess
+        import sys
+        import tempfile
+
+        from policy_server_tpu.runtime.frontend import EvaluationBridge
+
+        # 0700 private directory: a world-writable /tmp path would let any
+        # local user squat the socket name or connect to the evaluation
+        # bridge directly, bypassing the HTTP listener's TLS/auth surface
+        bridge_dir = tempfile.mkdtemp(prefix="policy-server-bridge-")
+        _os.chmod(bridge_dir, 0o700)
+        self._bridge_dir = bridge_dir
+        self._bridge_socket = _os.path.join(bridge_dir, "bridge.sock")
+        self._bridge = EvaluationBridge(self.state, self._bridge_socket)
+        await self._bridge.start()
+        n = self.config.http_workers - 1  # this process serves too
+        for i in range(n):
+            self._worker_procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "policy_server_tpu.runtime.frontend",
+                        "--socket", self._bridge_socket,
+                        "--addr", self.config.addr,
+                        "--port", str(self.api_port),
+                        "--hostname", self.config.hostname,
+                        "--log-level", self.config.log_level,
+                        "--log-fmt",
+                        self.config.log_fmt
+                        if self.config.log_fmt != "otlp"
+                        else "json",  # workers log; spans stay in-process
+                    ]
+                )
+            )
+        logger.info(
+            "prefork HTTP frontend started",
+            extra={"span_fields": {
+                "workers": n + 1, "bridge": self._bridge_socket,
+            }},
+        )
+
     async def stop(self) -> None:
+        import contextlib
+        import os as _os
+
+        for proc in self._worker_procs:
+            with contextlib.suppress(ProcessLookupError):
+                proc.terminate()
+        loop = asyncio.get_running_loop()
+        for proc in self._worker_procs:
+            try:
+                # off-loop wait: a wedged worker must not stall shutdown's
+                # event loop; escalate to SIGKILL so no orphan keeps a
+                # share of the SO_REUSEPORT port serving 503s
+                await loop.run_in_executor(None, proc.wait, 5)
+            except Exception:  # noqa: BLE001 — TimeoutExpired and friends
+                with contextlib.suppress(ProcessLookupError):
+                    proc.kill()
+                with contextlib.suppress(Exception):
+                    await loop.run_in_executor(None, proc.wait, 5)
+        self._worker_procs.clear()
+        if self._bridge is not None:
+            await self._bridge.stop()
+            self._bridge = None
+        if self._bridge_socket:
+            with contextlib.suppress(OSError):
+                _os.unlink(self._bridge_socket)
+            self._bridge_socket = None
+        if getattr(self, "_bridge_dir", None):
+            with contextlib.suppress(OSError):
+                _os.rmdir(self._bridge_dir)
+            self._bridge_dir = None
         for runner in self._runners:
             await runner.cleanup()
         self._runners.clear()

@@ -1,0 +1,92 @@
+"""Prefork HTTP frontend tests (runtime/frontend.py): worker processes
+share the API port via SO_REUSEPORT and proxy evaluation over the unix
+bridge — verdicts, error mapping, and raw/audit semantics must be
+indistinguishable from in-process serving."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+import requests
+
+from test_server import ServerHandle, make_config, pod_review_body
+
+
+@pytest.fixture(scope="module")
+def prefork_server():
+    from policy_server_tpu.telemetry import metrics as metrics_mod
+
+    metrics_mod.reset_metrics_for_tests()
+    handle = ServerHandle(make_config(http_workers=3))
+    # give the worker processes a moment to bind the shared port
+    deadline = time.time() + 30
+    while time.time() < deadline and len(handle.server._worker_procs) < 2:
+        time.sleep(0.1)
+    time.sleep(1.0)
+    yield handle
+    handle.stop()
+
+
+def fresh_post(url: str, body: dict) -> requests.Response:
+    """One request per CONNECTION so the kernel's SO_REUSEPORT balancing
+    spreads traffic across main + worker processes."""
+    return requests.post(
+        url, json=body, headers={"Connection": "close"}, timeout=60
+    )
+
+
+def test_workers_spawned(prefork_server):
+    assert len(prefork_server.server._worker_procs) == 2  # + main = 3
+    for proc in prefork_server.server._worker_procs:
+        assert proc.poll() is None  # alive
+
+
+def test_verdicts_identical_across_processes(prefork_server):
+    url = prefork_server.url("/validate/pod-privileged")
+    for _ in range(12):  # many fresh connections → both paths exercised
+        r = fresh_post(url, pod_review_body(True))
+        assert r.status_code == 200
+        body = r.json()
+        assert body["apiVersion"] == "admission.k8s.io/v1"
+        assert body["response"]["allowed"] is False
+        r = fresh_post(url, pod_review_body(False))
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is True
+
+
+def test_error_mapping_through_workers(prefork_server):
+    r = fresh_post(
+        prefork_server.url("/validate/nope"), pod_review_body(False)
+    )
+    assert r.status_code == 404
+    r = requests.post(
+        prefork_server.url("/validate/pod-privileged"),
+        data=b"not json",
+        headers={"Content-Type": "application/json", "Connection": "close"},
+        timeout=60,
+    )
+    assert r.status_code == 422
+
+
+def test_audit_and_raw_through_workers(prefork_server):
+    r = fresh_post(
+        prefork_server.url("/audit/pod-privileged"), pod_review_body(True)
+    )
+    assert r.status_code == 200
+    assert r.json()["response"]["allowed"] is False
+
+    raw = {"request": {"uid": "raw-1", "anything": True}}
+    r = fresh_post(prefork_server.url("/validate_raw/raw-gate"), raw)
+    # raw-gate isn't configured in make_config — expect clean 404, not 500
+    assert r.status_code == 404
+
+
+def test_worker_shutdown_with_server(prefork_server):
+    """Covered implicitly by fixture teardown; here assert bridge socket
+    path exists while serving."""
+    import os
+
+    assert prefork_server.server._bridge_socket
+    assert os.path.exists(prefork_server.server._bridge_socket)
