@@ -48,6 +48,31 @@ from policy_server_tpu.telemetry.tracing import logger, span
 
 STATE_KEY = web.AppKey("state", ApiServerState)
 
+# one request-body cap for EVERY process that can accept the API socket
+# (in-process app and prefork workers must agree or limits go
+# nondeterministic behind SO_REUSEPORT)
+MAX_BODY_BYTES = 8 * 1024**2
+
+
+class BodyError(Exception):
+    """Malformed request body; ``message`` carries the 422 text."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+def parse_admission_review_bytes(body: bytes) -> AdmissionReviewRequest:
+    """The ONE parse+error contract for admission review bodies, shared by
+    the in-process handlers, the prefork workers, and the evaluation
+    bridge (a 422 body must not depend on which process parsed it)."""
+    try:
+        return AdmissionReviewRequest.from_dict(json.loads(body))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise BodyError(f"Failed to parse the request body as JSON: {e}") from e
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        raise BodyError(f"Failed to deserialize the JSON body: {e}") from e
+
 
 def _span_fields_from_admission(review: AdmissionReviewRequest) -> dict:
     """populate_span_with_admission_request_data (handlers.rs:288-306)."""
@@ -93,8 +118,10 @@ async def _evaluate(
     """Dispatch through the batcher; map EvaluationError → ApiError
     responses (handlers.rs:321-342)."""
     try:
+        # submit_async returns a loop-bound asyncio future; whole batches
+        # deliver with one loop wakeup (runtime/batcher.py _DeliveryBatch)
         future = await state.batcher.submit_async(policy_id, request, origin)
-        return await asyncio.wrap_future(future)
+        return await future
     except PolicyNotFoundError as e:
         return api_error(404, str(e))
     except EvaluationError as e:
@@ -109,12 +136,9 @@ async def _read_admission_review(
     request: web.Request,
 ) -> AdmissionReviewRequest | web.Response:
     try:
-        body = json.loads(await request.read())
-        return AdmissionReviewRequest.from_dict(body)
-    except (json.JSONDecodeError, UnicodeDecodeError) as e:
-        return json_body_error(f"Failed to parse the request body as JSON: {e}")
-    except (KeyError, TypeError, ValueError, AttributeError) as e:
-        return json_body_error(f"Failed to deserialize the JSON body: {e}")
+        return parse_admission_review_bytes(await request.read())
+    except BodyError as e:
+        return json_body_error(e.message)
 
 
 async def validate_handler(request: web.Request) -> web.Response:
@@ -243,7 +267,7 @@ async def pprof_heap_handler(request: web.Request) -> web.Response:
 
 def build_router(state: ApiServerState) -> web.Application:
     """The API application (reference router wiring, src/lib.rs:205-225)."""
-    app = web.Application(client_max_size=8 * 1024**2)
+    app = web.Application(client_max_size=MAX_BODY_BYTES)
     app[STATE_KEY] = state
     app.router.add_post("/validate/{policy_id}", validate_handler)
     app.router.add_post("/validate_raw/{policy_id}", validate_raw_handler)

@@ -31,11 +31,13 @@ being torn down).
 
 from __future__ import annotations
 
+import asyncio
 import queue
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
+from typing import Any
 
 from policy_server_tpu.api import service
 from policy_server_tpu.evaluation.environment import (
@@ -62,6 +64,53 @@ class _Pending:
     trace_ctx: "otlp.SpanContext | None" = field(
         default_factory=otlp.current_span_context
     )
+    # asyncio-native completion (submit_async): results are mirrored into
+    # this loop-bound future so event-loop callers await it directly —
+    # and a whole batch delivers with ONE call_soon_threadsafe per loop
+    # instead of one wakeup per request (the fan-out dominated the
+    # serving profile, PROFILE.md round-3 follow-up)
+    aio_loop: Any = None
+    aio_future: Any = None
+
+
+def _set_many(items: list) -> None:
+    """Runs ON the target event loop: apply a batch of completions. Each
+    item is individually guarded — a duplicate completion (resolve then a
+    late _fail for the same pending) must not abort the rest of the
+    batch's deliveries."""
+    for fut, result, exc in items:
+        try:
+            if fut.cancelled():
+                continue
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except asyncio.InvalidStateError:
+            pass  # already completed: first completion wins
+
+
+class _DeliveryBatch:
+    """Accumulates asyncio completions per target loop; flush() wakes each
+    loop once for the whole batch."""
+
+    __slots__ = ("_by_loop",)
+
+    def __init__(self) -> None:
+        self._by_loop: dict = {}
+
+    def add(self, p: "_Pending", result=None, exc=None) -> None:
+        self._by_loop.setdefault(p.aio_loop, []).append(
+            (p.aio_future, result, exc)
+        )
+
+    def flush(self) -> None:
+        for loop, items in self._by_loop.items():
+            try:
+                loop.call_soon_threadsafe(_set_many, items)
+            except RuntimeError:  # loop closed: nothing awaits anymore
+                pass
+        self._by_loop.clear()
 
 
 class MicroBatcher:
@@ -275,10 +324,13 @@ class MicroBatcher:
         slice window), not strictly FIFO — the trade accepted for a
         shutdown that can never strand a blocked waiter. Thread count is
         bounded by the pool width."""
+        loop = asyncio.get_running_loop()
         pending = _Pending(policy_id, request, origin, Future())
+        pending.aio_loop = loop
+        pending.aio_future = loop.create_future()
         if self._stopping:
             self._reject_stopping(pending)
-            return pending.future
+            return pending.aio_future
         try:
             self._queue.put_nowait(pending)
             # same stranding window as the sync path (_put_waiting):
@@ -286,20 +338,21 @@ class MicroBatcher:
             # check above and this put — self-drain if so.
             if self._stopping and not pending.future.done():
                 self._drain_rejecting()
-            return pending.future
+            return pending.aio_future
         except queue.Full:
             pass
         try:
             self._overload_pool.submit(self._put_waiting, pending)
         except RuntimeError:  # pool already shut down (stop race)
             self._reject_stopping(pending)
-        return pending.future
+        return pending.aio_future
 
     def _reject_overloaded(self, pending: _Pending) -> None:
-        pending.future.set_result(
+        self._resolve(
+            pending,
             AdmissionResponse.reject(
                 pending.request.uid(), "policy server overloaded", 429
-            )
+            ),
         )
 
     def _reject_stopping(self, pending: _Pending) -> None:
@@ -381,8 +434,12 @@ class MicroBatcher:
             return None
         return self.policy_timeout - (time.perf_counter() - p.enqueued_at)
 
-    @staticmethod
-    def _resolve(p: _Pending, response: AdmissionResponse) -> None:
+    def _resolve(
+        self,
+        p: _Pending,
+        response: AdmissionResponse,
+        delivery: _DeliveryBatch | None = None,
+    ) -> None:
         """Complete a future, tolerating a concurrent client-side cancel
         (the webhook caller timing out mid-batch must never take down the
         dispatch thread)."""
@@ -390,17 +447,52 @@ class MicroBatcher:
             p.future.set_result(response)
         except Exception:  # cancelled/already-done race
             pass
+        self._mirror(p, response, None, delivery)
 
-    @staticmethod
-    def _fail(p: _Pending, exc: BaseException) -> None:
+    def _fail(
+        self,
+        p: _Pending,
+        exc: BaseException,
+        delivery: _DeliveryBatch | None = None,
+    ) -> None:
         try:
             p.future.set_exception(exc)
         except Exception:
             pass
+        self._mirror(p, None, exc, delivery)
 
-    def _reject_deadline(self, p: _Pending) -> None:
+    @staticmethod
+    def _mirror(
+        p: _Pending,
+        result,
+        exc,
+        delivery: _DeliveryBatch | None,
+    ) -> None:
+        if p.aio_future is None:
+            return
+        if delivery is not None:
+            delivery.add(p, result, exc)
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        item = [(p.aio_future, result, exc)]
+        if running is p.aio_loop:
+            _set_many(item)  # already on the loop: set inline
+            return
+        try:
+            p.aio_loop.call_soon_threadsafe(_set_many, item)
+        except RuntimeError:  # loop closed
+            pass
+
+    def _reject_deadline(
+        self, p: _Pending, delivery: _DeliveryBatch | None = None
+    ) -> None:
         self._resolve(
-            p, AdmissionResponse.reject(p.request.uid(), DEADLINE_MESSAGE, 500)
+            p,
+            AdmissionResponse.reject(p.request.uid(), DEADLINE_MESSAGE, 500),
+            delivery,
         )
         otlp.emit_span(
             "policy_evaluation",
@@ -487,17 +579,20 @@ class MicroBatcher:
         # arrived too late to be observable and must not double-count
         # metrics.
         live_ids = {id(p) for p in live}
+        delivery = _DeliveryBatch()
         for p, result in zip(runnable, results):
             if id(p) not in live_ids:
                 continue
             try:
                 if isinstance(result, PolicyInitializationError):
                     self._resolve(
-                        p, service.handle_initialization_error(p.request, result)
+                        p,
+                        service.handle_initialization_error(p.request, result),
+                        delivery,
                     )
                     continue
                 if isinstance(result, Exception):
-                    self._fail(p, result)
+                    self._fail(p, result, delivery)
                     continue
                 # No further deadline check: the watchdog guaranteed this
                 # item's verdict arrived inside its deadline, and discarding
@@ -506,7 +601,7 @@ class MicroBatcher:
                     self.env, p.policy_id, p.request, p.origin,
                     result, p.enqueued_at,
                 )
-                self._resolve(p, response)
+                self._resolve(p, response, delivery)
                 otlp.emit_span(
                     "policy_evaluation",
                     p.trace_ctx,
@@ -518,7 +613,9 @@ class MicroBatcher:
                     },
                 )
             except Exception as e:  # noqa: BLE001 — never kill the loop
-                self._fail(p, e)
+                self._fail(p, e, delivery)
+        # ONE wakeup per client loop for the whole batch
+        delivery.flush()
 
     def _watchdog_wait(
         self, dev_future: Future, runnable: list[_Pending]
@@ -548,8 +645,10 @@ class MicroBatcher:
                     p for p in live
                     if now >= p.enqueued_at + self.policy_timeout
                 ]
+                delivery = _DeliveryBatch()
                 for p in expired:
-                    self._reject_deadline(p)
+                    self._reject_deadline(p, delivery)
+                delivery.flush()
                 if expired:
                     live = [
                         p for p in live

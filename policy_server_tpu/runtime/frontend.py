@@ -45,9 +45,6 @@ ORIGIN_VALIDATE, ORIGIN_RAW, ORIGIN_AUDIT = 0, 1, 2
 ORIGIN_VALIDATE_PARSED, ORIGIN_AUDIT_PARSED = 3, 4
 
 MAX_FRAME = 32 * 1024 * 1024  # bridge frames (body + header + framing)
-# HTTP body cap — MUST match api/handlers.build_router's client_max_size so
-# request-size limits are identical whichever process accepts the socket
-MAX_BODY = 8 * 1024**2
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> bytes | None:
@@ -175,8 +172,17 @@ class EvaluationBridge:
         self, frame: bytes, writer: asyncio.StreamWriter, lock: asyncio.Lock
     ) -> None:
         # req_id first: once we have it, EVERY failure mode must still
-        # answer the worker (an unanswered frame hangs an HTTP request)
-        req_id, origin_code, pid_len = _REQ_HEADER.unpack_from(frame)
+        # answer the worker (an unanswered frame hangs an HTTP request);
+        # a frame too short to even carry the header closes the connection,
+        # which triggers the worker's fail-all-in-flight path
+        try:
+            req_id, origin_code, pid_len = _REQ_HEADER.unpack_from(frame)
+        except struct.error:
+            from policy_server_tpu.telemetry.tracing import logger
+
+            logger.error("malformed bridge frame (%d bytes); closing", len(frame))
+            writer.close()
+            return
         try:
             offset = _REQ_HEADER.size
             policy_id = frame[offset : offset + pid_len].decode()
@@ -243,9 +249,12 @@ class EvaluationBridge:
         # error mapping, same span-less core (the WORKER owns the span)
         from policy_server_tpu.api import handlers
         from policy_server_tpu.api.api_error import json_body_error
+        from policy_server_tpu.api.handlers import (
+            BodyError,
+            parse_admission_review_bytes,
+        )
         from policy_server_tpu.api.service import RequestOrigin
         from policy_server_tpu.models import (
-            AdmissionReviewRequest,
             AdmissionReviewResponse,
             RawReviewRequest,
             RawReviewResponse,
@@ -253,13 +262,15 @@ class EvaluationBridge:
         )
 
         try:
-            doc = json.loads(body)
             if origin_code == ORIGIN_RAW:
-                raw_review = RawReviewRequest.from_dict(doc)
+                raw_review = RawReviewRequest.from_dict(json.loads(body))
                 request = ValidateRequest.from_raw(raw_review.request)
             else:
-                review = AdmissionReviewRequest.from_dict(doc)
+                review = parse_admission_review_bytes(body)
                 request = ValidateRequest.from_admission(review.request)
+        except BodyError as e:
+            resp = json_body_error(e.message)
+            return resp.status, resp.body or b""
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             resp = json_body_error(
                 f"Failed to parse the request body as JSON: {e}"
@@ -300,8 +311,10 @@ class BridgeClient:
 
     def __init__(self, socket_path: str):
         self.socket_path = socket_path
-        self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
+        # pending futures are SCOPED PER CONNECTION: a stale read loop from
+        # a previous connection must never fail fresh requests riding the
+        # new one
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._lock = asyncio.Lock()
@@ -311,40 +324,53 @@ class BridgeClient:
         self._dead = True
 
     async def connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_unix_connection(
-            self.socket_path
-        )
+        if self._read_task is not None:
+            # a previous connection's loop may still be parked in a read;
+            # cancel it so it cannot race the new connection
+            self._read_task.cancel()
+            self._read_task = None
+        reader, writer = await asyncio.open_unix_connection(self.socket_path)
+        self._writer = writer
+        pending: dict[int, asyncio.Future] = {}
+        self._pending = pending
         self._dead = False
-        self._read_task = asyncio.ensure_future(self._read_loop())
+        self._read_task = asyncio.ensure_future(
+            self._read_loop(reader, pending)
+        )
 
-    async def _read_loop(self) -> None:
+    async def _read_loop(
+        self, reader: asyncio.StreamReader, pending: dict[int, asyncio.Future]
+    ) -> None:
+        """Reader bound to ONE connection: both the stream and the pending
+        map are locals, so a superseded loop can only touch its own."""
         try:
             while True:
-                frame = await _read_frame(self._reader)
+                frame = await _read_frame(reader)
                 if frame is None:
                     break
                 req_id, status = _RESP_HEADER.unpack_from(frame)
-                fut = self._pending.pop(req_id, None)
+                fut = pending.pop(req_id, None)
                 if fut is not None and not fut.done():
                     fut.set_result((status, frame[_RESP_HEADER.size :]))
         finally:
             # ANY exit — clean close, oversized frame, decode error — must
-            # fail everything in flight and mark the client for reconnect;
-            # leaving futures pending would hang their HTTP requests
-            self._dead = True
-            for fut in self._pending.values():
+            # fail THIS connection's in-flight requests; leaving futures
+            # pending would hang their HTTP requests
+            if pending is self._pending:
+                self._dead = True
+            for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(
                         ConnectionError("evaluation bridge closed")
                     )
-            self._pending.clear()
+            pending.clear()
 
     async def _ensure_connected(self) -> None:
         if self._dead or self._writer is None or self._writer.is_closing():
             await self.connect()
 
-    async def call(
-        self, origin_code: int, policy_id: str, body: bytes
+    async def _call(
+        self, origin_code: int, policy_id: str, tail: bytes
     ) -> tuple[int, bytes]:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         pid = policy_id.encode()
@@ -355,10 +381,15 @@ class BridgeClient:
             self._pending[req_id] = fut
             _write_frame(
                 self._writer,
-                _REQ_HEADER.pack(req_id, origin_code, len(pid)) + pid + body,
+                _REQ_HEADER.pack(req_id, origin_code, len(pid)) + pid + tail,
             )
             await self._writer.drain()
         return await fut
+
+    async def call(
+        self, origin_code: int, policy_id: str, body: bytes
+    ) -> tuple[int, bytes]:
+        return await self._call(origin_code, policy_id, body)
 
     async def call_parsed(
         self,
@@ -367,23 +398,11 @@ class BridgeClient:
         header: bytes,
         payload: bytes,
     ) -> tuple[int, bytes]:
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        pid = policy_id.encode()
-        async with self._lock:
-            await self._ensure_connected()
-            self._next_id += 1
-            req_id = self._next_id
-            self._pending[req_id] = fut
-            _write_frame(
-                self._writer,
-                _REQ_HEADER.pack(req_id, origin_code, len(pid))
-                + pid
-                + _PARSED_EXTRA.pack(len(header))
-                + header
-                + payload,
-            )
-            await self._writer.drain()
-        return await fut
+        return await self._call(
+            origin_code,
+            policy_id,
+            _PARSED_EXTRA.pack(len(header)) + header + payload,
+        )
 
 
 def build_worker_app(bridge: BridgeClient, hostname: str):
@@ -393,49 +412,30 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
 
     from policy_server_tpu.telemetry.tracing import span
 
-    def extract_span_fields(doc: Any) -> dict:
-        if not isinstance(doc, Mapping):
-            return {}
-        req = doc.get("request")
-        if not isinstance(req, Mapping):
-            return {}
-        kind = req.get("kind") or {}
-        resource = req.get("resource") or {}
-        return {
-            "request_uid": req.get("uid"),
-            "name": req.get("name"),
-            "namespace": req.get("namespace"),
-            "operation": req.get("operation"),
-            "kind_version": (kind.get("version") if isinstance(kind, Mapping) else None),
-            "kind": (kind.get("kind") if isinstance(kind, Mapping) else None),
-            "resource": (resource.get("resource") if isinstance(resource, Mapping) else None),
-        }
-
     def make_admission_handler(parsed_origin: int, span_name: str):
         """validate/audit: the WORKER parses and validates the review
         (422s never cross the bridge) and ships a parsed frame the
-        evaluation process consumes without re-parsing."""
+        evaluation process consumes without re-parsing. Parse/422 mapping
+        and span fields come from api/handlers — one contract regardless
+        of which process accepted the socket."""
         from policy_server_tpu.api.api_error import json_body_error
-        from policy_server_tpu.models import AdmissionReviewRequest
+        from policy_server_tpu.api.handlers import (
+            BodyError,
+            _span_fields_from_admission,
+            parse_admission_review_bytes,
+        )
 
         async def handler(request: web.Request) -> web.Response:
             policy_id = request.match_info["policy_id"]
             body = await request.read()
             try:
-                doc = json.loads(body)
-                review = AdmissionReviewRequest.from_dict(doc)
-            except (json.JSONDecodeError, UnicodeDecodeError) as e:
-                return json_body_error(
-                    f"Failed to parse the request body as JSON: {e}"
-                )
-            except (KeyError, TypeError, ValueError, AttributeError) as e:
-                return json_body_error(
-                    f"Failed to deserialize the JSON body: {e}"
-                )
+                review = parse_admission_review_bytes(body)
+            except BodyError as e:
+                return json_body_error(e.message)
             adm = review.request
             with span(
                 span_name, host=hostname, policy_id=policy_id,
-                **extract_span_fields(doc),
+                **_span_fields_from_admission(review),
             ) as fields:
                 header = json.dumps(
                     {
@@ -490,7 +490,9 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
                 status=status, body=payload, content_type="application/json"
             )
 
-    app = web.Application(client_max_size=MAX_BODY)
+    from policy_server_tpu.api.handlers import MAX_BODY_BYTES
+
+    app = web.Application(client_max_size=MAX_BODY_BYTES)
     app.router.add_post(
         "/validate/{policy_id}",
         make_admission_handler(ORIGIN_VALIDATE_PARSED, "validation"),

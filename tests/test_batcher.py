@@ -236,7 +236,7 @@ def test_submit_async_waits_without_blocking_loop(env):
         fut = await batcher.submit_async(
             "priv", pod_review("d", False), RequestOrigin.VALIDATE
         )
-        return await asyncio.wrap_future(fut)
+        return await fut
 
     resp = asyncio.run(go())
     assert not resp.allowed and resp.status.code == 429
@@ -302,7 +302,7 @@ def test_shutdown_resolves_overload_waiters(env):
             for _ in range(12)  # > overload pool width of 8
         ]
         await asyncio.get_running_loop().run_in_executor(None, batcher.shutdown)
-        return await asyncio.gather(*(asyncio.wrap_future(f) for f in futs))
+        return await asyncio.gather(*futs)
 
     responses = asyncio.run(asyncio.wait_for(go(), timeout=30))
     assert len(responses) == 12
