@@ -136,6 +136,7 @@ class EvaluationBridge:
         self.state = state
         self.socket_path = socket_path
         self._server: asyncio.AbstractServer | None = None
+        self._connections: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_unix_server(
@@ -145,6 +146,15 @@ class EvaluationBridge:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+        # close established connections BEFORE wait_closed(): workers
+        # detect the bridge's death through EOF (their read loops fail
+        # in-flight requests fast and reconnect later), and Python 3.12's
+        # wait_closed() blocks until connection handlers finish — a live
+        # _serve_connection parked in a read would deadlock the stop
+        for writer in list(self._connections):
+            writer.close()
+        self._connections.clear()
+        if self._server is not None:
             await self._server.wait_closed()
             self._server = None
 
@@ -153,6 +163,7 @@ class EvaluationBridge:
     ) -> None:
         lock = asyncio.Lock()  # frame writes must not interleave
         tasks: set[asyncio.Task] = set()
+        self._connections.add(writer)
         try:
             while True:
                 frame = await _read_frame(reader)
@@ -164,6 +175,7 @@ class EvaluationBridge:
                 tasks.add(task)
                 task.add_done_callback(tasks.discard)
         finally:
+            self._connections.discard(writer)
             for t in tasks:
                 t.cancel()
             writer.close()

@@ -83,6 +83,50 @@ def test_audit_and_raw_through_workers(prefork_server):
     assert r.status_code == 404
 
 
+def test_bridge_client_reconnects_after_bridge_restart():
+    """An evaluation-process restart (bridge gone, then back) must fail
+    in-flight requests fast and RECOVER on the next call — the worker
+    process stays up through it."""
+    import asyncio
+    import os
+    import tempfile
+
+    from policy_server_tpu.runtime.frontend import (
+        ORIGIN_RAW,
+        BridgeClient,
+        EvaluationBridge,
+    )
+
+    class EchoState:  # minimal ApiServerState stand-in is unnecessary:
+        pass  # the raw path 422s before touching the batcher
+
+    sock = os.path.join(tempfile.mkdtemp(prefix="bridge-test-"), "b.sock")
+
+    async def scenario() -> None:
+        bridge = EvaluationBridge(EchoState(), sock)
+        await bridge.start()
+        client = BridgeClient(sock)
+        await client.connect()
+        # raw path with junk body → mapped 422 through the bridge
+        status, body = await client.call(ORIGIN_RAW, "p", b"not json")
+        assert status == 422
+
+        # bridge dies (evaluation process restart)
+        await bridge.stop()
+        os.unlink(sock)
+        with pytest.raises(ConnectionError):
+            await client.call(ORIGIN_RAW, "p", b"not json")
+
+        # bridge returns on the same path; the client reconnects by itself
+        bridge2 = EvaluationBridge(EchoState(), sock)
+        await bridge2.start()
+        status, _ = await client.call(ORIGIN_RAW, "p", b"not json")
+        assert status == 422
+        await bridge2.stop()
+
+    asyncio.run(scenario())
+
+
 def test_worker_shutdown_with_server(prefork_server):
     """Covered implicitly by fixture teardown; here assert bridge socket
     path exists while serving."""
