@@ -289,35 +289,61 @@ class PolicyServer:
         self._bridge = EvaluationBridge(self.state, self._bridge_socket)
         await self._bridge.start()
         n = self.config.http_workers - 1  # this process serves too
+        self._worker_cmd = [
+            sys.executable,
+            "-m",
+            "policy_server_tpu.runtime.frontend",
+            "--socket", self._bridge_socket,
+            "--addr", self.config.addr,
+            "--port", str(self.api_port),
+            "--hostname", self.config.hostname,
+            "--log-level", self.config.log_level,
+            "--log-fmt",
+            self.config.log_fmt
+            if self.config.log_fmt != "otlp"
+            else "json",  # workers log; spans stay in-process
+        ]
         for i in range(n):
-            self._worker_procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable,
-                        "-m",
-                        "policy_server_tpu.runtime.frontend",
-                        "--socket", self._bridge_socket,
-                        "--addr", self.config.addr,
-                        "--port", str(self.api_port),
-                        "--hostname", self.config.hostname,
-                        "--log-level", self.config.log_level,
-                        "--log-fmt",
-                        self.config.log_fmt
-                        if self.config.log_fmt != "otlp"
-                        else "json",  # workers log; spans stay in-process
-                    ]
-                )
-            )
+            self._worker_procs.append(subprocess.Popen(self._worker_cmd))
         logger.info(
             "prefork HTTP frontend started",
             extra={"span_fields": {
                 "workers": n + 1, "bridge": self._bridge_socket,
             }},
         )
+        self._worker_supervisor = asyncio.ensure_future(
+            self._supervise_workers()
+        )
+
+    _WORKER_RESPAWN_INTERVAL_SECONDS = 2.0
+
+    async def _supervise_workers(self) -> None:
+        """Respawn dead frontend workers (the in-box analog of kubelet
+        restarting reference replicas): a crashed worker otherwise shrinks
+        the SO_REUSEPORT accept pool until restart."""
+        import subprocess
+        import sys
+
+        while True:
+            await asyncio.sleep(self._WORKER_RESPAWN_INTERVAL_SECONDS)
+            for i, proc in enumerate(list(self._worker_procs)):
+                if proc.poll() is None:
+                    continue
+                logger.warning(
+                    "frontend worker died (rc=%s); respawning", proc.returncode
+                )
+                self._worker_procs[i] = subprocess.Popen(self._worker_cmd)
 
     async def stop(self) -> None:
         import contextlib
         import os as _os
+
+        supervisor = getattr(self, "_worker_supervisor", None)
+        if supervisor is not None:
+            supervisor.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await supervisor
+            self._worker_supervisor = None
 
         for proc in self._worker_procs:
             with contextlib.suppress(ProcessLookupError):

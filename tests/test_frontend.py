@@ -127,6 +127,31 @@ def test_bridge_client_reconnects_after_bridge_restart():
     asyncio.run(scenario())
 
 
+def test_dead_worker_is_respawned(prefork_server):
+    """Supervision: killing a worker process must not permanently shrink
+    the accept pool — the parent respawns it within the supervise
+    interval."""
+    victim = prefork_server.server._worker_procs[0]
+    victim.kill()
+    victim.wait(timeout=10)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        procs = prefork_server.server._worker_procs
+        if len(procs) == 2 and all(p.poll() is None for p in procs):
+            break
+        time.sleep(0.2)
+    procs = prefork_server.server._worker_procs
+    assert len(procs) == 2 and all(p.poll() is None for p in procs)
+    # the respawned worker serves (kernel rebalances new connections)
+    time.sleep(1.0)
+    for _ in range(6):
+        r = fresh_post(
+            prefork_server.url("/validate/pod-privileged"),
+            pod_review_body(False),
+        )
+        assert r.status_code == 200
+
+
 def test_worker_shutdown_with_server(prefork_server):
     """Covered implicitly by fixture teardown; here assert bridge socket
     path exists while serving."""
