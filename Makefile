@@ -22,6 +22,10 @@ integration-tests:
 bench:
 	python bench.py
 
+# property-based differential fuzzing (device vs IR-oracle vs wasm)
+fuzz:
+	python -m pytest tests/test_fuzz_differential.py tests/test_differential.py -q
+
 # native host encoder (ops/fastenc.py compiles on demand into build/)
 fastenc:
 	python -c "from policy_server_tpu.ops import fastenc; print(fastenc._build_library())"
