@@ -133,6 +133,11 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
         ("--batch-timeout-ms", "KUBEWARDEN_BATCH_TIMEOUT_MS",
          dict(type=float, default=1.0, metavar="MS",
               help="Maximum time a request waits for its micro-batch to fill")),
+        ("--host-fastpath-threshold", "KUBEWARDEN_HOST_FASTPATH_THRESHOLD",
+         dict(type=int, default=64, metavar="N",
+              help="Micro-batches with at most N requests are answered by "
+                   "the bit-exact host oracle instead of a device dispatch "
+                   "(latency fast-path; 0 disables)")),
         ("--mesh", "KUBEWARDEN_MESH",
          dict(default="auto", metavar="MESH_SPEC",
               help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),

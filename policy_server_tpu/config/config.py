@@ -159,6 +159,9 @@ class Config:
     evaluation_backend: str = "jax"
     max_batch_size: int = 128
     batch_timeout_ms: float = 1.0
+    # latency fast-path: micro-batches ≤ this size are answered by the
+    # bit-exact host oracle instead of paying a device round-trip
+    host_fastpath_threshold: int = 64
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -288,6 +291,7 @@ class Config:
             evaluation_backend=args.evaluation_backend,
             max_batch_size=args.max_batch_size,
             batch_timeout_ms=float(args.batch_timeout_ms),
+            host_fastpath_threshold=int(args.host_fastpath_threshold),
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

@@ -376,6 +376,9 @@ class EvaluationEnvironment:
         )
         self._fused = jax.jit(self._forward)
         self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
+        # Serving-layer host fast-path counter (validate_batch(prefer_host=
+        # True) rows answered by the targeted host oracle; metrics surface)
+        self.host_fastpath_requests = 0
         # memoized service-layer lookups (immutable registry; unknown ids
         # still raise through the uncached path)
         self._mode_cache: dict[str, PolicyMode] = {}
@@ -787,6 +790,38 @@ class EvaluationEnvironment:
         for hook in pre_eval_hooks_of(target):
             hook(payload)
 
+    def _oracle_outputs_for(
+        self, target: BoundPolicy | BoundGroup, payload: Any
+    ) -> dict[str, Any]:
+        """Targeted host-oracle evaluation: only the programs the target's
+        materializer reads (one policy, or a group's members + expression).
+        This is the latency fast-path kernel — cost is proportional to the
+        addressed policy, not the whole loaded set (contrast
+        _oracle_outputs, the full-registry fallback)."""
+        out: dict[str, Any] = {}
+        if isinstance(target, BoundGroup):
+            member_allowed: dict[str, bool] = {}
+            for m, bp in target.members.items():
+                allowed, rule_idx = oracle_mod.evaluate_program(
+                    bp.precompiled.program, payload
+                )
+                out[f"p:{bp.policy_id}:allowed"] = allowed
+                out[f"p:{bp.policy_id}:rule"] = rule_idx
+                member_allowed[m] = bool(allowed)
+            verdict, evaluated = groups_mod.evaluate_group_host(
+                target.ast, member_allowed
+            )
+            out[f"g:{target.name}:allowed"] = verdict
+            for m in target.members:
+                out[f"g:{target.name}:eval:{m}"] = evaluated.get(m, False)
+            return out
+        allowed, rule_idx = oracle_mod.evaluate_program(
+            target.precompiled.program, payload
+        )
+        out[f"p:{target.policy_id}:allowed"] = allowed
+        out[f"p:{target.policy_id}:rule"] = rule_idx
+        return out
+
     def _oracle_outputs(self, payload: Any) -> dict[str, Any]:
         """Host-interpreter evaluation of every policy + group (scalar
         outputs, same keys as the device path)."""
@@ -811,10 +846,19 @@ class EvaluationEnvironment:
 
     # -- batched evaluation (the micro-batcher's device path) --------------
 
+    @property
+    def supports_host_fastpath(self) -> bool:
+        """True when validate_batch(prefer_host=True) short-circuits the
+        device: the scheduler (runtime/batcher.py) may answer small or
+        latency-critical batches on the host. Only meaningful on the jax
+        backend — the oracle backend is already host-side."""
+        return self.backend == "jax"
+
     def validate_batch(
         self,
         items: list[tuple[str, ValidateRequest]],
         run_hooks: bool = True,
+        prefer_host: bool = False,
     ) -> list[AdmissionResponse | Exception]:
         """Evaluate many (policy_id, request) pairs in ONE device dispatch.
 
@@ -827,9 +871,19 @@ class EvaluationEnvironment:
         Per-item failures (unknown id, initialization error) come back as
         Exception entries rather than failing the batch; SchemaOverflow rows
         fall back to the host oracle (SURVEY.md §7.4 escape hatch).
+
+        ``prefer_host=True`` (the scheduler's latency fast-path) answers
+        every IR row with the TARGETED host oracle instead of a device
+        dispatch — bit-exact by the differential suite's guarantee, and
+        microseconds instead of a device round-trip. The direct API
+        (prefer_host=False, the default) always exercises the device, so
+        differential tests comparing this environment against the oracle
+        backend stay non-circular.
         """
         if self._closed:
             raise RuntimeError("environment closed")
+        if prefer_host and self.backend == "jax":
+            return self._validate_batch_hostpath(items, run_hooks)
         if self.native_encoding and self.backend == "jax":
             # chunks to max_dispatch_batch internally, with pipelining
             return self._validate_batch_native(items, run_hooks)
@@ -891,6 +945,43 @@ class EvaluationEnvironment:
                 results[i] = self._materialize(
                     targets[i], request, _RowView(outputs, row)
                 )
+        return results  # type: ignore[return-value]
+
+    def _validate_batch_hostpath(
+        self,
+        items: list[tuple[str, ValidateRequest]],
+        run_hooks: bool,
+    ) -> list[AdmissionResponse | Exception]:
+        """The latency fast-path: per-item semantics identical to the device
+        path (lookup, hooks, wasm routing, context snapshot), but IR
+        verdicts come from the targeted host oracle — no encode, no
+        transfer, no device round-trip. The reference's per-request sync
+        path (src/api/handlers.rs:256-286) answers one request in ~1 ms on
+        CPU; this is the build's equivalent for batches too small to
+        amortize the device dispatch."""
+        results: list[AdmissionResponse | Exception | None] = [None] * len(items)
+        n_host = 0
+        for i, (policy_id, request) in enumerate(items):
+            try:
+                target = self._lookup_top_level(PolicyID.parse(policy_id))
+                payload = self.payload_for(target, request)
+                if run_hooks and pre_eval_hooks_of(target):
+                    self._run_pre_eval_hooks(target, payload)
+                    payload = self.payload_for(target, request)
+                if self._host_executed(target):
+                    results[i] = self._materialize_single(
+                        target, request.uid(), payload, {}
+                    )
+                    continue
+                results[i] = self._materialize(
+                    target, request, self._oracle_outputs_for(target, payload)
+                )
+                n_host += 1
+            except Exception as e:  # noqa: BLE001 — per-item error channel
+                results[i] = e
+        if n_host:
+            with self._fallback_lock:
+                self.host_fastpath_requests += n_host
         return results  # type: ignore[return-value]
 
     def _validate_batch_native(

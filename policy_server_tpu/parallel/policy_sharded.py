@@ -170,10 +170,28 @@ class PolicyShardedEvaluator:
     ) -> AdmissionResponse:
         return self._shard_of(policy_id).validate(policy_id, request)
 
+    @property
+    def host_fastpath_requests(self) -> int:
+        shards, _ = self._routing
+        return sum(env.host_fastpath_requests for env in shards)
+
+    @property
+    def oracle_fallbacks(self) -> int:
+        shards, _ = self._routing
+        return sum(env.oracle_fallbacks for env in shards)
+
+    @property
+    def supports_host_fastpath(self) -> bool:
+        """MicroBatcher latency fast-path capability (see
+        EvaluationEnvironment.supports_host_fastpath)."""
+        shards, _ = self._routing
+        return all(env.supports_host_fastpath for env in shards)
+
     def validate_batch(
         self,
         items: list[tuple[str, ValidateRequest]],
         run_hooks: bool = True,
+        prefer_host: bool = False,
     ) -> list[AdmissionResponse | Exception]:
         """Partition the batch by owning shard, dispatch every shard's fused
         program, merge in submission order. Shard dispatches overlap via
@@ -191,7 +209,7 @@ class PolicyShardedEvaluator:
         for idx, indices in per_shard.items():
             shard_items = [items[i] for i in indices]
             shard_results = shards[idx].validate_batch(
-                shard_items, run_hooks=run_hooks
+                shard_items, run_hooks=run_hooks, prefer_host=prefer_host
             )
             for i, r in zip(indices, shard_results):
                 results[i] = r

@@ -125,11 +125,24 @@ class MicroBatcher:
         batch_timeout_ms: float = 1.0,
         policy_timeout: float | None = 2.0,
         queue_capacity: int | None = None,
+        host_fastpath_threshold: int = 64,
     ) -> None:
         self.env = env
         self.max_batch_size = max(1, int(max_batch_size))
         self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
         self.policy_timeout = policy_timeout
+        # Latency fast-path: a formed batch with ≤ this many runnable items
+        # is answered by the environment's targeted host oracle (bit-exact
+        # with the device program by the differential suite) instead of
+        # paying a device round-trip — the batched analog of the
+        # reference's per-request sync path (src/api/handlers.rs:256-286).
+        # 0 disables. Under load the queue is deep, batches form at
+        # max_batch_size, and everything rides the device; the fast-path
+        # engages exactly when occupancy is low and latency dominates.
+        self.host_fastpath_threshold = max(0, int(host_fastpath_threshold))
+        self._env_fastpath = bool(
+            getattr(env, "supports_host_fastpath", False)
+        )
         self._queue: queue.Queue[_Pending] = queue.Queue(
             maxsize=queue_capacity or self.max_batch_size * 8
         )
@@ -173,6 +186,7 @@ class MicroBatcher:
         self.batches_dispatched = 0
         self.requests_dispatched = 0
         self.deadline_abandoned_batches = 0  # introspection for tests/metrics
+        self.host_fastpath_batches = 0  # batches answered host-side
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -551,19 +565,52 @@ class MicroBatcher:
         # hooks, matching the reference's mid-execution epoch interrupt
         # (src/lib.rs:176-190, tests/integration_test.rs:417).
         pairs = [(p.policy_id, p.request) for p in runnable]
+        # Latency fast-path decision: small batch ⇒ answer on the host.
+        # Occupancy is the signal — a batch this small means the queue was
+        # shallow when it formed, so the requests are latency-critical,
+        # not throughput traffic.
+        use_host = (
+            self._env_fastpath
+            and 0 < len(runnable) <= self.host_fastpath_threshold
+        )
+        if use_host:
+            with self._stats_lock:
+                self.host_fastpath_batches += 1
         dispatch_start_ns = time.time_ns()
         if self.policy_timeout is None:
-            # reference parity: timeout disabled ⇒ unbounded execution
+            # reference parity: timeout disabled ⇒ unbounded execution,
+            # run inline (host fast-path or device alike)
             try:
-                results = self.env.validate_batch(pairs, run_hooks=False)
+                results = (
+                    self.env.validate_batch(
+                        pairs, run_hooks=False, prefer_host=True
+                    )
+                    if use_host
+                    else self.env.validate_batch(pairs, run_hooks=False)
+                )
             except Exception as e:  # noqa: BLE001
                 for p in runnable:
                     self._fail(p, e)
                 return
             live = runnable
         else:
-            dev_future = self._device_pool.submit(
-                self.env.validate_batch, pairs, run_hooks=False
+            # BOTH paths run under the dispatch watchdog: the host
+            # fast-path is µs for IR rows, but a batch may carry
+            # host-executed wasm rows (fuel bounds instructions, not
+            # wall-clock) or slow context providers — no request future
+            # may outlive policy_timeout unresolved, whichever path
+            # served it.
+            dev_future = (
+                self._device_pool.submit(
+                    self.env.validate_batch,
+                    pairs,
+                    run_hooks=False,
+                    prefer_host=True,
+                )
+                if use_host
+                else self._device_pool.submit(
+                    self.env.validate_batch, pairs, run_hooks=False
+                )
             )
             try:
                 results, live = self._watchdog_wait(dev_future, runnable)

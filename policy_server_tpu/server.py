@@ -149,6 +149,7 @@ class PolicyServer:
             batch_timeout_ms=config.batch_timeout_ms,
             policy_timeout=config.policy_timeout,
             queue_capacity=config.pool_size * config.max_batch_size,
+            host_fastpath_threshold=config.host_fastpath_threshold,
         )
         if config.warmup_at_boot and config.evaluation_backend == "jax":
             batcher.warmup()
@@ -186,6 +187,16 @@ class PolicyServer:
                 "policy_server_oracle_fallbacks", "counter",
                 "Requests routed to the host oracle (schema overflow)",
                 getattr(environment, "oracle_fallbacks", 0) or 0,
+            )
+            yield (
+                "policy_server_host_fastpath_batches", "counter",
+                "Micro-batches answered by the host latency fast-path",
+                batcher.host_fastpath_batches,
+            )
+            yield (
+                "policy_server_host_fastpath_requests", "counter",
+                "Requests answered by the host latency fast-path",
+                getattr(environment, "host_fastpath_requests", 0) or 0,
             )
 
         from policy_server_tpu.telemetry import default_registry

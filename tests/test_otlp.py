@@ -242,7 +242,7 @@ def test_batcher_emits_child_spans_with_propagated_trace_id(collector):
     env = EvaluationEnvironmentBuilder(backend="jax").build(
         {"priv": parse_policy_entry("priv", {"module": "builtin://pod-privileged"})}
     )
-    batcher = MicroBatcher(env, max_batch_size=4, batch_timeout_ms=1.0).start()
+    batcher = MicroBatcher(env, host_fastpath_threshold=0, max_batch_size=4, batch_timeout_ms=1.0).start()
     try:
         req = ValidateRequest.from_admission(
             AdmissionReviewRequest.from_dict(build_admission_review_dict()).request

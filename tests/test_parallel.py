@@ -259,7 +259,7 @@ def test_sharded_evaluator_hooks_through_batcher():
 
     mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
     sharded = PolicyShardedEvaluator(parse_all(POLICIES), mesh)
-    batcher = MicroBatcher(sharded, max_batch_size=4, batch_timeout_ms=1.0).start()
+    batcher = MicroBatcher(sharded, host_fastpath_threshold=0, max_batch_size=4, batch_timeout_ms=1.0).start()
     try:
         resp = batcher.evaluate(
             "priv", pod_request("default", True), RequestOrigin.VALIDATE,

@@ -66,7 +66,8 @@ def test_hung_device_call_rejects_in_band_and_loop_survives(env):
 
     env.validate_batch = hanging_validate_batch
     batcher = MicroBatcher(
-        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.5
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.5,
+        host_fastpath_threshold=0,  # these tests exercise the DEVICE path
     ).start()
     try:
         t0 = time.perf_counter()
@@ -102,7 +103,8 @@ def test_cold_bucket_compile_stall_bounded_then_fast(env):
 
     env.validate_batch = stalling_validate_batch
     batcher = MicroBatcher(
-        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.4
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.4,
+        host_fastpath_threshold=0,
     ).start()
     try:
         cold = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
@@ -128,7 +130,8 @@ def test_timeout_disabled_keeps_unbounded_execution(env):
 
     env.validate_batch = slow_validate_batch
     batcher = MicroBatcher(
-        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=None
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=None,
+        host_fastpath_threshold=0,
     ).start()
     try:
         fut = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
@@ -156,7 +159,8 @@ def test_partial_expiry_late_items_still_served(env):
     # max_batch_size=1 → each submission is its own batch; the first wedges
     # one device worker, the second runs concurrently on another.
     batcher = MicroBatcher(
-        env, max_batch_size=1, batch_timeout_ms=0.1, policy_timeout=0.6
+        env, max_batch_size=1, batch_timeout_ms=0.1, policy_timeout=0.6,
+        host_fastpath_threshold=0,
     ).start()
     try:
         doomed = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
