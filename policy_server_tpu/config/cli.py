@@ -184,12 +184,18 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
 
 
 def long_version() -> str:
-    """``--long-version`` banner: version + the predicate-IR op registry
-    (reference prints the OPA builtins, cli.rs:7-21)."""
+    """``--long-version`` banner: version + the predicate-IR op registry +
+    the OPA builtins host registry (reference prints the burrego builtins,
+    cli.rs:7-21)."""
     from policy_server_tpu.ops.ir import registered_op_names
+    from policy_server_tpu.wasm.builtins import get_builtins
 
     ops = "\n".join(f"  - {name}" for name in registered_op_names())
-    return f"{PROG} {__version__}\npredicate IR ops:\n{ops}"
+    builtins = "\n".join(f"  - {name}" for name in sorted(get_builtins()))
+    return (
+        f"{PROG} {__version__}\npredicate IR ops:\n{ops}\n\n"
+        f"Open Policy Agent/Gatekeeper implemented builtins:\n{builtins}"
+    )
 
 
 def build_cli() -> argparse.ArgumentParser:

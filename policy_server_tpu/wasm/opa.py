@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
+from policy_server_tpu.wasm import builtins as builtins_mod
 from policy_server_tpu.wasm.binary import WasmModule, ensure_module
 from policy_server_tpu.wasm.interp import Instance, Memory, WasmTrap
 
@@ -54,6 +55,16 @@ class OpaPolicy:
         missing = required - exports
         if missing:
             raise OpaError(f"not an OPA wasm module (missing {sorted(missing)})")
+        # id → name for host-dispatched builtins, from the module's own
+        # builtins() declaration (the OPA wasm ABI contract; burrego reads
+        # the same export). Resolved once at load; {} when the module
+        # declares none.
+        self._builtin_names: dict[int, str] = {}
+        if "builtins" in exports:
+            declared = self.builtins()
+            self._builtin_names = {
+                int(v): str(k) for k, v in declared.items()
+            }
 
     # -- instantiation ------------------------------------------------------
 
@@ -70,10 +81,29 @@ class OpaPolicy:
 
         def builtin(n: int) -> Callable:
             def call(instance: Instance, builtin_id: int, ctx: int, *args: int) -> int:
-                raise WasmTrap(
-                    f"OPA builtin {builtin_id} (arity {n}) is not provided "
-                    "by this host"
-                )
+                name = self._builtin_names.get(builtin_id)
+                impl = builtins_mod.REGISTRY.get(name) if name else None
+                if impl is None:
+                    label = f"{builtin_id} ({name})" if name else str(builtin_id)
+                    raise WasmTrap(
+                        f"OPA builtin {label} (arity {n}) is not provided "
+                        "by this host"
+                    )
+                # decode each arg through the guest's own serializer, run
+                # the host implementation, re-enter the guest to intern the
+                # result (burrego round-trips values the same way). EVERY
+                # host failure — BuiltinError, arity-mismatch TypeError,
+                # decode errors from a hostile module — must surface as a
+                # WasmTrap so the policy layer maps it to an in-band
+                # rejection, never a crashed request handler.
+                try:
+                    decoded = [self._dump_value(instance, a) for a in args]
+                    result = impl(*decoded)
+                except WasmTrap:
+                    raise
+                except Exception as e:
+                    raise WasmTrap(f"OPA builtin {name}: {e}") from e
+                return self._parse_value(instance, result)
 
             return call
 
@@ -91,6 +121,25 @@ class OpaPolicy:
     def instantiate(self) -> Instance:
         imports, _aborts = self._imports()
         return Instance(self.module, imports, fuel=self.fuel)
+
+    # -- host-builtin value marshalling -------------------------------------
+
+    @staticmethod
+    def _dump_value(instance: Instance, addr: int) -> Any:
+        """Guest OPA value → decoded JSON, via the guest's opa_json_dump."""
+        dumped = instance.invoke("opa_json_dump", addr)[0]
+        return json.loads(_read_cstring(instance, dumped).decode())
+
+    @staticmethod
+    def _parse_value(instance: Instance, value: Any) -> int:
+        """Host JSON value → guest OPA value address."""
+        raw = json.dumps(value).encode()
+        addr = instance.invoke("opa_malloc", len(raw))[0]
+        instance.memory.write(addr, raw)
+        parsed = instance.invoke("opa_json_parse", addr, len(raw))[0]
+        if parsed == 0:
+            raise WasmTrap("opa_json_parse failed for builtin result")
+        return parsed
 
     # -- evaluation ---------------------------------------------------------
 

@@ -1,0 +1,241 @@
+"""A WAT-authored OPA-ABI wasm module that calls host builtins.
+
+Rego cannot be compiled to wasm in this offline environment, so this
+module plays the role of an opa-compiled policy for the builtins-registry
+tests: it exports the full OPA eval surface (opa_malloc / opa_json_parse /
+opa_json_dump / opa_eval_ctx_* / eval / builtins / entrypoints), declares
+four host builtins in its ``builtins()`` map exactly like the OPA wasm
+compiler does, and its ``eval`` drives them through ``opa_builtin1/2``:
+
+1. ``json.marshal(input)``          — serializes the whole input document,
+2. ``regex.match(pat, marshaled)``  — the policy's decision predicate,
+3. ``sprintf(fmt, args)``           — the violation message,
+4. ``units.parse_bytes("128Mi")``   — a numeric round-trip.
+
+Value representation: an OPA value address is the address of a
+NUL-terminated JSON text (opa_json_parse copies + terminates,
+opa_json_dump is the identity) — a legal ABI choice the host must not
+assume anything about, which is exactly the point: the host only ever
+touches values through the module's own exports, like burrego.
+
+Gatekeeper mapping: a privileged marshaled input produces two violations
+(the sprintf message and the units number); otherwise no violations.
+"""
+
+from __future__ import annotations
+
+import json
+
+from policy_server_tpu.wasm.wat import assemble
+
+BUILTIN_IDS = {
+    "json.marshal": 0,
+    "regex.match": 1,
+    "sprintf": 2,
+    "units.parse_bytes": 3,
+}
+
+PATTERN = '"privileged": *true'
+FMT = "privileged container denied (%s)"
+ARGS = ["pod"]
+UNITS_ARG = "128Mi"
+
+
+def _esc(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def builtin_oracle_wasm(
+    builtin_ids: dict | None = None,
+) -> bytes:
+    """Assemble the fixture; ``builtin_ids`` overrides the declared
+    name → id map (used to test the unknown-builtin failure surface)."""
+    ids = dict(builtin_ids if builtin_ids is not None else BUILTIN_IDS)
+    # JSON texts living in guest memory (each is a VALUE in this module's
+    # representation). Offsets assigned with gaps; memory is zero-filled,
+    # so every text is NUL-terminated by construction.
+    texts = {
+        "BUILTINS": json.dumps(ids),
+        "ENTRYPOINTS": json.dumps({"policy": 0}),
+        "PATTERN": json.dumps(PATTERN),
+        "FMT": json.dumps(FMT),
+        "ARGS": json.dumps(ARGS),
+        "UNITS": json.dumps(UNITS_ARG),
+        "PREFIX": '[{"result":{"violations":[{"msg":',
+        "MID": '},{"msg":',
+        "SUFFIX": '}]}}]',
+        "ACCEPT": '[{"result":{"violations":[]}}]',
+    }
+    off = {}
+    cursor = 16
+    for name, text in texts.items():
+        off[name] = cursor
+        cursor += len(text.encode()) + 16  # NUL gap
+    data = "\n  ".join(
+        f'(data (i32.const {off[name]}) "{_esc(text)}")'
+        for name, text in texts.items()
+    )
+    src = f"""
+(module
+  (import "env" "opa_builtin1" (func $builtin1 (param i32 i32 i32) (result i32)))
+  (import "env" "opa_builtin2" (func $builtin2 (param i32 i32 i32 i32) (result i32)))
+  (memory (export "memory") 2)
+  {data}
+  (global $heap (mut i32) (i32.const 65536))
+  (global $input (mut i32) (i32.const 0))
+  (global $data (mut i32) (i32.const 0))
+  (global $result (mut i32) (i32.const {off['ACCEPT']}))
+
+  (func $malloc (param $n i32) (result i32)
+    (local $p i32)
+    global.get $heap
+    local.set $p
+    global.get $heap
+    local.get $n
+    i32.add
+    i32.const 15
+    i32.add
+    i32.const -8
+    i32.and
+    global.set $heap
+    local.get $p)
+  (export "opa_malloc" (func $malloc))
+
+  (func $strlen (param $p i32) (result i32)
+    (local $n i32)
+    block $done
+      loop $scan
+        local.get $p
+        local.get $n
+        i32.add
+        i32.load8_u
+        i32.eqz
+        br_if $done
+        local.get $n
+        i32.const 1
+        i32.add
+        local.set $n
+        br $scan
+      end
+    end
+    local.get $n)
+
+  ;; append NUL-terminated src at dst, return the new write head
+  (func $append (param $dst i32) (param $src i32) (result i32)
+    (local $n i32)
+    local.get $src
+    call $strlen
+    local.set $n
+    local.get $dst
+    local.get $src
+    local.get $n
+    memory.copy
+    local.get $dst
+    local.get $n
+    i32.add)
+
+  ;; a value IS a NUL-terminated JSON text: parse copies + terminates
+  (func (export "opa_json_parse") (param $addr i32) (param $len i32) (result i32)
+    (local $dst i32)
+    local.get $len
+    i32.const 1
+    i32.add
+    call $malloc
+    local.set $dst
+    local.get $dst
+    local.get $addr
+    local.get $len
+    memory.copy
+    local.get $dst
+    local.get $len
+    i32.add
+    i32.const 0
+    i32.store8
+    local.get $dst)
+
+  (func (export "opa_json_dump") (param $v i32) (result i32)
+    local.get $v)
+
+  (func (export "opa_eval_ctx_new") (result i32)
+    i32.const 8)
+  (func (export "opa_eval_ctx_set_input") (param $ctx i32) (param $v i32)
+    local.get $v
+    global.set $input)
+  (func (export "opa_eval_ctx_set_data") (param $ctx i32) (param $v i32)
+    local.get $v
+    global.set $data)
+  (func (export "opa_eval_ctx_get_result") (param $ctx i32) (result i32)
+    global.get $result)
+
+  (func (export "builtins") (result i32)
+    i32.const {off['BUILTINS']})
+  (func (export "entrypoints") (result i32)
+    i32.const {off['ENTRYPOINTS']})
+
+  (func (export "eval") (param $ctx i32) (result i32)
+    (local $marshaled i32)
+    (local $matched i32)
+    (local $msg i32)
+    (local $units i32)
+    (local $buf i32)
+    (local $p i32)
+    ;; marshaled = json.marshal(input)
+    i32.const {ids.get('json.marshal', 0)}
+    i32.const 0
+    global.get $input
+    call $builtin1
+    local.set $marshaled
+    ;; matched = regex.match(PATTERN, marshaled)
+    i32.const {ids.get('regex.match', 1)}
+    i32.const 0
+    i32.const {off['PATTERN']}
+    local.get $marshaled
+    call $builtin2
+    local.set $matched
+    ;; the value text of true is "true": test its first byte
+    local.get $matched
+    i32.load8_u
+    i32.const 116
+    i32.eq
+    if
+      ;; msg = sprintf(FMT, ARGS); units = units.parse_bytes(UNITS)
+      i32.const {ids.get('sprintf', 2)}
+      i32.const 0
+      i32.const {off['FMT']}
+      i32.const {off['ARGS']}
+      call $builtin2
+      local.set $msg
+      i32.const {ids.get('units.parse_bytes', 3)}
+      i32.const 0
+      i32.const {off['UNITS']}
+      call $builtin1
+      local.set $units
+      ;; result = PREFIX + msg + MID + units + SUFFIX
+      i32.const 4096
+      call $malloc
+      local.set $buf
+      local.get $buf
+      i32.const {off['PREFIX']}
+      call $append
+      local.get $msg
+      call $append
+      i32.const {off['MID']}
+      call $append
+      local.get $units
+      call $append
+      i32.const {off['SUFFIX']}
+      call $append
+      local.set $p
+      local.get $p
+      i32.const 0
+      i32.store8
+      local.get $buf
+      global.set $result
+    else
+      i32.const {off['ACCEPT']}
+      global.set $result
+    end
+    i32.const 0)
+)
+"""
+    return assemble(src)

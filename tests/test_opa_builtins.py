@@ -1,0 +1,275 @@
+"""OPA builtins host registry tests (round-4 VERDICT item 2).
+
+Covers the registry implementations directly, the full wasm ABI dispatch
+(a WAT-authored OPA module declaring builtins and calling them through
+``opa_builtin{1,2}``, tests/opa_builtin_fixture.py), the unknown-builtin
+failure surface, and the serving path end-to-end (the module loaded as a
+policy into the evaluation environment). Reference parity:
+burrego's builtins set and banner (/root/reference/src/cli.rs:7-21)."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.wasm import builtins as bi
+from policy_server_tpu.wasm.opa import OpaPolicy, gatekeeper_validate
+
+from opa_builtin_fixture import builtin_oracle_wasm
+
+
+# ---------------------------------------------------------------------------
+# registry unit tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "fmt,args,expected",
+    [
+        ("hello %s", ["world"], "hello world"),
+        ("%d pods over %d", [3, 2], "3 pods over 2"),
+        ("%v", [{"a": 1}], '{"a": 1}'),
+        ("%v", [True], "true"),
+        ("%05d", [42], "00042"),
+        ("%.2f", [3.14159], "3.14"),
+        ("%x", [255], "ff"),
+        ("%q", ["x"], '"x"'),
+        ("100%%", [], "100%"),
+        ("%s %s", ["only"], "only %!s(MISSING)"),
+    ],
+)
+def test_sprintf(fmt, args, expected):
+    assert bi.REGISTRY["sprintf"](fmt, args) == expected
+
+
+def test_string_builtins():
+    r = bi.REGISTRY
+    assert r["concat"]("/", ["a", "b", "c"]) == "a/b/c"
+    assert r["contains"]("registry.io/img", "/") is True
+    assert r["startswith"]("docker.io/nginx", "docker.io/") is True
+    assert r["endswith"]("img:latest", ":latest") is True
+    assert r["lower"]("ABC") == "abc"
+    assert r["upper"]("abc") == "ABC"
+    assert r["replace"]("a.b.c", ".", "-") == "a-b-c"
+    assert r["split"]("a,b,c", ",") == ["a", "b", "c"]
+    assert r["substring"]("kubernetes", 4, 3) == "rne"
+    assert r["substring"]("kubernetes", 4, -1) == "rnetes"
+    assert r["trim"]("xxaxx", "x") == "a"
+    assert r["trim_space"]("  a\t") == "a"
+    assert r["trim_prefix"]("docker.io/nginx", "docker.io/") == "nginx"
+    assert r["trim_suffix"]("img:latest", ":latest") == "img"
+    assert r["indexof"]("abcdef", "cd") == 2
+    assert r["format_int"](255, 16) == "ff"
+    assert r["format_int"](-7, 2) == "-111"
+
+
+def test_regex_builtins():
+    r = bi.REGISTRY
+    assert r["regex.match"]("^docker\\.io/", "docker.io/nginx") is True
+    assert r["regex.match"]("^ghcr\\.io/", "docker.io/nginx") is False
+    assert r["re_match"]("ngin.", "docker.io/nginx") is True
+    assert r["regex.is_valid"]("a(b") is False
+    assert r["regex.split"](",\\s*", "a, b,c") == ["a", "b", "c"]
+    assert r["regex.find_n"]("[a-z]+", "ab1cd2ef", 2) == ["ab", "cd"]
+    assert r["regex.replace"]("a-b-c", "-", "+") == "a+b+c"
+    # Go replacement syntax: $1/${name} are groups, $$ literal, lone $ literal
+    assert r["regex.replace"]("ab", "(a)(b)", "${2}${1}") == "ba"
+    assert r["regex.replace"]("ab", "(a)(b)", "$2$1") == "ba"
+    assert r["regex.replace"]("price", "price", "cost $5") == "cost "  # Go: missing group -> empty
+    assert r["regex.replace"]("x", "x", "$$1") == "$1"
+    # full-match text even with capture groups
+    assert r["regex.find_n"]("a(b)", "ab ab", -1) == ["ab", "ab"]
+    with pytest.raises(bi.BuiltinError):
+        r["regex.match"]("(bad", "x")
+
+
+def test_glob_builtins():
+    r = bi.REGISTRY
+    # delimiter-aware *: does not cross separators
+    assert r["glob.match"]("registry.io/*", ["/"], "registry.io/img") is True
+    assert r["glob.match"]("registry.io/*", ["/"], "registry.io/a/b") is False
+    assert r["glob.match"]("registry.io/**", ["/"], "registry.io/a/b") is True
+    assert r["glob.match"]("*.example.com", None, "api.example.com") is True
+    assert r["glob.match"]("*.example.com", None, "a.b.example.com") is False
+    assert r["glob.match"]("img-?", ["/"], "img-1") is True
+    assert r["glob.match"]("{a,b}.io", ["."], "b.io") is True
+    assert r["glob.quote_meta"]("a*b") == "a\\*b"
+
+
+def test_set_builtins():
+    r = bi.REGISTRY
+    assert r["intersection"]([[1, 2, 3], [2, 3, 4], [3, 2]]) == [2, 3]
+    assert r["union"]([[1, 2], [2, 3]]) == [1, 2, 3]
+    assert r["intersection"]([]) == []
+
+
+def test_encoding_builtins():
+    r = bi.REGISTRY
+    assert r["json.marshal"]({"a": [1, True]}) == '{"a":[1,true]}'
+    assert r["json.unmarshal"]('{"a":1}') == {"a": 1}
+    assert r["json.is_valid"]("{") is False
+    assert r["base64.encode"]("hi") == "aGk="
+    assert r["base64.decode"]("aGk=") == "hi"
+    assert r["base64.is_valid"]("aGk=") is True
+    assert r["base64.is_valid"]("a?") is False
+    assert r["base64url.encode_no_pad"]("hi") == "aGk"
+    assert r["base64url.decode"]("aGk") == "hi"
+    assert r["urlquery.encode"]("a b&c") == "a+b%26c"
+    assert r["urlquery.decode"]("a+b%26c") == "a b&c"
+
+
+def test_semver_builtins():
+    r = bi.REGISTRY
+    assert r["semver.compare"]("1.2.3", "1.2.3") == 0
+    assert r["semver.compare"]("1.2.3", "1.10.0") == -1
+    assert r["semver.compare"]("2.0.0", "2.0.0-rc.1") == 1
+    assert r["semver.compare"]("1.0.0-alpha", "1.0.0-alpha.1") == -1
+    assert r["semver.is_valid"]("1.2.3-rc.1+build5") is True
+    assert r["semver.is_valid"]("1.2") is False
+    with pytest.raises(bi.BuiltinError):
+        r["semver.compare"]("not-a-version", "1.0.0")
+
+
+def test_units_builtins():
+    r = bi.REGISTRY
+    assert r["units.parse_bytes"]("128Mi") == 128 * 1024 * 1024
+    assert r["units.parse_bytes"]("1GB") == 10**9
+    assert r["units.parse_bytes"]("42") == 42
+    assert r["units.parse"]("500m") == 0.5
+    assert r["units.parse"]("2Ki") == 2048
+    # SI suffixes are case-sensitive: M is mega, m is milli
+    assert r["units.parse"]("1M") == 10**6
+    assert r["units.parse"]("1G") == 10**9
+    with pytest.raises(bi.BuiltinError):
+        r["units.parse_bytes"]("12parsecs")
+
+
+def test_long_version_banners_builtins():
+    from policy_server_tpu.config.cli import long_version
+
+    banner = long_version()
+    assert "Open Policy Agent/Gatekeeper implemented builtins:" in banner
+    assert "  - sprintf" in banner
+    assert "  - regex.match" in banner
+    assert "  - units.parse_bytes" in banner
+
+
+# ---------------------------------------------------------------------------
+# wasm ABI dispatch through the interpreter
+# ---------------------------------------------------------------------------
+
+
+PRIV_REQUEST = {
+    "uid": "u1",
+    "kind": {"group": "", "version": "v1", "kind": "Pod"},
+    "operation": "CREATE",
+    "object": {
+        "spec": {
+            "containers": [
+                {"name": "c", "securityContext": {"privileged": True}}
+            ]
+        }
+    },
+}
+
+OK_REQUEST = {
+    "uid": "u2",
+    "kind": {"group": "", "version": "v1", "kind": "Pod"},
+    "operation": "CREATE",
+    "object": {"spec": {"containers": [{"name": "c"}]}},
+}
+
+
+def test_builtin_dispatch_through_wasm_abi():
+    """The fixture declares 4 builtins and calls them all on the reject
+    path; the violation messages prove every value round-tripped through
+    the guest's own serializer."""
+    policy = OpaPolicy(builtin_oracle_wasm())
+    assert policy.builtins() == {
+        "json.marshal": 0, "regex.match": 1, "sprintf": 2,
+        "units.parse_bytes": 3,
+    }
+    allowed, message = gatekeeper_validate(policy, PRIV_REQUEST)
+    assert allowed is False
+    # sprintf output and the units.parse_bytes number, joined by the
+    # gatekeeper aggregator
+    assert message == "privileged container denied (pod); 134217728"
+    allowed, message = gatekeeper_validate(policy, OK_REQUEST)
+    assert allowed is True
+    assert message is None
+
+
+def test_wrong_arity_builtin_maps_to_wasm_trap():
+    """A module binding a name at the wrong arity (host TypeError) must
+    surface as a WasmTrap → in-band rejection, not a crashed handler."""
+    from policy_server_tpu.wasm.interp import WasmTrap
+
+    # 'lower' is unary; the fixture calls id 1 through opa_builtin2
+    wasm = builtin_oracle_wasm(
+        {"json.marshal": 0, "lower": 1, "sprintf": 2, "units.parse_bytes": 3}
+    )
+    policy = OpaPolicy(wasm)
+    with pytest.raises(WasmTrap, match="OPA builtin lower"):
+        gatekeeper_validate(policy, PRIV_REQUEST)
+
+
+def test_unknown_builtin_fails_loudly():
+    """A module declaring a builtin this host does not implement must fail
+    with a deterministic error naming it (burrego behavior), not crash."""
+    wasm = builtin_oracle_wasm(
+        {"json.marshal": 0, "regex.match": 1, "sprintf": 2,
+         "crypto.x509.parse_certificates": 3}
+    )
+    policy = OpaPolicy(wasm)
+    from policy_server_tpu.wasm.interp import WasmTrap
+
+    with pytest.raises(WasmTrap, match="crypto.x509.parse_certificates"):
+        gatekeeper_validate(policy, PRIV_REQUEST)
+
+
+def test_builtins_through_evaluation_environment(tmp_path):
+    """Serving-path end-to-end: the builtin-calling module loads from a
+    .wasm artifact and serves through the environment (device batch path
+    routes host-executed wasm rows), with in-band builtin verdicts."""
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironmentBuilder,
+    )
+    from policy_server_tpu.fetch.artifact import load_artifact
+    from policy_server_tpu.models import (
+        AdmissionReviewRequest,
+        ValidateRequest,
+    )
+    from policy_server_tpu.models.policy import parse_policy_entry
+
+    import conftest
+
+    wasm_path = tmp_path / "builtins-policy.wasm"
+    wasm_path.write_bytes(builtin_oracle_wasm())
+    module = load_artifact(wasm_path)
+    assert module.abi == "opa-gatekeeper"
+    env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=lambda url: module
+    ).build(
+        {
+            "builtin-policy": parse_policy_entry(
+                "builtin-policy", {"module": "file:///builtins.wasm"}
+            )
+        }
+    )
+
+    def to_request(request_dict):
+        doc = conftest.build_admission_review_dict()
+        doc["request"] = {**doc["request"], **request_dict}
+        return ValidateRequest.from_admission(
+            AdmissionReviewRequest.from_dict(doc).request
+        )
+
+    rejected = env.validate("builtin-policy", to_request(PRIV_REQUEST))
+    assert rejected.allowed is False
+    assert "privileged container denied (pod)" in rejected.status.message
+    accepted = env.validate("builtin-policy", to_request(OK_REQUEST))
+    assert accepted.allowed is True
+    # the host fast-path routes host-executed rows identically
+    (fast,) = env.validate_batch(
+        [("builtin-policy", to_request(PRIV_REQUEST))], prefer_host=True
+    )
+    assert fast.to_dict() == rejected.to_dict()
