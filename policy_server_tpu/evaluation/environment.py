@@ -81,6 +81,11 @@ from policy_server_tpu.utils.interning import InternTable
 
 GROUP_MUTATION_MESSAGE = "mutation is not allowed inside of policy group"
 
+# Device-input feature key carrying host-computed wasm group-member verdict
+# bits, shape (batch, n_wasm_members) bool — how host-executed policies
+# participate in the fused on-device group reduction.
+WASM_BITS_KEY = "__wasm_bits__"
+
 
 class _RowView:
     """Zero-copy row view over the batched output arrays — materializers
@@ -254,17 +259,13 @@ class EvaluationEnvironmentBuilder:
                             False,  # group members never mutate (rs group ban)
                             member.context_aware_resources,
                         )
-                        if member_bp.precompiled.program.host_evaluator is not None:
-                            # group verdicts fuse on-device from member
-                            # bits; host-executed (wasm) members have no
-                            # device bits — unsupported in this build
-                            raise PolicyInitializationError(
-                                member_pid,
-                                "wasm-executed policies cannot be members "
-                                "of a policy group (their verdicts are "
-                                "host-side; group expressions fuse on the "
-                                "device)",
-                            )
+                        # wasm-executed members are supported: their
+                        # verdicts are computed host-side at encode time
+                        # and fed into the fused group reduction as device
+                        # input bits (WASM_BITS_KEY), matching the
+                        # reference's free composition of any loaded
+                        # policy into groups
+                        # (evaluation_environment.rs:596-651)
                         group.members[member_name] = member_bp
                     groups[name] = group
                     for member_name, bp in group.members.items():
@@ -374,6 +375,27 @@ class EvaluationEnvironment:
         self._max_group_members = max(
             (len(g.members) for g in groups.values()), default=0
         )
+        # Host-executed (wasm) group members: their verdict bits enter the
+        # fused program as the WASM_BITS_KEY input, one column per member
+        # in this order. Standalone wasm policies are not listed — they
+        # bypass the device entirely (_host_executed).
+        self._wasm_member_order = [
+            bp.policy_id
+            for g in groups.values()
+            for bp in g.members.values()
+            if bp.precompiled.program.host_evaluator is not None
+        ]
+        self._wasm_member_col = {
+            pid: j for j, pid in enumerate(self._wasm_member_order)
+        }
+        self._groups_with_wasm = {
+            g.name
+            for g in groups.values()
+            if any(
+                bp.precompiled.program.host_evaluator is not None
+                for bp in g.members.values()
+            )
+        }
         self._fused = jax.jit(self._forward)
         self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
         # Serving-layer host fast-path counter (validate_batch(prefer_host=
@@ -599,7 +621,11 @@ class EvaluationEnvironment:
                 break
         assert layout is not None, "no schema matches packed buffer width"
         batch = buf.shape[0]
-        out: dict[str, Any] = {}
+        # side-channel inputs riding alongside the packed buffer (wasm
+        # member verdict bits) pass through untouched
+        out: dict[str, Any] = {
+            k: v for k, v in features.items() if k != PACKED_KEY
+        }
         if layout.total32:
             # int32 tail region: groups of 4 bytes bitcast to int32 (slice
             # the exact region — widened layouts carry trailing pad bytes)
@@ -635,6 +661,15 @@ class EvaluationEnvironment:
         per_policy: dict[str, tuple[Any, Any]] = {}
         for pid, fn in self._compiled.items():
             per_policy[pid] = fn(features)
+        # Host-executed group members: their compiled programs are inert
+        # placeholders — the real verdicts arrive as input bits, computed
+        # by the host wasm engine at encode time, and join the fused group
+        # reduction here like any other member column.
+        if self._wasm_member_order:
+            bits = jnp.asarray(features[WASM_BITS_KEY])
+            zero_rule = jnp.zeros(bits.shape[0], jnp.int32)
+            for j, pid in enumerate(self._wasm_member_order):
+                per_policy[pid] = (bits[:, j] != 0, zero_rule)
         p_allowed = jnp.stack(
             [per_policy[pid][0] for pid in self._policy_order], axis=-1
         ) if self._policy_order else jnp.zeros((0, 0), jnp.bool_)
@@ -726,7 +761,9 @@ class EvaluationEnvironment:
         step 6)."""
         for schema in self.schemas:
             for b in sorted({self.bucket_for(b) for b in batch_sizes}):
-                self.run_batch(schema.empty_batch_packed(b))
+                batch = schema.empty_batch_packed(b)
+                self._add_wasm_bits(batch, b)
+                self.run_batch(batch)
 
     def encode_bucketed(
         self, payload: Any
@@ -762,18 +799,23 @@ class EvaluationEnvironment:
             # the raw request: wasm policies get __context__ too
             return self._materialize_single(target, request.uid(), payload, {})
         if self.backend == "oracle":
-            return self._materialize(target, request, self._oracle_outputs(payload))
+            return self._materialize(target, request, self._oracle_outputs(payload, target))
         try:
             bucket_idx, encoded = self.encode_bucketed(payload)
         except SchemaOverflow:
             with self._fallback_lock:
                 self.oracle_fallbacks += 1
-            return self._materialize(target, request, self._oracle_outputs(payload))
+            return self._materialize(target, request, self._oracle_outputs(payload, target))
         schema = self.schemas[bucket_idx]
-        batch = schema.pack(
-            schema.stack([encoded], batch_size=self.bucket_for(1))
+        bucket = self.bucket_for(1)
+        batch = schema.pack(schema.stack([encoded], batch_size=bucket))
+        winfo = self._eval_wasm_members(target, payload)
+        stash = self._add_wasm_bits(
+            batch, bucket, [(0, winfo)] if winfo else None
         )
         outputs = {k: v[0] for k, v in self.run_batch(batch).items()}
+        for k, v in stash.items():
+            outputs[k] = v[0]
         return self._materialize(target, request, outputs)
 
     def pre_eval_hooks_of(
@@ -790,6 +832,80 @@ class EvaluationEnvironment:
         for hook in pre_eval_hooks_of(target):
             hook(payload)
 
+    # -- wasm group members (host verdicts as device inputs) ---------------
+
+    @staticmethod
+    def _wasm_verdict_triple(verdict: Mapping[str, Any]) -> tuple[bool, Any, bool]:
+        """Host-evaluator verdict dict → (allowed, message, would_mutate);
+        the single decode point for every path that consumes wasm member
+        verdicts."""
+        return (
+            bool(verdict.get("accepted")),
+            verdict.get("message"),
+            verdict.get("mutated_object") is not None,
+        )
+
+    def _wasm_member_outputs(
+        self, bp: BoundPolicy, payload: Any, out: dict[str, Any]
+    ) -> bool:
+        """Evaluate one wasm member host-side and write its output keys
+        (used by both oracle paths); returns the allowed bit."""
+        verdict = bp.precompiled.program.host_evaluator(payload)
+        allowed, msg, mutated = self._wasm_verdict_triple(verdict)
+        out[f"p:{bp.policy_id}:allowed"] = allowed
+        out[f"p:{bp.policy_id}:rule"] = -1
+        out[f"wm:{bp.policy_id}:msg"] = msg
+        out[f"wm:{bp.policy_id}:mutated"] = mutated
+        return allowed
+
+    def _eval_wasm_members(
+        self, target: "BoundPolicy | BoundGroup", payload: Any
+    ) -> dict[str, tuple[bool, Any, bool]]:
+        """Host-evaluate a group target's wasm members on one payload →
+        {member pid: (allowed, message, would_mutate)}. Members the group
+        expression never references are skipped — their verdicts are
+        masked out anyway (evaluated-semantics), so running the engine
+        for them would be pure waste. Host evaluators never raise (wasm
+        errors map to in-band rejections, evaluation/wasm_policy.py)."""
+        if not isinstance(target, BoundGroup) or (
+            target.name not in self._groups_with_wasm
+        ):
+            return {}
+        referenced = groups_mod.referenced_members(target.ast)
+        out: dict[str, tuple[bool, Any, bool]] = {}
+        for member_name, bp in target.members.items():
+            he = bp.precompiled.program.host_evaluator
+            if he is None or member_name not in referenced:
+                continue
+            out[bp.policy_id] = self._wasm_verdict_triple(he(payload))
+        return out
+
+    def _add_wasm_bits(
+        self,
+        batch_features: dict,
+        bucket: int,
+        row_infos: "list[tuple[int, dict]] | None" = None,
+    ) -> dict[str, list]:
+        """Attach the WASM_BITS_KEY device input for a batch and return
+        the host-side stash (per-row member messages / mutation flags) to
+        merge into the outputs dict. ``row_infos``: (row, info) pairs from
+        _eval_wasm_members. No-op (returns {}) when no wasm members are
+        loaded — the jit signature then stays bit-for-bit identical to a
+        wasm-free environment."""
+        if not self._wasm_member_order:
+            return {}
+        bits = np.zeros((bucket, len(self._wasm_member_order)), np.bool_)
+        stash: dict[str, list] = {}
+        for row, info in row_infos or []:
+            for pid, (allowed, msg, mutated) in info.items():
+                bits[row, self._wasm_member_col[pid]] = allowed
+                stash.setdefault(f"wm:{pid}:msg", [None] * bucket)[row] = msg
+                stash.setdefault(f"wm:{pid}:mutated", [False] * bucket)[
+                    row
+                ] = mutated
+        batch_features[WASM_BITS_KEY] = bits
+        return stash
+
     def _oracle_outputs_for(
         self, target: BoundPolicy | BoundGroup, payload: Any
     ) -> dict[str, Any]:
@@ -801,7 +917,21 @@ class EvaluationEnvironment:
         out: dict[str, Any] = {}
         if isinstance(target, BoundGroup):
             member_allowed: dict[str, bool] = {}
+            referenced = groups_mod.referenced_members(target.ast)
             for m, bp in target.members.items():
+                if bp.precompiled.program.host_evaluator is not None:
+                    if m in referenced:
+                        member_allowed[m] = self._wasm_member_outputs(
+                            bp, payload, out
+                        )
+                    else:
+                        # unreferenced wasm member: masked out — skip the
+                        # engine, write an inert verdict (the materializer
+                        # indexes every member's keys)
+                        out[f"p:{bp.policy_id}:allowed"] = False
+                        out[f"p:{bp.policy_id}:rule"] = -1
+                        member_allowed[m] = False
+                    continue
                 allowed, rule_idx = oracle_mod.evaluate_program(
                     bp.precompiled.program, payload
                 )
@@ -822,11 +952,38 @@ class EvaluationEnvironment:
         out[f"p:{target.policy_id}:rule"] = rule_idx
         return out
 
-    def _oracle_outputs(self, payload: Any) -> dict[str, Any]:
+    def _oracle_outputs(
+        self, payload: Any, target: "BoundPolicy | BoundGroup | None" = None
+    ) -> dict[str, Any]:
         """Host-interpreter evaluation of every policy + group (scalar
-        outputs, same keys as the device path)."""
+        outputs, same keys as the device path). The wasm engine runs ONLY
+        for members the target's materializer will read (referenced
+        members of the target group) — every other wasm entry is inert;
+        running a 50M-fuel interpretation for a verdict nobody reads
+        would dominate this fallback's cost."""
+        needed: set[str] = set()
+        if (
+            isinstance(target, BoundGroup)
+            and target.name in self._groups_with_wasm
+        ):
+            referenced = groups_mod.referenced_members(target.ast)
+            needed = {
+                bp.policy_id
+                for m, bp in target.members.items()
+                if m in referenced
+                and bp.precompiled.program.host_evaluator is not None
+            }
         out: dict[str, Any] = {}
         for pid, bp in self._bound.items():
+            if bp.precompiled.program.host_evaluator is not None:
+                if pid in needed:
+                    self._wasm_member_outputs(bp, payload, out)
+                else:
+                    # unread (standalone wasm routes via _host_executed;
+                    # other groups' members are not this target's)
+                    out[f"p:{pid}:allowed"] = False
+                    out[f"p:{pid}:rule"] = -1
+                continue
             allowed, rule_idx = oracle_mod.evaluate_program(
                 bp.precompiled.program, payload
             )
@@ -900,9 +1057,10 @@ class EvaluationEnvironment:
             return out
         results: list[AdmissionResponse | Exception | None] = [None] * len(items)
         targets: list[Any] = [None] * len(items)
-        # per shape bucket: (item indices, encodings)
+        # per shape bucket: (item indices, encodings, wasm-member infos)
         encodable: dict[int, list[int]] = {}
         encoded: dict[int, list[dict[str, np.ndarray]]] = {}
+        winfos: dict[int, list[dict]] = {}
         for i, (policy_id, request) in enumerate(items):
             try:
                 target = self._lookup_top_level(PolicyID.parse(policy_id))
@@ -919,17 +1077,20 @@ class EvaluationEnvironment:
                     continue
                 if self.backend == "oracle":
                     results[i] = self._materialize(
-                        target, request, self._oracle_outputs(payload)
+                        target, request, self._oracle_outputs(payload, target)
                     )
                     continue
                 bucket_idx, enc = self.encode_bucketed(payload)
                 encodable.setdefault(bucket_idx, []).append(i)
                 encoded.setdefault(bucket_idx, []).append(enc)
+                winfos.setdefault(bucket_idx, []).append(
+                    self._eval_wasm_members(target, payload)
+                )
             except SchemaOverflow:
                 with self._fallback_lock:
                     self.oracle_fallbacks += 1
                 results[i] = self._materialize(
-                    target, request, self._oracle_outputs(payload)
+                    target, request, self._oracle_outputs(payload, target)
                 )
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
@@ -939,7 +1100,17 @@ class EvaluationEnvironment:
             batch = schema.pack(
                 schema.stack(encoded[bucket_idx], batch_size=bucket)
             )
+            stash = self._add_wasm_bits(
+                batch,
+                bucket,
+                [
+                    (row, info)
+                    for row, info in enumerate(winfos.get(bucket_idx, []))
+                    if info
+                ],
+            )
             outputs = self.run_batch(batch)
+            outputs.update(stash)
             for row, i in enumerate(indices):
                 policy_id, request = items[i]
                 results[i] = self._materialize(
@@ -996,6 +1167,7 @@ class EvaluationEnvironment:
         results: list[AdmissionResponse | Exception | None] = [None] * len(items)
         targets: list[Any] = [None] * len(items)
         pending: list[int] = []
+        wasm_infos: dict[int, dict] = {}
         for i, (policy_id, request) in enumerate(items):
             try:
                 target = self._lookup_top_level(PolicyID.parse(policy_id))
@@ -1017,6 +1189,16 @@ class EvaluationEnvironment:
                         {},
                     )
                     continue
+                if (
+                    isinstance(target, BoundGroup)
+                    and target.name in self._groups_with_wasm
+                ):
+                    # groups with wasm members: run the wasm engine NOW
+                    # (host side), bits join the device batch below; the
+                    # payload parse is paid only for these rows
+                    wasm_infos[i] = self._eval_wasm_members(
+                        target, self.payload_for(target, request)
+                    )
                 pending.append(i)
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
@@ -1025,7 +1207,7 @@ class EvaluationEnvironment:
             if not pending:
                 break
             pending = self._native_schema_pass(
-                schema, items, targets, results, pending
+                schema, items, targets, results, pending, wasm_infos
             )
 
         for i in pending:  # beyond the widest schema → oracle
@@ -1034,7 +1216,9 @@ class EvaluationEnvironment:
             policy_id, request = items[i]
             results[i] = self._materialize(
                 targets[i], request,
-                self._oracle_outputs(self.payload_for(targets[i], request)),
+                self._oracle_outputs(
+                    self.payload_for(targets[i], request), targets[i]
+                ),
             )
         return results  # type: ignore[return-value]
 
@@ -1053,6 +1237,7 @@ class EvaluationEnvironment:
         targets: list[Any],
         results: list[AdmissionResponse | Exception | None],
         pending: list[int],
+        wasm_infos: dict[int, dict] | None = None,
     ) -> list[int]:
         """Encode+dispatch all ``pending`` rows against one schema.
 
@@ -1068,7 +1253,8 @@ class EvaluationEnvironment:
             for c in range(0, len(pending), chunk_size)
         ]
         overflowed: list[int] = []
-        drains: list[tuple[Any, list[tuple[int, int]]]] = []
+        # (device future, ok rows, wasm-member host stash) per chunk
+        drains: list[tuple[Any, list[tuple[int, int]], dict]] = []
 
         def encode(chunk: list[int]):
             blobs = [self._payload_blob(targets[i], items[i][1]) for i in chunk]
@@ -1076,9 +1262,10 @@ class EvaluationEnvironment:
                 blobs, self.bucket_for(len(blobs)), self.table
             )
 
-        def materialize(entry: tuple[Any, list[tuple[int, int]]]) -> None:
-            fut, ok_rows = entry
+        def materialize(entry) -> None:
+            fut, ok_rows, stash = entry
             outputs = self._unpack(fut.result())
+            outputs.update(stash)
             for row, i in ok_rows:
                 _, request = items[i]
                 results[i] = self._materialize(
@@ -1108,13 +1295,26 @@ class EvaluationEnvironment:
                 i for row, i in enumerate(chunk) if status[row] != 0
             )
             if ok_rows:
+                stash = self._add_wasm_bits(
+                    features,
+                    features[PACKED_KEY].shape[0],
+                    [
+                        (row, wasm_infos[i])
+                        for row, i in enumerate(chunk)
+                        if wasm_infos and i in wasm_infos
+                    ],
+                )
                 if self._mesh is not None:
                     from policy_server_tpu.parallel import mesh as mesh_mod
 
                     features = mesh_mod.shard_features(features, self._mesh)
                 dev_out = self._fused(features)  # async dispatch
                 drains.append(
-                    (self._drain_pool.submit(jax.device_get, dev_out), ok_rows)
+                    (
+                        self._drain_pool.submit(jax.device_get, dev_out),
+                        ok_rows,
+                        stash,
+                    )
                 )
                 if len(drains) - drained >= window:
                     materialize(drains[drained])
@@ -1212,19 +1412,30 @@ class EvaluationEnvironment:
         allowed = bool(outputs[f"g:{group.name}:allowed"])
         # group-member mutation ban (reference integration_test.rs:239-251):
         # an evaluated member that *would* mutate rejects the whole group.
+        # Wasm members report would-mutate from their host verdict
+        # (wm:<pid>:mutated, stashed at encode time).
         for member_name, bp in group.members.items():
             evaluated = bool(outputs.get(f"g:{group.name}:eval:{member_name}", False))
             member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
-            mutator = bp.precompiled.program.mutator
-            if evaluated and member_allowed and mutator is not None:
-                if mutator(payload_of()):
-                    return AdmissionResponse(
-                        uid=uid,
-                        allowed=False,
-                        status=ValidationStatus(
-                            message=GROUP_MUTATION_MESSAGE, code=500
-                        ),
-                    )
+            if not (evaluated and member_allowed):
+                continue
+            if bp.precompiled.program.host_evaluator is not None:
+                would_mutate = bool(
+                    outputs.get(f"wm:{bp.policy_id}:mutated", False)
+                )
+            else:
+                mutator = bp.precompiled.program.mutator
+                would_mutate = mutator is not None and bool(
+                    mutator(payload_of())
+                )
+            if would_mutate:
+                return AdmissionResponse(
+                    uid=uid,
+                    allowed=False,
+                    status=ValidationStatus(
+                        message=GROUP_MUTATION_MESSAGE, code=500
+                    ),
+                )
         if allowed:
             return AdmissionResponse(uid=uid, allowed=True)
         causes: list[StatusCause] = []
@@ -1232,13 +1443,19 @@ class EvaluationEnvironment:
             evaluated = bool(outputs.get(f"g:{group.name}:eval:{member_name}", False))
             member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
             if evaluated and not member_allowed:
-                rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
-                rule = bp.precompiled.program.rules[rule_idx]
-                message = (
-                    rule.message
-                    if isinstance(rule.message, str)
-                    else rule.message(payload_of())
-                )
+                if bp.precompiled.program.host_evaluator is not None:
+                    message = (
+                        outputs.get(f"wm:{bp.policy_id}:msg")
+                        or "rejected by policy"
+                    )
+                else:
+                    rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
+                    rule = bp.precompiled.program.rules[rule_idx]
+                    message = (
+                        rule.message
+                        if isinstance(rule.message, str)
+                        else rule.message(payload_of())
+                    )
                 causes.append(
                     StatusCause(
                         field=f"spec.policies.{member_name}", message=message

@@ -186,8 +186,10 @@ def test_wasm_artifact_policies_serve_end_to_end(tmp_path):
     assert [r.allowed for r in results] == [False, True]
 
 
-def test_wasm_group_member_rejected_at_boot(tmp_path):
-    from policy_server_tpu.evaluation.errors import BootstrapFailure
+def test_wasm_group_member_serves(tmp_path):
+    """Wasm policies compose into groups (round-4: host verdicts feed the
+    fused reduction as device input bits; the round-3 boot-time rejection
+    is gone). Full matrix in tests/test_wasm_group_members.py."""
     from policy_server_tpu.fetch.artifact import load_artifact
     from policy_server_tpu.models.policy import parse_policy_entry
     from policy_server_tpu.policies.wasm_oracle import oracle_wasm
@@ -195,21 +197,22 @@ def test_wasm_group_member_rejected_at_boot(tmp_path):
     wasm_path = tmp_path / "m.wasm"
     wasm_path.write_bytes(oracle_wasm("always-happy"))
     module = load_artifact(wasm_path)
-    with pytest.raises(BootstrapFailure, match="policy group"):
-        EvaluationEnvironmentBuilder(
-            backend="jax", module_resolver=lambda url: module
-        ).build(
-            {
-                "grp": parse_policy_entry(
-                    "grp",
-                    {
-                        "expression": "m()",
-                        "message": "no",
-                        "policies": {"m": {"module": "file:///m.wasm"}},
-                    },
-                )
-            }
-        )
+    env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=lambda url: module
+    ).build(
+        {
+            "grp": parse_policy_entry(
+                "grp",
+                {
+                    "expression": "m()",
+                    "message": "no",
+                    "policies": {"m": {"module": "file:///m.wasm"}},
+                },
+            )
+        }
+    )
+    resp = env.validate("grp", to_request(synthetic_firehose(1, seed=3)[0]))
+    assert resp.allowed is True
 
 
 def test_adversarial_shapes_differential(envs):

@@ -1,0 +1,259 @@
+"""Wasm policies as policy-group members (round-4 VERDICT item 3).
+
+The reference composes ANY loaded policy into groups
+(src/evaluation/evaluation_environment.rs:596-651). Here, host-executed
+wasm members contribute their verdict bits as device inputs to the fused
+group reduction (WASM_BITS_KEY): the wasm engine runs at encode time, the
+boolean expression still evaluates on-device, and causes/mutation-ban
+semantics match IR members. These tests mix a real WAT-authored waPC
+wasm member with IR members and pin verdicts, causes, the evaluated-mask
+semantics, the mutation ban, and agreement across every execution path
+(device batch, single validate, host fast-path, oracle backend)."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.fetch.artifact import load_artifact
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.policies import resolve_builtin
+from policy_server_tpu.policies.wasm_oracle import oracle_wasm
+
+from conftest import build_admission_review_dict
+
+
+def pod_review(namespace: str, privileged: bool) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["namespace"] = namespace
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": namespace},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+@pytest.fixture(scope="module")
+def mixed_group_env(tmp_path_factory):
+    """Group 'guard' = wasmpriv() && ns(): a REAL wasm member (the
+    WAT-authored pod-privileged oracle over the waPC protocol) AND'd with
+    an IR member."""
+    wasm_path = tmp_path_factory.mktemp("wasm") / "priv.wasm"
+    wasm_path.write_bytes(oracle_wasm("pod-privileged"))
+    wasm_module = load_artifact(wasm_path)
+
+    def resolver(url: str):
+        if url.endswith(".wasm"):
+            return wasm_module
+        builtin = resolve_builtin(url)
+        assert builtin is not None, url
+        return builtin
+
+    def build(backend: str):
+        return EvaluationEnvironmentBuilder(
+            backend=backend, module_resolver=resolver
+        ).build(
+            {
+                "guard": parse_policy_entry(
+                    "guard",
+                    {
+                        "expression": "wasmpriv() && ns()",
+                        "message": "pod guard rejected",
+                        "policies": {
+                            "wasmpriv": {"module": "file:///priv.wasm"},
+                            "ns": {
+                                "module": "builtin://namespace-validate",
+                                "settings": {
+                                    "denied_namespaces": ["blocked"]
+                                },
+                            },
+                        },
+                    },
+                ),
+            }
+        )
+
+    return build("jax"), build("oracle")
+
+
+CASES = [
+    # (namespace, privileged) → allowed, rejecting member (or None)
+    ("default", False, True, None),
+    ("default", True, False, "wasmpriv"),
+    ("blocked", False, False, "ns"),
+]
+
+
+@pytest.mark.parametrize("namespace,privileged,want_allowed,rejecter", CASES)
+def test_mixed_group_device_path(
+    mixed_group_env, namespace, privileged, want_allowed, rejecter
+):
+    env, _ = mixed_group_env
+    resp = env.validate("guard", pod_review(namespace, privileged))
+    assert resp.allowed is want_allowed
+    if not want_allowed:
+        assert resp.status.message == "pod guard rejected"
+        fields = [c.field for c in resp.status.details.causes]
+        assert f"spec.policies.{rejecter}" in fields
+
+
+def test_all_paths_agree(mixed_group_env):
+    """Device batch (native), host fast-path, and the oracle backend must
+    produce identical responses for the mixed group."""
+    env, oracle_env = mixed_group_env
+    items = [
+        ("guard", pod_review(ns, priv))
+        for ns, priv, _, _ in CASES
+        for _ in range(3)
+    ]
+    device = env.validate_batch(items)
+    fast = env.validate_batch(items, prefer_host=True)
+    oracle = oracle_env.validate_batch(items)
+    for d, f, o in zip(device, fast, oracle):
+        assert not isinstance(d, Exception), d
+        assert d.to_dict() == f.to_dict() == o.to_dict()
+
+
+def test_wasm_member_cause_message_is_from_wasm(mixed_group_env):
+    env, _ = mixed_group_env
+    resp = env.validate("guard", pod_review("default", True))
+    (cause,) = [
+        c
+        for c in resp.status.details.causes
+        if c.field == "spec.policies.wasmpriv"
+    ]
+    # the message is the wasm guest's own rejection message
+    assert "wasm oracle policy" in cause.message
+
+
+def test_unreferenced_wasm_member_never_evaluated(tmp_path):
+    """Masked evaluated-semantics hold for wasm members: a member the
+    expression never references produces no cause."""
+    wasm_path = tmp_path / "priv.wasm"
+    wasm_path.write_bytes(oracle_wasm("pod-privileged"))
+    wasm_module = load_artifact(wasm_path)
+
+    def resolver(url: str):
+        if url.endswith(".wasm"):
+            return wasm_module
+        return resolve_builtin(url)
+
+    env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=resolver
+    ).build(
+        {
+            "g": parse_policy_entry(
+                "g",
+                {
+                    # wasmpriv defined but NOT referenced
+                    "expression": "ns()",
+                    "message": "denied",
+                    "policies": {
+                        "wasmpriv": {"module": "file:///priv.wasm"},
+                        "ns": {
+                            "module": "builtin://namespace-validate",
+                            "settings": {"denied_namespaces": ["blocked"]},
+                        },
+                    },
+                },
+            )
+        }
+    )
+    resp = env.validate("g", pod_review("blocked", True))
+    assert resp.allowed is False
+    fields = [c.field for c in resp.status.details.causes]
+    assert fields == ["spec.policies.ns"]
+
+
+def test_mutating_wasm_member_rejects_group():
+    """A wasm member whose verdict carries a mutation rejects the whole
+    group with the reference's message (integration_test.rs:239-251)."""
+    from policy_server_tpu.evaluation.environment import (
+        GROUP_MUTATION_MESSAGE,
+    )
+    from policy_server_tpu.ops.compiler import PolicyProgram, Rule
+    from policy_server_tpu.ops.ir import false
+    from policy_server_tpu.policies.base import SettingsValidationResponse
+
+    class MutatingWasmStub:
+        name = "mutator"
+        digest = "stub"
+
+        def build(self, settings):
+            return PolicyProgram(
+                rules=(Rule("wasm-host-executed", false(), "unreachable"),),
+                host_evaluator=lambda payload: {
+                    "accepted": True,
+                    "mutated_object": {"patched": True},
+                },
+            )
+
+        def validate_settings(self, settings):
+            return SettingsValidationResponse(valid=True, message=None)
+
+    def resolver(url: str):
+        if url == "stub://mutator":
+            return MutatingWasmStub()
+        return resolve_builtin(url)
+
+    env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=resolver
+    ).build(
+        {
+            "g": parse_policy_entry(
+                "g",
+                {
+                    "expression": "mut() || happy()",
+                    "message": "denied",
+                    "policies": {
+                        "mut": {"module": "stub://mutator"},
+                        "happy": {"module": "builtin://always-happy"},
+                    },
+                },
+            )
+        }
+    )
+    resp = env.validate("g", pod_review("default", False))
+    assert resp.allowed is False
+    assert resp.status.message == GROUP_MUTATION_MESSAGE
+    assert resp.status.code == 500
+    # the fast-path agrees
+    (fast,) = env.validate_batch(
+        [("g", pod_review("default", False))], prefer_host=True
+    )
+    assert fast.to_dict() == resp.to_dict()
+
+
+def test_wasm_member_through_batcher(mixed_group_env):
+    """Serving path: the mixed group batches through the MicroBatcher on
+    the device path (threshold 0 forces device)."""
+    from policy_server_tpu.api.service import RequestOrigin
+    from policy_server_tpu.runtime.batcher import MicroBatcher
+    from policy_server_tpu.telemetry import metrics as metrics_mod
+
+    metrics_mod.reset_metrics_for_tests()
+    env, _ = mixed_group_env
+    b = MicroBatcher(
+        env, host_fastpath_threshold=0, max_batch_size=8, batch_timeout_ms=5.0
+    ).start()
+    try:
+        ok = b.evaluate("guard", pod_review("default", False), RequestOrigin.VALIDATE)
+        assert ok.allowed is True
+        bad = b.evaluate("guard", pod_review("default", True), RequestOrigin.VALIDATE)
+        assert bad.allowed is False
+    finally:
+        b.shutdown()
+        metrics_mod.reset_metrics_for_tests()
