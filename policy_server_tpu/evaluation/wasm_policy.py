@@ -12,7 +12,9 @@ predicate-IR path remains the TPU fast path.
 
 ABI detection is by exports: ``__guest_call`` ⇒ waPC (Kubewarden
 protocol, wasm/wapc.py); ``opa_eval_ctx_new`` ⇒ OPA/Gatekeeper
-(wasm/opa.py). A runaway module exhausts its interpreter fuel and is
+(wasm/opa.py); ``_start`` ⇒ WASI command module (wasm/wasi.py:
+argv-selected operation, request JSON on stdin, verdict JSON on
+stdout). A runaway module exhausts its interpreter fuel and is
 rejected in-band with the reference's "execution deadline exceeded"
 message (the epoch-interruption analog, src/lib.rs:176-190)."""
 
@@ -27,6 +29,7 @@ from policy_server_tpu.wasm.binary import decode_module
 from policy_server_tpu.wasm.interp import WasmFuelExhausted, WasmTrap
 from policy_server_tpu.wasm.opa import OpaError, OpaPolicy, gatekeeper_validate
 from policy_server_tpu.wasm.wapc import KubewardenWapcPolicy, WapcError
+from policy_server_tpu.wasm.wasi import WasiError, WasiPolicy
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
 
@@ -51,14 +54,19 @@ class WasmPolicyModule:
         elif "opa_eval_ctx_new" in exports:
             self.abi = "opa-gatekeeper"
             self._opa = OpaPolicy(module, fuel=fuel)
+        elif "_start" in exports:
+            self.abi = "wasi"
+            self._wasi = WasiPolicy(module, fuel=fuel)
+            self._wasi.name = name
         else:
             raise WasmTrap(
                 f"wasm module {name!r} speaks no supported policy ABI "
-                "(expected waPC __guest_call or OPA opa_eval_ctx_new exports)"
+                "(expected waPC __guest_call, OPA opa_eval_ctx_new, or "
+                "WASI _start exports)"
             )
-        # waPC guests may return a mutated object; whether the operator
-        # permits it is gated by allowedToMutate exactly like any policy
-        self.mutating = self.abi == "wapc"
+        # waPC and WASI guests may return a mutated object; whether the
+        # operator permits it is gated by allowedToMutate like any policy
+        self.mutating = self.abi in ("wapc", "wasi")
 
     def build(self, settings: Mapping[str, Any]) -> PolicyProgram:
         from policy_server_tpu.context.service import CONTEXT_KEY
@@ -106,6 +114,13 @@ class WasmPolicyModule:
                             **kubernetes_capabilities(payload),
                         },
                     )
+                if self.abi == "wasi":
+                    request_doc = (
+                        {k: v for k, v in payload.items() if k != CONTEXT_KEY}
+                        if isinstance(payload, Mapping)
+                        else payload
+                    )
+                    return self._wasi.validate(request_doc, bound_settings)
                 allowed, message = gatekeeper_validate(
                     self._opa, payload, parameters=bound_settings
                 )
@@ -116,7 +131,7 @@ class WasmPolicyModule:
                     "message": DEADLINE_MESSAGE,
                     "code": 500,
                 }
-            except (WasmTrap, WapcError, OpaError) as e:
+            except (WasmTrap, WapcError, OpaError, WasiError) as e:
                 # guest crash → in-band rejection, mirroring the reference
                 # surfacing wasm errors as 500 responses
                 return {
@@ -135,10 +150,11 @@ class WasmPolicyModule:
     def validate_settings(
         self, settings: Mapping[str, Any]
     ) -> SettingsValidationResponse:
-        if self.abi == "wapc":
+        if self.abi in ("wapc", "wasi"):
+            host = self._wapc if self.abi == "wapc" else self._wasi
             try:
-                doc = self._wapc.validate_settings(dict(settings or {}))
-            except (WasmTrap, WapcError, OpaError) as e:
+                doc = host.validate_settings(dict(settings or {}))
+            except (WasmTrap, WapcError, OpaError, WasiError) as e:
                 return SettingsValidationResponse(
                     valid=False, message=f"settings validation failed: {e}"
                 )

@@ -1,0 +1,373 @@
+"""WASI + CEL execution modes (round-4 VERDICT item 4).
+
+Completes the reference's PolicyExecutionMode matrix
+(src/evaluation/precompiled_policy.rs:46-64): waPC and OPA/Gatekeeper
+landed in round 3; this file covers the remaining two — WASI command
+modules (argv/stdin/stdout protocol, wasm/wasi.py) driven by a real
+WAT-authored module, and CEL policies (cel/) that lower to predicate IR
+for the device fast path with a host-interpreter fallback."""
+
+from __future__ import annotations
+
+import pytest
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+
+from conftest import build_admission_review_dict
+from wasi_fixture import wasi_policy_wasm
+
+
+def pod_review(privileged: bool, replicas: int | None = None) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "labels": {"app": "web"}},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "docker.io/nginx:1.25",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+    if replicas is not None:
+        obj["spec"]["replicas"] = replicas
+    doc["request"]["object"] = obj
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+# ---------------------------------------------------------------------------
+# WASI
+# ---------------------------------------------------------------------------
+
+
+def test_wasi_policy_direct():
+    from policy_server_tpu.wasm.wasi import WasiPolicy
+
+    policy = WasiPolicy(wasi_policy_wasm())
+    verdict = policy.validate(
+        {"object": {"spec": {"containers": [
+            {"securityContext": {"privileged": True}}
+        ]}}},
+        {},
+    )
+    assert verdict == {
+        "accepted": False,
+        "message": "privileged container denied (wasi)",
+    }
+    verdict = policy.validate(
+        {"object": {"spec": {"containers": [{"name": "c"}]}}}, {}
+    )
+    assert verdict == {"accepted": True}
+    assert policy.validate_settings({"anything": 1}) == {"valid": True}
+
+
+def test_wasi_artifact_loads_and_serves(tmp_path):
+    from policy_server_tpu.fetch.artifact import load_artifact
+
+    wasm_path = tmp_path / "wasi-policy.wasm"
+    wasm_path.write_bytes(wasi_policy_wasm())
+    module = load_artifact(wasm_path)
+    assert module.abi == "wasi"
+    env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=lambda url: module
+    ).build(
+        {"wasi-priv": parse_policy_entry("wasi-priv", {"module": "file:///w.wasm"})}
+    )
+    rejected = env.validate("wasi-priv", pod_review(True))
+    assert rejected.allowed is False
+    assert "wasi" in rejected.status.message
+    accepted = env.validate("wasi-priv", pod_review(False))
+    assert accepted.allowed is True
+    # batch + fast-path route host-executed rows identically
+    a, b = env.validate_batch(
+        [("wasi-priv", pod_review(True)), ("wasi-priv", pod_review(False))],
+        prefer_host=True,
+    )
+    assert a.to_dict() == rejected.to_dict()
+    assert b.to_dict() == accepted.to_dict()
+
+
+def test_wasi_group_member(tmp_path):
+    """WASI members compose into groups like any wasm policy."""
+    from policy_server_tpu.fetch.artifact import load_artifact
+    from policy_server_tpu.policies import resolve_builtin
+
+    wasm_path = tmp_path / "wasi-policy.wasm"
+    wasm_path.write_bytes(wasi_policy_wasm())
+    module = load_artifact(wasm_path)
+
+    def resolver(url):
+        if url.endswith(".wasm"):
+            return module
+        return resolve_builtin(url)
+
+    env = EvaluationEnvironmentBuilder(
+        backend="jax", module_resolver=resolver
+    ).build(
+        {
+            "g": parse_policy_entry(
+                "g",
+                {
+                    "expression": "wasi() && happy()",
+                    "message": "group denied",
+                    "policies": {
+                        "wasi": {"module": "file:///w.wasm"},
+                        "happy": {"module": "builtin://always-happy"},
+                    },
+                },
+            )
+        }
+    )
+    resp = env.validate("g", pod_review(True))
+    assert resp.allowed is False
+    assert [c.field for c in resp.status.details.causes] == ["spec.policies.wasi"]
+    assert env.validate("g", pod_review(False)).allowed is True
+
+
+# ---------------------------------------------------------------------------
+# CEL: parser
+# ---------------------------------------------------------------------------
+
+
+def test_cel_parser_shapes():
+    from policy_server_tpu.cel import parser as P
+
+    ast = P.parse("object.spec.replicas <= 5")
+    assert isinstance(ast, P.Binary) and ast.op == "<="
+    ast = P.parse("!(request.operation == 'DELETE')")
+    assert isinstance(ast, P.Unary)
+    ast = P.parse("object.spec.containers.all(c, !c.privileged)")
+    assert isinstance(ast, P.Call) and ast.name == "all"
+    ast = P.parse("x ? 1 : 2")
+    assert isinstance(ast, P.Ternary)
+    with pytest.raises(P.CelParseError):
+        P.parse("object.spec.")
+    with pytest.raises(P.CelParseError):
+        P.parse("")
+
+
+# ---------------------------------------------------------------------------
+# CEL: device lowering
+# ---------------------------------------------------------------------------
+
+
+DEVICE_CEL_SETTINGS = {
+    "validations": [
+        {
+            "expression": (
+                "object.spec.containers.all(c, "
+                "!(c.securityContext.privileged == true))"
+            ),
+            "message": "privileged containers are not allowed",
+        },
+        {
+            "expression": "request.operation in ['CREATE', 'UPDATE']",
+            "message": "only create/update supported",
+        },
+    ]
+}
+
+
+def cel_env(backend: str, settings):
+    return EvaluationEnvironmentBuilder(backend=backend).build(
+        {
+            "cel": parse_policy_entry(
+                "cel", {"module": "builtin://cel-policy", "settings": settings}
+            )
+        }
+    )
+
+
+def test_cel_lowers_to_device_program():
+    from policy_server_tpu.cel.policy import CelPolicy
+
+    program = CelPolicy().build(DEVICE_CEL_SETTINGS)
+    assert program.host_evaluator is None  # the TPU path, not the fallback
+    assert len(program.rules) == 2
+
+
+def test_cel_device_verdicts_and_oracle_agree():
+    jax_env = cel_env("jax", DEVICE_CEL_SETTINGS)
+    oracle_env = cel_env("oracle", DEVICE_CEL_SETTINGS)
+    for req in (pod_review(True), pod_review(False)):
+        a = jax_env.validate("cel", req)
+        b = oracle_env.validate("cel", req)
+        assert a.to_dict() == b.to_dict()
+    rejected = jax_env.validate("cel", pod_review(True))
+    assert rejected.allowed is False
+    assert rejected.status.message == "privileged containers are not allowed"
+    assert jax_env.validate("cel", pod_review(False)).allowed is True
+
+
+@pytest.mark.parametrize(
+    "expression,privileged,want_allowed",
+    [
+        ("has(object.spec.replicas)", False, False),  # pod has no replicas
+        ("size(object.spec.containers) <= 2", False, True),
+        ("object.metadata.name.startsWith('p')", False, True),
+        ("object.metadata.name.matches('^[a-z]+$')", False, True),
+        ("'NET_ADMIN' in object.spec.containers", False, False),
+        (
+            "object.spec.containers.exists(c, "
+            "c.image.contains('nginx'))",
+            False,
+            True,
+        ),
+    ],
+)
+def test_cel_lowered_expression_matrix(expression, privileged, want_allowed):
+    env = cel_env(
+        "jax", {"validations": [{"expression": expression}]}
+    )
+    resp = env.validate("cel", pod_review(privileged))
+    assert resp.allowed is want_allowed, expression
+
+
+def test_cel_variables_inline_and_lower():
+    from policy_server_tpu.cel.policy import CelPolicy
+
+    settings = {
+        "variables": [
+            {"name": "containers", "expression": "object.spec.containers"}
+        ],
+        "validations": [
+            {
+                "expression": "variables.containers.all(c, "
+                "!(c.securityContext.privileged == true))"
+            }
+        ],
+    }
+    program = CelPolicy().build(settings)
+    assert program.host_evaluator is None  # variables do not force host
+    env = cel_env("jax", settings)
+    assert env.validate("cel", pod_review(True)).allowed is False
+    assert env.validate("cel", pod_review(False)).allowed is True
+
+
+# ---------------------------------------------------------------------------
+# CEL: host interpreter fallback
+# ---------------------------------------------------------------------------
+
+
+HOST_CEL_SETTINGS = {
+    "validations": [
+        {
+            # arithmetic does not lower → whole policy host-interpreted
+            "expression": "object.spec.replicas * 2 <= 10",
+            "message": "too many replicas",
+            "messageExpression": (
+                "'replicas ' + string(object.spec.replicas) + ' over limit'"
+            ),
+        }
+    ]
+}
+
+
+def test_cel_host_fallback():
+    from policy_server_tpu.cel.policy import CelPolicy
+
+    program = CelPolicy().build(HOST_CEL_SETTINGS)
+    assert program.host_evaluator is not None
+    env = cel_env("jax", HOST_CEL_SETTINGS)
+    assert env.validate("cel", pod_review(False, replicas=3)).allowed is True
+    rejected = env.validate("cel", pod_review(False, replicas=9))
+    assert rejected.allowed is False
+    # messageExpression evaluated on the host
+    assert rejected.status.message == "replicas 9 over limit"
+    # missing field → CEL error → deny (K8s VAP semantics)
+    missing = env.validate("cel", pod_review(False))
+    assert missing.allowed is False
+    assert "CEL error" in missing.status.message
+
+
+def test_cel_field_to_field_comparison_host_fallback():
+    """Path-vs-path comparisons cannot lower (unknowable dtypes) — they
+    must take the host interpreter and produce CEL-correct results."""
+    from policy_server_tpu.cel.policy import CelPolicy
+
+    settings = {
+        "validations": [
+            {"expression": "object.spec.replicas == object.spec.minReplicas"}
+        ]
+    }
+    program = CelPolicy().build(settings)
+    assert program.host_evaluator is not None
+    doc = build_admission_review_dict()
+    doc["request"]["object"] = {"spec": {"replicas": 3, "minReplicas": 3}}
+    env = EvaluationEnvironmentBuilder(backend="jax").build(
+        {
+            "cel": parse_policy_entry(
+                "cel", {"module": "builtin://cel-policy", "settings": settings}
+            )
+        }
+    )
+    req = ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+    assert env.validate("cel", req).allowed is True
+
+
+def test_cel_size_of_string_host_semantics():
+    """size() is polymorphic (string length!) so it never lowers; the
+    host interpreter gives CEL-correct lengths."""
+    from policy_server_tpu.cel.policy import CelPolicy
+
+    settings = {
+        "validations": [{"expression": "size(object.metadata.name) > 3"}]
+    }
+    program = CelPolicy().build(settings)
+    assert program.host_evaluator is not None
+    env = cel_env("jax", settings)
+    assert env.validate("cel", pod_review(False)).allowed is False  # 'p'
+    long_name = pod_review(False)
+    long_name.payload()["object"]["metadata"]["name"] = "verylongname"
+    assert env.validate("cel", long_name).allowed is True
+
+
+def test_cel_in_type_mismatch_is_in_band_deny():
+    """'in' with a non-string lhs over a string rhs must produce an
+    in-band CEL-error deny, never an exception out of the host
+    evaluator (the group-member contract)."""
+    settings = {
+        "allowed": "1,2,3",
+        "validations": [
+            {"expression": "object.spec.replicas in params.allowed"}
+        ],
+    }
+    env = cel_env("jax", settings)
+    req = pod_review(False, replicas=2)
+    resp = env.validate("cel", req)
+    assert resp.allowed is False
+    assert "CEL error" in resp.status.message
+
+
+def test_cel_settings_validation():
+    from policy_server_tpu.cel.policy import CelPolicy
+
+    p = CelPolicy()
+    assert p.validate_settings({}).valid is False
+    assert p.validate_settings({"validations": []}).valid is False
+    bad = p.validate_settings(
+        {"validations": [{"expression": "object.spec.("}]}
+    )
+    assert bad.valid is False
+    assert "invalid CEL expression" in bad.message
+    ok = p.validate_settings(DEVICE_CEL_SETTINGS)
+    assert ok.valid is True
+
+
+def test_cel_upstream_url_resolves():
+    from policy_server_tpu.policies import resolve_builtin
+
+    module = resolve_builtin(
+        "registry://ghcr.io/kubewarden/policies/cel-policy:v1.0.0"
+    )
+    assert module is not None and module.name == "cel-policy"

@@ -1,0 +1,247 @@
+"""A WAT-authored WASI command-module policy.
+
+Plays the role of a wasmtime WASI policy for the execution-mode tests:
+imports ``wasi_snapshot_preview1`` (fd_read / fd_write / proc_exit /
+args_*), exports ``_start`` and memory, and speaks the protocol
+wasm/wasi.py defines — argv[1] selects the operation, the request JSON
+arrives on stdin, the verdict JSON leaves on stdout.
+
+Policy semantics: reject when the request contains a privileged
+container (substring scan for ``"privileged":true`` over the compact
+stdin JSON); ``validate-settings`` always answers ``{"valid":true}``.
+"""
+
+from __future__ import annotations
+
+from policy_server_tpu.wasm.wat import assemble
+
+PATTERN = '"privileged":true'
+ACCEPT = '{"accepted":true}'
+REJECT = '{"accepted":false,"message":"privileged container denied (wasi)"}'
+VALID = '{"valid":true}'
+SETTINGS_OP = "validate-settings"
+
+# data offsets (memory is zero-filled; gaps keep texts NUL-terminated)
+_PATTERN_OFF = 16
+_ACCEPT_OFF = 48
+_REJECT_OFF = 96
+_VALID_OFF = 192
+_SETTINGS_OP_OFF = 224
+# scratch: iovec/result words at 1024, argv pointers at 1056, argv text
+# buffer at 1152, stdin buffer from 8192
+_SCRATCH = 1024
+_ARGV_PTRS = 1056
+_ARGV_BUF = 1152
+_STDIN = 8192
+_STDIN_CAP = 180000
+
+
+def _esc(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def wasi_policy_wasm() -> bytes:
+    src = f"""
+(module
+  (import "wasi_snapshot_preview1" "fd_read"
+    (func $fd_read (param i32 i32 i32 i32) (result i32)))
+  (import "wasi_snapshot_preview1" "fd_write"
+    (func $fd_write (param i32 i32 i32 i32) (result i32)))
+  (import "wasi_snapshot_preview1" "proc_exit"
+    (func $proc_exit (param i32)))
+  (import "wasi_snapshot_preview1" "args_sizes_get"
+    (func $args_sizes_get (param i32 i32) (result i32)))
+  (import "wasi_snapshot_preview1" "args_get"
+    (func $args_get (param i32 i32) (result i32)))
+  (memory (export "memory") 4)
+  (data (i32.const {_PATTERN_OFF}) "{_esc(PATTERN)}")
+  (data (i32.const {_ACCEPT_OFF}) "{_esc(ACCEPT)}")
+  (data (i32.const {_REJECT_OFF}) "{_esc(REJECT)}")
+  (data (i32.const {_VALID_OFF}) "{_esc(VALID)}")
+  (data (i32.const {_SETTINGS_OP_OFF}) "{_esc(SETTINGS_OP)}")
+
+  (func $strlen (param $p i32) (result i32)
+    (local $n i32)
+    block $done
+      loop $scan
+        local.get $p
+        local.get $n
+        i32.add
+        i32.load8_u
+        i32.eqz
+        br_if $done
+        local.get $n
+        i32.const 1
+        i32.add
+        local.set $n
+        br $scan
+      end
+    end
+    local.get $n)
+
+  (func $memeq (param $a i32) (param $b i32) (param $len i32) (result i32)
+    (local $i i32)
+    block $ne
+      loop $next
+        local.get $i
+        local.get $len
+        i32.ge_u
+        if
+          i32.const 1
+          return
+        end
+        local.get $a
+        local.get $i
+        i32.add
+        i32.load8_u
+        local.get $b
+        local.get $i
+        i32.add
+        i32.load8_u
+        i32.ne
+        br_if $ne
+        local.get $i
+        i32.const 1
+        i32.add
+        local.set $i
+        br $next
+      end
+    end
+    i32.const 0)
+
+  ;; naive substring search: pattern at $pat (len $plen) in [$buf, $buf+$n)
+  (func $find (param $buf i32) (param $n i32) (param $pat i32) (param $plen i32) (result i32)
+    (local $i i32)
+    block $no
+      loop $next
+        local.get $i
+        local.get $plen
+        i32.add
+        local.get $n
+        i32.gt_u
+        br_if $no
+        local.get $buf
+        local.get $i
+        i32.add
+        local.get $pat
+        local.get $plen
+        call $memeq
+        if
+          i32.const 1
+          return
+        end
+        local.get $i
+        i32.const 1
+        i32.add
+        local.set $i
+        br $next
+      end
+    end
+    i32.const 0)
+
+  ;; write a NUL-terminated text to stdout via one ciovec
+  (func $print (param $p i32)
+    i32.const {_SCRATCH}
+    local.get $p
+    i32.store
+    i32.const {_SCRATCH + 4}
+    local.get $p
+    call $strlen
+    i32.store
+    i32.const 1
+    i32.const {_SCRATCH}
+    i32.const 1
+    i32.const {_SCRATCH + 8}
+    call $fd_write
+    drop)
+
+  (func (export "_start")
+    (local $argc i32)
+    (local $arg1 i32)
+    (local $total i32)
+    (local $n i32)
+    ;; argv: operation is argv[1]
+    i32.const {_SCRATCH}
+    i32.const {_SCRATCH + 4}
+    call $args_sizes_get
+    drop
+    i32.const {_SCRATCH}
+    i32.load
+    local.set $argc
+    i32.const {_ARGV_PTRS}
+    i32.const {_ARGV_BUF}
+    call $args_get
+    drop
+    local.get $argc
+    i32.const 2
+    i32.ge_u
+    if
+      i32.const {_ARGV_PTRS + 4}
+      i32.load
+      local.set $arg1
+      local.get $arg1
+      call $strlen
+      i32.const {len(SETTINGS_OP)}
+      i32.eq
+      if
+        local.get $arg1
+        i32.const {_SETTINGS_OP_OFF}
+        i32.const {len(SETTINGS_OP)}
+        call $memeq
+        if
+          i32.const {_VALID_OFF}
+          call $print
+          i32.const 0
+          call $proc_exit
+        end
+      end
+    end
+    ;; validate: read ALL of stdin
+    block $eof
+      loop $more
+        i32.const {_SCRATCH}
+        i32.const {_STDIN}
+        local.get $total
+        i32.add
+        i32.store
+        i32.const {_SCRATCH + 4}
+        i32.const {_STDIN_CAP}
+        local.get $total
+        i32.sub
+        i32.store
+        i32.const 0
+        i32.const {_SCRATCH}
+        i32.const 1
+        i32.const {_SCRATCH + 8}
+        call $fd_read
+        drop
+        i32.const {_SCRATCH + 8}
+        i32.load
+        local.set $n
+        local.get $n
+        i32.eqz
+        br_if $eof
+        local.get $total
+        local.get $n
+        i32.add
+        local.set $total
+        br $more
+      end
+    end
+    i32.const {_STDIN}
+    local.get $total
+    i32.const {_PATTERN_OFF}
+    i32.const {len(PATTERN)}
+    call $find
+    if
+      i32.const {_REJECT_OFF}
+      call $print
+    else
+      i32.const {_ACCEPT_OFF}
+      call $print
+    end
+    i32.const 0
+    call $proc_exit)
+)
+"""
+    return assemble(src)
