@@ -26,12 +26,28 @@ from policy_server_tpu.ops.compiler import PolicyProgram, Rule
 from policy_server_tpu.ops.ir import false
 from policy_server_tpu.policies.base import SettingsValidationResponse
 from policy_server_tpu.wasm.binary import decode_module
-from policy_server_tpu.wasm.interp import WasmFuelExhausted, WasmTrap
+from policy_server_tpu.wasm.interp import (
+    WasmFuelExhausted,
+    WasmTrap,
+    deadline_scope,
+)
 from policy_server_tpu.wasm.opa import OpaError, OpaPolicy, gatekeeper_validate
 from policy_server_tpu.wasm.wapc import KubewardenWapcPolicy, WapcError
 from policy_server_tpu.wasm.wasi import WasiError, WasiPolicy
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
+
+# Wall-clock budget per wasm evaluation — the epoch-interruption analog
+# (reference --policy-timeout default 2 s, src/cli.rs:164-169). The server
+# bootstrap syncs this to the configured policy timeout; None disables.
+_WALL_CLOCK_BUDGET: float | None = 2.0
+
+
+def configure_wall_clock_budget(seconds: float | None) -> None:
+    """Set the per-evaluation wall-clock budget for all wasm policies
+    (called from server bootstrap with --policy-timeout)."""
+    global _WALL_CLOCK_BUDGET
+    _WALL_CLOCK_BUDGET = seconds
 
 
 class WasmPolicyModule:
@@ -97,6 +113,25 @@ class WasmPolicyModule:
 
         def evaluate(payload: Any) -> Mapping[str, Any]:
             try:
+                return _evaluate_inner(payload)
+            except WasmFuelExhausted:
+                # fuel OR wall-clock deadline (WasmDeadlineExceeded)
+                return {
+                    "accepted": False,
+                    "message": DEADLINE_MESSAGE,
+                    "code": 500,
+                }
+            except (WasmTrap, WapcError, OpaError, WasiError) as e:
+                # guest crash → in-band rejection, mirroring the reference
+                # surfacing wasm errors as 500 responses
+                return {
+                    "accepted": False,
+                    "message": f"wasm policy execution failed: {e}",
+                    "code": 500,
+                }
+
+        def _evaluate_inner(payload: Any) -> Mapping[str, Any]:
+            with deadline_scope(_WALL_CLOCK_BUDGET):
                 if self.abi == "wapc":
                     # the guest gets the REQUEST; cluster state is served
                     # on demand through the kubernetes capabilities from
@@ -125,20 +160,6 @@ class WasmPolicyModule:
                     self._opa, payload, parameters=bound_settings
                 )
                 return {"accepted": allowed, "message": message}
-            except WasmFuelExhausted:
-                return {
-                    "accepted": False,
-                    "message": DEADLINE_MESSAGE,
-                    "code": 500,
-                }
-            except (WasmTrap, WapcError, OpaError, WasiError) as e:
-                # guest crash → in-band rejection, mirroring the reference
-                # surfacing wasm errors as 500 responses
-                return {
-                    "accepted": False,
-                    "message": f"wasm policy execution failed: {e}",
-                    "code": 500,
-                }
 
         return PolicyProgram(
             # the device program never decides for wasm policies; the

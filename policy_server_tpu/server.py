@@ -143,6 +143,15 @@ class PolicyServer:
         )
         environment = _build_environment(config, builder_kwargs)
 
+        # wasm guests share the configured wall-clock budget (the
+        # epoch-interruption analog: fuel bounds instructions, this bounds
+        # TIME, reference src/lib.rs:176-190)
+        from policy_server_tpu.evaluation.wasm_policy import (
+            configure_wall_clock_budget,
+        )
+
+        configure_wall_clock_budget(config.policy_timeout)
+
         batcher = MicroBatcher(
             environment,
             max_batch_size=config.max_batch_size,

@@ -11,6 +11,14 @@ wasm/binary.py. Design choices:
   every executed instruction costs 1 fuel; exhaustion raises
   :class:`WasmFuelExhausted` and the caller maps it to the reference's
   "execution deadline exceeded" semantics.
+* **Wall-clock deadline**: fuel bounds instructions, not time — a slow-
+  but-terminating guest can exceed the policy timeout in real time
+  without exhausting fuel. :func:`deadline_scope` arms an ambient
+  (thread-local) absolute deadline; the dispatch loop checks the clock
+  every 65536 instructions (piggybacked on the fuel countdown, ~ms
+  granularity) and raises :class:`WasmDeadlineExceeded`, which IS a
+  WasmFuelExhausted so callers map both to the reference's wall-clock
+  epoch semantics (src/lib.rs:176-190).
 * **Host imports** are plain Python callables registered per module+name;
   imported memories come from the embedder (the OPA ABI imports
   ``env.memory``).
@@ -18,9 +26,12 @@ wasm/binary.py. Design choices:
 
 from __future__ import annotations
 
+import contextlib
 import math
 import struct
-from typing import Any, Callable, Mapping
+import threading
+import time
+from typing import Any, Callable, Iterator, Mapping
 
 from policy_server_tpu.wasm.binary import (
     ELSE,
@@ -46,6 +57,31 @@ class WasmTrap(Exception):
 
 class WasmFuelExhausted(WasmTrap):
     pass
+
+
+class WasmDeadlineExceeded(WasmFuelExhausted):
+    """Wall-clock budget exceeded (subclasses WasmFuelExhausted so every
+    caller's deadline mapping covers both)."""
+
+
+_ambient = threading.local()
+
+
+@contextlib.contextmanager
+def deadline_scope(seconds: float | None) -> Iterator[None]:
+    """Arm a wall-clock budget for Instances created on this thread within
+    the scope (nested scopes keep the TIGHTER deadline). ``None`` is a
+    no-op — deadline disabled, reference parity with --policy-timeout 0."""
+    if seconds is None:
+        yield
+        return
+    prev = getattr(_ambient, "deadline", None)
+    mine = time.monotonic() + seconds
+    _ambient.deadline = mine if prev is None else min(prev, mine)
+    try:
+        yield
+    finally:
+        _ambient.deadline = prev
 
 
 def _i32(v: int) -> int:
@@ -134,6 +170,12 @@ class Instance:
         fuel: int | None = 500_000_000,
     ):
         self.module = module
+        # ambient wall-clock deadline (deadline_scope) captured at
+        # instantiation; the check piggybacks on the fuel countdown, so a
+        # deadline with fuel disabled arms an effectively-infinite tank
+        self.deadline = getattr(_ambient, "deadline", None)
+        if self.deadline is not None and fuel is None:
+            fuel = 1 << 62
         self.fuel = fuel
         imports = imports or {}
         self.funcs: list[_Func] = []
@@ -257,6 +299,7 @@ class Instance:
         code = fn.body
         pc = 0
         fuel = self.fuel
+        deadline = self.deadline
 
         while True:
             if fuel is not None:
@@ -264,6 +307,15 @@ class Instance:
                 if fuel <= 0:
                     self.fuel = 0
                     raise WasmFuelExhausted("wasm fuel exhausted")
+                if (
+                    deadline is not None
+                    and (fuel & 0xFFFF) == 0
+                    and time.monotonic() >= deadline
+                ):
+                    self.fuel = fuel
+                    raise WasmDeadlineExceeded(
+                        "wasm wall-clock deadline exceeded"
+                    )
             op, imm = code[pc]
 
             if op == 0x20:  # local.get
