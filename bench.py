@@ -285,7 +285,11 @@ def bench_config5_child() -> None:
     requests = build_requests(2048, seed=9)
     pids = list(policies)
     items = [(pids[i % len(pids)], r) for i, r in enumerate(requests)]
-    sharded.validate_batch(items[:256])  # prime every shard
+    # prime with a FULL pass: per-shard batches land in the same shape
+    # bucket as the timed run, so XLA compiles OUTSIDE the timed region
+    # (priming with a slice measured compile time, not serving: 2,085
+    # rps reported in r3 vs ~90k steady-state on the same machine)
+    sharded.validate_batch(items)
     t0 = time.perf_counter()
     sharded.validate_batch(items)
     wall = time.perf_counter() - t0
@@ -295,15 +299,21 @@ def bench_config5_child() -> None:
     t1 = time.perf_counter()
     sharded.resize(list(jax.devices())[:6])
     churn_s = time.perf_counter() - t1
+    # first post-churn batch pays the rebalanced shards' compiles —
+    # report that stall separately from steady-state serving
     t2 = time.perf_counter()
     sharded.validate_batch(items[:512])
-    post_wall = time.perf_counter() - t2
+    first_post_wall = time.perf_counter() - t2
+    t3 = time.perf_counter()
+    sharded.validate_batch(items[:512])
+    post_wall = time.perf_counter() - t3
 
     print(
         json.dumps(
             {
                 "rps": len(items) / wall,
                 "churn_rebuild_s": churn_s,
+                "post_churn_first_batch_s": first_post_wall,
                 "post_churn_rps": 512 / post_wall,
                 "shards_before": 8,
                 "shards_after": sharded.mesh.shape["policy"],
@@ -347,6 +357,7 @@ def bench_config5() -> None:
         "reviews/s (8 virtual cpu devices)",
         doc["rps"] / NORTH_STAR_RPS,
         churn_rebuild_s=round(doc["churn_rebuild_s"], 2),
+        post_churn_first_batch_s=round(doc["post_churn_first_batch_s"], 2),
         post_churn_rps=round(doc["post_churn_rps"], 1),
         shards_before=doc["shards_before"],
         shards_after=doc["shards_after"],
@@ -478,6 +489,62 @@ def bench_http(
 
 
 # ---------------------------------------------------------------------------
+# Wasm escape-hatch path: interpreter reviews/s (VERDICT r3 weak #4)
+# ---------------------------------------------------------------------------
+
+
+def bench_wasm(requests) -> None:
+    """Cost of the host wasm interpreter — the generality escape hatch for
+    policies outside the predicate IR. Measures reviews/s through the waPC
+    WAT oracle policy and (when the upstream fixture is present) an
+    upstream-compiled Gatekeeper module. Its own baseline: the reference
+    runs these under wasmtime's cranelift-JIT native code at ≈1 ms/request
+    (≈1k reviews/s end-to-end, dominated by non-wasm overhead); a pure-
+    Python interpreter is expected to be far slower — this line makes that
+    cost a number instead of a guess."""
+    import pathlib
+
+    from policy_server_tpu.policies.wasm_oracle import oracle_policy
+    from policy_server_tpu.wasm.opa import OpaPolicy, gatekeeper_validate
+
+    ref_single_rps = 1_000.0
+    docs = [r.payload() for r in requests[:200]]
+
+    pol = oracle_policy("pod-privileged")
+    pol.validate(docs[0], {})  # prime (assemble + decode)
+    t0 = time.perf_counter()
+    for d in docs:
+        pol.validate(d, {})
+    wapc_wall = time.perf_counter() - t0
+    wapc_rps = len(docs) / wapc_wall
+
+    gk_rps = None
+    fixture = pathlib.Path(
+        "/root/reference/tests/data/gatekeeper_always_happy_policy.wasm"
+    )
+    if fixture.exists():
+        opa = OpaPolicy(fixture.read_bytes())
+        gk_docs = docs[:20]  # upstream module: heavier per call
+        gatekeeper_validate(opa, gk_docs[0], parameters={})
+        t0 = time.perf_counter()
+        for d in gk_docs:
+            gatekeeper_validate(opa, d, parameters={})
+        gk_rps = len(gk_docs) / (time.perf_counter() - t0)
+
+    emit(
+        "wasm_interpreter_reviews_per_sec",
+        wapc_rps,
+        "reviews/s",
+        wapc_rps / ref_single_rps,
+        wat_wapc_rps=round(wapc_rps, 1),
+        gatekeeper_fixture_rps=round(gk_rps, 1) if gk_rps else None,
+        n_requests=len(docs),
+        baseline="reference wasmtime-JIT sync path ≈1k reviews/s; the "
+        "interpreter is the correctness escape hatch, not the serving path",
+    )
+
+
+# ---------------------------------------------------------------------------
 # Config 4 (headline): 32-policy firehose
 # ---------------------------------------------------------------------------
 
@@ -552,6 +619,7 @@ def main() -> int:
         bench_config1: "config1_namespace_validate_single",
         bench_config2: "config2_psp_pair_1k_replay",
         bench_config3: "config3_image_signatures_group",
+        bench_wasm: "wasm_interpreter_reviews_per_sec",
     }
     for fn, metric in config_metrics.items():
         try:

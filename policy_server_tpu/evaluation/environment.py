@@ -79,6 +79,10 @@ from policy_server_tpu.ops.compiler import compile_program
 from policy_server_tpu.policies import resolve_builtin
 from policy_server_tpu.utils.interning import InternTable
 
+# distinct from None: None DISABLES the wasm wall-clock budget
+# (--disable-timeout-protection), the sentinel leaves module defaults
+_BUDGET_UNSET = object()
+
 GROUP_MUTATION_MESSAGE = "mutation is not allowed inside of policy group"
 
 # Device-input feature key carrying host-computed wasm group-member verdict
@@ -182,6 +186,7 @@ class EvaluationEnvironmentBuilder:
         small_nested_axis_cap: int = 4,
         always_accept_admission_reviews_on_namespace: str | None = None,
         context_service: Any = None,
+        wasm_wall_clock_budget: float | None | object = _BUDGET_UNSET,
     ) -> None:
         self.backend = backend
         self.continue_on_errors = continue_on_errors
@@ -192,6 +197,10 @@ class EvaluationEnvironmentBuilder:
         self.small_nested_axis_cap = small_nested_axis_cap
         self.always_accept_namespace = always_accept_admission_reviews_on_namespace
         self.context_service = context_service
+        # unset = leave each module's own default; a float syncs wasm
+        # modules to the server's --policy-timeout (wall-clock epoch
+        # analog); None disables (--disable-timeout-protection)
+        self.wasm_wall_clock_budget = wasm_wall_clock_budget
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
         cache = ProgramCache()
@@ -208,6 +217,10 @@ class EvaluationEnvironmentBuilder:
             ctx_allowlist: frozenset = frozenset(),
         ) -> BoundPolicy:
             module = self.module_resolver(module_url)
+            if self.wasm_wall_clock_budget is not _BUDGET_UNSET and hasattr(
+                module, "wall_clock_budget"
+            ):
+                module.wall_clock_budget = self.wasm_wall_clock_budget
             validation = module.validate_settings(dict(settings or {}))
             if not validation.valid:
                 # reference: "Policy settings are invalid" (rs:472-510)

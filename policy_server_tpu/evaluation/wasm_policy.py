@@ -37,18 +37,6 @@ from policy_server_tpu.wasm.wasi import WasiError, WasiPolicy
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
 
-# Wall-clock budget per wasm evaluation — the epoch-interruption analog
-# (reference --policy-timeout default 2 s, src/cli.rs:164-169). The server
-# bootstrap syncs this to the configured policy timeout; None disables.
-_WALL_CLOCK_BUDGET: float | None = 2.0
-
-
-def configure_wall_clock_budget(seconds: float | None) -> None:
-    """Set the per-evaluation wall-clock budget for all wasm policies
-    (called from server bootstrap with --policy-timeout)."""
-    global _WALL_CLOCK_BUDGET
-    _WALL_CLOCK_BUDGET = seconds
-
 
 class WasmPolicyModule:
     """PolicyModule protocol over a wasm payload (multi-ABI)."""
@@ -59,9 +47,17 @@ class WasmPolicyModule:
         name: str,
         digest: str,
         fuel: int | None = 50_000_000,
+        wall_clock_budget: float | None = 2.0,
     ):
         self.name = name
         self.digest = digest
+        # Per-evaluation wall-clock budget — the epoch-interruption analog
+        # (reference --policy-timeout default 2 s, src/cli.rs:164-169).
+        # The environment builder syncs this to the server's configured
+        # policy timeout; None disables. Instance state, not a process
+        # global: each server's environment owns its modules the way each
+        # reference PolicyServer owns its wasmtime Engine epoch.
+        self.wall_clock_budget = wall_clock_budget
         module = decode_module(wasm_bytes)  # decoded ONCE, shared by hosts
         exports = {e.name for e in module.exports}
         if "__guest_call" in exports:
@@ -131,7 +127,7 @@ class WasmPolicyModule:
                 }
 
         def _evaluate_inner(payload: Any) -> Mapping[str, Any]:
-            with deadline_scope(_WALL_CLOCK_BUDGET):
+            with deadline_scope(self.wall_clock_budget):
                 if self.abi == "wapc":
                     # the guest gets the REQUEST; cluster state is served
                     # on demand through the kubernetes capabilities from
@@ -174,7 +170,16 @@ class WasmPolicyModule:
         if self.abi in ("wapc", "wasi"):
             host = self._wapc if self.abi == "wapc" else self._wasi
             try:
-                doc = host.validate_settings(dict(settings or {}))
+                # settings validation runs at boot but executes GUEST code:
+                # it needs the same wall-clock cut as evaluate(), or a
+                # spinning validate_settings hangs environment build
+                with deadline_scope(self.wall_clock_budget):
+                    doc = host.validate_settings(dict(settings or {}))
+            except WasmFuelExhausted:
+                return SettingsValidationResponse(
+                    valid=False,
+                    message=f"settings validation failed: {DEADLINE_MESSAGE}",
+                )
             except (WasmTrap, WapcError, OpaError, WasiError) as e:
                 return SettingsValidationResponse(
                     valid=False, message=f"settings validation failed: {e}"

@@ -278,8 +278,105 @@ class _Strings:
         return off, len(text.encode())
 
 
-def _simple_match_policy(match_body: str, strings: _Strings) -> str:
-    funcs = f"""
+def _container_item_helpers(s: _Strings) -> str:
+    """WAT helpers enforcing the flat-ABI list discipline: a key reaches a
+    container item only through ``spec.<list>.#<digits>`` — mapping keys
+    can never render a ``#``-leading segment (wapc.flatten_payload), so
+    adversarial mapping-shaped ``containers`` cannot spoof a match.
+    Mirrors the tensor codec, whose container star axes iterate LIST
+    items only (entry wrappers for mappings expose no container fields)."""
+    pres = [
+        s.add("request.object.spec.containers.#"),
+        s.add("request.object.spec.initContainers.#"),
+        s.add("request.object.spec.ephemeralContainers.#"),
+    ]
+    arms = "\n".join(
+        f"""    local.get $k
+    local.get $klen
+    i32.const {off}
+    i32.const {ln}
+    call $starts_with
+    if
+      local.get $k
+      local.get $klen
+      i32.const {ln}
+      local.get $suf
+      local.get $suflen
+      call $digits_then_suffix
+      if
+        i32.const 1
+        return
+      end
+    end"""
+        for off, ln in pres
+    )
+    return f"""
+  ;; key[i..] is 1+ ASCII digits immediately followed by exactly $suf
+  (func $digits_then_suffix (param $k i32) (param $klen i32) (param $i i32) (param $suf i32) (param $suflen i32) (result i32)
+    (local $n i32) (local $c i32)
+    block $done
+      loop $scan
+        local.get $i
+        local.get $klen
+        i32.ge_u
+        br_if $done
+        local.get $k
+        local.get $i
+        i32.add
+        i32.load8_u
+        local.set $c
+        local.get $c
+        i32.const 48
+        i32.lt_u
+        br_if $done
+        local.get $c
+        i32.const 57
+        i32.gt_u
+        br_if $done
+        local.get $i
+        i32.const 1
+        i32.add
+        local.set $i
+        local.get $n
+        i32.const 1
+        i32.add
+        local.set $n
+        br $scan
+      end
+    end
+    local.get $n
+    i32.eqz
+    if
+      i32.const 0
+      return
+    end
+    local.get $klen
+    local.get $i
+    i32.sub
+    local.get $suflen
+    i32.ne
+    if
+      i32.const 0
+      return
+    end
+    local.get $k
+    local.get $i
+    i32.add
+    local.get $suf
+    local.get $suflen
+    call $memeq)
+
+  ;; key == spec.(containers|initContainers|ephemeralContainers).#N + $suf
+  (func $container_item_suffix (param $k i32) (param $klen i32) (param $suf i32) (param $suflen i32) (result i32)
+{arms}
+    i32.const 0)
+"""
+
+
+def _simple_match_policy(
+    match_body: str, strings: _Strings, extra_funcs: str = ""
+) -> str:
+    funcs = f"""{extra_funcs}
   (func $match (param $k i32) (param $klen i32) (param $v i32) (param $vlen i32) (result i32)
 {match_body})
 
@@ -313,31 +410,24 @@ def _always_unhappy() -> str:
 
 def _pod_privileged() -> str:
     s = _Strings()
-    pre, prelen = s.add("request.object.spec.")
+    helpers = _container_item_helpers(s)
     suf, suflen = s.add(".securityContext.privileged")
-    true_off, true_len = s.add("true")
+    true_off, true_len = s.add("btrue")  # type-tagged bool true
     body = f"""    local.get $k
     local.get $klen
-    i32.const {pre}
-    i32.const {prelen}
-    call $starts_with
+    i32.const {suf}
+    i32.const {suflen}
+    call $container_item_suffix
     if
-      local.get $k
-      local.get $klen
-      i32.const {suf}
-      i32.const {suflen}
-      call $ends_with
-      if
-        local.get $v
-        local.get $vlen
-        i32.const {true_off}
-        i32.const {true_len}
-        call $str_eq
-        return
-      end
+      local.get $v
+      local.get $vlen
+      i32.const {true_off}
+      i32.const {true_len}
+      call $str_eq
+      return
     end
     i32.const 0"""
-    return _simple_match_policy(body, s)
+    return _simple_match_policy(body, s, helpers)
 
 
 def _host_namespaces() -> str:
@@ -347,7 +437,7 @@ def _host_namespaces() -> str:
         s.add("request.object.spec.hostPID"),
         s.add("request.object.spec.hostIPC"),
     ]
-    true_off, true_len = s.add("true")
+    true_off, true_len = s.add("btrue")  # type-tagged bool true
     checks = []
     for off, length in keys:
         checks.append(f"""    local.get $k
@@ -475,10 +565,10 @@ def _namespace_validate() -> str:
 def _disallow_latest_tag() -> str:
     """Image must carry an explicit non-latest tag (or a digest)."""
     s = _Strings()
-    pre, prelen = s.add("request.object.spec.")
+    helpers = _container_item_helpers(s)
     suf, suflen = s.add(".image")
     latest, latest_len = s.add(":latest")
-    funcs = f"""
+    funcs = f"""{helpers}
   ;; is the image value untagged (no ':' or '@' after the last '/')?
   (func $untagged (param $v i32) (param $vlen i32) (result i32)
     (local $i i32) (local $start i32) (local $c i32)
@@ -550,31 +640,53 @@ def _disallow_latest_tag() -> str:
   (func $match (param $k i32) (param $klen i32) (param $v i32) (param $vlen i32) (result i32)
     local.get $k
     local.get $klen
-    i32.const {pre}
-    i32.const {prelen}
-    call $starts_with
+    i32.const {suf}
+    i32.const {suflen}
+    call $container_item_suffix
     if
-      local.get $k
-      local.get $klen
-      i32.const {suf}
-      i32.const {suflen}
-      call $ends_with
+      ;; null ('z') means image absent → no violation; any other
+      ;; non-string value is present-but-not-a-string, which the device
+      ;; treats as untagged (Exists & ~matches-regex) → violation
+      local.get $vlen
+      i32.eqz
       if
-        ;; violation when untagged OR ends with :latest
-        local.get $v
-        local.get $vlen
-        call $untagged
-        if
-          i32.const 1
-          return
-        end
-        local.get $v
-        local.get $vlen
-        i32.const {latest}
-        i32.const {latest_len}
-        call $ends_with
+        i32.const 0
         return
       end
+      local.get $v
+      i32.load8_u
+      i32.const 122  ;; 'z'
+      i32.eq
+      if
+        i32.const 0
+        return
+      end
+      local.get $v
+      i32.load8_u
+      i32.const 115  ;; 's'
+      i32.ne
+      if
+        i32.const 1
+        return
+      end
+      ;; violation when untagged OR ends with :latest (skip the tag byte)
+      local.get $v
+      i32.const 1
+      i32.add
+      local.get $vlen
+      i32.const 1
+      i32.sub
+      call $untagged
+      if
+        i32.const 1
+        return
+      end
+      local.get $v
+      local.get $vlen
+      i32.const {latest}
+      i32.const {latest_len}
+      call $ends_with
+      return
     end
     i32.const 0)
 

@@ -35,6 +35,17 @@ from policy_server_tpu.telemetry import setup_metrics
 from policy_server_tpu.telemetry.tracing import logger
 
 
+class _PendingRespawn:
+    """Placeholder in the worker-process table for a slot whose respawn is
+    delayed by crash-loop backoff (its previous process has been reaped)."""
+
+    def __init__(self, returncode):
+        self.returncode = returncode
+
+    def poll(self):  # duck-type subprocess.Popen for liveness checks
+        return self.returncode
+
+
 class PolicyServer:
     """The bootstrapped server (reference PolicyServer, lib.rs:64-72)."""
 
@@ -140,17 +151,12 @@ class PolicyServer:
                 config.always_accept_admission_reviews_on_namespace
             ),
             context_service=context_service,
+            # wasm guests get the configured wall-clock budget (the
+            # epoch-interruption analog: fuel bounds instructions, this
+            # bounds TIME, reference src/lib.rs:176-190)
+            wasm_wall_clock_budget=config.policy_timeout,
         )
         environment = _build_environment(config, builder_kwargs)
-
-        # wasm guests share the configured wall-clock budget (the
-        # epoch-interruption analog: fuel bounds instructions, this bounds
-        # TIME, reference src/lib.rs:176-190)
-        from policy_server_tpu.evaluation.wasm_policy import (
-            configure_wall_clock_budget,
-        )
-
-        configure_wall_clock_budget(config.policy_timeout)
 
         batcher = MicroBatcher(
             environment,
@@ -336,23 +342,81 @@ class PolicyServer:
         )
 
     _WORKER_RESPAWN_INTERVAL_SECONDS = 2.0
+    # crash-loop discipline (the reference defers to kubelet's restart
+    # backoff; the in-box supervisor needs the same): a worker dying
+    # within the crash window of its spawn is a crash-loop death —
+    # respawn with exponential backoff, give up on the slot after K
+    # consecutive fast deaths (a worker that boots on a bad port/config
+    # would otherwise respawn forever at 0.5 Hz)
+    _WORKER_CRASH_WINDOW_SECONDS = 5.0
+    _WORKER_BACKOFF_BASE_SECONDS = 0.5
+    _WORKER_BACKOFF_CAP_SECONDS = 30.0
+    _WORKER_CRASH_GIVEUP = 5
 
     async def _supervise_workers(self) -> None:
         """Respawn dead frontend workers (the in-box analog of kubelet
         restarting reference replicas): a crashed worker otherwise shrinks
-        the SO_REUSEPORT accept pool until restart."""
+        the SO_REUSEPORT accept pool until restart. Fast-crashing workers
+        back off exponentially and the slot is abandoned after
+        ``_WORKER_CRASH_GIVEUP`` consecutive fast deaths."""
         import subprocess
         import sys
+        import time as _time
+
+        now = _time.monotonic()
+        spawned_at = [now] * len(self._worker_procs)
+        fast_deaths = [0] * len(self._worker_procs)
+        respawn_at = [0.0] * len(self._worker_procs)
+        self._worker_slots_given_up = 0
 
         while True:
             await asyncio.sleep(self._WORKER_RESPAWN_INTERVAL_SECONDS)
+            now = _time.monotonic()
             for i, proc in enumerate(list(self._worker_procs)):
-                if proc.poll() is None:
+                if (
+                    proc is None
+                    or isinstance(proc, _PendingRespawn)
+                    or proc.poll() is None
+                ):
                     continue
+                lifetime = now - spawned_at[i]
+                if lifetime < self._WORKER_CRASH_WINDOW_SECONDS:
+                    fast_deaths[i] += 1
+                else:
+                    fast_deaths[i] = 0
+                if fast_deaths[i] >= self._WORKER_CRASH_GIVEUP:
+                    logger.error(
+                        "frontend worker slot %d crash-looped %d times "
+                        "within %.1fs of spawn (rc=%s); giving up on the "
+                        "slot — the remaining processes keep serving",
+                        i, fast_deaths[i],
+                        self._WORKER_CRASH_WINDOW_SECONDS, proc.returncode,
+                    )
+                    self._worker_procs[i] = None
+                    self._worker_slots_given_up += 1
+                    continue
+                backoff = 0.0
+                if fast_deaths[i]:
+                    backoff = min(
+                        self._WORKER_BACKOFF_CAP_SECONDS,
+                        self._WORKER_BACKOFF_BASE_SECONDS
+                        * 2 ** (fast_deaths[i] - 1),
+                    )
+                respawn_at[i] = now + backoff
                 logger.warning(
-                    "frontend worker died (rc=%s); respawning", proc.returncode
+                    "frontend worker died (rc=%s, lived %.1fs); respawning "
+                    "in %.1fs (consecutive fast deaths: %d)",
+                    proc.returncode, lifetime, backoff, fast_deaths[i],
                 )
-                self._worker_procs[i] = subprocess.Popen(self._worker_cmd)
+                # mark the slot pending; actual spawn below when due
+                self._worker_procs[i] = _PendingRespawn(proc.returncode)
+            for i, proc in enumerate(list(self._worker_procs)):
+                if (
+                    isinstance(proc, _PendingRespawn)
+                    and now >= respawn_at[i]
+                ):
+                    self._worker_procs[i] = subprocess.Popen(self._worker_cmd)
+                    spawned_at[i] = _time.monotonic()
 
     async def stop(self) -> None:
         import contextlib
@@ -365,11 +429,15 @@ class PolicyServer:
                 await supervisor
             self._worker_supervisor = None
 
-        for proc in self._worker_procs:
+        live_procs = [
+            p for p in self._worker_procs
+            if p is not None and not isinstance(p, _PendingRespawn)
+        ]
+        for proc in live_procs:
             with contextlib.suppress(ProcessLookupError):
                 proc.terminate()
         loop = asyncio.get_running_loop()
-        for proc in self._worker_procs:
+        for proc in live_procs:
             try:
                 # off-loop wait: a wedged worker must not stall shutdown's
                 # event loop; escalate to SIGKILL so no orphan keeps a

@@ -40,30 +40,53 @@ class WapcError(Exception):
     pass
 
 
+def _escape_map_key(k: str) -> str:
+    """Mapping keys are escaped so a rendered mapping key can never
+    contain ``#`` (list-index marker) or ``.`` (path separator): any
+    ``#`` in a flat key provably marks list traversal, and any ``.``
+    provably separates path segments. Without this, a mapping key like
+    ``spec.hostNetwork`` or ``containers.#0.securityContext.privileged``
+    (dots inside ONE key) would render byte-identical to a real
+    structural path and spoof the WAT oracles' matchers. The tensor
+    codec treats such keys as single opaque keys (trie walk is
+    structural), so the oracles must see them the same way."""
+    return k.replace("%", "%25").replace("#", "%23").replace(".", "%2E")
+
+
 def flatten_payload(doc: Any, prefix: str = "") -> bytes:
     """JSON → ``key\\0value\\0`` entries (sorted, deterministic).
-    Scalars render as JSON-ish text: strings raw, bools true/false,
-    null, numbers via repr. Arrays use numeric path segments."""
+
+    Keys: dotted paths; list indices render as ``#N`` segments; mapping
+    keys are %-escaped so they can never start with ``#`` (see
+    ``_escape_map_key``).
+
+    Values are TYPE-TAGGED with one leading byte so wasm policies can
+    tell a JSON string from other scalars rendering to the same text
+    (``true`` vs ``"true"`` — an untagged ABI made bool-valued policy
+    checks spoofable by strings): ``s`` string (raw bytes follow),
+    ``b`` bool (``btrue``/``bfalse``), ``z`` null, ``n`` number
+    (JSON text follows)."""
     entries: list[tuple[str, str]] = []
 
     def walk(node: Any, path: str) -> None:
         if isinstance(node, Mapping):
             for k in sorted(node):
-                walk(node[k], f"{path}.{k}" if path else str(k))
+                ek = _escape_map_key(str(k))
+                walk(node[k], f"{path}.{ek}" if path else ek)
         elif isinstance(node, (list, tuple)):
             for i, v in enumerate(node):
-                walk(v, f"{path}.{i}" if path else str(i))
+                walk(v, f"{path}.#{i}" if path else f"#{i}")
         else:
             if node is True:
-                text = "true"
+                text = "btrue"
             elif node is False:
-                text = "false"
+                text = "bfalse"
             elif node is None:
-                text = "null"
+                text = "z"
             elif isinstance(node, str):
-                text = node
+                text = "s" + node
             else:
-                text = json.dumps(node)
+                text = "n" + json.dumps(node)
             if "\x00" in path or "\x00" in text:
                 # NUL is legal inside JSON strings but is this ABI's entry
                 # framing: letting it through would let a request string

@@ -159,3 +159,61 @@ def test_worker_shutdown_with_server(prefork_server):
 
     assert prefork_server.server._bridge_socket
     assert os.path.exists(prefork_server.server._bridge_socket)
+
+
+def test_crash_looping_worker_backs_off_and_gives_up():
+    """A worker that dies at startup must not respawn forever at a fixed
+    rate: consecutive fast deaths back off exponentially and the slot is
+    abandoned after the give-up threshold, while the remaining processes
+    keep serving (the reference defers this discipline to kubelet's
+    CrashLoopBackOff; the in-box supervisor needs its own)."""
+    import asyncio
+    import sys
+
+    import aiohttp
+
+    from policy_server_tpu.server import PolicyServer
+    from policy_server_tpu.telemetry import metrics as metrics_mod
+
+    metrics_mod.reset_metrics_for_tests()
+    config = make_config(http_workers=2)  # main + 1 child worker
+    server = PolicyServer.new_from_config(config)
+    # fast supervision so the whole loop fits in test time; a WIDE crash
+    # window because python subprocess startup alone can take seconds on
+    # a loaded single-core VM — every death here must count as "fast"
+    server._WORKER_RESPAWN_INTERVAL_SECONDS = 0.1
+    server._WORKER_CRASH_WINDOW_SECONDS = 60.0
+    server._WORKER_BACKOFF_BASE_SECONDS = 0.05
+    server._WORKER_CRASH_GIVEUP = 3
+
+    async def scenario():
+        await server.start()
+        try:
+            assert len(server._worker_procs) == 1
+            # every future respawn now crashes immediately at startup
+            server._worker_cmd = [
+                sys.executable, "-c", "import sys; sys.exit(7)"
+            ]
+            server._worker_procs[0].kill()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if server._worker_procs[0] is None:
+                    break
+                await asyncio.sleep(0.1)
+            assert server._worker_procs[0] is None, "slot must be abandoned"
+            assert server._worker_slots_given_up == 1
+            # the main process keeps serving after giving the slot up
+            async with aiohttp.ClientSession() as s:
+                body = pod_review_body(False)
+                url = (
+                    f"http://127.0.0.1:{server.api_port}"
+                    "/validate/pod-privileged"
+                )
+                async with s.post(url, json=body) as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert doc["response"]["allowed"] is True
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())

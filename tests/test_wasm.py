@@ -193,9 +193,35 @@ def test_br_table_and_globals():
 
 
 def test_flatten_payload_deterministic():
-    doc = {"b": [1, {"x": True}], "a": None, "s": "txt"}
+    # values carry a one-byte type tag (s/b/n/z); list indices render as
+    # #N segments; mapping keys are %-escaped so none can contain '#'
+    # (list marker) or '.' (path separator) — both would spoof structure
+    doc = {"b": [1, {"x": True}], "a": None, "s": "txt", "a.#0.b": 2, "%": ""}
     flat = flatten_payload(doc)
-    assert flat == b"a\x00null\x00b.0\x001\x00b.1.x\x00true\x00s\x00txt\x00"
+    assert flat == (
+        b"%25\x00s\x00"  # sorted by original key; '%' escapes to '%25'
+        b"a\x00z\x00"
+        b"a%2E%230%2Eb\x00n2\x00"  # '#' and '.' escape ANYWHERE in a key
+        b"b.#0\x00n1\x00"
+        b"b.#1.x\x00btrue\x00"
+        b"s\x00stxt\x00"
+    )
+
+
+def test_flat_abi_dotted_mapping_key_cannot_spoof_structure():
+    """A mapping key 'spec.hostNetwork' is ONE key (the tensor codec's
+    trie walk is structural); the flat ABI must not render it identical
+    to the real nested path, or the WAT oracle falsely denies."""
+    from policy_server_tpu.policies.wasm_oracle import oracle_policy
+
+    out = oracle_policy("host-namespaces").validate(
+        {"object": {"spec.hostNetwork": True}}, {}
+    )
+    assert out["accepted"] is True
+    out = oracle_policy("host-namespaces").validate(
+        {"object": {"spec": {"hostNetwork": True}}}, {}
+    )
+    assert out["accepted"] is False
 
 
 def test_wapc_missing_export_rejected():

@@ -59,40 +59,82 @@ def test_no_deadline_without_scope():
         inst.invoke("__guest_call", 0, 0)
 
 
+# spins on the 8-byte "validate" op only; any other op (validate_settings,
+# protocol_version) answers {"valid":true} — so environment BUILD succeeds
+# and the deadline trips at evaluation time
+SPIN_ON_VALIDATE_WAPC = """
+(module
+  (import "wapc" "__guest_response" (func $guest_response (param i32 i32)))
+  (memory (export "memory") 1)
+  (data (i32.const 8) "{\\22valid\\22:true}")
+  (func (export "__guest_call") (param $op_len i32) (param $n i32) (result i32)
+    local.get $op_len
+    i32.const 8
+    i32.eq
+    if
+      loop $spin
+        br $spin
+      end
+    end
+    i32.const 8
+    i32.const 14
+    call $guest_response
+    i32.const 1)
+)
+"""
+
+
 def test_wasm_policy_rejected_in_band_at_wall_clock():
     """A spinning wasm POLICY resolves in-band with the reference's
     deadline message at ~policy_timeout, regardless of fuel."""
     from policy_server_tpu.evaluation.wasm_policy import (
         DEADLINE_MESSAGE,
         WasmPolicyModule,
-        configure_wall_clock_budget,
     )
 
     module = WasmPolicyModule(
-        assemble(SPIN_WAPC), name="spin", digest="x", fuel=None
+        assemble(SPIN_WAPC), name="spin", digest="x", fuel=None,
+        wall_clock_budget=0.3,
     )
     program = module.build({})
-    configure_wall_clock_budget(0.3)
-    try:
-        t0 = time.perf_counter()
-        verdict = program.host_evaluator({"uid": "u1"})
-        elapsed = time.perf_counter() - t0
-    finally:
-        configure_wall_clock_budget(2.0)  # restore the default
+    t0 = time.perf_counter()
+    verdict = program.host_evaluator({"uid": "u1"})
+    elapsed = time.perf_counter() - t0
     assert verdict["accepted"] is False
     assert verdict["message"] == DEADLINE_MESSAGE
     assert verdict["code"] == 500
     assert elapsed < 2.0
 
 
+def test_settings_validation_deadline_cut():
+    """validate_settings also executes guest code — a spinning guest must
+    not hang environment build; it surfaces as invalid settings."""
+    from policy_server_tpu.evaluation.wasm_policy import (
+        DEADLINE_MESSAGE,
+        WasmPolicyModule,
+    )
+
+    module = WasmPolicyModule(
+        assemble(SPIN_WAPC), name="spin", digest="x", fuel=None,
+        wall_clock_budget=0.3,
+    )
+    t0 = time.perf_counter()
+    resp = module.validate_settings({})
+    elapsed = time.perf_counter() - t0
+    assert resp.valid is False
+    assert DEADLINE_MESSAGE in (resp.message or "")
+    assert elapsed < 2.0
+
+
 def test_wasm_policy_serves_deadline_through_environment():
+    """End to end: the builder syncs --policy-timeout onto the module
+    (wasm_wall_clock_budget) and a spinning validate is rejected in-band."""
     from policy_server_tpu.evaluation.environment import (
         EvaluationEnvironmentBuilder,
     )
     from policy_server_tpu.evaluation.wasm_policy import (
         DEADLINE_MESSAGE,
         WasmPolicyModule,
-        configure_wall_clock_budget,
     )
     from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
     from policy_server_tpu.models.policy import parse_policy_entry
@@ -100,19 +142,21 @@ def test_wasm_policy_serves_deadline_through_environment():
     from conftest import build_admission_review_dict
 
     module = WasmPolicyModule(
-        assemble(SPIN_WAPC), name="spin", digest="x", fuel=None
+        assemble(SPIN_ON_VALIDATE_WAPC), name="spin", digest="x", fuel=None
     )
     env = EvaluationEnvironmentBuilder(
-        backend="jax", module_resolver=lambda url: module
+        backend="jax",
+        module_resolver=lambda url: module,
+        wasm_wall_clock_budget=0.3,
     ).build({"spin": parse_policy_entry("spin", {"module": "file:///s.wasm"})})
+    assert module.wall_clock_budget == 0.3  # builder synced the budget
     req = ValidateRequest.from_admission(
         AdmissionReviewRequest.from_dict(build_admission_review_dict()).request
     )
-    configure_wall_clock_budget(0.3)
-    try:
-        resp = env.validate("spin", req)
-    finally:
-        configure_wall_clock_budget(2.0)
+    t0 = time.perf_counter()
+    resp = env.validate("spin", req)
+    elapsed = time.perf_counter() - t0
     assert resp.allowed is False
     assert resp.status.code == 500
     assert DEADLINE_MESSAGE in resp.status.message
+    assert elapsed < 2.0
