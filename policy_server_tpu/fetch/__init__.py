@@ -53,10 +53,19 @@ def make_module_resolver(config: "Config") -> Callable[[str], "PolicyModule"]:
     per verification.yml, and loaded as a `.tpp.json` IR artifact."""
     from policy_server_tpu.policies import resolve_builtin
 
+    # offline sigstore trust root (lib.rs:309-336 analog): present in the
+    # sigstore cache dir → keyless requirement kinds verify; absent →
+    # they fail loudly per-requirement (degraded, like the reference's
+    # failed TUF fetch, lib.rs:81-89)
+    from policy_server_tpu.fetch.keyless import TrustRoot
+
+    trust_root = TrustRoot.load_from_cache_dir(config.sigstore_cache_dir)
+
     downloader = Downloader(
         sources=config.sources,
         verification_config=config.verification_config,
         docker_config_json_path=config.docker_config_json_path,
+        trust_root=trust_root,
     )
     dest = Path(config.policies_download_dir)
     cache: dict[str, "PolicyModule"] = {}
@@ -71,7 +80,9 @@ def make_module_resolver(config: "Config") -> Callable[[str], "PolicyModule"]:
         path = downloader.fetch_policy(url, dest)
         digest = None
         if config.verification_config is not None:
-            digest = verify_artifact(path, config.verification_config)
+            digest = verify_artifact(
+                path, config.verification_config, trust_root=trust_root
+            )
         module = load_artifact(path)
         if digest is not None and module.digest != digest:
             # verify→load TOCTOU guard (the reference's post-download local

@@ -96,9 +96,11 @@ class Downloader:
         sources: Sources | None = None,
         verification_config: VerificationConfig | None = None,
         docker_config_json_path: str | None = None,
+        trust_root=None,  # fetch/keyless.TrustRoot for keyless kinds
     ) -> None:
         self.sources = sources or Sources()
         self.verification_config = verification_config
+        self.trust_root = trust_root
         self._docker_auths = _load_docker_auths(docker_config_json_path)
         self._ca_bundles: dict[str, str] = {}  # host → bundle path (cached)
 
@@ -121,7 +123,11 @@ class Downloader:
                     # signature/digest verification; the verify→load
                     # checksum guard runs at module-resolution time
                     # (fetch/__init__.make_module_resolver)
-                    verify_artifact(path, self.verification_config)
+                    verify_artifact(
+                        path,
+                        self.verification_config,
+                        trust_root=self.trust_root,
+                    )
                 result.fetched[url] = path
             except (FetchError, VerificationError, OSError, ValueError) as e:
                 logger.error("failed to fetch policy %s: %s", url, e)

@@ -23,9 +23,12 @@ would otherwise be attacker-editable):
 ]}
 ```
 
-``genericIssuer`` / ``githubAction`` kinds (keyless) are declared
-unsupported loudly — verification FAILS if a config demands only kinds this
-build cannot check (never silently accepted)."""
+``genericIssuer`` / ``githubAction`` kinds (keyless) verify OFFLINE when a
+file-based trust root is present (``trust_root.json`` in the sigstore
+cache dir — fetch/keyless.py: Fulcio-style cert chain, Rekor-style SET +
+Merkle inclusion). Without a trust root they keep FAILING LOUDLY —
+verification FAILS if a config demands kinds this build cannot check
+(never silently accepted)."""
 
 from __future__ import annotations
 
@@ -76,37 +79,97 @@ def make_signature_payload(
     return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
 
 
-def load_signatures(artifact_path: str | Path) -> list[ArtifactSignature]:
+def load_signature_document(
+    artifact_path: str | Path,
+) -> tuple[list[ArtifactSignature], list[dict]]:
+    """One parse of the ``.sig.json`` sidecar → (pubKey signatures,
+    keyless entries). Keyless entries (a ``cert`` field) are verified by
+    fetch/keyless.py; the rest are detached pubKey signatures."""
     sig_path = Path(str(artifact_path) + ".sig.json")
     if not sig_path.exists():
-        return []
+        return [], []
     try:
         doc = json.loads(sig_path.read_text())
-        out = []
+        signatures: list[ArtifactSignature] = []
+        keyless: list[dict] = []
         for s in doc.get("signatures") or []:
-            out.append(
+            if isinstance(s, Mapping) and s.get("cert"):
+                keyless.append(dict(s))
+                continue
+            signatures.append(
                 ArtifactSignature(
                     keyid=str(s.get("keyid", "")),
                     signature=base64.b64decode(s["signature"]),
                     payload=base64.b64decode(s["payload"]),
                 )
             )
-        return out
+        return signatures, keyless
     except (ValueError, KeyError, TypeError) as e:
         raise VerificationError(f"malformed signature document {sig_path}: {e}") from e
+
+
+def load_signatures(artifact_path: str | Path) -> list[ArtifactSignature]:
+    return load_signature_document(artifact_path)[0]
+
+
+def _keyless_requirement_matches(
+    req: SignatureRequirement,
+    artifact_digest: str,
+    keyless_entries: list[dict],
+    trust_root,
+) -> tuple[bool, str]:
+    from policy_server_tpu.fetch import keyless as keyless_mod
+
+    if trust_root is None:
+        return False, (
+            f"signature kind {req.kind!r} requires a sigstore trust root; "
+            "none is available (place trust_root.json in the sigstore cache "
+            "dir, or use network egress to fetch the TUF root — not "
+            "supported by this build)"
+        )
+    if not keyless_entries:
+        return False, (
+            f"signature kind {req.kind!r}: artifact carries no keyless "
+            "signature bundle"
+        )
+    reasons: list[str] = []
+    for entry in keyless_entries:
+        try:
+            identity, signed_annotations = keyless_mod.verify_keyless_entry(
+                entry, artifact_digest, trust_root, SIGNATURE_PAYLOAD_TYPE
+            )
+        except keyless_mod.KeylessError as e:
+            reasons.append(str(e))
+            continue
+        ok, why = keyless_mod.identity_satisfies(req, identity)
+        if not ok:
+            reasons.append(why)
+            continue
+        if req.annotations and any(
+            signed_annotations.get(k) != v
+            for k, v in req.annotations.items()
+        ):
+            reasons.append("signed annotations do not match requirement")
+            continue
+        return True, ""
+    return False, "; ".join(reasons) or "no keyless bundle verified"
 
 
 def _requirement_matches(
     req: SignatureRequirement,
     artifact_digest: str,
     signatures: list[ArtifactSignature],
+    keyless_entries: list[dict] | None = None,
+    trust_root=None,
 ) -> tuple[bool, str]:
     """→ (matched, reason-if-not)."""
+    if req.kind in ("genericIssuer", "githubAction"):
+        return _keyless_requirement_matches(
+            req, artifact_digest, keyless_entries or [], trust_root
+        )
     if req.kind != "pubKey":
         return False, (
-            f"signature kind {req.kind!r} requires sigstore keyless "
-            "verification, which needs network egress to Fulcio/Rekor and is "
-            "not supported by this build"
+            f"signature kind {req.kind!r} is not supported by this build"
         )
     try:
         key = load_pem_public_key(req.key.encode())
@@ -144,28 +207,35 @@ def _requirement_matches(
 
 
 def verify_artifact(
-    artifact_path: str | Path, config: VerificationConfig | None
+    artifact_path: str | Path,
+    config: VerificationConfig | None,
+    trust_root=None,
 ) -> str:
     """Apply the verification config to a downloaded artifact. Returns the
     artifact's sha256 digest (the reference returns the verified manifest
     digest, policy_downloader.rs:118-126). Raises VerificationError when
-    requirements are not met."""
+    requirements are not met. ``trust_root`` (fetch/keyless.TrustRoot)
+    enables the offline keyless kinds; without one they fail loudly."""
     data = Path(artifact_path).read_bytes()
     digest = hashlib.sha256(data).hexdigest()
     if config is None:
         return digest
-    signatures = load_signatures(artifact_path)
+    signatures, keyless_entries = load_signature_document(artifact_path)
 
     failures: list[str] = []
     for req in config.all_of:
-        ok, why = _requirement_matches(req, digest, signatures)
+        ok, why = _requirement_matches(
+            req, digest, signatures, keyless_entries, trust_root
+        )
         if not ok:
             failures.append(f"allOf requirement not satisfied: {why}")
     if config.any_of is not None:
         matched = 0
         reasons: list[str] = []
         for req in config.any_of.signatures:
-            ok, why = _requirement_matches(req, digest, signatures)
+            ok, why = _requirement_matches(
+                req, digest, signatures, keyless_entries, trust_root
+            )
             if ok:
                 matched += 1
             else:

@@ -19,6 +19,7 @@ replacement for the reference's replicas-behind-a-Service scale-out
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Mapping
 
 from policy_server_tpu.evaluation.environment import (
@@ -46,8 +47,6 @@ class PolicyShardedEvaluator:
         continue_on_errors: bool = False,
         builder_kwargs: dict[str, Any] | None = None,
     ) -> None:
-        import threading
-
         from concurrent.futures import ThreadPoolExecutor
 
         self._policies = dict(policies)
@@ -61,6 +60,11 @@ class PolicyShardedEvaluator:
             max_workers=max(1, mesh.shape[mesh_mod.POLICY_AXIS]),
             thread_name_prefix="policy-shard",
         )
+        # environments retired by resize(): closed after a grace period
+        # (in-flight validate_batch calls on the old routing snapshot must
+        # drain first) — without this every churn event leaks the old
+        # shards' worker pools
+        self._retire_timers: list[tuple[threading.Timer, list]] = []
         self.mesh = mesh
         # the operator-configured policy parallelism: resize() re-factors
         # toward this cap, so a transient shrink can grow back
@@ -120,9 +124,29 @@ class PolicyShardedEvaluator:
             new_mesh = mesh_mod.make_mesh(spec, devices)
             # atomic swap: in-flight validate_batch calls finish on the
             # old shard environments; new calls route through the new set
+            old_shards = self._routing[0]
             self._routing = self._build_shards(new_mesh)
             self.mesh = new_mesh
             self.resizes += 1
+            timer = threading.Timer(
+                self._RETIRE_GRACE_SECONDS,
+                self._close_retired,
+                args=(old_shards,),
+            )
+            timer.daemon = True
+            timer.start()
+            self._retire_timers = [
+                (t, envs)
+                for t, envs in self._retire_timers
+                if t.is_alive()
+            ] + [(timer, old_shards)]
+
+    _RETIRE_GRACE_SECONDS = 30.0  # longest plausible in-flight dispatch
+
+    @staticmethod
+    def _close_retired(envs) -> None:
+        for env in envs:
+            env.close()
 
     # -- routing -----------------------------------------------------------
 
@@ -252,7 +276,12 @@ class PolicyShardedEvaluator:
 
     def close(self) -> None:
         """Server-shutdown surface (EvaluationEnvironment.close parity):
-        close every shard environment and stop the dispatch pool."""
+        close every shard environment — current AND resize-retired — and
+        stop the dispatch pool."""
+        for timer, envs in self._retire_timers:
+            timer.cancel()
+            self._close_retired(envs)
+        self._retire_timers = []
         for env in self.shards:
             env.close()
         self._shard_pool.shutdown(wait=False)
