@@ -181,10 +181,13 @@ def post_evaluate(
     origin: RequestOrigin,
     vanilla: AdmissionResponse,
     start_time: float,
+    metrics_sink: list | None = None,
 ) -> AdmissionResponse:
     """The post-dispatch half: constraints + metrics (service.rs:96-150).
     Metrics record the vanilla verdict; constraints apply only to the
-    Validate origin."""
+    Validate origin. ``metrics_sink`` (the batcher's phase 3) collects
+    ``(latency_ms, metric)`` pairs for one batched
+    ``record_evaluations_batch`` flush instead of per-item recording."""
     policy_mode = env.get_policy_mode(policy_id)
     allowed_to_mutate = env.get_policy_allowed_to_mutate(policy_id)
 
@@ -203,9 +206,13 @@ def post_evaluate(
         env, policy_id, request, origin,
         accepted=accepted, mutated=mutated, error_code=error_code,
     )
-    reg = _registry()
-    reg.record_policy_latency((time.perf_counter() - start_time) * 1e3, m)
-    reg.add_policy_evaluation(m)
+    latency_ms = (time.perf_counter() - start_time) * 1e3
+    if metrics_sink is not None:
+        metrics_sink.append((latency_ms, m))
+    else:
+        reg = _registry()
+        reg.record_policy_latency(latency_ms, m)
+        reg.add_policy_evaluation(m)
     return response
 
 

@@ -82,6 +82,11 @@ class TrustRoot:
             doc = json.loads(Path(path).read_text())
         except (OSError, ValueError) as e:
             raise KeylessError(f"cannot load trust root {path}: {e}") from e
+        if not isinstance(doc, Mapping):
+            raise KeylessError(
+                f"trust root {path} must be a JSON object with "
+                "fulcio_certs and rekor_keys"
+            )
         certs = []
         for pem in doc.get("fulcio_certs") or []:
             try:
@@ -117,15 +122,23 @@ class TrustRoot:
 # ---------------------------------------------------------------------------
 
 
-def _verify_with_key(key: Any, signature: bytes, data: bytes) -> None:
+def _verify_with_key(
+    key: Any,
+    signature: bytes,
+    data: bytes,
+    hash_alg: hashes.HashAlgorithm | None = None,
+) -> None:
     """Algorithm-dispatched signature check (ECDSA-P256/SHA256 is the
-    sigstore default; Ed25519 and RSA-PKCS1v15 accepted)."""
+    sigstore default; Ed25519 and RSA-PKCS1v15 accepted). ``hash_alg``
+    overrides SHA-256 when the signature declares its own digest (X.509
+    signatures carry it — real Fulcio intermediates sign with SHA-384)."""
+    h = hash_alg or hashes.SHA256()
     if isinstance(key, ec.EllipticCurvePublicKey):
-        key.verify(signature, data, ec.ECDSA(hashes.SHA256()))
+        key.verify(signature, data, ec.ECDSA(h))
     elif isinstance(key, Ed25519PublicKey):
         key.verify(signature, data)
     elif isinstance(key, rsa.RSAPublicKey):
-        key.verify(signature, data, padding.PKCS1v15(), hashes.SHA256())
+        key.verify(signature, data, padding.PKCS1v15(), h)
     else:
         raise KeylessError(f"unsupported key type {type(key).__name__}")
 
@@ -222,16 +235,24 @@ def _verify_cert_signature(cert: x509.Certificate, issuer: x509.Certificate) -> 
         issuer.public_key(),
         cert.signature,
         cert.tbs_certificate_bytes,
+        hash_alg=cert.signature_hash_algorithm,
     )
+
+
+def _valid_at(cert: x509.Certificate, t: _dt.datetime) -> bool:
+    return cert.not_valid_before_utc <= t <= cert.not_valid_after_utc
 
 
 def _build_chain_to_root(
     leaf: x509.Certificate,
     intermediates: list[x509.Certificate],
     trust_root: TrustRoot,
+    at: _dt.datetime,
 ) -> None:
     """Walk issuer links from the leaf up to a trust-root CA, verifying
-    every signature. Raises KeylessError if no path verifies."""
+    every signature and every CA's validity window at the log integration
+    time (an expired intermediate must not vouch for fresh leaves).
+    Raises KeylessError if no path verifies."""
     root_fps = {c.fingerprint(hashes.SHA256()) for c in trust_root.fulcio_certs}
     pool = list(intermediates) + list(trust_root.fulcio_certs)
     cur = leaf
@@ -241,6 +262,8 @@ def _build_chain_to_root(
             try:
                 _verify_cert_signature(cur, cand)
             except (InvalidSignature, KeylessError):
+                continue
+            if not _valid_at(cand, at):
                 continue
             if cand.fingerprint(hashes.SHA256()) in root_fps:
                 return
@@ -313,8 +336,10 @@ def verify_keyless_entry(
     except (KeyError, TypeError, ValueError) as e:
         raise KeylessError(f"malformed keyless entry: {e}") from e
 
-    # 1. chain of custody: leaf verifies up to a trust-root Fulcio CA
-    _build_chain_to_root(leaf, chain, trust_root)
+    # 1. chain of custody: leaf verifies up to a trust-root Fulcio CA,
+    # every CA valid at the log integration time
+    t = _dt.datetime.fromtimestamp(integrated_time, tz=_dt.timezone.utc)
+    _build_chain_to_root(leaf, chain, trust_root, at=t)
     _check_leaf_usage(leaf)
 
     # 2. artifact signature by the leaf key, over the canonical payload
@@ -373,10 +398,7 @@ def verify_keyless_entry(
         raise KeylessError("merkle inclusion proof does not verify")
 
     # 7. the short-lived cert must have been valid AT INTEGRATION TIME
-    t = _dt.datetime.fromtimestamp(integrated_time, tz=_dt.timezone.utc)
-    if not (
-        leaf.not_valid_before_utc <= t <= leaf.not_valid_after_utc
-    ):
+    if not _valid_at(leaf, t):
         raise KeylessError(
             "certificate was not valid at the log integration time"
         )
@@ -460,6 +482,31 @@ def make_test_ca(
     return cert, key
 
 
+def issue_intermediate_ca(
+    parent_cert: x509.Certificate,
+    parent_key: ec.EllipticCurvePrivateKey,
+    name: str = "sigstore-test-intermediate",
+    not_before: _dt.datetime | None = None,
+    lifetime_days: int = 365,
+) -> tuple[x509.Certificate, ec.EllipticCurvePrivateKey]:
+    key = ec.generate_private_key(ec.SECP256R1())
+    nb = not_before or (
+        _dt.datetime.now(_dt.timezone.utc) - _dt.timedelta(days=1)
+    )
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)]))
+        .issuer_name(parent_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(nb)
+        .not_valid_after(nb + _dt.timedelta(days=lifetime_days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), True)
+        .sign(parent_key, hashes.SHA256())
+    )
+    return cert, key
+
+
 def issue_identity_cert(
     ca_cert: x509.Certificate,
     ca_key: ec.EllipticCurvePrivateKey,
@@ -538,6 +585,7 @@ def make_keyless_entry(
     log_padding: int = 4,
     integrated_time: int | None = None,
     leaf_override: tuple[x509.Certificate, ec.EllipticCurvePrivateKey] | None = None,
+    chain_certs: list[x509.Certificate] | None = None,
 ) -> dict[str, Any]:
     """Authoring/test helper: a complete keyless sidecar entry — leaf cert
     from the CA, signed payload, rekor body + SET + checkpoint + inclusion
@@ -591,7 +639,10 @@ def make_keyless_entry(
     )
     return {
         "cert": leaf_cert.public_bytes(serialization.Encoding.PEM).decode(),
-        "chain": [],
+        "chain": [
+            c.public_bytes(serialization.Encoding.PEM).decode()
+            for c in (chain_certs or [])
+        ],
         "payload": base64.b64encode(payload).decode(),
         "signature": base64.b64encode(signature).decode(),
         "rekor": {

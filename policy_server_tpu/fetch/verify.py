@@ -108,10 +108,6 @@ def load_signature_document(
         raise VerificationError(f"malformed signature document {sig_path}: {e}") from e
 
 
-def load_signatures(artifact_path: str | Path) -> list[ArtifactSignature]:
-    return load_signature_document(artifact_path)[0]
-
-
 def _keyless_requirement_matches(
     req: SignatureRequirement,
     artifact_digest: str,

@@ -627,6 +627,7 @@ class MicroBatcher:
         # metrics.
         live_ids = {id(p) for p in live}
         delivery = _DeliveryBatch()
+        metrics_sink: list = []
         for p, result in zip(runnable, results):
             if id(p) not in live_ids:
                 continue
@@ -646,7 +647,7 @@ class MicroBatcher:
                 # completed work protects nothing.
                 response = service.post_evaluate(
                     self.env, p.policy_id, p.request, p.origin,
-                    result, p.enqueued_at,
+                    result, p.enqueued_at, metrics_sink=metrics_sink,
                 )
                 self._resolve(p, response, delivery)
                 otlp.emit_span(
@@ -663,6 +664,8 @@ class MicroBatcher:
                 self._fail(p, e, delivery)
         # ONE wakeup per client loop for the whole batch
         delivery.flush()
+        if metrics_sink:
+            service._registry().record_evaluations_batch(metrics_sink)
 
     def _watchdog_wait(
         self, dev_future: Future, runnable: list[_Pending]

@@ -167,6 +167,13 @@ class MetricsRegistry:
         # label-set → (counter child, histogram child); dict assignment is
         # atomic under the GIL, racing builders produce identical children
         self._prom_children: dict[tuple, tuple] = {}
+        # metric dataclass → (sorted label key, children): the serving
+        # path records TWO observations per request with the same frozen
+        # dataclass — hashing it once replaces rebuilding + sorting the
+        # 9-entry label dict on every call (measured ~2/3 of phase-3
+        # post-processing time). Cardinality is bounded like the children
+        # cache (policy set × verdict space).
+        self._resolved: dict[object, tuple] = {}
         # serving-runtime stats provider (attach_runtime_stats): yields
         # (name, kind, help, value) tuples scraped on collect — ONE
         # collector registered here, so re-attachment can never produce
@@ -215,29 +222,74 @@ class MetricsRegistry:
             self._prom_children[key] = hit
         return hit
 
+    def _resolve(
+        self, m: PolicyEvaluation | RawPolicyEvaluation
+    ) -> tuple[tuple, tuple | None]:
+        """(sorted label key, prometheus children) for a metric dataclass,
+        computed once per distinct label combination."""
+        ent = self._resolved.get(m)
+        if ent is None:
+            labels = m.labels()
+            key = tuple(sorted(labels.items()))
+            children = (
+                self._children(key, labels)
+                if self.registry is not None
+                else None
+            )
+            ent = (key, children)
+            self._resolved[m] = ent
+        return ent
+
     def add_policy_evaluation(
         self, m: PolicyEvaluation | RawPolicyEvaluation
     ) -> None:
-        labels = m.labels()
-        key = tuple(sorted(labels.items()))
+        key, children = self._resolve(m)
         with self._lock:
             self._counters[(EVALUATIONS_TOTAL, key)] = (
                 self._counters.get((EVALUATIONS_TOTAL, key), 0) + 1
             )
-        if self.registry is not None:
-            self._children(key, labels)[0].inc()
+        if children is not None:
+            children[0].inc()
 
     def record_policy_latency(
         self, milliseconds: float, m: PolicyEvaluation | RawPolicyEvaluation
     ) -> None:
-        labels = m.labels()
-        key = tuple(sorted(labels.items()))
+        key, children = self._resolve(m)
         with self._lock:
             self._latencies.setdefault(
                 key, collections.deque(maxlen=4096)
             ).append(milliseconds)
-        if self.registry is not None:
-            self._children(key, labels)[1].observe(milliseconds)
+        if children is not None:
+            children[1].observe(milliseconds)
+
+    def record_evaluations_batch(
+        self,
+        pairs: list[tuple[float, PolicyEvaluation | RawPolicyEvaluation]],
+    ) -> None:
+        """Batch form of add_policy_evaluation + record_policy_latency for
+        the dispatch thread's phase 3: one lock acquisition and one
+        counter increment per LABEL GROUP per batch instead of two locked
+        updates per request (a serving batch is typically 1-3 groups —
+        same policy, accept/reject split)."""
+        groups: dict[object, list[float]] = {}
+        for ms, m in pairs:
+            groups.setdefault(m, []).append(ms)
+        resolved = [(self._resolve(m), vals) for m, vals in groups.items()]
+        with self._lock:
+            for (key, _children), vals in resolved:
+                self._counters[(EVALUATIONS_TOTAL, key)] = (
+                    self._counters.get((EVALUATIONS_TOTAL, key), 0)
+                    + len(vals)
+                )
+                self._latencies.setdefault(
+                    key, collections.deque(maxlen=4096)
+                ).extend(vals)
+        for (_key, children), vals in resolved:
+            if children is not None:
+                children[0].inc(len(vals))
+                observe = children[1].observe
+                for v in vals:
+                    observe(v)
 
     def add_policy_initialization_error(
         self, m: PolicyInitializationError

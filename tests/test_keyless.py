@@ -234,3 +234,112 @@ def test_inclusion_proof_primitive():
 
 def test_trust_root_absent_is_none(tmp_path):
     assert TrustRoot.load_from_cache_dir(tmp_path) is None
+
+
+def test_intermediate_chain_verifies_and_expired_intermediate_rejects(pki):
+    """A leaf issued by an intermediate verifies up to the trust root;
+    the SAME structure with an expired intermediate is rejected — an
+    expired CA must not vouch for fresh leaves even when the leaf itself
+    is valid at integration time."""
+    from policy_server_tpu.fetch.keyless import issue_intermediate_ca
+
+    ca_cert, ca_key = pki["ca"]
+    good_int, good_key = issue_intermediate_ca(ca_cert, ca_key)
+    entry = make_keyless_entry(
+        ARTIFACT, good_int, good_key, pki["rekor_key"],
+        subject=SUBJECT, issuer_claim=ISSUER,
+        payload_type=SIGNATURE_PAYLOAD_TYPE, chain_certs=[good_int],
+    )
+    identity, _ = verify_keyless_entry(
+        entry, DIGEST, pki["trust_root"], SIGNATURE_PAYLOAD_TYPE
+    )
+    assert identity.subject == SUBJECT
+
+    dead_start = dt.datetime.now(dt.timezone.utc) - dt.timedelta(days=400)
+    dead_int, dead_key = issue_intermediate_ca(
+        ca_cert, ca_key, not_before=dead_start, lifetime_days=30
+    )
+    entry = make_keyless_entry(
+        ARTIFACT, dead_int, dead_key, pki["rekor_key"],
+        subject=SUBJECT, issuer_claim=ISSUER,
+        payload_type=SIGNATURE_PAYLOAD_TYPE, chain_certs=[dead_int],
+    )
+    with pytest.raises(KeylessError, match="trust-root"):
+        verify_keyless_entry(
+            entry, DIGEST, pki["trust_root"], SIGNATURE_PAYLOAD_TYPE
+        )
+
+
+def test_sha384_signed_chain_verifies(pki):
+    """Certificate signatures declare their own digest — a CA signing
+    with SHA-384 (real Fulcio intermediates do) must chain."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    import json as _json
+
+    from policy_server_tpu.fetch.keyless import (
+        TrustRoot, make_test_trust_root_doc,
+    )
+
+    key = ec.generate_private_key(ec.SECP384R1())
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "sha384-ca")])
+    now = dt.datetime.now(dt.timezone.utc)
+    ca384 = (
+        x509.CertificateBuilder()
+        .subject_name(subject).issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - dt.timedelta(days=1))
+        .not_valid_after(now + dt.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+        .sign(key, hashes.SHA384())
+    )
+    doc = make_test_trust_root_doc(ca384, pki["rekor_key"])
+    import tempfile, pathlib
+    d = pathlib.Path(tempfile.mkdtemp())
+    (d / "trust_root.json").write_text(_json.dumps(doc))
+    root = TrustRoot.load_from_cache_dir(d)
+
+    # leaf issued by the SHA-384 CA (issue_identity_cert signs SHA-256;
+    # the LEAF's own signature algorithm is what the verifier must honor,
+    # so sign the leaf with SHA-384 by hand)
+    from policy_server_tpu.fetch.keyless import (
+        OID_FULCIO_ISSUER,
+    )
+    from cryptography.x509.oid import ExtendedKeyUsageOID
+
+    lk = ec.generate_private_key(ec.SECP256R1())
+    leaf = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([])).issuer_name(ca384.subject)
+        .public_key(lk.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - dt.timedelta(minutes=1))
+        .not_valid_after(now + dt.timedelta(minutes=10))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.RFC822Name(SUBJECT)]), False)
+        .add_extension(
+            x509.ExtendedKeyUsage([ExtendedKeyUsageOID.CODE_SIGNING]), False)
+        .add_extension(
+            x509.UnrecognizedExtension(OID_FULCIO_ISSUER, ISSUER.encode()),
+            False)
+        .sign(key, hashes.SHA384())
+    )
+    entry = make_keyless_entry(
+        ARTIFACT, ca384, key, pki["rekor_key"],
+        subject=SUBJECT, issuer_claim=ISSUER,
+        payload_type=SIGNATURE_PAYLOAD_TYPE,
+        leaf_override=(leaf, lk),
+    )
+    identity, _ = verify_keyless_entry(
+        entry, DIGEST, root, SIGNATURE_PAYLOAD_TYPE
+    )
+    assert identity.subject == SUBJECT
+
+
+def test_trust_root_not_an_object_rejects(tmp_path):
+    (tmp_path / "trust_root.json").write_text("[]")
+    with pytest.raises(KeylessError, match="JSON object"):
+        TrustRoot.load_from_cache_dir(tmp_path)
