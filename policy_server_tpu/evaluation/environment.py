@@ -187,6 +187,7 @@ class EvaluationEnvironmentBuilder:
         always_accept_admission_reviews_on_namespace: str | None = None,
         context_service: Any = None,
         wasm_wall_clock_budget: float | None | object = _BUDGET_UNSET,
+        wasm_trust_root: Any = None,
     ) -> None:
         self.backend = backend
         self.continue_on_errors = continue_on_errors
@@ -201,6 +202,9 @@ class EvaluationEnvironmentBuilder:
         # modules to the server's --policy-timeout (wall-clock epoch
         # analog); None disables (--disable-timeout-protection)
         self.wasm_wall_clock_budget = wasm_wall_clock_budget
+        # offline sigstore trust root handed to wasm modules for the
+        # keyless v2/verify host capability
+        self.wasm_trust_root = wasm_trust_root
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
         cache = ProgramCache()
@@ -221,6 +225,10 @@ class EvaluationEnvironmentBuilder:
                 module, "wall_clock_budget"
             ):
                 module.wall_clock_budget = self.wasm_wall_clock_budget
+            if self.wasm_trust_root is not None and hasattr(
+                module, "trust_root"
+            ):
+                module.trust_root = self.wasm_trust_root
             validation = module.validate_settings(dict(settings or {}))
             if not validation.valid:
                 # reference: "Policy settings are invalid" (rs:472-510)

@@ -58,6 +58,10 @@ class WasmPolicyModule:
         # global: each server's environment owns its modules the way each
         # reference PolicyServer owns its wasmtime Engine epoch.
         self.wall_clock_budget = wall_clock_budget
+        # offline sigstore trust root (fetch/keyless.TrustRoot) for the
+        # keyless v2/verify host capability; synced by the environment
+        # builder from the server's sigstore cache dir
+        self.trust_root = None
         module = decode_module(wasm_bytes)  # decoded ONCE, shared by hosts
         exports = {e.name for e in module.exports}
         if "__guest_call" in exports:
@@ -105,7 +109,9 @@ class WasmPolicyModule:
             bundle_source = file_bundle_source(store)
         allow_network = bool(bound_settings.get("allowNetworkCapabilities"))
         # payload-independent capability entries: built ONCE per policy
-        statics = static_capabilities(bundle_source, allow_network)
+        statics = static_capabilities(
+            bundle_source, allow_network, trust_root=self.trust_root
+        )
 
         def evaluate(payload: Any) -> Mapping[str, Any]:
             try:

@@ -46,20 +46,25 @@ __all__ = [
 ]
 
 
-def make_module_resolver(config: "Config") -> Callable[[str], "PolicyModule"]:
+def make_module_resolver(
+    config: "Config", trust_root=None
+) -> Callable[[str], "PolicyModule"]:
     """The server's module resolver (lib.rs:134-143 download step folded
     into evaluation bootstrap): builtin:// and known upstream refs resolve
     natively; everything else is fetched into the download dir, verified
-    per verification.yml, and loaded as a `.tpp.json` IR artifact."""
+    per verification.yml, and loaded as a `.tpp.json` IR artifact.
+
+    ``trust_root``: the offline sigstore trust root (lib.rs:309-336
+    analog) — keyless requirement kinds verify against it; absent, they
+    fail loudly per-requirement (degraded, like the reference's failed
+    TUF fetch, lib.rs:81-89). Loaded here only when the caller did not
+    already load it (the server loads once and shares)."""
     from policy_server_tpu.policies import resolve_builtin
 
-    # offline sigstore trust root (lib.rs:309-336 analog): present in the
-    # sigstore cache dir → keyless requirement kinds verify; absent →
-    # they fail loudly per-requirement (degraded, like the reference's
-    # failed TUF fetch, lib.rs:81-89)
-    from policy_server_tpu.fetch.keyless import TrustRoot
+    if trust_root is None:
+        from policy_server_tpu.fetch.keyless import TrustRoot
 
-    trust_root = TrustRoot.load_from_cache_dir(config.sigstore_cache_dir)
+        trust_root = TrustRoot.load_from_cache_dir(config.sigstore_cache_dir)
 
     downloader = Downloader(
         sources=config.sources,

@@ -296,16 +296,16 @@ def _check_leaf_usage(leaf: x509.Certificate) -> None:
         raise KeylessError("leaf certificate lacks code-signing EKU")
 
 
-def verify_keyless_entry(
+def verify_keyless_signature(
     entry: Mapping[str, Any],
-    artifact_digest: str,
     trust_root: TrustRoot,
-    payload_type: str,
-) -> tuple[KeylessIdentity, dict[str, str]]:
-    """Verify one keyless sidecar entry end to end. Returns the attested
-    identity and the SIGNED annotations. Raises KeylessError on any
-    failure — callers decide whether the identity satisfies the
-    verification.yml requirement.
+) -> tuple[KeylessIdentity, dict[str, Any]]:
+    """The generic keyless core: certificate chain to the trust root,
+    signature over the payload, Rekor-style SET + Merkle inclusion, and
+    cert validity at integration time. Returns (identity, parsed signed
+    payload document) — the CALLER binds the payload to its subject
+    (artifact digest for policy bundles, image reference+digest for the
+    cosign-style image flavor). Raises KeylessError on any failure.
 
     Entry schema (the bundle analog):
     ``{"cert": PEM, "chain": [PEM...], "payload": b64, "signature": b64,
@@ -342,27 +342,19 @@ def verify_keyless_entry(
     _build_chain_to_root(leaf, chain, trust_root, at=t)
     _check_leaf_usage(leaf)
 
-    # 2. artifact signature by the leaf key, over the canonical payload
+    # 2. signature by the leaf key, over the canonical payload
     try:
         _verify_with_key(leaf.public_key(), signature, payload)
     except InvalidSignature:
-        raise KeylessError("artifact signature does not verify against leaf")
+        raise KeylessError("signature does not verify against leaf")
 
-    # 3. payload binds THIS artifact (digest + annotations under the sig)
+    # 3. the payload parses; WHAT it binds is the caller's check
     try:
         pdoc = json.loads(payload)
-        signed_digest = pdoc["critical"]["artifact"]["sha256-digest"]
-        ptype = pdoc["critical"]["type"]
-        annotations = dict(pdoc.get("optional") or {})
-    except (ValueError, KeyError, TypeError) as e:
+        if not isinstance(pdoc, dict):
+            raise ValueError("payload is not an object")
+    except (ValueError, TypeError) as e:
         raise KeylessError(f"malformed signed payload: {e}") from e
-    if ptype != payload_type:
-        raise KeylessError(f"signed payload type {ptype!r} unexpected")
-    if signed_digest != artifact_digest:
-        raise KeylessError(
-            "signed digest does not match artifact "
-            f"({signed_digest} != {artifact_digest})"
-        )
 
     # 4. rekor body binds the payload hash and the signing certificate
     try:
@@ -403,7 +395,34 @@ def verify_keyless_entry(
             "certificate was not valid at the log integration time"
         )
 
-    return _cert_identity(leaf), annotations
+    return _cert_identity(leaf), pdoc
+
+
+def verify_keyless_entry(
+    entry: Mapping[str, Any],
+    artifact_digest: str,
+    trust_root: TrustRoot,
+    payload_type: str,
+) -> tuple[KeylessIdentity, dict[str, str]]:
+    """Policy-artifact flavor: the generic core plus the artifact binding
+    (payload type + sha256 digest). Returns the attested identity and the
+    SIGNED annotations; callers decide whether the identity satisfies the
+    verification.yml requirement."""
+    identity, pdoc = verify_keyless_signature(entry, trust_root)
+    try:
+        signed_digest = pdoc["critical"]["artifact"]["sha256-digest"]
+        ptype = pdoc["critical"]["type"]
+        annotations = dict(pdoc.get("optional") or {})
+    except (KeyError, TypeError) as e:
+        raise KeylessError(f"malformed signed payload: {e}") from e
+    if ptype != payload_type:
+        raise KeylessError(f"signed payload type {ptype!r} unexpected")
+    if signed_digest != artifact_digest:
+        raise KeylessError(
+            "signed digest does not match artifact "
+            f"({signed_digest} != {artifact_digest})"
+        )
+    return identity, annotations
 
 
 def _any_rekor_key_verifies(
@@ -586,6 +605,7 @@ def make_keyless_entry(
     integrated_time: int | None = None,
     leaf_override: tuple[x509.Certificate, ec.EllipticCurvePrivateKey] | None = None,
     chain_certs: list[x509.Certificate] | None = None,
+    payload_override: bytes | None = None,
 ) -> dict[str, Any]:
     """Authoring/test helper: a complete keyless sidecar entry — leaf cert
     from the CA, signed payload, rekor body + SET + checkpoint + inclusion
@@ -595,15 +615,18 @@ def make_keyless_entry(
         ca_cert, ca_key, subject, issuer_claim
     )
     digest = hashlib.sha256(artifact_bytes).hexdigest()
-    payload = _canonical(
-        {
-            "critical": {
-                "artifact": {"sha256-digest": digest},
-                "type": payload_type,
-            },
-            "optional": dict(annotations or {}),
-        }
-    )
+    if payload_override is not None:
+        payload = payload_override
+    else:
+        payload = _canonical(
+            {
+                "critical": {
+                    "artifact": {"sha256-digest": digest},
+                    "type": payload_type,
+                },
+                "optional": dict(annotations or {}),
+            }
+        )
     signature = leaf_key.sign(payload, ec.ECDSA(hashes.SHA256()))
     body = _canonical(
         {

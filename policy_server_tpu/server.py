@@ -130,6 +130,22 @@ class PolicyServer:
                 }},
             )
 
+        # offline sigstore trust root, loaded ONCE and shared by the
+        # module resolver (artifact verification) and the evaluation
+        # builder (wasm keyless v2/verify capability). The fetch/crypto
+        # subsystem is optional — absent, keyless paths reject in-band
+        # (lib.rs:309-336 analog; absent root = degraded like the
+        # reference's failed TUF fetch, lib.rs:81-89).
+        trust_root = None
+        try:
+            from policy_server_tpu.fetch.keyless import TrustRoot
+
+            trust_root = TrustRoot.load_from_cache_dir(
+                config.sigstore_cache_dir
+            )
+        except ImportError:
+            pass
+
         resolver = module_resolver
         if resolver is None and (config.sources or config.verification_config
                                  or _needs_fetch(config)):
@@ -141,7 +157,7 @@ class PolicyServer:
                     "or fetch settings, but the fetch subsystem is not "
                     "available"
                 ) from e
-            resolver = make_module_resolver(config)
+            resolver = make_module_resolver(config, trust_root=trust_root)
 
         context_service = _build_context_service(config)
 
@@ -155,6 +171,9 @@ class PolicyServer:
             # epoch-interruption analog: fuel bounds instructions, this
             # bounds TIME, reference src/lib.rs:176-190)
             wasm_wall_clock_budget=config.policy_timeout,
+            # offline sigstore trust root for the keyless v2/verify host
+            # capability
+            wasm_trust_root=trust_root,
         )
         environment = _build_environment(config, builder_kwargs)
 

@@ -133,12 +133,15 @@ def kubernetes_capabilities(payload: Any) -> dict[tuple[str, str], HostCapabilit
 def static_capabilities(
     signature_bundle_source: Callable[[str], Mapping | None] | None = None,
     allow_network: bool = False,
+    trust_root: Any = None,
 ) -> dict[tuple[str, str], HostCapability]:
     """The payload-independent entries — build ONCE per bound policy.
     Network-reaching capabilities (DNS, OCI) are served only when the
     policy opted in via ``allowNetworkCapabilities: true``: a guest must
     not gain blocking egress (which the fuel meter cannot see) by
-    default."""
+    default. ``trust_root`` (fetch/keyless.TrustRoot) enables the
+    keyless ``v2/verify`` flavor against cosign-style keyless bundles in
+    the signature store; without one it rejects in-band."""
 
     # -- sigstore verify (pub-key flavor; keyless needs Fulcio/Rekor) -------
 
@@ -164,11 +167,67 @@ def static_capabilities(
         trusted = bool(bundle) and _entry_verifies(entry, image, bundle)
         return json.dumps({"is_trusted": trusted, "digest": ""}).encode()
 
-    def keyless_unsupported(raw: bytes) -> bytes:
-        raise RuntimeError(
-            "sigstore keyless verification requires Fulcio/Rekor egress, "
-            "which this build does not support"
+    def verify_keyless_image(raw: bytes) -> bytes:
+        """Keyless image verification against the OFFLINE trust root: the
+        signature store's bundle carries cosign-style keyless entries
+        (cert + rekor scaffolding) whose signed payload binds the image
+        reference and manifest digest; identity must match a requested
+        (issuer, subject) pair."""
+        if trust_root is None:
+            raise RuntimeError(
+                "sigstore keyless verification requires a trust root "
+                "(place trust_root.json in the sigstore cache dir; "
+                "fetching the public Fulcio/Rekor TUF root needs network "
+                "egress this build does not have)"
+            )
+        if signature_bundle_source is None:
+            raise RuntimeError(
+                "image signature verification requires a configured "
+                "signature store (signatureStore setting)"
+            )
+        from policy_server_tpu.fetch.keyless import (
+            KeylessError,
+            verify_keyless_signature,
         )
+        from policy_server_tpu.policies.images import IMAGE_SIGNATURE_TYPE
+
+        req = json.loads(raw)
+        image = str(req.get("image"))
+        wanted = [
+            (str(k.get("issuer")), str(k.get("subject")))
+            for k in req.get("keyless") or []
+            if isinstance(k, Mapping)
+        ]
+        annotations = dict(req.get("annotations") or {})
+        bundle = signature_bundle_source(image) or {}
+        for entry in bundle.get("keyless") or []:
+            try:
+                identity, pdoc = verify_keyless_signature(entry, trust_root)
+            except KeylessError:
+                continue
+            try:
+                crit = pdoc["critical"]
+                if crit["type"] != IMAGE_SIGNATURE_TYPE:
+                    continue
+                if crit["identity"]["docker-reference"] != image:
+                    continue
+                digest = str(crit["image"]["docker-manifest-digest"])
+            except (KeyError, TypeError):
+                continue
+            if not digest.startswith("sha256:"):
+                # same trust boundary as the v1 flavor: a digest handed
+                # back for pinning must be a real manifest digest
+                continue
+            signed_ann = dict(pdoc.get("optional") or {})
+            if annotations and any(
+                signed_ann.get(k) != v for k, v in annotations.items()
+            ):
+                continue
+            if (identity.issuer, identity.subject) in wanted:
+                return json.dumps(
+                    {"is_trusted": True, "digest": digest}
+                ).encode()
+        return json.dumps({"is_trusted": False, "digest": ""}).encode()
 
     # -- net ---------------------------------------------------------------
 
@@ -282,7 +341,7 @@ def static_capabilities(
 
     return {
         ("kubewarden", "v1/verify"): verify_pub_keys_image,
-        ("kubewarden", "v2/verify"): keyless_unsupported,
+        ("kubewarden", "v2/verify"): verify_keyless_image,
         ("net", "v1/dns_lookup_host"): dns_lookup_host,
         ("crypto", "v1/is_certificate_trusted"): is_certificate_trusted,
         ("oci", "v1/manifest_digest"): manifest_digest,

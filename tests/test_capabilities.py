@@ -316,3 +316,80 @@ def test_keyless_v2_verify_rejects_in_band_through_environment():
     assert resp.status.code == 500
     assert "keyless" in resp.status.message
     assert "Fulcio/Rekor" in resp.status.message
+
+
+def test_keyless_v2_verify_with_trust_root(tmp_path):
+    """With an offline trust root and a cosign-style keyless bundle in
+    the signature store, the v2/verify capability verifies the chain +
+    rekor scaffolding and matches the requested (issuer, subject)."""
+    import json as _json
+
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    from policy_server_tpu.fetch.keyless import (
+        TrustRoot,
+        make_keyless_entry,
+        make_test_ca,
+        make_test_trust_root_doc,
+    )
+    from policy_server_tpu.policies.images import (
+        file_bundle_source,
+        make_image_signature_payload,
+        signature_bundle_path,
+    )
+    from policy_server_tpu.wasm.capabilities import static_capabilities
+
+    image = "registry.prod.example.com/app:1.2"
+    digest = "sha256:" + "ab" * 32
+    issuer = "https://token.actions.githubusercontent.com"
+    subject = "https://github.com/org/app/.github/workflows/release.yml@refs/tags/v1"
+
+    ca_cert, ca_key = make_test_ca()
+    rekor_key = ec.generate_private_key(ec.SECP256R1())
+    (tmp_path / "trust_root.json").write_text(
+        _json.dumps(make_test_trust_root_doc(ca_cert, rekor_key))
+    )
+    root = TrustRoot.load_from_cache_dir(tmp_path)
+
+    payload = make_image_signature_payload(image, digest, {"env": "prod"})
+    entry = make_keyless_entry(
+        payload, ca_cert, ca_key, rekor_key,
+        subject=subject, issuer_claim=issuer,
+        payload_type="unused", payload_override=payload,
+    )
+    store = tmp_path / "sigstore-store"
+    store.mkdir()
+    bp = signature_bundle_path(str(store), image)
+    bp.write_text(_json.dumps({"keyless": [entry]}))
+
+    caps = static_capabilities(
+        file_bundle_source(str(store)), trust_root=root
+    )
+    verify = caps[("kubewarden", "v2/verify")]
+
+    out = json.loads(verify(json.dumps({
+        "image": image,
+        "keyless": [{"issuer": issuer, "subject": subject}],
+        "annotations": {"env": "prod"},
+    }).encode()))
+    assert out == {"is_trusted": True, "digest": digest}
+
+    # wrong subject → untrusted
+    out = json.loads(verify(json.dumps({
+        "image": image,
+        "keyless": [{"issuer": issuer, "subject": "someone-else"}],
+    }).encode()))
+    assert out["is_trusted"] is False
+
+    # annotation mismatch → untrusted
+    out = json.loads(verify(json.dumps({
+        "image": image,
+        "keyless": [{"issuer": issuer, "subject": subject}],
+        "annotations": {"env": "staging"},
+    }).encode()))
+    assert out["is_trusted"] is False
+
+    # no trust root → in-band host error (loud, never fabricated)
+    caps = static_capabilities(file_bundle_source(str(store)))
+    with pytest.raises(RuntimeError, match="trust root"):
+        caps[("kubewarden", "v2/verify")](json.dumps({"image": image}).encode())

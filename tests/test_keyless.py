@@ -270,7 +270,7 @@ def test_intermediate_chain_verifies_and_expired_intermediate_rejects(pki):
         )
 
 
-def test_sha384_signed_chain_verifies(pki):
+def test_sha384_signed_chain_verifies(pki, tmp_path):
     """Certificate signatures declare their own digest — a CA signing
     with SHA-384 (real Fulcio intermediates do) must chain."""
     from cryptography import x509
@@ -297,10 +297,8 @@ def test_sha384_signed_chain_verifies(pki):
         .sign(key, hashes.SHA384())
     )
     doc = make_test_trust_root_doc(ca384, pki["rekor_key"])
-    import tempfile, pathlib
-    d = pathlib.Path(tempfile.mkdtemp())
-    (d / "trust_root.json").write_text(_json.dumps(doc))
-    root = TrustRoot.load_from_cache_dir(d)
+    (tmp_path / "trust_root.json").write_text(_json.dumps(doc))
+    root = TrustRoot.load_from_cache_dir(tmp_path)
 
     # leaf issued by the SHA-384 CA (issue_identity_cert signs SHA-256;
     # the LEAF's own signature algorithm is what the verifier must honor,
