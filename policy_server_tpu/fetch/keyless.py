@@ -413,7 +413,7 @@ def verify_keyless_entry(
         signed_digest = pdoc["critical"]["artifact"]["sha256-digest"]
         ptype = pdoc["critical"]["type"]
         annotations = dict(pdoc.get("optional") or {})
-    except (KeyError, TypeError) as e:
+    except (ValueError, KeyError, TypeError) as e:
         raise KeylessError(f"malformed signed payload: {e}") from e
     if ptype != payload_type:
         raise KeylessError(f"signed payload type {ptype!r} unexpected")

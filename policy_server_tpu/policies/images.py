@@ -99,6 +99,26 @@ def make_image_signature_payload(
     return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
 
 
+def payload_binds_image(doc: Any, image: str) -> str | None:
+    """The shared cosign payload trust boundary for BOTH verify flavors
+    (pubKey v1 and keyless v2): a parsed signed payload counts for
+    ``image`` only when it carries the cosign signature type, names this
+    exact image reference, and pins a real sha256 manifest digest.
+    Returns the digest, or None when the payload does not bind."""
+    try:
+        critical = doc["critical"]
+        if critical["type"] != IMAGE_SIGNATURE_TYPE:
+            return None
+        if critical["identity"]["docker-reference"] != image:
+            return None
+        digest = str(critical["image"]["docker-manifest-digest"])
+    except (ValueError, KeyError, TypeError):
+        return None
+    if not digest.startswith("sha256:"):
+        return None
+    return digest
+
+
 def _entry_verifies(
     entry: SignatureEntry, image: str, bundle: Mapping
 ) -> bool:
@@ -131,14 +151,7 @@ def _entry_verifies(
         # image and check annotations from the SIGNED payload only
         try:
             doc = json.loads(payload)
-            critical = doc["critical"]
-            if critical["type"] != IMAGE_SIGNATURE_TYPE:
-                continue
-            if critical["identity"]["docker-reference"] != image:
-                continue
-            if not str(critical["image"]["docker-manifest-digest"]).startswith(
-                "sha256:"
-            ):
+            if payload_binds_image(doc, image) is None:
                 continue
             signed_annotations = dict(doc.get("optional") or {})
         except (ValueError, KeyError, TypeError):

@@ -138,11 +138,21 @@ class PolicyServer:
         # reference's failed TUF fetch, lib.rs:81-89).
         trust_root = None
         try:
-            from policy_server_tpu.fetch.keyless import TrustRoot
+            from policy_server_tpu.fetch.keyless import KeylessError, TrustRoot
 
-            trust_root = TrustRoot.load_from_cache_dir(
-                config.sigstore_cache_dir
-            )
+            try:
+                trust_root = TrustRoot.load_from_cache_dir(
+                    config.sigstore_cache_dir
+                )
+            except KeylessError as e:
+                # degrade like the reference's failed TUF fetch
+                # (lib.rs:81-89): warn and continue without keyless —
+                # verification configs that REQUIRE keyless will still
+                # fail loudly per-requirement at policy bootstrap
+                logger.warning(
+                    "cannot load sigstore trust root; keyless "
+                    "verification disabled: %s", e,
+                )
         except ImportError:
             pass
 

@@ -189,7 +189,7 @@ def static_capabilities(
             KeylessError,
             verify_keyless_signature,
         )
-        from policy_server_tpu.policies.images import IMAGE_SIGNATURE_TYPE
+        from policy_server_tpu.policies.images import payload_binds_image
 
         req = json.loads(raw)
         image = str(req.get("image"))
@@ -205,20 +205,14 @@ def static_capabilities(
                 identity, pdoc = verify_keyless_signature(entry, trust_root)
             except KeylessError:
                 continue
+            # shared v1/v2 trust boundary: type + reference + real digest
+            digest = payload_binds_image(pdoc, image)
+            if digest is None:
+                continue
             try:
-                crit = pdoc["critical"]
-                if crit["type"] != IMAGE_SIGNATURE_TYPE:
-                    continue
-                if crit["identity"]["docker-reference"] != image:
-                    continue
-                digest = str(crit["image"]["docker-manifest-digest"])
-            except (KeyError, TypeError):
+                signed_ann = dict(pdoc.get("optional") or {})
+            except (TypeError, ValueError):
                 continue
-            if not digest.startswith("sha256:"):
-                # same trust boundary as the v1 flavor: a digest handed
-                # back for pinning must be a real manifest digest
-                continue
-            signed_ann = dict(pdoc.get("optional") or {})
             if annotations and any(
                 signed_ann.get(k) != v for k, v in annotations.items()
             ):
@@ -353,11 +347,14 @@ def build_default_capabilities(
     payload: Any,
     signature_bundle_source: Callable[[str], Mapping | None] | None = None,
     allow_network: bool = False,
+    trust_root: Any = None,
 ) -> dict[tuple[str, str], HostCapability]:
     """Full table for one request (tests and one-off callers; the serving
     path hoists static_capabilities per policy and merges only the
     kubernetes closures per request)."""
     return {
-        **static_capabilities(signature_bundle_source, allow_network),
+        **static_capabilities(
+            signature_bundle_source, allow_network, trust_root=trust_root
+        ),
         **kubernetes_capabilities(payload),
     }
