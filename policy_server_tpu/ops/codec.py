@@ -307,6 +307,82 @@ class FeatureSchema:
             out[e.key] = views[e.key].reshape(batch, *e.caps)
         return out
 
+    def to_transport(
+        self,
+        packed: Mapping[str, np.ndarray],
+        vocab_size: int | None = None,
+    ) -> dict[str, np.ndarray]:
+        """Wide packed batch → the bit-packed TRANSPORT buffer shipped to
+        the device: the byte region (all 0/1-valued) packs 8:1 via one
+        vectorized packbits; intern-id lanes narrow to uint16 while the
+        vocabulary fits (``vocab_size``, the NARROW form — ids are dense
+        and non-negative); remaining int32/f32 lanes copy verbatim.
+        Non-packed side-channel keys (wasm member bits) pass through.
+        Idempotent — a buffer already at a transport width is returned
+        unchanged."""
+        layout = self.packed_layout()
+        buf = np.asarray(packed[PACKED_KEY])
+        if buf.shape[1] in (layout.transport_width, layout.transport16_width):
+            return dict(packed)
+        batch = buf.shape[0]
+        narrow = (
+            vocab_size is not None
+            and vocab_size <= 65536
+            and layout.u16_count > 0
+        )
+        bits = np.packbits(
+            buf[:, : layout.total8] != 0, axis=1, bitorder="little"
+        )
+        if narrow:
+            region32 = np.ascontiguousarray(
+                buf[
+                    :,
+                    layout.off32_bytes : layout.off32_bytes
+                    + layout.total32 * 4,
+                ]
+            ).view(np.int32)
+            out = np.zeros((batch, layout.transport16_width), np.uint8)
+            out[:, : bits.shape[1]] = bits
+            id_cols, other_cols = self._transport_col_split()
+            u16 = np.ascontiguousarray(
+                region32[:, id_cols].astype(np.uint16)
+            )
+            o = layout.t16_off_u16_bytes
+            out[:, o : o + u16.shape[1] * 2] = u16.view(np.uint8).reshape(
+                batch, -1
+            )
+            if other_cols:
+                rest = np.ascontiguousarray(region32[:, other_cols])
+                o = layout.t16_off32_bytes
+                out[:, o : o + rest.shape[1] * 4] = rest.view(
+                    np.uint8
+                ).reshape(batch, -1)
+        else:
+            out = np.zeros((batch, layout.transport_width), np.uint8)
+            out[:, : bits.shape[1]] = bits
+            n32 = layout.total32 * 4
+            if n32:
+                out[:, layout.t_off32_bytes : layout.t_off32_bytes + n32] = (
+                    buf[:, layout.off32_bytes : layout.off32_bytes + n32]
+                )
+        converted = dict(packed)
+        converted[PACKED_KEY] = out
+        return converted
+
+    def _transport_col_split(self) -> tuple[list[int], list[int]]:
+        """(id int32-columns, non-id int32-columns) of the 32-bit region,
+        in entry order — cached; used by the narrow transport gather."""
+        cached = getattr(self, "_col_split_cache", None)
+        if cached is None:
+            layout = self.packed_layout()
+            id_cols: list[int] = []
+            other_cols: list[int] = []
+            for e in layout.entries32:
+                cols = range(e.offset, e.offset + e.elems)
+                (id_cols if e.is_id else other_cols).extend(cols)
+            cached = self._col_split_cache = (id_cols, other_cols)
+        return cached
+
     def pack(self, features: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
         """Per-key batch arrays → the packed buffer (slow-path/test helper;
         the native encoder writes the packed buffer directly)."""
@@ -334,6 +410,7 @@ class PackedEntry:
     elems: int  # elements per row
     caps: tuple[int, ...]
     is_f32: bool = False
+    is_id: bool = False  # intern-table id lane (non-negative, dense)
 
 
 @dataclass(frozen=True)
@@ -355,6 +432,42 @@ class PackedLayout:
     total8: int
     off32_bytes: int
     width: int
+    # transport forms: every 1-byte entry is 0/1-valued (the device unpack
+    # reads them all as ``!= 0``), so the wire row bit-packs the byte
+    # region 8:1 — on a bandwidth-bound host→device link (the tunneled
+    # dev chip measures ~7 MB/s) this roughly halves bytes/row. The wide
+    # (byte-per-entry) form remains the HOST working layout (fastenc
+    # writes it; views stay zero-copy); ``FeatureSchema.to_transport``
+    # converts one whole batch with a single vectorized packbits.
+    #
+    # The NARROW form additionally ships intern-id lanes as uint16 while
+    # the intern table fits (ids are dense and non-negative; admission
+    # vocabularies are small) — ids dominate the 32-bit region, so this
+    # nearly halves the wire row AGAIN. A table past 65,536 strings falls
+    # back to the int32 transport (lazily compiled, watchdog-bounded like
+    # any cold bucket).
+    transport_width: int = 0
+    transport16_width: int = 0
+
+    @property
+    def bits_bytes(self) -> int:
+        return (self.total8 + 7) // 8
+
+    @property
+    def t_off32_bytes(self) -> int:
+        return (self.bits_bytes + 3) // 4 * 4
+
+    @property
+    def u16_count(self) -> int:
+        return sum(e.elems for e in self.entries32 if e.is_id)
+
+    @property
+    def t16_off_u16_bytes(self) -> int:
+        return (self.bits_bytes + 1) // 2 * 2
+
+    @property
+    def t16_off32_bytes(self) -> int:
+        return (self.t16_off_u16_bytes + self.u16_count * 2 + 3) // 4 * 4
 
     @classmethod
     def build(cls, schema: "FeatureSchema") -> "PackedLayout":
@@ -370,6 +483,7 @@ class PackedLayout:
                 e32.append(PackedEntry(
                     spec.key, off32, elems, spec.caps,
                     is_f32=spec.dtype is DType.F32,
+                    is_id=spec.dtype is DType.ID,
                 ))
                 off32 += elems
             else:
@@ -383,7 +497,18 @@ class PackedLayout:
             off8 += elems
         off32_bytes = (off8 + 3) // 4 * 4
         width = off32_bytes + off32 * 4
-        return cls(tuple(e32), tuple(e8), off32, off8, off32_bytes, width)
+        base = cls(tuple(e32), tuple(e8), off32, off8, off32_bytes, width)
+        # transport widths derive from the instance's OWN offset
+        # properties — one copy of the alignment math
+        import dataclasses
+
+        return dataclasses.replace(
+            base,
+            transport_width=base.t_off32_bytes + off32 * 4,
+            transport16_width=(
+                base.t16_off32_bytes + (off32 - base.u16_count) * 4
+            ),
+        )
 
     def widened(self, width: int) -> "PackedLayout":
         """A copy with trailing pad bytes up to ``width`` (multiple of 4).
@@ -398,6 +523,21 @@ class PackedLayout:
         import dataclasses
 
         return dataclasses.replace(self, width=width)
+
+    def transport_widened(self, width: int) -> "PackedLayout":
+        """Like ``widened`` but pads the TRANSPORT row width — transport
+        widths must be unique across schemas AND disjoint from every wide
+        width, since the device unpack keys on buffer width alone."""
+        assert width >= self.transport_width and width % 4 == 0
+        import dataclasses
+
+        return dataclasses.replace(self, transport_width=width)
+
+    def transport16_widened(self, width: int) -> "PackedLayout":
+        assert width >= self.transport16_width and width % 4 == 0
+        import dataclasses
+
+        return dataclasses.replace(self, transport16_width=width)
 
 
 class _TrieNode:
@@ -426,6 +566,20 @@ def ensure_unique_packed_widths(schemas) -> None:
             layout = layout.widened(layout.width + 4)
             schema.install_packed_layout(layout)
         used_widths.add(layout.width)
+    # transport widths share the same width-keyed dispatch, so they must
+    # be unique among themselves AND never collide with a wide width
+    for schema in schemas:
+        layout = schema.packed_layout()
+        while layout.transport_width in used_widths:
+            layout = layout.transport_widened(layout.transport_width + 4)
+            schema.install_packed_layout(layout)
+        used_widths.add(layout.transport_width)
+    for schema in schemas:
+        layout = schema.packed_layout()
+        while layout.transport16_width in used_widths:
+            layout = layout.transport16_widened(layout.transport16_width + 4)
+            schema.install_packed_layout(layout)
+        used_widths.add(layout.transport16_width)
 
 
 def _build_trie(specs) -> _TrieNode:
