@@ -18,6 +18,7 @@ from policy_server_tpu.fetch.downloader import (
     FetchError,
     iter_module_urls,
 )
+from policy_server_tpu.telemetry.tracing import logger
 from policy_server_tpu.fetch.verify import (
     VerificationError,
     sign_artifact_bytes,
@@ -46,8 +47,14 @@ __all__ = [
 ]
 
 
+# distinct from None: None means "load attempted, unavailable/failed"
+# (keyless then fails loudly per-requirement), the sentinel means the
+# caller did not try to load at all
+_TRUST_ROOT_UNSET = object()
+
+
 def make_module_resolver(
-    config: "Config", trust_root=None
+    config: "Config", trust_root=_TRUST_ROOT_UNSET
 ) -> Callable[[str], "PolicyModule"]:
     """The server's module resolver (lib.rs:134-143 download step folded
     into evaluation bootstrap): builtin:// and known upstream refs resolve
@@ -58,13 +65,24 @@ def make_module_resolver(
     analog) — keyless requirement kinds verify against it; absent, they
     fail loudly per-requirement (degraded, like the reference's failed
     TUF fetch, lib.rs:81-89). Loaded here only when the caller did not
-    already load it (the server loads once and shares)."""
+    already attempt the load (the server loads once and shares,
+    including its failure: a malformed root degrades with a warning,
+    it must not crash boot on the reload)."""
     from policy_server_tpu.policies import resolve_builtin
 
-    if trust_root is None:
-        from policy_server_tpu.fetch.keyless import TrustRoot
+    if trust_root is _TRUST_ROOT_UNSET:
+        from policy_server_tpu.fetch.keyless import KeylessError, TrustRoot
 
-        trust_root = TrustRoot.load_from_cache_dir(config.sigstore_cache_dir)
+        try:
+            trust_root = TrustRoot.load_from_cache_dir(
+                config.sigstore_cache_dir
+            )
+        except KeylessError as e:
+            logger.warning(
+                "cannot load sigstore trust root; keyless verification "
+                "disabled: %s", e,
+            )
+            trust_root = None
 
     downloader = Downloader(
         sources=config.sources,

@@ -389,7 +389,6 @@ class PolicyServer:
         back off exponentially and the slot is abandoned after
         ``_WORKER_CRASH_GIVEUP`` consecutive fast deaths."""
         import subprocess
-        import sys
         import time as _time
 
         now = _time.monotonic()

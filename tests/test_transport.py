@@ -1,0 +1,123 @@
+"""Wire-format transport contract (ops/codec.py to_transport + the
+device-side unpack): the bit-packed / uint16-narrowed transport forms
+must decode to EXACTLY the features the wide form decodes to, the narrow
+form must refuse vocabularies that no longer fit uint16, and width-keyed
+dispatch must stay unambiguous across every form of every schema."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.ops.codec import PACKED_KEY
+
+from conftest import build_admission_review_dict
+
+POLICIES = {
+    "priv": {"module": "builtin://pod-privileged"},
+    "ns": {
+        "module": "builtin://namespace-validate",
+        "settings": {"denied_namespaces": ["blocked"]},
+    },
+    "latest": {"module": "builtin://disallow-latest-tag"},
+}
+
+
+@pytest.fixture(scope="module")
+def env():
+    return EvaluationEnvironmentBuilder(backend="jax").build(
+        {k: parse_policy_entry(k, v) for k, v in POLICIES.items()}
+    )
+
+
+def _encode_batch(env, docs):
+    schema = env.schemas[0]
+    encoded = []
+    for doc in docs:
+        req = ValidateRequest.from_admission(
+            AdmissionReviewRequest.from_dict(doc).request
+        )
+        encoded.append(schema.encode(req.payload(), env.table))
+    return schema, schema.pack(schema.stack(encoded, batch_size=len(docs)))
+
+
+def _docs():
+    out = []
+    for ns, priv, image in (
+        ("default", False, "r:1.2"),
+        ("blocked", True, "r:latest"),
+        ("x", True, ""),
+    ):
+        d = build_admission_review_dict()
+        d["request"]["namespace"] = ns
+        d["request"]["object"] = {
+            "spec": {"containers": [
+                {"image": image, "securityContext": {"privileged": priv}}
+            ]}
+        }
+        out.append(d)
+    return out
+
+
+def test_widths_unique_across_all_forms(env):
+    widths = []
+    for s in env.schemas:
+        lo = s.packed_layout()
+        widths += [lo.width, lo.transport_width, lo.transport16_width]
+    assert len(widths) == len(set(widths))
+
+
+def test_narrow_and_t8_decode_identically_to_wide(env):
+    schema, wide = _encode_batch(env, _docs())
+    t8 = schema.to_transport(wide, vocab_size=None)
+    t16 = schema.to_transport(wide, vocab_size=len(env.table))
+    lo = schema.packed_layout()
+    assert t8[PACKED_KEY].shape[1] == lo.transport_width
+    assert t16[PACKED_KEY].shape[1] == lo.transport16_width
+    ref = {k: np.asarray(v) for k, v in env._unpack_features(wide).items()}
+    for label, form in (("t8", t8), ("t16", t16)):
+        got = {k: np.asarray(v) for k, v in env._unpack_features(form).items()}
+        assert set(got) == set(ref), label
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=f"{label}:{k}")
+
+
+def test_vocab_overflow_falls_back_to_int32_transport(env):
+    schema, wide = _encode_batch(env, _docs())
+    lo = schema.packed_layout()
+    over = schema.to_transport(wide, vocab_size=65537)
+    assert over[PACKED_KEY].shape[1] == lo.transport_width  # not narrow
+    ref = {k: np.asarray(v) for k, v in env._unpack_features(wide).items()}
+    got = {k: np.asarray(v) for k, v in env._unpack_features(over).items()}
+    for k in ref:
+        np.testing.assert_array_equal(got[k], ref[k])
+
+
+def test_to_transport_idempotent(env):
+    schema, wide = _encode_batch(env, _docs())
+    t16 = schema.to_transport(wide, vocab_size=len(env.table))
+    again = schema.to_transport(t16, vocab_size=len(env.table))
+    np.testing.assert_array_equal(again[PACKED_KEY], t16[PACKED_KEY])
+
+
+def test_verdicts_identical_through_run_batch(env):
+    """End to end through run_batch (which converts to transport): the
+    verdicts match a direct per-key evaluation of the same rows."""
+    docs = _docs()
+    reqs = [
+        ValidateRequest.from_admission(
+            AdmissionReviewRequest.from_dict(d).request
+        )
+        for d in docs
+    ]
+    for pid, wants in (
+        ("priv", [True, False, False]),
+        ("ns", [True, False, True]),
+        ("latest", [True, False, False]),
+    ):
+        for r, want in zip(reqs, wants):
+            resp = env.validate(pid, r)
+            assert resp.allowed is want, (pid, r.payload(), resp.to_dict())
