@@ -27,6 +27,7 @@ from typing import Any, Callable, Mapping
 
 from policy_server_tpu.wasm import builtins as builtins_mod
 from policy_server_tpu.wasm.binary import WasmModule, ensure_module
+from policy_server_tpu.wasm.native_exec import make_instance
 from policy_server_tpu.wasm.interp import Instance, Memory, WasmTrap
 
 
@@ -120,7 +121,7 @@ class OpaPolicy:
 
     def instantiate(self) -> Instance:
         imports, _aborts = self._imports()
-        return Instance(self.module, imports, fuel=self.fuel)
+        return make_instance(self.module, imports, fuel=self.fuel)
 
     # -- host-builtin value marshalling -------------------------------------
 

@@ -31,6 +31,7 @@ import json
 from typing import Any, Callable, Mapping
 
 from policy_server_tpu.wasm.binary import ensure_module
+from policy_server_tpu.wasm.native_exec import make_instance
 from policy_server_tpu.wasm.interp import Instance, WasmTrap
 
 HostCapability = Callable[[bytes], bytes]
@@ -192,7 +193,7 @@ class WapcGuest:
                 "__console_log": console_log,
             }
         }
-        inst = Instance(self.module, imports, fuel=self.fuel)
+        inst = make_instance(self.module, imports, fuel=self.fuel)
         ok = inst.invoke("__guest_call", len(op_bytes), len(payload))
         if not ok or not ok[0]:
             err = state["error"] or b"guest call failed"

@@ -26,6 +26,7 @@ import time
 from typing import Any, Mapping
 
 from policy_server_tpu.wasm.binary import WasmModule, ensure_module
+from policy_server_tpu.wasm.native_exec import make_instance
 from policy_server_tpu.wasm.interp import Instance, Memory, WasmTrap
 
 ERRNO_SUCCESS = 0
@@ -217,7 +218,7 @@ class WasiPolicy:
                 )
             elif imp.kind == "memory":
                 imports.setdefault(imp.module, {})[imp.name] = Memory(imp.desc)
-        inst = Instance(self.module, imports, fuel=self.fuel)
+        inst = make_instance(self.module, imports, fuel=self.fuel)
         code = 0
         try:
             inst.invoke("_start")
