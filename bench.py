@@ -494,14 +494,14 @@ def bench_http(
 
 
 def bench_wasm(requests) -> None:
-    """Cost of the host wasm interpreter — the generality escape hatch for
+    """Cost of the host wasm engine — the generality escape hatch for
     policies outside the predicate IR. Measures reviews/s through the waPC
     WAT oracle policy and (when the upstream fixture is present) an
-    upstream-compiled Gatekeeper module. Its own baseline: the reference
-    runs these under wasmtime's cranelift-JIT native code at ≈1 ms/request
-    (≈1k reviews/s end-to-end, dominated by non-wasm overhead); a pure-
-    Python interpreter is expected to be far slower — this line makes that
-    cost a number instead of a guess."""
+    upstream-compiled Gatekeeper module, on whichever engine the ABI
+    hosts select (the native C++ core when it builds, else the Python
+    reference interpreter). Its own baseline: the reference runs these
+    under wasmtime's cranelift-JIT at ≈1 ms/request (≈1k reviews/s
+    end-to-end, dominated by non-wasm overhead)."""
     import pathlib
 
     from policy_server_tpu.policies.wasm_oracle import oracle_policy
@@ -544,7 +544,10 @@ def bench_wasm(requests) -> None:
         gatekeeper_fixture_rps=round(gk_rps, 1) if gk_rps else gk_note,
         n_requests=len(docs),
         baseline="reference wasmtime-JIT sync path ≈1k reviews/s; the "
-        "interpreter is the correctness escape hatch, not the serving path",
+        "wasm engine is the correctness escape hatch, not the serving path",
+        native_engine=__import__(
+            "policy_server_tpu.wasm.native_exec", fromlist=["available"]
+        ).available(),
     )
 
 
