@@ -18,6 +18,8 @@
 #include <cstring>
 #include <cmath>
 #include <ctime>
+#include <exception>
+#include <new>
 #include <vector>
 
 namespace {
@@ -984,64 +986,107 @@ int32_t exec_fn(Inst* in, const Func& fn, const Val* args, Val* results,
 
 extern "C" {
 
-void* wasmint_module_new() { return new Module(); }
+// Every entry point that allocates is exception-guarded: C++ exceptions
+// must never unwind across the ctypes boundary (undefined behavior; in
+// practice std::terminate kills the whole server). Allocating void
+// functions return an int32 status instead (0 ok, 1 allocation failure)
+// so the bridge can raise per-request.
 
-void wasmint_module_free(void* m) { delete (Module*)m; }
-
-void wasmint_add_func(void* m, int32_t type_id, int32_t n_params,
-                      int32_t n_results, int32_t n_locals, int32_t is_host,
-                      const uint32_t* ops, const int64_t* ia,
-                      const int32_t* ib, const int32_t* ic, int64_t n) {
-    Module* mod = (Module*)m;
-    mod->funcs.emplace_back();
-    Func& f = mod->funcs.back();
-    f.type_id = type_id;
-    f.n_params = n_params;
-    f.n_results = n_results;
-    f.n_locals = n_locals;
-    f.is_host = (uint8_t)is_host;
-    if (!is_host && n > 0) {
-        f.ops.assign(ops, ops + n);
-        f.ia.assign(ia, ia + n);
-        f.ib.assign(ib, ib + n);
-        f.ic.assign(ic, ic + n);
+void* wasmint_module_new() {
+    try {
+        return new Module();
+    } catch (...) {
+        return nullptr;
     }
 }
 
-void wasmint_set_brpool(void* m, const int32_t* pool, int64_t n) {
-    ((Module*)m)->br_pool.assign(pool, pool + n);
+void wasmint_module_free(void* m) { delete (Module*)m; }
+
+int32_t wasmint_add_func(void* m, int32_t type_id, int32_t n_params,
+                         int32_t n_results, int32_t n_locals, int32_t is_host,
+                         const uint32_t* ops, const int64_t* ia,
+                         const int32_t* ib, const int32_t* ic, int64_t n) {
+    try {
+        Module* mod = (Module*)m;
+        mod->funcs.emplace_back();
+        Func& f = mod->funcs.back();
+        f.type_id = type_id;
+        f.n_params = n_params;
+        f.n_results = n_results;
+        f.n_locals = n_locals;
+        f.is_host = (uint8_t)is_host;
+        if (!is_host && n > 0) {
+            f.ops.assign(ops, ops + n);
+            f.ia.assign(ia, ia + n);
+            f.ib.assign(ib, ib + n);
+            f.ic.assign(ic, ic + n);
+        }
+        return 0;
+    } catch (...) {
+        return 1;
+    }
 }
 
-void wasmint_add_data(void* m, const uint8_t* bytes, int64_t n) {
-    Module* mod = (Module*)m;
-    mod->data.emplace_back();
-    mod->data.back().bytes.assign(bytes, bytes + n);
+int32_t wasmint_set_brpool(void* m, const int32_t* pool, int64_t n) {
+    try {
+        ((Module*)m)->br_pool.assign(pool, pool + n);
+        return 0;
+    } catch (...) {
+        return 1;
+    }
 }
 
+int32_t wasmint_add_data(void* m, const uint8_t* bytes, int64_t n) {
+    try {
+        Module* mod = (Module*)m;
+        mod->data.emplace_back();
+        mod->data.back().bytes.assign(bytes, bytes + n);
+        return 0;
+    } catch (...) {
+        return 1;
+    }
+}
+
+// C++ exceptions must not unwind across the ctypes boundary (undefined
+// behavior; in practice std::terminate kills the whole server). A policy
+// module can legally request a ~4 GiB initial memory, so allocation
+// failure here is reachable from untrusted-but-verified input: report it
+// as NULL and let the bridge raise a per-request trap instead.
 void* wasmint_inst_new(void* m, int64_t mem_pages, int64_t mem_max_pages,
                        int64_t fuel, int32_t has_fuel, double deadline,
                        int32_t has_deadline, HostCb cb, void* ctx) {
     Module* mod = (Module*)m;
-    Inst* in = new Inst();
-    in->mod = mod;
-    in->mem.assign((size_t)(mem_pages * PAGE), 0);
-    in->mem_max_pages = mem_max_pages;
-    in->fuel = fuel;
-    in->has_fuel = (uint8_t)has_fuel;
-    in->deadline = deadline;
-    in->has_deadline = (uint8_t)has_deadline;
-    in->hostcb = cb;
-    in->host_ctx = ctx;
-    in->data_dropped.assign(mod->data.size(), 0);
-    return in;
+    Inst* in = nullptr;
+    try {
+        in = new Inst();
+        in->mod = mod;
+        in->mem.assign((size_t)(mem_pages * PAGE), 0);
+        in->mem_max_pages = mem_max_pages;
+        in->fuel = fuel;
+        in->has_fuel = (uint8_t)has_fuel;
+        in->deadline = deadline;
+        in->has_deadline = (uint8_t)has_deadline;
+        in->hostcb = cb;
+        in->host_ctx = ctx;
+        in->data_dropped.assign(mod->data.size(), 0);
+        return in;
+    } catch (...) {
+        delete in;
+        return nullptr;
+    }
 }
 
 void wasmint_inst_free(void* i) { delete (Inst*)i; }
 
-void wasmint_set_globals(void* i, const uint64_t* bits, int64_t n) {
-    Inst* in = (Inst*)i;
-    in->globals.resize((size_t)n);
-    for (int64_t k = 0; k < n; k++) memcpy(&in->globals[k], &bits[k], 8);
+int32_t wasmint_set_globals(void* i, const uint64_t* bits, int64_t n) {
+    try {
+        Inst* in = (Inst*)i;
+        in->globals.resize((size_t)n);
+        for (int64_t k = 0; k < n; k++) memcpy(&in->globals[k], &bits[k], 8);
+        return 0;
+    } catch (...) {
+        return 1;
+    }
 }
 
 int64_t wasmint_get_global(void* i, int64_t idx) {
@@ -1051,9 +1096,14 @@ int64_t wasmint_get_global(void* i, int64_t idx) {
     return out;
 }
 
-void wasmint_add_table(void* i, const int32_t* elems, int64_t n) {
-    Inst* in = (Inst*)i;
-    in->tables.emplace_back(elems, elems + n);
+int32_t wasmint_add_table(void* i, const int32_t* elems, int64_t n) {
+    try {
+        Inst* in = (Inst*)i;
+        in->tables.emplace_back(elems, elems + n);
+        return 0;
+    } catch (...) {
+        return 1;
+    }
 }
 
 int64_t wasmint_mem_size(void* i) {
@@ -1104,7 +1154,19 @@ int32_t wasmint_invoke(void* i, int32_t findex, const uint64_t* args,
         memcpy(&vargs[k], &args[k], 8);
     Val vres[32];
     int32_t nres = 0;
-    int32_t rc = call_index(in, findex, vargs, vres, &nres);
+    int32_t rc;
+    // memory.grow and value-stack growth allocate mid-interpretation; a
+    // thrown bad_alloc must become a per-request TRAP, never unwind into
+    // ctypes (std::terminate would take the whole server down).
+    try {
+        rc = call_index(in, findex, vargs, vres, &nres);
+    } catch (const std::bad_alloc&) {
+        rc = trap(in, TRAP, "out of memory");
+    } catch (const std::exception& e) {
+        rc = trap(in, TRAP, e.what());
+    } catch (...) {
+        rc = trap(in, TRAP, "native engine exception");
+    }
     if (rc != OK) return rc;
     for (int32_t k = 0; k < nres && k < 32; k++)
         memcpy(&results[k], &vres[k], 8);

@@ -255,18 +255,30 @@ def _build_chain_to_root(
     Raises KeylessError if no path verifies."""
     root_fps = {c.fingerprint(hashes.SHA256()) for c in trust_root.fulcio_certs}
     pool = list(intermediates) + list(trust_root.fulcio_certs)
-    cur = leaf
-    for _ in range(_MAX_CHAIN_LEN):
-        candidates = [c for c in pool if c.subject == cur.issuer]
-        for cand in candidates:
+
+    # Depth-first with backtracking: two pool certificates may share the
+    # subject a child names as issuer, and the one whose signature happens
+    # to verify first can still lead to a dead end — a greedy walk would
+    # then reject a chain whose OTHER candidate reaches the root. The pool
+    # is tiny (bundle chain + trust-root CAs), so exhaustive search costs
+    # nothing; `seen` breaks cross-signature cycles.
+    def ascend(cur: x509.Certificate, depth: int, seen: frozenset) -> bool:
+        if depth >= _MAX_CHAIN_LEN:
+            return False
+        for cand in pool:
+            if cand.subject != cur.issuer:
+                continue
+            fp = cand.fingerprint(hashes.SHA256())
+            if fp in seen:
+                continue
             try:
                 _verify_cert_signature(cur, cand)
             except (InvalidSignature, KeylessError):
                 continue
             if not _valid_at(cand, at):
                 continue
-            if cand.fingerprint(hashes.SHA256()) in root_fps:
-                return
+            if fp in root_fps:
+                return True
             # non-root parent must be a CA
             try:
                 bc = cand.extensions.get_extension_for_class(
@@ -276,13 +288,14 @@ def _build_chain_to_root(
                     continue
             except x509.ExtensionNotFound:
                 continue
-            cur = cand
-            break
-        else:
-            raise KeylessError(
-                "certificate chain does not verify up to a trust-root CA"
-            )
-    raise KeylessError("certificate chain too long")
+            if ascend(cand, depth + 1, seen | {fp}):
+                return True
+        return False
+
+    if not ascend(leaf, 0, frozenset()):
+        raise KeylessError(
+            "certificate chain does not verify up to a trust-root CA"
+        )
 
 
 def _check_leaf_usage(leaf: x509.Certificate) -> None:

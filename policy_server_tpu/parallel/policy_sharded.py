@@ -19,8 +19,9 @@ replacement for the reference's replicas-behind-a-Service scale-out
 
 from __future__ import annotations
 
+import contextlib
 import threading
-from typing import Any, Mapping
+from typing import Any, Iterator, Mapping
 
 from policy_server_tpu.evaluation.environment import (
     EvaluationEnvironment,
@@ -30,6 +31,26 @@ from policy_server_tpu.evaluation.errors import PolicyNotFoundError
 from policy_server_tpu.models import AdmissionResponse, ValidateRequest
 from policy_server_tpu.models.policy import PolicyOrPolicyGroup
 from policy_server_tpu.parallel import mesh as mesh_mod
+
+
+class _Routing:
+    """One immutable routing snapshot (shards + policy→shard owner map)
+    plus its lifecycle state: dispatches in flight against it and whether
+    a resize has retired it. Retired snapshots close when the last
+    in-flight dispatch drains — never on a wall-clock timer, so a
+    post-churn lazy-compile stall can take arbitrarily long without its
+    encode/drain pools being shut down mid-flight."""
+
+    __slots__ = ("shards", "owner", "inflight", "retired", "closed")
+
+    def __init__(
+        self, shards: list[EvaluationEnvironment], owner: dict[str, int]
+    ) -> None:
+        self.shards = shards
+        self.owner = owner
+        self.inflight = 0
+        self.retired = False
+        self.closed = False
 
 
 class PolicyShardedEvaluator:
@@ -60,21 +81,22 @@ class PolicyShardedEvaluator:
             max_workers=max(1, mesh.shape[mesh_mod.POLICY_AXIS]),
             thread_name_prefix="policy-shard",
         )
-        # environments retired by resize(): closed after a grace period
-        # (in-flight validate_batch calls on the old routing snapshot must
-        # drain first) — without this every churn event leaks the old
-        # shards' worker pools
-        self._retire_timers: list[tuple[threading.Timer, list]] = []
+        # guards snapshot lifecycle state (inflight/retired/closed and the
+        # retired list) — resize() AND close() both take it, so retirement
+        # bookkeeping is never racily mutated from two paths
+        self._snapshot_lock = threading.Lock()
+        # snapshots retired by resize() that still have dispatches in
+        # flight; each closes when its last dispatch drains — without this
+        # every churn event leaks the old shards' worker pools
+        self._retired: list[_Routing] = []
         self.mesh = mesh
         # the operator-configured policy parallelism: resize() re-factors
         # toward this cap, so a transient shrink can grow back
         self._configured_policy_axis = mesh.shape[mesh_mod.POLICY_AXIS]
         self.resizes = 0  # introspection for tests/metrics
-        # (shards, owner) swap as ONE tuple so routing always reads a
-        # consistent pair across a concurrent resize
-        self._routing: tuple[list[EvaluationEnvironment], dict[str, int]] = (
-            self._build_shards(mesh)
-        )
+        # shards+owner swap as ONE _Routing object so routing always reads
+        # a consistent pair across a concurrent resize
+        self._routing: _Routing = _Routing(*self._build_shards(mesh))
 
     def _build_shards(
         self, mesh: Any
@@ -122,45 +144,67 @@ class PolicyShardedEvaluator:
                 f"policy:{new_policy_axis}"
             )
             new_mesh = mesh_mod.make_mesh(spec, devices)
-            # atomic swap: in-flight validate_batch calls finish on the
-            # old shard environments; new calls route through the new set
-            old_shards = self._routing[0]
-            self._routing = self._build_shards(new_mesh)
+            # atomic swap: in-flight dispatches finish on the old shard
+            # environments; new calls route through the new set
+            new_routing = _Routing(*self._build_shards(new_mesh))
+            with self._snapshot_lock:
+                old = self._routing
+                self._routing = new_routing
+                old.retired = True
+                drained = old.inflight == 0
+                if not drained:
+                    self._retired.append(old)
             self.mesh = new_mesh
             self.resizes += 1
-            timer = threading.Timer(
-                self._RETIRE_GRACE_SECONDS,
-                self._close_retired,
-                args=(old_shards,),
-            )
-            timer.daemon = True
-            timer.start()
-            self._retire_timers = [
-                (t, envs)
-                for t, envs in self._retire_timers
-                if t.is_alive()
-            ] + [(timer, old_shards)]
+            if drained:
+                self._close_snapshot(old)
 
-    _RETIRE_GRACE_SECONDS = 30.0  # longest plausible in-flight dispatch
+    @contextlib.contextmanager
+    def _pin_routing(self) -> Iterator[_Routing]:
+        """Pin the current routing snapshot for one dispatch: a concurrent
+        resize() cannot close its shard environments until this dispatch
+        (and every other pinned one) drains."""
+        with self._snapshot_lock:
+            snap = self._routing
+            snap.inflight += 1
+        try:
+            yield snap
+        finally:
+            with self._snapshot_lock:
+                snap.inflight -= 1
+                close_now = (
+                    snap.retired and snap.inflight == 0 and not snap.closed
+                )
+                if close_now:
+                    with contextlib.suppress(ValueError):
+                        self._retired.remove(snap)
+            if close_now:
+                self._close_snapshot(snap)
 
     @staticmethod
-    def _close_retired(envs) -> None:
-        for env in envs:
+    def _close_snapshot(snap: _Routing) -> None:
+        if snap.closed:
+            return
+        snap.closed = True
+        for env in snap.shards:
             env.close()
 
     # -- routing -----------------------------------------------------------
 
     @property
     def shards(self) -> list[EvaluationEnvironment]:
-        return self._routing[0]
+        return self._routing.shards
 
-    def _shard_of(self, policy_id: str) -> EvaluationEnvironment:
-        shards, owner = self._routing
+    @staticmethod
+    def _shard_in(snap: _Routing, policy_id: str) -> EvaluationEnvironment:
         top = policy_id.split("/")[0]
-        idx = owner.get(top)
+        idx = snap.owner.get(top)
         if idx is None:
             raise PolicyNotFoundError(policy_id)
-        return shards[idx]
+        return snap.shards[idx]
+
+    def _shard_of(self, policy_id: str) -> EvaluationEnvironment:
+        return self._shard_in(self._routing, policy_id)
 
     # -- environment surface ----------------------------------------------
 
@@ -200,24 +244,24 @@ class PolicyShardedEvaluator:
     def validate(
         self, policy_id: str, request: ValidateRequest
     ) -> AdmissionResponse:
-        return self._shard_of(policy_id).validate(policy_id, request)
+        with self._pin_routing() as snap:
+            return self._shard_in(snap, policy_id).validate(policy_id, request)
 
     @property
     def host_fastpath_requests(self) -> int:
-        shards, _ = self._routing
-        return sum(env.host_fastpath_requests for env in shards)
+        return sum(env.host_fastpath_requests for env in self._routing.shards)
 
     @property
     def oracle_fallbacks(self) -> int:
-        shards, _ = self._routing
-        return sum(env.oracle_fallbacks for env in shards)
+        return sum(env.oracle_fallbacks for env in self._routing.shards)
 
     @property
     def supports_host_fastpath(self) -> bool:
         """MicroBatcher latency fast-path capability (see
         EvaluationEnvironment.supports_host_fastpath)."""
-        shards, _ = self._routing
-        return all(env.supports_host_fastpath for env in shards)
+        return all(
+            env.supports_host_fastpath for env in self._routing.shards
+        )
 
     def validate_batch(
         self,
@@ -237,38 +281,41 @@ class PolicyShardedEvaluator:
         each shard's host-side encode with other shards' device time.
         Each environment is only ever entered by one thread at a time —
         environments are shard-private."""
-        shards, owner = self._routing  # one consistent routing snapshot
-        per_shard: dict[int, list[int]] = {}
-        results: list[AdmissionResponse | Exception | None] = [None] * len(items)
-        for i, (pid, _) in enumerate(items):
-            top = pid.split("/")[0]
-            idx = owner.get(top)
-            if idx is None:
-                results[i] = PolicyNotFoundError(pid)
-                continue
-            per_shard.setdefault(idx, []).append(i)
-
-        def run_shard(idx: int, indices: list[int]):
-            shard_items = [items[i] for i in indices]
-            return shards[idx].validate_batch(
-                shard_items, run_hooks=run_hooks, prefer_host=prefer_host
+        with self._pin_routing() as snap:  # one consistent routing snapshot
+            shards, owner = snap.shards, snap.owner
+            per_shard: dict[int, list[int]] = {}
+            results: list[AdmissionResponse | Exception | None] = (
+                [None] * len(items)
             )
+            for i, (pid, _) in enumerate(items):
+                top = pid.split("/")[0]
+                idx = owner.get(top)
+                if idx is None:
+                    results[i] = PolicyNotFoundError(pid)
+                    continue
+                per_shard.setdefault(idx, []).append(i)
 
-        if len(per_shard) > 1:
-            futures = {
-                idx: self._shard_pool.submit(run_shard, idx, indices)
-                for idx, indices in per_shard.items()
-            }
-            shard_outs = {idx: f.result() for idx, f in futures.items()}
-        else:
-            shard_outs = {
-                idx: run_shard(idx, indices)
-                for idx, indices in per_shard.items()
-            }
-        for idx, indices in per_shard.items():
-            for i, r in zip(indices, shard_outs[idx]):
-                results[i] = r
-        return results  # type: ignore[return-value]
+            def run_shard(idx: int, indices: list[int]):
+                shard_items = [items[i] for i in indices]
+                return shards[idx].validate_batch(
+                    shard_items, run_hooks=run_hooks, prefer_host=prefer_host
+                )
+
+            if len(per_shard) > 1:
+                futures = {
+                    idx: self._shard_pool.submit(run_shard, idx, indices)
+                    for idx, indices in per_shard.items()
+                }
+                shard_outs = {idx: f.result() for idx, f in futures.items()}
+            else:
+                shard_outs = {
+                    idx: run_shard(idx, indices)
+                    for idx, indices in per_shard.items()
+                }
+            for idx, indices in per_shard.items():
+                for i, r in zip(indices, shard_outs[idx]):
+                    results[i] = r
+            return results  # type: ignore[return-value]
 
     def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
         for env in self.shards:
@@ -277,11 +324,11 @@ class PolicyShardedEvaluator:
     def close(self) -> None:
         """Server-shutdown surface (EvaluationEnvironment.close parity):
         close every shard environment — current AND resize-retired — and
-        stop the dispatch pool."""
-        for timer, envs in self._retire_timers:
-            timer.cancel()
-            self._close_retired(envs)
-        self._retire_timers = []
-        for env in self.shards:
-            env.close()
+        stop the dispatch pool. Shutdown overrides the drain-before-close
+        rule: the process is going away."""
+        with self._snapshot_lock:
+            snaps = [self._routing] + self._retired
+            self._retired = []
+        for snap in snaps:
+            self._close_snapshot(snap)
         self._shard_pool.shutdown(wait=False)

@@ -110,6 +110,7 @@ def _load() -> ctypes.CDLL | None:
             return None
         lib.wasmint_module_new.restype = ctypes.c_void_p
         lib.wasmint_module_free.argtypes = [ctypes.c_void_p]
+        lib.wasmint_add_func.restype = ctypes.c_int32
         lib.wasmint_add_func.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32,
@@ -117,9 +118,11 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64,
         ]
+        lib.wasmint_set_brpool.restype = ctypes.c_int32
         lib.wasmint_set_brpool.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
         ]
+        lib.wasmint_add_data.restype = ctypes.c_int32
         lib.wasmint_add_data.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
         ]
@@ -130,11 +133,13 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_void_p,
         ]
         lib.wasmint_inst_free.argtypes = [ctypes.c_void_p]
+        lib.wasmint_set_globals.restype = ctypes.c_int32
         lib.wasmint_set_globals.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
         ]
         lib.wasmint_get_global.restype = ctypes.c_int64
         lib.wasmint_get_global.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.wasmint_add_table.restype = ctypes.c_int32
         lib.wasmint_add_table.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
         ]
@@ -206,6 +211,15 @@ class _CompiledModule:
 
         self.functypes = []
         self.handle = lib.wasmint_module_new()
+        if not self.handle:
+            raise MemoryError("out of memory creating native module")
+
+        def checked(status: int) -> None:
+            # nonzero = allocation failure inside the native core (it must
+            # not let bad_alloc unwind through ctypes)
+            if status:
+                raise MemoryError("out of memory building native module")
+
         try:
             br_pool: list[int] = []
             translated = []
@@ -228,29 +242,29 @@ class _CompiledModule:
                                    arrays))
             for tid, np_, nr, nl, is_host, arrays in translated:
                 if arrays is None:
-                    lib.wasmint_add_func(
+                    checked(lib.wasmint_add_func(
                         self.handle, tid, np_, nr, nl, is_host,
                         None, None, None, None, 0,
-                    )
+                    ))
                 else:
                     ops, ia, ib, ic = arrays
                     n = len(ops)
-                    lib.wasmint_add_func(
+                    checked(lib.wasmint_add_func(
                         self.handle, tid, np_, nr, nl, is_host,
                         (ctypes.c_uint32 * n)(*ops),
                         (ctypes.c_int64 * n)(*ia),
                         (ctypes.c_int32 * n)(*ib),
                         (ctypes.c_int32 * n)(*ic),
                         n,
-                    )
+                    ))
             if br_pool:
-                lib.wasmint_set_brpool(
+                checked(lib.wasmint_set_brpool(
                     self.handle, (ctypes.c_int32 * len(br_pool))(*br_pool),
                     len(br_pool),
-                )
+                ))
             for seg in module.data:
-                lib.wasmint_add_data(self.handle, bytes(seg.data),
-                                     len(seg.data))
+                checked(lib.wasmint_add_data(self.handle, bytes(seg.data),
+                                             len(seg.data)))
         except Exception:
             lib.wasmint_module_free(self.handle)
             raise
@@ -373,14 +387,25 @@ class _NativeMemData:
         return self._proxy._inst._find0(start)
 
     def __getitem__(self, item):
+        # bytearray-faithful indexing: negative indices/bounds wrap from
+        # the end and out-of-range slice bounds clamp — host code treating
+        # memory.data as a bytearray must not silently read wrong offsets
+        n = len(self._proxy)
         if isinstance(item, slice):
-            start = item.start or 0
-            stop = len(self._proxy) if item.stop is None else item.stop
-            stop = min(stop, len(self._proxy))
+            if item.step not in (None, 1):
+                raise ValueError(
+                    "extended slice steps are not supported on wasm memory"
+                )
+            start, stop, _ = item.indices(n)
             if stop <= start:
                 return b""
             return self._proxy.read(start, stop - start)
-        return self._proxy.read(item, 1)[0]
+        idx = int(item)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError("index out of range")
+        return self._proxy.read(idx, 1)[0]
 
     def __len__(self) -> int:
         return len(self._proxy)
@@ -477,6 +502,12 @@ class NativeInstance:
             deadline, 1 if self.deadline is not None else 0,
             self._cb, None,
         )
+        if not self._handle:
+            # NULL = allocation failure in the native core (a module may
+            # legally declare a ~4 GiB initial memory); fail this request,
+            # not the process.
+            self._handle = None
+            raise WasmTrap("out of memory instantiating module")
         self._lib = lib
         if imported_memory is not None and any(imported_memory.data):
             # the provided Memory's pre-existing content seeds the
@@ -493,11 +524,12 @@ class NativeInstance:
             self._global_types.append(g.valtype)
             global_bits.append(self._encode_slot(value, g.valtype))
         if global_bits:
-            lib.wasmint_set_globals(
+            if lib.wasmint_set_globals(
                 self._handle,
                 (ctypes.c_uint64 * len(global_bits))(*global_bits),
                 len(global_bits),
-            )
+            ):
+                raise WasmTrap("out of memory instantiating module")
 
         # tables + element segments
         tables = [[-1] * limits.minimum for limits in module.tables]
@@ -509,9 +541,10 @@ class NativeInstance:
             for j, fidx in enumerate(seg.func_indices):
                 table[offset + j] = fidx
         for t in tables:
-            lib.wasmint_add_table(
+            if lib.wasmint_add_table(
                 self._handle, (ctypes.c_int32 * len(t))(*t), len(t)
-            )
+            ):
+                raise WasmTrap("out of memory instantiating module")
 
         # active data segments
         for seg in module.data:

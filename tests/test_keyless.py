@@ -273,6 +273,53 @@ def test_intermediate_chain_verifies_and_expired_intermediate_rejects(pki):
         )
 
 
+def test_chain_backtracks_over_same_subject_dead_end(pki):
+    """Two pool certificates can share the subject a leaf names as issuer
+    (cross-signed intermediates reuse subject AND key). If the one listed
+    first verifies the leaf but chains to an orphan, a greedy walk dies in
+    that dead end; the verifier must backtrack and accept the alternative
+    path that reaches the trust root (ADVICE r4)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    from policy_server_tpu.fetch.keyless import issue_intermediate_ca
+
+    ca_cert, ca_key = pki["ca"]
+    good_int, good_key = issue_intermediate_ca(ca_cert, ca_key)
+
+    # decoy: SAME subject and SAME key as the good intermediate (so it
+    # verifies the leaf's signature), but issued by an orphan CA that is
+    # in no pool — committing to it strands the walk
+    orphan_key = ec.generate_private_key(ec.SECP256R1())
+    now = dt.datetime.now(dt.timezone.utc)
+    decoy = (
+        x509.CertificateBuilder()
+        .subject_name(good_int.subject)
+        .issuer_name(x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "orphan-ca")]
+        ))
+        .public_key(good_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - dt.timedelta(days=1))
+        .not_valid_after(now + dt.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), True)
+        .sign(orphan_key, hashes.SHA256())
+    )
+
+    entry = make_keyless_entry(
+        ARTIFACT, good_int, good_key, pki["rekor_key"],
+        subject=SUBJECT, issuer_claim=ISSUER,
+        payload_type=SIGNATURE_PAYLOAD_TYPE,
+        chain_certs=[decoy, good_int],  # decoy first → greedy dead-ends
+    )
+    identity, _ = verify_keyless_entry(
+        entry, DIGEST, pki["trust_root"], SIGNATURE_PAYLOAD_TYPE
+    )
+    assert identity.subject == SUBJECT
+
+
 def test_sha384_signed_chain_verifies(pki, tmp_path):
     """Certificate signatures declare their own digest — a CA signing
     with SHA-384 (real Fulcio intermediates do) must chain."""

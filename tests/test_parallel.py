@@ -204,6 +204,70 @@ def test_policy_sharded_resize_during_inflight_batch():
     assert not failures, failures
 
 
+def test_policy_sharded_retired_snapshot_closes_on_drain():
+    """Drain-based retirement (ADVICE r4): a resize must NOT close the old
+    shard environments while a dispatch is still pinned to them — however
+    long it takes (the old 30s wall-clock grace shut pools down under a
+    post-churn lazy-compile stall) — and must close them exactly when the
+    last pinned dispatch drains."""
+    import threading
+
+    mesh = make_mesh(MeshSpec.parse("data:4,policy:2"))
+    sharded = PolicyShardedEvaluator(parse_all(POLICIES), mesh)
+    old_envs = list(sharded.shards)
+    closed = {id(env): False for env in old_envs}
+    originals = {}
+    for env in old_envs:
+        originals[id(env)] = env.close
+
+        def make_close(e):
+            orig = originals[id(e)]
+
+            def _close():
+                closed[id(e)] = True
+                orig()
+
+            return _close
+
+        env.close = make_close(env)
+
+    entered = threading.Event()
+    release = threading.Event()
+    target_env = sharded._shard_of("priv")  # the shard the dispatch hits
+    orig_vb = target_env.validate_batch
+
+    def blocking_vb(items, **kw):
+        entered.set()
+        assert release.wait(timeout=30), "test deadlock"
+        return orig_vb(items, **kw)
+
+    target_env.validate_batch = blocking_vb
+
+    cases = [("priv", pod_request("default", True))]
+    result: list = []
+    t = threading.Thread(
+        target=lambda: result.append(sharded.validate_batch(cases)),
+        daemon=True,
+    )
+    t.start()
+    assert entered.wait(timeout=30)
+
+    # resize while the dispatch is pinned: retired envs must stay open
+    sharded.resize(list(jax.devices())[:4])
+    assert not any(closed.values()), "retired env closed mid-flight"
+
+    release.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert result and not isinstance(result[0][0], Exception)
+    # the drain of the last pinned dispatch closed every retired env
+    assert all(closed.values()), "retired envs never closed after drain"
+    # the current routing is untouched
+    verdicts = sharded.validate_batch(cases)
+    assert not isinstance(verdicts[0], Exception)
+    sharded.close()
+
+
 def test_policy_sharded_group_routing():
     policies = dict(POLICIES)
     policies["grp"] = {
