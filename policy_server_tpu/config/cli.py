@@ -138,6 +138,13 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
               help="Micro-batches with at most N requests are answered by "
                    "the bit-exact host oracle instead of a device dispatch "
                    "(latency fast-path; 0 disables)")),
+        ("--verdict-cache-size", "KUBEWARDEN_VERDICT_CACHE_SIZE",
+         dict(type=int, default=4096, metavar="N",
+              help="Rows kept in the bit-exact verdict cache: identical "
+                   "(policy, payload) rows are answered without re-dispatch "
+                   "(policy evaluation is a pure function of the payload, so "
+                   "this is lossless; wasm-backed verdicts are never cached). "
+                   "0 disables caching AND in-batch row dedup")),
         ("--mesh", "KUBEWARDEN_MESH",
          dict(default="auto", metavar="MESH_SPEC",
               help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),

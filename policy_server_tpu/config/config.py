@@ -162,6 +162,9 @@ class Config:
     # latency fast-path: micro-batches ≤ this size are answered by the
     # bit-exact host oracle instead of paying a device round-trip
     host_fastpath_threshold: int = 64
+    # bit-exact verdict cache / in-batch row dedup capacity (rows);
+    # 0 disables (evaluation/verdict_cache.py)
+    verdict_cache_size: int = 4096
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -292,6 +295,7 @@ class Config:
             max_batch_size=args.max_batch_size,
             batch_timeout_ms=float(args.batch_timeout_ms),
             host_fastpath_threshold=int(args.host_fastpath_threshold),
+            verdict_cache_size=int(args.verdict_cache_size),
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

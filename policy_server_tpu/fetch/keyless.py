@@ -253,15 +253,30 @@ def _build_chain_to_root(
     every signature and every CA's validity window at the log integration
     time (an expired intermediate must not vouch for fresh leaves).
     Raises KeylessError if no path verifies."""
+    # Bound the attacker-supplied search space FIRST: real sigstore
+    # bundles carry 1-3 intermediates, and without a cap a crafted bundle
+    # of cross-signed same-subject/same-key certificates makes the
+    # backtracking walk below combinatorial (each candidate's signature
+    # verifies, every path dead-ends late).
+    if len(intermediates) > _MAX_CHAIN_LEN * 2:
+        raise KeylessError(
+            f"certificate chain too long ({len(intermediates)} intermediates)"
+        )
     root_fps = {c.fingerprint(hashes.SHA256()) for c in trust_root.fulcio_certs}
     pool = list(intermediates) + list(trust_root.fulcio_certs)
 
     # Depth-first with backtracking: two pool certificates may share the
     # subject a child names as issuer, and the one whose signature happens
     # to verify first can still lead to a dead end — a greedy walk would
-    # then reject a chain whose OTHER candidate reaches the root. The pool
-    # is tiny (bundle chain + trust-root CAs), so exhaustive search costs
-    # nothing; `seen` breaks cross-signature cycles.
+    # then reject a chain whose OTHER candidate reaches the root. `seen`
+    # breaks cross-signature cycles; `failed_at` memoizes the shallowest
+    # depth at which a certificate dead-ended (failure with budget r
+    # implies failure with any budget ≤ r), bounding the walk to
+    # O(pool × depth) expansions instead of exponential. The memo can
+    # only ever REJECT (fail closed) in pathological cross-signed cycles
+    # through an ancestor — it never widens what verifies.
+    failed_at: dict[bytes, int] = {}
+
     def ascend(cur: x509.Certificate, depth: int, seen: frozenset) -> bool:
         if depth >= _MAX_CHAIN_LEN:
             return False
@@ -269,7 +284,7 @@ def _build_chain_to_root(
             if cand.subject != cur.issuer:
                 continue
             fp = cand.fingerprint(hashes.SHA256())
-            if fp in seen:
+            if fp in seen or depth >= failed_at.get(fp, _MAX_CHAIN_LEN + 1):
                 continue
             try:
                 _verify_cert_signature(cur, cand)
@@ -290,6 +305,7 @@ def _build_chain_to_root(
                 continue
             if ascend(cand, depth + 1, seen | {fp}):
                 return True
+            failed_at[fp] = min(failed_at.get(fp, depth), depth)
         return False
 
     if not ascend(leaf, 0, frozenset()):

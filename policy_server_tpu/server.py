@@ -184,6 +184,8 @@ class PolicyServer:
             # offline sigstore trust root for the keyless v2/verify host
             # capability
             wasm_trust_root=trust_root,
+            # bit-exact verdict cache / row dedup (0 disables)
+            verdict_cache_size=config.verdict_cache_size,
         )
         environment = _build_environment(config, builder_kwargs)
 
