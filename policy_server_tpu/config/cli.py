@@ -138,6 +138,14 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
               help="Micro-batches with at most N requests are answered by "
                    "the bit-exact host oracle instead of a device dispatch "
                    "(latency fast-path; 0 disables)")),
+        ("--latency-budget-ms", "KUBEWARDEN_LATENCY_BUDGET_MS",
+         dict(type=float, default=50.0, metavar="MS",
+              help="Soft per-request latency target for deadline-aware "
+                   "routing: when the measured device round-trip estimate "
+                   "would exceed the oldest queued request's remaining "
+                   "budget, the batch is answered by the bit-exact host "
+                   "oracle instead (0 disables; distinct from "
+                   "--policy-timeout, the hard in-band deadline)")),
         ("--verdict-cache-size", "KUBEWARDEN_VERDICT_CACHE_SIZE",
          dict(type=int, default=4096, metavar="N",
               help="Rows kept in the bit-exact verdict cache: identical "

@@ -165,6 +165,10 @@ class Config:
     # bit-exact verdict cache / in-batch row dedup capacity (rows);
     # 0 disables (evaluation/verdict_cache.py)
     verdict_cache_size: int = 4096
+    # soft per-request latency target (ms) for deadline-aware routing:
+    # a batch whose measured device RTT estimate would exceed the oldest
+    # request's remaining budget is answered host-side; ≤0 disables
+    latency_budget_ms: float = 50.0
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -296,6 +300,7 @@ class Config:
             batch_timeout_ms=float(args.batch_timeout_ms),
             host_fastpath_threshold=int(args.host_fastpath_threshold),
             verdict_cache_size=int(args.verdict_cache_size),
+            latency_budget_ms=float(args.latency_budget_ms),
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

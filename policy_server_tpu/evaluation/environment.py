@@ -195,6 +195,7 @@ class EvaluationEnvironmentBuilder:
         context_service: Any = None,
         wasm_wall_clock_budget: float | None | object = _BUDGET_UNSET,
         wasm_trust_root: Any = None,
+        wasm_oci_digest_source: Callable[[str], str] | None = None,
         verdict_cache_size: int = DEFAULT_VERDICT_CACHE_SIZE,
     ) -> None:
         self.backend = backend
@@ -213,6 +214,9 @@ class EvaluationEnvironmentBuilder:
         # offline sigstore trust root handed to wasm modules for the
         # keyless v2/verify host capability
         self.wasm_trust_root = wasm_trust_root
+        # registry client (image ref → manifest digest) handed to wasm
+        # modules for the oci/v1/manifest_digest host capability
+        self.wasm_oci_digest_source = wasm_oci_digest_source
         # bit-exact row dedup / verdict caching (verdict_cache.py); 0 = off
         self.verdict_cache_size = verdict_cache_size
 
@@ -239,6 +243,10 @@ class EvaluationEnvironmentBuilder:
                 module, "trust_root"
             ):
                 module.trust_root = self.wasm_trust_root
+            if self.wasm_oci_digest_source is not None and hasattr(
+                module, "oci_digest_source"
+            ):
+                module.oci_digest_source = self.wasm_oci_digest_source
             validation = module.validate_settings(dict(settings or {}))
             if not validation.valid:
                 # reference: "Policy settings are invalid" (rs:472-510)
@@ -695,6 +703,13 @@ class EvaluationEnvironment:
         except ValueError:
             return None
         return None
+
+    def reset_verdict_cache(self) -> None:
+        """Drop every cached verdict row (benchmark pass isolation; a
+        no-op when caching is disabled). Counters are kept — they are
+        cumulative serving metrics."""
+        if self._verdict_cache is not None:
+            self._verdict_cache.clear()
 
     @property
     def dedup_stats(self) -> dict[str, int]:

@@ -62,6 +62,10 @@ class WasmPolicyModule:
         # keyless v2/verify host capability; synced by the environment
         # builder from the server's sigstore cache dir
         self.trust_root = None
+        # image ref → manifest digest callable backing oci/v1/
+        # manifest_digest (Downloader.manifest_digest); synced by the
+        # environment builder from the server's registry client
+        self.oci_digest_source = None
         module = decode_module(wasm_bytes)  # decoded ONCE, shared by hosts
         exports = {e.name for e in module.exports}
         if "__guest_call" in exports:
@@ -110,7 +114,8 @@ class WasmPolicyModule:
         allow_network = bool(bound_settings.get("allowNetworkCapabilities"))
         # payload-independent capability entries: built ONCE per policy
         statics = static_capabilities(
-            bundle_source, allow_network, trust_root=self.trust_root
+            bundle_source, allow_network, trust_root=self.trust_root,
+            oci_digest_source=self.oci_digest_source,
         )
 
         def evaluate(payload: Any) -> Mapping[str, Any]:

@@ -274,6 +274,57 @@ class Downloader:
         )
         return blob, suffix
 
+    def manifest_digest(self, image: str) -> str:
+        """Resolve an image reference to its manifest digest — the backing
+        client for the ``("oci", "v1/manifest_digest")`` host capability
+        (the reference serves it through the callback handler's registry
+        client, src/lib.rs:91-125). Reuses this downloader's token-auth /
+        TLS / docker-config machinery; raises FetchError on any actual
+        network or registry failure.
+
+        Accepts docker-style refs (``host/name:tag``, ``name@sha256:..``,
+        optionally ``registry://``-prefixed); registry-less refs get the
+        standard docker.io/library defaults."""
+        ref = image
+        for prefix in ("registry://", "oci://", "docker://"):
+            if ref.startswith(prefix):
+                ref = ref[len(prefix):]
+                break
+        first, slash, rest = ref.partition("/")
+        if not slash or (
+            "." not in first and ":" not in first and first != "localhost"
+        ):
+            # no registry component: docker hub defaults
+            host = "registry-1.docker.io"
+            name_part = ref if slash else f"library/{ref}"
+        else:
+            host, name_part = first, rest
+        name, tag = _split_ref(name_part)
+        scheme = "http" if self.sources.is_insecure(host) else "https"
+        session = requests.Session()
+        headers = {
+            # the digest is of whatever manifest the registry serves for
+            # the ref — accept single manifests AND multi-arch indexes so
+            # the returned digest matches what cosign signs
+            "Accept": (
+                "application/vnd.oci.image.manifest.v1+json, "
+                "application/vnd.oci.image.index.v1+json, "
+                "application/vnd.docker.distribution.manifest.v2+json, "
+                "application/vnd.docker.distribution.manifest.list.v2+json"
+            )
+        }
+        auth = self._docker_auths.get(host)
+        if auth:
+            headers["Authorization"] = f"Basic {auth}"
+        resp = self._oci_get(
+            session, f"{scheme}://{host}/v2/{name}/manifests/{tag}",
+            host, headers,
+        )
+        digest = resp.headers.get("Docker-Content-Digest")
+        if digest:
+            return digest
+        return "sha256:" + hashlib.sha256(resp.content).hexdigest()
+
     def _fetch_oci_signature(
         self, parsed: urllib.parse.ParseResult, artifact_bytes: bytes
     ) -> bytes | None:

@@ -126,11 +126,28 @@ class MicroBatcher:
         policy_timeout: float | None = 2.0,
         queue_capacity: int | None = None,
         host_fastpath_threshold: int = 64,
+        latency_budget_ms: float = 50.0,
     ) -> None:
         self.env = env
         self.max_batch_size = max(1, int(max_batch_size))
         self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
         self.policy_timeout = policy_timeout
+        # Deadline-aware routing (VERDICT r4 #2): beyond the static
+        # fast-path count, a batch is answered host-side whenever the
+        # MEASURED device round-trip estimate would blow the oldest
+        # item's latency budget and the host estimate would not. The
+        # budget is a soft serving target (p99 goal), distinct from
+        # policy_timeout (the hard in-band deadline). ≤0 disables.
+        self.latency_budget = (
+            None if latency_budget_ms <= 0 else latency_budget_ms / 1e3
+        )
+        # EWMA device dispatch RTT per batch bucket, seconds — learned
+        # from real dispatches (seeded by timed warmup); decayed slightly
+        # each time budget routing bypasses the device so a stale slow
+        # estimate re-probes instead of pinning traffic host-side forever.
+        self._dev_rtt: dict[int, float] = {}
+        # EWMA host fast-path cost per row, seconds
+        self._host_cost_per_row = 1e-4
         # Latency fast-path: a formed batch with ≤ this many runnable items
         # is answered by the environment's targeted host oracle (bit-exact
         # with the device program by the differential suite) instead of
@@ -187,6 +204,9 @@ class MicroBatcher:
         self.requests_dispatched = 0
         self.deadline_abandoned_batches = 0  # introspection for tests/metrics
         self.host_fastpath_batches = 0  # batches answered host-side
+        # batches routed host-side by the latency-budget check (a strict
+        # subset of host_fastpath_batches)
+        self.budget_routed_batches = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -251,7 +271,10 @@ class MicroBatcher:
 
     def warmup(self) -> None:
         """Compile every batch bucket at boot (reference precompiles all
-        policies via rayon at boot, src/lib.rs:287-307)."""
+        policies via rayon at boot, src/lib.rs:287-307) and seed the
+        device-RTT estimator: each bucket warms twice, the second —
+        compile-free — run is the routing baseline (a compile-inclusive
+        seed would misroute everything host-side until corrected)."""
         sizes = []
         b = 1
         while b < self.max_batch_size:
@@ -259,6 +282,16 @@ class MicroBatcher:
             b <<= 1
         sizes.append(bucket_size(self.max_batch_size))
         self.env.warmup(tuple(sizes))
+        if self.latency_budget is not None:
+            n_schemas = max(1, len(getattr(self.env, "schemas", []) or []))
+            for b in sizes:
+                t0 = time.perf_counter()
+                self.env.warmup((b,))
+                # warmup dispatches once per shape schema; a serving batch
+                # dispatches one schema, so normalize the seed
+                self._dev_rtt[bucket_size(b)] = (
+                    time.perf_counter() - t0
+                ) / n_schemas
 
     # -- submission --------------------------------------------------------
 
@@ -565,18 +598,50 @@ class MicroBatcher:
         # hooks, matching the reference's mid-execution epoch interrupt
         # (src/lib.rs:176-190, tests/integration_test.rs:417).
         pairs = [(p.policy_id, p.request) for p in runnable]
-        # Latency fast-path decision: small batch ⇒ answer on the host.
-        # Occupancy is the signal — a batch this small means the queue was
-        # shallow when it formed, so the requests are latency-critical,
-        # not throughput traffic.
+        # Latency fast-path decision, two tiers:
+        # 1. occupancy: a small batch means the queue was shallow when it
+        #    formed — the requests are latency-critical, not throughput
+        #    traffic — so answer on the host;
+        # 2. budget (VERDICT r4 #2): for larger batches, compare the
+        #    MEASURED device round-trip estimate against the oldest
+        #    item's remaining latency budget; when the device would blow
+        #    the budget and the host estimate would not, route host-side.
+        #    The stored estimate decays on every bypass so a stale slow
+        #    reading re-probes the device instead of pinning traffic.
+        n = len(runnable)
+        bucket = bucket_size(n)
         use_host = (
-            self._env_fastpath
-            and 0 < len(runnable) <= self.host_fastpath_threshold
+            self._env_fastpath and 0 < n <= self.host_fastpath_threshold
         )
+        if (
+            not use_host
+            and self._env_fastpath
+            and self.latency_budget is not None
+            and n > 0
+        ):
+            est = self._dev_rtt.get(bucket)
+            if est is not None:
+                oldest = min(p.enqueued_at for p in runnable)
+                remaining_budget = self.latency_budget - (
+                    time.perf_counter() - oldest
+                )
+                host_est = self._host_cost_per_row * n
+                # route host-side only when the host can actually MEET the
+                # budget the device would blow. A batch whose budget is
+                # already gone (deep queue under sustained load) stays on
+                # the device — the host oracle cannot un-blow it, and
+                # flipping the firehose to the scalar host path would
+                # collapse throughput and deepen the queue further.
+                if host_est <= remaining_budget < est:
+                    use_host = True
+                    self._dev_rtt[bucket] = est * 0.98
+                    with self._stats_lock:
+                        self.budget_routed_batches += 1
         if use_host:
             with self._stats_lock:
                 self.host_fastpath_batches += 1
         dispatch_start_ns = time.time_ns()
+        dispatch_start = time.perf_counter()
         if self.policy_timeout is None:
             # reference parity: timeout disabled ⇒ unbounded execution,
             # run inline (host fast-path or device alike)
@@ -619,7 +684,16 @@ class MicroBatcher:
                     self._fail(p, e)
                 return
             if results is None:
+                # the elapsed time is a LOWER bound on this bucket's RTT —
+                # teach the router the device is slow right now
+                self._observe_dispatch(
+                    use_host, bucket, n,
+                    time.perf_counter() - dispatch_start, lower_bound=True,
+                )
                 return  # every item deadline-rejected; device work abandoned
+        self._observe_dispatch(
+            use_host, bucket, n, time.perf_counter() - dispatch_start
+        )
 
         # Phase 3 (host): service-layer constraints + metrics per item.
         # Items the watchdog already rejected are skipped — their verdicts
@@ -666,6 +740,38 @@ class MicroBatcher:
         delivery.flush()
         if metrics_sink:
             service._registry().record_evaluations_batch(metrics_sink)
+
+    def _observe_dispatch(
+        self,
+        use_host: bool,
+        bucket: int,
+        n: int,
+        dur: float,
+        lower_bound: bool = False,
+    ) -> None:
+        """Feed the routing estimators with a measured dispatch. Racy
+        float writes from concurrent batch workers are benign (last EWMA
+        step wins)."""
+        if self.latency_budget is None or n <= 0:
+            return
+        if use_host:
+            if lower_bound:
+                # a watchdog-truncated host batch (hung wasm row) is not a
+                # cost measurement — feeding it in would inflate host_est
+                # and suppress legitimate routing long after the hang
+                return
+            self._host_cost_per_row = (
+                0.7 * self._host_cost_per_row + 0.3 * dur / n
+            )
+            return
+        est = self._dev_rtt.get(bucket)
+        if lower_bound:
+            # a watchdog-abandoned dispatch only bounds the RTT from below
+            self._dev_rtt[bucket] = max(est or 0.0, dur)
+        else:
+            self._dev_rtt[bucket] = (
+                dur if est is None else 0.7 * est + 0.3 * dur
+            )
 
     def _watchdog_wait(
         self, dev_future: Future, runnable: list[_Pending]

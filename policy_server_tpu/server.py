@@ -171,6 +171,22 @@ class PolicyServer:
 
         context_service = _build_context_service(config)
 
+        # registry client for the oci/v1/manifest_digest host capability:
+        # the same token-auth/TLS/docker-config machinery registry://
+        # pulls use (reference wires its registry sources into the
+        # callback handler, src/lib.rs:91-125). Policies still opt in via
+        # allowNetworkCapabilities before any egress happens.
+        oci_digest_source = None
+        try:
+            from policy_server_tpu.fetch.downloader import Downloader
+
+            oci_digest_source = Downloader(
+                sources=config.sources,
+                docker_config_json_path=config.docker_config_json_path,
+            ).manifest_digest
+        except ImportError:  # fetch subsystem unavailable: capability
+            pass  # fails loudly in-band instead
+
         builder_kwargs = dict(
             module_resolver=resolver,
             always_accept_admission_reviews_on_namespace=(
@@ -184,6 +200,7 @@ class PolicyServer:
             # offline sigstore trust root for the keyless v2/verify host
             # capability
             wasm_trust_root=trust_root,
+            wasm_oci_digest_source=oci_digest_source,
             # bit-exact verdict cache / row dedup (0 disables)
             verdict_cache_size=config.verdict_cache_size,
         )
@@ -196,6 +213,7 @@ class PolicyServer:
             policy_timeout=config.policy_timeout,
             queue_capacity=config.pool_size * config.max_batch_size,
             host_fastpath_threshold=config.host_fastpath_threshold,
+            latency_budget_ms=config.latency_budget_ms,
         )
         if config.warmup_at_boot and config.evaluation_backend == "jax":
             batcher.warmup()

@@ -134,6 +134,7 @@ def static_capabilities(
     signature_bundle_source: Callable[[str], Mapping | None] | None = None,
     allow_network: bool = False,
     trust_root: Any = None,
+    oci_digest_source: Callable[[str], str] | None = None,
 ) -> dict[tuple[str, str], HostCapability]:
     """The payload-independent entries — build ONCE per bound policy.
     Network-reaching capabilities (DNS, OCI) are served only when the
@@ -141,7 +142,10 @@ def static_capabilities(
     not gain blocking egress (which the fuel meter cannot see) by
     default. ``trust_root`` (fetch/keyless.TrustRoot) enables the
     keyless ``v2/verify`` flavor against cosign-style keyless bundles in
-    the signature store; without one it rejects in-band."""
+    the signature store; without one it rejects in-band.
+    ``oci_digest_source`` (image ref → manifest digest; the server wires
+    ``Downloader.manifest_digest``) backs ``oci/v1/manifest_digest`` —
+    absent, that capability fails loudly."""
 
     # -- sigstore verify (pub-key flavor; keyless needs Fulcio/Rekor) -------
 
@@ -328,10 +332,28 @@ def static_capabilities(
                 "network capabilities are not enabled for this policy "
                 "(set allowNetworkCapabilities: true in its settings)"
             )
-        raise RuntimeError(
-            "OCI manifest digest lookup requires registry egress, which "
-            "this environment does not have"
-        )
+        if oci_digest_source is None:
+            # no registry client was wired in (library callers outside a
+            # server bootstrap) — loud, like the reference without its
+            # callback handler's registry sources (src/lib.rs:91-125)
+            raise RuntimeError(
+                "OCI manifest digest lookup requires registry egress, which "
+                "this environment does not have"
+            )
+        doc = json.loads(raw.decode())
+        # the SDK sends a bare JSON string; tolerate {"image": ...} too
+        image = doc.get("image") if isinstance(doc, Mapping) else doc
+        if not isinstance(image, str) or not image:
+            raise RuntimeError(
+                "manifest_digest request must carry an image reference"
+            )
+        try:
+            digest = oci_digest_source(image)
+        except Exception as e:  # noqa: BLE001 — network failure → in-band
+            raise RuntimeError(
+                f"manifest digest lookup for {image!r} failed: {e}"
+            ) from e
+        return json.dumps({"digest": digest}).encode()
 
     return {
         ("kubewarden", "v1/verify"): verify_pub_keys_image,
@@ -348,13 +370,15 @@ def build_default_capabilities(
     signature_bundle_source: Callable[[str], Mapping | None] | None = None,
     allow_network: bool = False,
     trust_root: Any = None,
+    oci_digest_source: Callable[[str], str] | None = None,
 ) -> dict[tuple[str, str], HostCapability]:
     """Full table for one request (tests and one-off callers; the serving
     path hoists static_capabilities per policy and merges only the
     kubernetes closures per request)."""
     return {
         **static_capabilities(
-            signature_bundle_source, allow_network, trust_root=trust_root
+            signature_bundle_source, allow_network, trust_root=trust_root,
+            oci_digest_source=oci_digest_source,
         ),
         **kubernetes_capabilities(payload),
     }

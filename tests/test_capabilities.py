@@ -393,3 +393,40 @@ def test_keyless_v2_verify_with_trust_root(tmp_path):
     caps = static_capabilities(file_bundle_source(str(store)))
     with pytest.raises(RuntimeError, match="trust root"):
         caps[("kubewarden", "v2/verify")](json.dumps({"image": image}).encode())
+
+
+def test_manifest_digest_served_from_wired_registry_client():
+    """(oci, v1/manifest_digest) answers through a wired registry client
+    (VERDICT r4 #3): opt-in still required, the digest comes back in-band,
+    and an actual network failure surfaces loudly — not the old
+    unconditional stub error."""
+    def source(image: str) -> str:
+        assert image == "reg.example.com/app/web:v1"
+        return "sha256:" + "ab" * 32
+
+    caps = build_default_capabilities(
+        {}, allow_network=True, oci_digest_source=source
+    )
+    out = call(caps, "oci", "v1/manifest_digest",
+               {"image": "reg.example.com/app/web:v1"})
+    assert out["digest"] == "sha256:" + "ab" * 32
+
+    # SDK flavor: bare JSON string request
+    out = call(caps, "oci", "v1/oci_manifest_digest",
+               "reg.example.com/app/web:v1")
+    assert out["digest"] == "sha256:" + "ab" * 32
+
+    # no opt-in → still refused before any egress
+    gated = build_default_capabilities({}, oci_digest_source=source)
+    with pytest.raises(RuntimeError, match="allowNetworkCapabilities"):
+        call(gated, "oci", "v1/manifest_digest", {"image": "x"})
+
+    # network failure → loud in-band error naming the image
+    def failing(image: str) -> str:
+        raise OSError("connection refused")
+
+    broken = build_default_capabilities(
+        {}, allow_network=True, oci_digest_source=failing
+    )
+    with pytest.raises(RuntimeError, match="'x'.*failed"):
+        call(broken, "oci", "v1/manifest_digest", {"image": "x"})

@@ -455,3 +455,35 @@ def test_server_bootstraps_fetched_artifact(tmp_path):
         AdmissionReviewRequest.from_dict(doc).request
     )
     assert env.validate("deny-ns", req2).allowed
+
+
+def test_manifest_digest_token_auth_flow(registry):
+    """Downloader.manifest_digest resolves a ref through the same
+    token-challenge flow registry:// pulls use; the digest matches the
+    sha256 of the manifest the fake registry serves (it sends no
+    Docker-Content-Digest header, so the body hash is the answer)."""
+    import hashlib as _hashlib
+
+    d = Downloader(sources=insecure_sources(registry))
+    digest = d.manifest_digest(f"{registry}/kubewarden/policies/deny-ns:v1.0")
+    art = _Registry.artifact
+    manifest = {
+        "schemaVersion": 2,
+        "layers": [
+            {
+                "mediaType": "application/vnd.tpp.policy.v1+json",
+                "digest": "sha256:" + _hashlib.sha256(art).hexdigest(),
+                "size": len(art),
+            }
+        ],
+    }
+    expected = "sha256:" + _hashlib.sha256(
+        json.dumps(manifest).encode()
+    ).hexdigest()
+    assert digest == expected
+
+    # an unknown repository is an actual registry failure → FetchError
+    from policy_server_tpu.fetch.downloader import FetchError
+
+    with pytest.raises(FetchError):
+        d.manifest_digest(f"{registry.replace(':', 'x:')}/nope/nope:v0")

@@ -68,6 +68,7 @@ def test_hung_device_call_rejects_in_band_and_loop_survives(env):
     batcher = MicroBatcher(
         env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.5,
         host_fastpath_threshold=0,  # these tests exercise the DEVICE path
+        latency_budget_ms=0,  # keep the budget router from bypassing it
     ).start()
     try:
         t0 = time.perf_counter()
@@ -105,6 +106,7 @@ def test_cold_bucket_compile_stall_bounded_then_fast(env):
     batcher = MicroBatcher(
         env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.4,
         host_fastpath_threshold=0,
+        latency_budget_ms=0,
     ).start()
     try:
         cold = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
@@ -132,6 +134,7 @@ def test_timeout_disabled_keeps_unbounded_execution(env):
     batcher = MicroBatcher(
         env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=None,
         host_fastpath_threshold=0,
+        latency_budget_ms=0,
     ).start()
     try:
         fut = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
@@ -161,6 +164,7 @@ def test_partial_expiry_late_items_still_served(env):
     batcher = MicroBatcher(
         env, max_batch_size=1, batch_timeout_ms=0.1, policy_timeout=0.6,
         host_fastpath_threshold=0,
+        latency_budget_ms=0,
     ).start()
     try:
         doomed = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
