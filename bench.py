@@ -632,6 +632,29 @@ def build_rollout_stream(n_requests: int, replicas: int, seed: int):
     return [to_req(d) for d in stream_docs], [to_req(d) for d in uniq_docs]
 
 
+def profile_delta(after: dict, before: dict) -> dict:
+    """Per-row host decomposition between two host_profile snapshots:
+    encode / dedup-bookkeeping / dispatch-wait in µs/row (PROFILE.md r6).
+    Every number here is recoverable from the emitted BENCH JSON alone."""
+    d = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+    enc_rows = max(1, d.get("encode_rows", 0))
+    book_rows = max(1, d.get("bookkeeping_rows", 0))
+    disp_rows = max(1, d.get("dispatched_rows", 0))
+    return {
+        "encode_us_per_row": round(d.get("encode_ns", 0) / 1e3 / enc_rows, 2),
+        "encode_rows": d.get("encode_rows", 0),
+        "bookkeeping_us_per_row": round(
+            d.get("bookkeeping_ns", 0) / 1e3 / book_rows, 2
+        ),
+        "bookkeeping_rows": d.get("bookkeeping_rows", 0),
+        "dispatch_wait_us_per_dispatched_row": round(
+            d.get("dispatch_wait_ns", 0) / 1e3 / disp_rows, 2
+        ),
+        "dispatched_rows": d.get("dispatched_rows", 0),
+        "dispatched_chunks": d.get("dispatched_chunks", 0),
+    }
+
+
 def bench_config4(n_requests: int, batch_size: int) -> None:
     from policy_server_tpu.policies.flagship import flagship_policies
 
@@ -685,9 +708,8 @@ def bench_config4(n_requests: int, batch_size: int) -> None:
     env.reset_verdict_cache()
     env.validate_batch(items)
     fallbacks_before = env.oracle_fallbacks  # report the timed-pass DELTA
-    dedup_before = (
-        env.dedup_stats["cache_hits"] + env.batch_dedup_hits
-    )
+    dedup_before = dict(env.dedup_stats)
+    profile_before = env.host_profile
     rps_runs = []
     for _ in range(3):
         env.reset_verdict_cache()  # each pass does the same work
@@ -698,10 +720,24 @@ def bench_config4(n_requests: int, batch_size: int) -> None:
         if errors:
             raise RuntimeError(f"bench evaluation error: {errors[0]}")
     s_on = spread(rps_runs)
+    dedup_after = env.dedup_stats
+    rollout_profile = profile_delta(env.host_profile, profile_before)
     dedup_total = (
-        env.dedup_stats["cache_hits"] + env.batch_dedup_hits - dedup_before
+        dedup_after["cache_hits"] - dedup_before["cache_hits"]
+        + dedup_after["blob_cache_hits"] - dedup_before["blob_cache_hits"]
+        + dedup_after["batch_dup_hits"] - dedup_before["batch_dup_hits"]
     )
     dedup_rate = dedup_total / max(1, 3 * len(items))
+    dedup_tiers = {
+        "blob_tier_hits": dedup_after["blob_cache_hits"]
+        - dedup_before["blob_cache_hits"],
+        "row_tier_hits": dedup_after["cache_hits"]
+        - dedup_before["cache_hits"],
+        "in_batch_dup_hits": dedup_after["batch_dup_hits"]
+        - dedup_before["batch_dup_hits"],
+        "cache_bytes": dedup_after["cache_bytes"]
+        + dedup_after["blob_cache_bytes"],
+    }
 
     fallbacks_on = env.oracle_fallbacks - fallbacks_before
 
@@ -721,12 +757,14 @@ def bench_config4(n_requests: int, batch_size: int) -> None:
         off_runs.append(len(items) / (time.perf_counter() - t0))
     s_off = spread(off_runs)
     env_off.validate_batch(uniq_items)  # prime the unique-only shapes
+    uniq_profile_before = env_off.host_profile
     uniq_runs = []
     for _ in range(3):
         t0 = time.perf_counter()
         env_off.validate_batch(uniq_items)
         uniq_runs.append(len(uniq_items) / (time.perf_counter() - t0))
     s_uniq = spread(uniq_runs)
+    uniq_profile = profile_delta(env_off.host_profile, uniq_profile_before)
 
     # steady-state per-dispatch latency at a serving-sized batch, on the
     # CACHE-OFF environment: this metric means "one device round-trip at
@@ -742,8 +780,13 @@ def bench_config4(n_requests: int, batch_size: int) -> None:
     lats.sort()
     env_off.close()
 
+    # The dedup-on rollout number moved OFF the historical key in round 6
+    # (ADVICE r5 #5): ``admission_reviews_per_sec_32policies`` measured an
+    # all-unique no-dedup stream in rounds 1-4, so the historical key
+    # carries that workload again (emitted last, below) and the rollout
+    # stream gets its own metric here.
     emit(
-        "admission_reviews_per_sec_32policies",
+        "admission_reviews_per_sec_32policies_rollout_dedup",
         s_on["median"],
         "reviews/s/chip",
         s_on["median"] / NORTH_STAR_RPS,
@@ -752,20 +795,48 @@ def bench_config4(n_requests: int, batch_size: int) -> None:
         workload=(
             f"rollout firehose: {len(uniq_items)} unique pod templates x "
             f"{REPLICAS} replica admissions each (bursty, fresh uid+name "
-            f"per replica) — bit-exact row dedup collapses replicas"
+            f"per replica) — two-tier dedup: blob tier collapses exact "
+            f"replays pre-encode, row tier collapses uid/name variants "
+            f"post-encode"
         ),
         rps_min=round(s_on["min"], 1),
         rps_max=round(s_on["max"], 1),
         rps_runs=s_on["runs"],
         dedup_rate=round(dedup_rate, 4),
+        dedup_tiers=dedup_tiers,
+        host_decomposition_us_per_row=rollout_profile,
         unique_templates=len(uniq_items),
         replicas=REPLICAS,
         rps_no_dedup_same_stream=round(s_off["median"], 1),
         rps_no_dedup_min=round(s_off["min"], 1),
         rps_no_dedup_max=round(s_off["max"], 1),
-        rps_all_unique_no_dedup=round(s_uniq["median"], 1),
-        rps_all_unique_min=round(s_uniq["min"], 1),
-        rps_all_unique_max=round(s_uniq["max"], 1),
+        n_policies=32,
+        oracle_fallbacks=fallbacks_on,
+    )
+
+    # HEADLINE (the driver records the LAST line): all-unique stream, no
+    # dedup — the exact workload rounds 1-4 published under this key, so
+    # cross-round trend lines stay apples-to-apples (ADVICE r5 #5).
+    emit(
+        "admission_reviews_per_sec_32policies",
+        s_uniq["median"],
+        "reviews/s/chip",
+        s_uniq["median"] / NORTH_STAR_RPS,
+        n_requests=len(uniq_items),
+        batch_size=batch_size,
+        workload=(
+            "all-unique synthetic firehose, verdict cache OFF — the "
+            "historical config4 workload (rounds 1-4); the rollout-dedup "
+            "figure lives in admission_reviews_per_sec_32policies_rollout_dedup"
+        ),
+        rps_min=round(s_uniq["min"], 1),
+        rps_max=round(s_uniq["max"], 1),
+        rps_runs=s_uniq["runs"],
+        host_decomposition_us_per_row=uniq_profile,
+        rps_rollout_dedup=round(s_on["median"], 1),
+        rps_rollout_dedup_min=round(s_on["min"], 1),
+        rps_rollout_dedup_max=round(s_on["max"], 1),
+        rps_no_dedup_same_rollout_stream=round(s_off["median"], 1),
         p50_dispatch_latency_ms=round(pct(lats, 0.5), 2),
         p95_dispatch_latency_ms=round(pct(lats, 0.95), 2),
         p99_dispatch_latency_ms=round(pct(lats, 0.99), 2),
@@ -845,7 +916,11 @@ def main() -> int:
         flush=True,
     )
     # headline LAST: the driver records the final JSON line
-    bench_config4(n_requests, batch_size)
+    try:
+        bench_config4(n_requests, batch_size)
+    except Exception as e:  # noqa: BLE001 — the headline line must exist
+        emit("admission_reviews_per_sec_32policies", 0.0, "error", 0.0,
+             error=repr(e)[:300])
     return 0
 
 

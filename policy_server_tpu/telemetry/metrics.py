@@ -39,6 +39,25 @@ EVALUATIONS_TOTAL = "kubewarden_policy_evaluations_total"
 LATENCY_MILLISECONDS = "kubewarden_policy_evaluation_latency_milliseconds"
 INIT_ERRORS_TOTAL = "kubewarden_policy_initialization_errors_total"
 
+# Serving-runtime instrument names (round 6): exported through the
+# runtime-stats collector (attach_runtime_stats, server.py wires the
+# provider), so they appear on BOTH the Prometheus pull endpoint
+# (/metrics) and the OTLP push pipeline (otlp.prometheus_to_otlp walks
+# the same registry). Kept here so server, dashboard, and tests agree on
+# one spelling.
+DEDUP_BLOB_HITS = "policy_server_dedup_blob_hits"
+DEDUP_BLOB_MISSES = "policy_server_dedup_blob_misses"
+VERDICT_CACHE_HITS = "policy_server_verdict_cache_hits"
+VERDICT_CACHE_MISSES = "policy_server_verdict_cache_misses"
+VERDICT_CACHE_BYTES = "policy_server_verdict_cache_bytes"
+BATCH_DEDUP_HITS = "policy_server_batch_dedup_hits"
+BUDGET_ROUTED_BATCHES = "policy_server_budget_routed_batches"
+HOST_ENCODE_SECONDS = "policy_server_host_encode_seconds_total"
+HOST_ENCODE_ROWS = "policy_server_host_encode_rows_total"
+HOST_BOOKKEEPING_SECONDS = "policy_server_host_bookkeeping_seconds_total"
+DISPATCH_WAIT_SECONDS = "policy_server_dispatch_wait_seconds_total"
+DISPATCHED_ROWS = "policy_server_dispatched_rows_total"
+
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".
 _EVAL_LABELS = (

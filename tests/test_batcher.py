@@ -76,6 +76,47 @@ def env():
     return EvaluationEnvironmentBuilder(backend="jax").build(policies)
 
 
+def test_warmup_rtt_seed_normalized_by_warmup_dispatches():
+    """ADVICE r5 #4: the warmup RTT seed divides by the environment's own
+    per-warmup dispatch count (schemas × shards for the sharded
+    evaluator), not by a schemas attribute the evaluator may not expose —
+    the old code overestimated per-dispatch RTT by shards×schemas and
+    biased early routing host-side."""
+    import time as _time
+
+    class FakeShardedEnv:
+        """Duck-typed evaluator: warmup costs a fixed wall per dispatch,
+        exposes warmup_dispatches like PolicyShardedEvaluator (no
+        ``schemas`` attribute, like the real sharded evaluator)."""
+
+        supports_host_fastpath = True
+        warmup_dispatches = 6  # e.g. 3 shards × 2 schemas
+        PER_DISPATCH_S = 0.01
+
+        def warmup(self, batch_sizes=(1,)):
+            _time.sleep(self.PER_DISPATCH_S * self.warmup_dispatches)
+
+    env = FakeShardedEnv()
+    batcher = MicroBatcher(
+        env, max_batch_size=2, latency_budget_ms=50.0, policy_timeout=2.0
+    )
+    batcher.warmup()
+    for bucket, rtt in batcher._dev_rtt.items():
+        # the seed must approximate ONE dispatch (~10 ms), not the whole
+        # shards×schemas warmup sweep (~60 ms)
+        assert rtt < 3 * env.PER_DISPATCH_S, (bucket, rtt)
+        assert rtt > 0
+
+
+def test_sharded_evaluator_exposes_warmup_dispatches():
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironment,
+    )
+
+    # one fused environment: one dispatch per schema per warmup call
+    assert EvaluationEnvironment.warmup_dispatches.fget is not None
+
+
 def test_bucket_size():
     assert [bucket_size(n) for n in (1, 2, 3, 5, 8, 9, 128)] == [
         1, 2, 4, 8, 8, 16, 128,
@@ -350,6 +391,13 @@ def test_budget_routing_keeps_latency_under_budget(env):
             self.device_batches += 1
             time.sleep(SLOW_DEVICE_S)
             return self._inner.validate_batch(items, run_hooks=run_hooks)
+
+        def validate_batch_finish(self, handle):
+            # the split (double-buffered) pipeline blocks on device
+            # results here — the simulated slowness must cover it too
+            self.device_batches += 1
+            time.sleep(SLOW_DEVICE_S)
+            return self._inner.validate_batch_finish(handle)
 
     slow = SlowDeviceEnv(env)
     batcher = MicroBatcher(

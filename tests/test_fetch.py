@@ -313,6 +313,8 @@ class _Registry(http.server.BaseHTTPRequestHandler):
 
     artifact = bundle_bytes()
     token_required = True
+    # optional headers injected on manifest responses (digest-verify tests)
+    manifest_headers: dict = {}
 
     def log_message(self, *a):  # silence
         pass
@@ -347,17 +349,23 @@ class _Registry(http.server.BaseHTTPRequestHandler):
                     }
                 ],
             }
-            self._ok(json.dumps(manifest).encode(), "application/vnd.oci.image.manifest.v1+json")
+            self._ok(
+                json.dumps(manifest).encode(),
+                "application/vnd.oci.image.manifest.v1+json",
+                extra_headers=self.manifest_headers,
+            )
         elif self.path.startswith("/v2/") and "/blobs/" in self.path:
             self._ok(self.artifact, "application/octet-stream")
         else:
             self.send_response(404)
             self.end_headers()
 
-    def _ok(self, body: bytes, ctype: str):
+    def _ok(self, body: bytes, ctype: str, extra_headers: dict | None = None):
         self.send_response(200)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -487,3 +495,60 @@ def test_manifest_digest_token_auth_flow(registry):
 
     with pytest.raises(FetchError):
         d.manifest_digest(f"{registry.replace(':', 'x:')}/nope/nope:v0")
+
+
+def test_manifest_digest_header_verified_not_trusted(registry):
+    """ADVICE r5 #2: the Docker-Content-Digest header is VERIFIED against
+    the sha256 of the served manifest bytes — a matching header is
+    returned, a mismatching one (misbehaving registry) raises, and an
+    unverifiable algorithm falls back to the client-computed digest.
+    The value feeds policy verify decisions via oci/v1/manifest_digest,
+    so header trust would let a registry forge provenance."""
+    import hashlib as _hashlib
+
+    from policy_server_tpu.fetch.downloader import FetchError
+
+    d = Downloader(sources=insecure_sources(registry))
+    ref = f"{registry}/kubewarden/policies/deny-ns:v1.0"
+    computed = d.manifest_digest(ref)  # no header: body hash
+    try:
+        # 1) header agrees with the bytes → returned verbatim
+        _Registry.manifest_headers = {"Docker-Content-Digest": computed}
+        assert d.manifest_digest(ref) == computed
+        # 2) header disagrees → rejected, never trusted
+        _Registry.manifest_headers = {
+            "Docker-Content-Digest": "sha256:" + "0" * 64
+        }
+        with pytest.raises(FetchError, match="digest mismatch"):
+            d.manifest_digest(ref)
+        # 3) unverifiable algorithm → fall back to the computed sha256
+        _Registry.manifest_headers = {
+            "Docker-Content-Digest": "nothash:abcdef"
+        }
+        assert d.manifest_digest(ref) == computed
+        # 3b) variable-length digests (shake_*) are unverifiable too:
+        # hashlib constructs them but hexdigest() needs a length — must
+        # fall back, not leak a TypeError past the FetchError contract
+        _Registry.manifest_headers = {
+            "Docker-Content-Digest": "shake_128:abcdef"
+        }
+        assert d.manifest_digest(ref) == computed
+        # 4) a non-sha256 but supported algorithm is verified on its own
+        # terms
+        manifest_bytes = None
+        _Registry.manifest_headers = {}
+        # recover the exact served bytes via the computed digest check
+        art = _Registry.artifact
+        manifest_bytes = json.dumps({
+            "schemaVersion": 2,
+            "layers": [{
+                "mediaType": "application/vnd.tpp.policy.v1+json",
+                "digest": "sha256:" + _hashlib.sha256(art).hexdigest(),
+                "size": len(art),
+            }],
+        }).encode()
+        sha512 = "sha512:" + _hashlib.sha512(manifest_bytes).hexdigest()
+        _Registry.manifest_headers = {"Docker-Content-Digest": sha512}
+        assert d.manifest_digest(ref) == sha512
+    finally:
+        _Registry.manifest_headers = {}

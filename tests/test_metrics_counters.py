@@ -1,0 +1,156 @@
+"""Round-6 serving counters are operator-visible (VERDICT r5 weak #4):
+two-tier dedup, verdict-cache hit/miss, host-fastpath, budget routing,
+and the host-pipeline decomposition must appear — with correct values —
+on the Prometheus pull endpoint (/metrics) AND survive the OTLP
+conversion that the metrics pusher uses, after a REAL served batch."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+import requests
+
+from policy_server_tpu.config.config import Config, TlsConfig
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from conftest import build_admission_review_dict
+from test_server import ServerHandle
+
+
+def _review_body(uid: str, privileged: bool) -> bytes:
+    doc = build_admission_review_dict()
+    doc["request"]["uid"] = uid
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "containers": [
+                {"name": "c", "image": "nginx",
+                 "securityContext": {"privileged": privileged}}
+            ]
+        },
+    }
+    return json.dumps(doc).encode()
+
+
+@pytest.fixture(scope="module")
+def server():
+    metrics_mod.reset_metrics_for_tests()
+    config = Config(
+        addr="127.0.0.1",
+        port=0,
+        readiness_probe_port=0,
+        tls_config=TlsConfig(),
+        policies={
+            "pod-privileged": parse_policy_entry(
+                "pod-privileged", {"module": "builtin://pod-privileged"}
+            ),
+        },
+        policy_timeout_seconds=30.0,
+        max_batch_size=8,
+        batch_timeout_ms=1.0,
+        # 0 forces the DEVICE path so the encode/dedup/dispatch counters
+        # all move (the host fast-path would bypass the native pipeline)
+        host_fastpath_threshold=0,
+        warmup_at_boot=True,
+    )
+    handle = ServerHandle(config)
+    yield handle
+    handle.stop()
+    metrics_mod.reset_metrics_for_tests()
+
+
+def _scrape(server) -> dict[str, float]:
+    r = requests.get(server.readiness_url("/metrics"), timeout=10)
+    assert r.status_code == 200
+    out: dict[str, float] = {}
+    for line in r.text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.rpartition(" ")
+        name = name.split("{")[0].strip()
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def test_dedup_and_pipeline_counters_after_served_batch(server):
+    url = server.url("/validate/pod-privileged")
+    headers = {"Content-Type": "application/json"}
+    # 1) cold: unique payload → full encode + dispatch (all misses)
+    r = requests.post(url, data=_review_body("u-1", False),
+                      headers=headers, timeout=30)
+    assert r.status_code == 200
+    # 2) exact replay (same uid, same payload) → BLOB-tier hit, no encode
+    r = requests.post(url, data=_review_body("u-1", False),
+                      headers=headers, timeout=30)
+    assert r.status_code == 200
+    # 3) fresh uid, same pod spec → blob miss, ROW-tier hit post-encode
+    r = requests.post(url, data=_review_body("u-2", False),
+                      headers=headers, timeout=30)
+    assert r.status_code == 200
+    time.sleep(0.1)  # let phase-3 bookkeeping settle
+
+    env = server.server.environment
+    dedup = env.dedup_stats
+    profile = env.host_profile
+    assert dedup["blob_cache_hits"] >= 1
+    assert dedup["cache_hits"] >= 1
+
+    m = _scrape(server)
+    # two-tier dedup counters, values matching the environment's own
+    assert m["policy_server_dedup_blob_hits_total"] == dedup["blob_cache_hits"]
+    assert (
+        m["policy_server_dedup_blob_misses_total"]
+        == dedup["blob_cache_misses"]
+    )
+    assert m["policy_server_verdict_cache_hits_total"] == dedup["cache_hits"]
+    assert (
+        m["policy_server_verdict_cache_misses_total"]
+        == dedup["cache_misses"]
+    )
+    assert m["policy_server_batch_dedup_hits_total"] == dedup["batch_dup_hits"]
+    assert (
+        m["policy_server_verdict_cache_bytes"]
+        == dedup["cache_bytes"] + dedup["blob_cache_bytes"]
+    )
+    # host-pipeline decomposition: encode ran for the two misses, the
+    # blob-tier hit skipped it; dispatch shipped at least one row
+    assert m["policy_server_host_encode_rows_total"] == profile["encode_rows"]
+    assert profile["encode_rows"] >= 2
+    assert m["policy_server_dispatched_rows_total"] == profile["dispatched_rows"]
+    assert profile["dispatched_rows"] >= 1
+    assert m["policy_server_host_encode_seconds_total"] > 0
+    assert m["policy_server_host_bookkeeping_seconds_total"] > 0
+    assert m["policy_server_dispatch_wait_seconds_total"] > 0
+    # routing counters exist (0 is fine — no budget pressure here)
+    assert "policy_server_budget_routed_batches_total" in m
+    assert "policy_server_host_fastpath_batches_total" in m
+
+
+def test_counters_survive_otlp_conversion(server):
+    """The OTLP pusher converts the SAME registry (one source of truth);
+    the round-6 instruments must come through as monotonic sums/gauges."""
+    pb = pytest.importorskip("policy_server_tpu.telemetry.otlp")
+    from policy_server_tpu.telemetry import default_registry
+
+    registry = default_registry().registry
+    now = time.time_ns()
+    metrics = pb.prometheus_to_otlp(registry, now - 10**9, now)
+    names = {m.name for m in metrics}
+    for expected in (
+        metrics_mod.DEDUP_BLOB_HITS,
+        metrics_mod.VERDICT_CACHE_HITS,
+        metrics_mod.BATCH_DEDUP_HITS,
+        metrics_mod.HOST_ENCODE_SECONDS,
+        metrics_mod.DISPATCH_WAIT_SECONDS,
+        metrics_mod.DISPATCHED_ROWS,
+        metrics_mod.VERDICT_CACHE_BYTES,
+    ):
+        assert any(expected in n for n in names), (expected, names)

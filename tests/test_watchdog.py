@@ -35,6 +35,30 @@ def review() -> ValidateRequest:
     )
 
 
+def wedge_device_half(env, gate_fn):
+    """Wrap whichever callable the batcher's device path will block on —
+    validate_batch_finish on the split (double-buffered) native pipeline,
+    validate_batch otherwise — so a simulated hang/stall lands exactly
+    where a real device wait would. Returns an undo callable."""
+    if env.native_encoding:
+        real = env.validate_batch_finish
+
+        def wrapped(handle):
+            gate_fn()
+            return real(handle)
+
+        env.validate_batch_finish = wrapped
+        return lambda: setattr(env, "validate_batch_finish", real)
+    real = env.validate_batch
+
+    def wrapped(items, run_hooks=True):
+        gate_fn()
+        return real(items, run_hooks=run_hooks)
+
+    env.validate_batch = wrapped
+    return lambda: setattr(env, "validate_batch", real)
+
+
 @pytest.fixture()
 def env():
     policies = {
@@ -55,16 +79,14 @@ def test_hung_device_call_rejects_in_band_and_loop_survives(env):
     the NEXT batch must still be served (the hang wedges one device-pool
     worker, not the dispatch loop)."""
     release = threading.Event()
-    real = env.validate_batch
     hang_once = {"armed": True}
 
-    def hanging_validate_batch(items, run_hooks=True):
+    def gate():
         if hang_once["armed"]:
             hang_once["armed"] = False
             release.wait(timeout=30)  # simulated hung device_get
-        return real(items, run_hooks=run_hooks)
 
-    env.validate_batch = hanging_validate_batch
+    undo = wedge_device_half(env, gate)
     batcher = MicroBatcher(
         env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.5,
         host_fastpath_threshold=0,  # these tests exercise the DEVICE path
@@ -86,23 +108,34 @@ def test_hung_device_call_rejects_in_band_and_loop_survives(env):
     finally:
         release.set()
         batcher.shutdown()
-        env.validate_batch = real
+        undo()
 
 
 def test_cold_bucket_compile_stall_bounded_then_fast(env):
     """A compile stall on a cold (schema × batch) bucket: the first request
     is deadline-rejected in-band while compilation finishes in the
     background; once warm, the same bucket serves within the deadline."""
-    real = env.validate_batch
     stall = {"first": True}
 
-    def stalling_validate_batch(items, run_hooks=True):
+    def gate():
         if stall["first"]:
             stall["first"] = False
             time.sleep(1.2)  # simulated cold-bucket XLA compile
-        return real(items, run_hooks=run_hooks)
 
-    env.validate_batch = stalling_validate_batch
+    # a compile stall surfaces in the HOST half (the jit dispatch runs in
+    # validate_batch_begin) — wedge that half on the split pipeline so
+    # this test proves the encode-stage watchdog too
+    if env.native_encoding:
+        real_begin = env.validate_batch_begin
+
+        def stalling_begin(items, run_hooks=True):
+            gate()
+            return real_begin(items, run_hooks=run_hooks)
+
+        env.validate_batch_begin = stalling_begin
+        undo = lambda: setattr(env, "validate_batch_begin", real_begin)  # noqa: E731
+    else:
+        undo = wedge_device_half(env, gate)
     batcher = MicroBatcher(
         env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.4,
         host_fastpath_threshold=0,
@@ -118,7 +151,7 @@ def test_cold_bucket_compile_stall_bounded_then_fast(env):
         assert warm.result(timeout=10).allowed is True
     finally:
         batcher.shutdown()
-        env.validate_batch = real
+        undo()
 
 
 def test_timeout_disabled_keeps_unbounded_execution(env):
@@ -149,16 +182,16 @@ def test_partial_expiry_late_items_still_served(env):
     """Items with later deadlines stay live after earlier items expire:
     the watchdog rejects progressively, not batch-at-once."""
     release = threading.Event()
-    real = env.validate_batch
+    entered = threading.Event()
     calls = {"n": 0}
 
-    def gated_validate_batch(items, run_hooks=True):
+    def gate():
         calls["n"] += 1
         if calls["n"] == 1:
+            entered.set()
             release.wait(timeout=30)
-        return real(items, run_hooks=run_hooks)
 
-    env.validate_batch = gated_validate_batch
+    undo = wedge_device_half(env, gate)
     # max_batch_size=1 → each submission is its own batch; the first wedges
     # one device worker, the second runs concurrently on another.
     batcher = MicroBatcher(
@@ -168,6 +201,11 @@ def test_partial_expiry_late_items_still_served(env):
     ).start()
     try:
         doomed = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+        # wait until doomed's device half is provably the wedged one —
+        # submitting both back-to-back would race which batch's device
+        # half reaches the gate first (wider window under the split
+        # pipeline, whose host half does real encode work)
+        assert entered.wait(timeout=5), "doomed batch never reached device"
         ok = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
         assert ok.result(timeout=10).allowed is True
         resp = doomed.result(timeout=5)
@@ -176,4 +214,4 @@ def test_partial_expiry_late_items_still_served(env):
     finally:
         release.set()
         batcher.shutdown()
-        env.validate_batch = real
+        undo()
