@@ -3,7 +3,7 @@
 
 IMG ?= policy-server-tpu:latest
 
-.PHONY: all test unit-tests integration-tests bench docs docs-check \
+.PHONY: all test unit-tests integration-tests bench chaos docs docs-check \
         fastenc image dev-stack dev-stack-down dryrun-multichip clean
 
 all: test
@@ -25,6 +25,12 @@ bench:
 # property-based differential fuzzing (device vs IR-oracle vs wasm)
 fuzz:
 	python -m pytest tests/test_fuzz_differential.py tests/test_differential.py -q
+
+# fault-injection chaos suite: shedding, deadline drops, breaker
+# trip/recover, fetch retry, shutdown-under-load (failpoints armed by the
+# tests themselves; slow-marked cases included)
+chaos:
+	python -m pytest tests/test_resilience.py -q
 
 # native host encoder (ops/fastenc.py compiles on demand into build/)
 fastenc:

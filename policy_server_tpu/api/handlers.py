@@ -35,6 +35,7 @@ from policy_server_tpu.evaluation.errors import (
     EvaluationError,
     PolicyNotFoundError,
 )
+from policy_server_tpu.runtime.batcher import ShedError
 from policy_server_tpu.models import (
     AdmissionResponse,
     AdmissionReviewRequest,
@@ -122,6 +123,21 @@ async def _evaluate(
         # deliver with one loop wakeup (runtime/batcher.py _DeliveryBatch)
         future = await state.batcher.submit_async(policy_id, request, origin)
         return await future
+    except ShedError as e:
+        # admission-time load shed: the queue cannot meet this request's
+        # deadline budget — an HTTP 429 with Retry-After beats evaluating
+        # work the API server will time out anyway
+        import math as _math
+
+        retry_after = max(1, _math.ceil(e.retry_after_seconds))
+        return web.json_response(
+            {
+                "message": "policy server overloaded; retry later",
+                "retry_after_seconds": retry_after,
+            },
+            status=429,
+            headers={"Retry-After": str(retry_after)},
+        )
     except PolicyNotFoundError as e:
         return api_error(404, str(e))
     except EvaluationError as e:

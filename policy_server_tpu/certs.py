@@ -244,6 +244,12 @@ class ReloadableTlsContext:
         )
 
     def _reload_identity(self) -> None:
+        # chaos site: simulates corrupted on-disk cert material mid-rotation
+        # (a raise here must keep the last-good identity serving, exactly
+        # like a real truncated/garbage PEM caught by the validators below)
+        from policy_server_tpu import failpoints
+
+        failpoints.fire("certs.reload")
         # read + validate exactly once; all contexts below use these bytes
         new_identity = (
             _validate_cert_file(self.tls_config.cert_file),

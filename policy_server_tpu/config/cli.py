@@ -78,6 +78,16 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
         ("--policy-timeout", "KUBEWARDEN_POLICY_TIMEOUT",
          dict(type=float, default=2.0, metavar="MAXIMUM_EXECUTION_TIME_SECONDS",
               help="Interrupt policy evaluation after the given time")),
+        ("--request-timeout-ms", "KUBEWARDEN_REQUEST_TIMEOUT_MS",
+         dict(type=float, default=10000.0, metavar="MS",
+              help="Propagated per-request deadline, aligned to the "
+                   "admission webhook timeoutSeconds model (the API server "
+                   "abandons a review after its timeout, so work past it is "
+                   "waste). Requests whose estimated queue wait exceeds the "
+                   "budget are shed at admission with 429 + Retry-After, "
+                   "and rows already expired when their batch forms are "
+                   "dropped before encode/dispatch. 0 disables deadline "
+                   "propagation and load shedding")),
         ("--disable-timeout-protection", "KUBEWARDEN_DISABLE_TIMEOUT_PROTECTION",
          dict(action="store_true", help="Disable policy timeout protection")),
         ("--ignore-kubernetes-connection-failure",
@@ -146,6 +156,28 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "budget, the batch is answered by the bit-exact host "
                    "oracle instead (0 disables; distinct from "
                    "--policy-timeout, the hard in-band deadline)")),
+        ("--breaker-failure-threshold", "KUBEWARDEN_BREAKER_FAILURE_THRESHOLD",
+         dict(type=int, default=5, metavar="N",
+              help="Device circuit breaker: dispatch faults / watchdog "
+                   "trips within the window that trip a shard OPEN (its "
+                   "traffic then serves from the bit-exact host oracle "
+                   "until a half-open probe succeeds)")),
+        ("--breaker-window-seconds", "KUBEWARDEN_BREAKER_WINDOW_SECONDS",
+         dict(type=float, default=30.0, metavar="SECONDS",
+              help="Device circuit breaker: sliding window over which "
+                   "failures accumulate toward the trip threshold")),
+        ("--breaker-cooldown-seconds", "KUBEWARDEN_BREAKER_COOLDOWN_SECONDS",
+         dict(type=float, default=5.0, metavar="SECONDS",
+              help="Device circuit breaker: time a tripped shard stays "
+                   "OPEN before a half-open recovery probe is admitted")),
+        ("--degraded-mode", "KUBEWARDEN_DEGRADED_MODE",
+         dict(default="oracle", metavar="MODE",
+              choices=["oracle", "monitor", "reject"],
+              help="What to serve while EVERY device shard's breaker is "
+                   "tripped: 'oracle' keeps serving bit-exact host-oracle "
+                   "verdicts (default), 'monitor' serves accept-all "
+                   "monitor-mode verdicts (fail-open), 'reject' answers "
+                   "in-band 503s (fail-closed)")),
         ("--verdict-cache-size", "KUBEWARDEN_VERDICT_CACHE_SIZE",
          dict(default="256Mi", metavar="BYTES",
               help="Byte budget of the bit-exact two-tier verdict cache "

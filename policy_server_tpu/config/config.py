@@ -191,6 +191,20 @@ class Config:
     # a batch whose measured device RTT estimate would exceed the oldest
     # request's remaining budget is answered host-side; ≤0 disables
     latency_budget_ms: float = 50.0
+    # propagated per-request deadline (the webhook timeoutSeconds model):
+    # requests that cannot meet it are shed at admission (429 +
+    # Retry-After) and rows already past it are dropped pre-encode;
+    # 0 disables deadline propagation and shedding
+    request_timeout_ms: float = 10000.0
+    # device circuit breaker: N failures within the window trip a shard
+    # to the host-oracle fallback; after the cooldown a half-open probe
+    # decides recovery
+    breaker_failure_threshold: int = 5
+    breaker_window_seconds: float = 30.0
+    breaker_cooldown_seconds: float = 5.0
+    # what to serve while EVERY shard's breaker is tripped:
+    # oracle (bit-exact host verdicts) | monitor (accept-all) | reject (503)
+    degraded_mode: str = "oracle"
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -243,6 +257,17 @@ class Config:
             raise ValueError("ports must be in [0, 65535]")
         if self.context_refresh_seconds <= 0:
             raise ValueError("--context-refresh-seconds must be > 0")
+        if self.breaker_failure_threshold < 1:
+            raise ValueError("--breaker-failure-threshold must be >= 1")
+        if self.breaker_window_seconds <= 0:
+            raise ValueError("--breaker-window-seconds must be > 0")
+        if self.breaker_cooldown_seconds < 0:
+            raise ValueError("--breaker-cooldown-seconds must be >= 0")
+        if self.degraded_mode not in ("oracle", "monitor", "reject"):
+            raise ValueError(
+                f"invalid degraded mode {self.degraded_mode!r} "
+                "(expected oracle, monitor, or reject)"
+            )
         if self.http_workers < 1:
             raise ValueError("--http-workers must be >= 1")
         if self.distributed_coordinator is None:
@@ -338,6 +363,11 @@ class Config:
             host_fastpath_threshold=int(args.host_fastpath_threshold),
             verdict_cache_size=parse_size(args.verdict_cache_size),
             latency_budget_ms=float(args.latency_budget_ms),
+            request_timeout_ms=float(args.request_timeout_ms),
+            breaker_failure_threshold=int(args.breaker_failure_threshold),
+            breaker_window_seconds=float(args.breaker_window_seconds),
+            breaker_cooldown_seconds=float(args.breaker_cooldown_seconds),
+            degraded_mode=args.degraded_mode,
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from policy_server_tpu import failpoints
+from policy_server_tpu.resilience import CircuitBreaker
 from policy_server_tpu.evaluation import groups as groups_mod
 from policy_server_tpu.evaluation import oracle as oracle_mod
 from policy_server_tpu.evaluation.errors import (
@@ -202,6 +204,7 @@ class EvaluationEnvironmentBuilder:
         wasm_trust_root: Any = None,
         wasm_oci_digest_source: Callable[[str], str] | None = None,
         verdict_cache_size: int = DEFAULT_VERDICT_CACHE_SIZE,
+        breaker_config: Mapping[str, Any] | None = None,
     ) -> None:
         self.backend = backend
         self.continue_on_errors = continue_on_errors
@@ -224,6 +227,9 @@ class EvaluationEnvironmentBuilder:
         self.wasm_oci_digest_source = wasm_oci_digest_source
         # bit-exact row dedup / verdict caching (verdict_cache.py); 0 = off
         self.verdict_cache_size = verdict_cache_size
+        # per-environment device circuit breaker thresholds
+        # (resilience.CircuitBreaker kwargs); None = defaults
+        self.breaker_config = breaker_config
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
         cache = ProgramCache()
@@ -341,6 +347,7 @@ class EvaluationEnvironmentBuilder:
             always_accept_namespace=self.always_accept_namespace,
             context_service=self.context_service,
             verdict_cache_size=self.verdict_cache_size,
+            breaker_config=self.breaker_config,
         )
 
 
@@ -364,6 +371,7 @@ class EvaluationEnvironment:
         always_accept_namespace: str | None = None,
         context_service: Any = None,
         verdict_cache_size: int = DEFAULT_VERDICT_CACHE_SIZE,
+        breaker_config: Mapping[str, Any] | None = None,
     ) -> None:
         self.backend = backend
         self.always_accept_namespace = always_accept_namespace
@@ -450,6 +458,20 @@ class EvaluationEnvironment:
         }
         self._fused = jax.jit(self._forward)
         self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
+        # Device circuit breaker (resilience.py): repeated dispatch faults
+        # or watchdog trips (reported by the batcher via
+        # record_dispatch_failure) trip THIS environment — one breaker per
+        # shard on a policy-sharded mesh, so a hung shard degrades alone —
+        # and tripped batches short-circuit to the bit-exact host oracle
+        # until a half-open probe succeeds. Oracle backend: no device, no
+        # breaker.
+        self.breaker = (
+            CircuitBreaker(**dict(breaker_config or {}))
+            if backend == "jax"
+            else None
+        )
+        # requests answered host-side because the breaker was open
+        self.breaker_short_circuited_requests = 0
         # Serving-layer host fast-path counter (validate_batch(prefer_host=
         # True) rows answered by the targeted host oracle; metrics surface)
         self.host_fastpath_requests = 0
@@ -1108,6 +1130,74 @@ class EvaluationEnvironment:
                 return s.to_transport(features, vocab_size=len(self.table))
         return features  # already transport width (or side-channel only)
 
+    def _device_call(self, fn: Callable, *args: Any) -> Any:
+        """Run a synchronous device-path call (the jit dispatch itself),
+        feeding dispatch-time raises — driver errors, RESOURCE_EXHAUSTED
+        thrown at the call rather than at fetch — to the breaker before
+        re-raising. Fetch-time raises feed it in _device_fetch."""
+        try:
+            return fn(*args)
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+
+    def _device_fetch(self, dev_out: Any) -> Any:
+        """The choke point every device RESULT FETCH goes through (plain
+        run_batch and the native pipeline's drain futures): fires the
+        ``device.fetch`` failpoint and feeds the circuit breaker — a
+        fetch that raises is a dispatch fault, a fetch that returns is
+        the success that closes a half-open breaker. Dispatch-time raises
+        feed the breaker in _device_call; a fetch that HANGS is invisible
+        to both, and the batcher's watchdog reports those through
+        record_dispatch_failure."""
+        breaker = self.breaker
+        try:
+            failpoints.fire("device.fetch")
+            out = jax.device_get(dev_out)
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return out
+
+    def record_dispatch_failure(self, policy_ids: Any = None) -> None:
+        """Report a device-path failure the environment cannot observe
+        itself — the dispatch watchdog abandoning a hung batch
+        (runtime/batcher.py). ``policy_ids`` exists for the sharded
+        evaluator's override, which routes the report to the owning
+        shards; a single environment has exactly one breaker."""
+        if self.breaker is not None:
+            self.breaker.record_failure()
+
+    @property
+    def breaker_all_open(self) -> bool:
+        """True while the device path is fully tripped AND still blocking
+        (the --degraded-mode gate consults this; on a sharded mesh it
+        means EVERY shard). Deliberately ``blocking_device``, not
+        ``is_open``: when the cooldown makes a probe due this flips False
+        so the batch proceeds to the dispatch path, whose allow_device()
+        runs the half-open probe — otherwise monitor/reject modes would
+        bypass the only recovery mechanism and stay degraded forever."""
+        return self.breaker is not None and self.breaker.blocking_device
+
+    @property
+    def breaker_stats(self) -> dict[str, int]:
+        """Breaker counters for /metrics (+ open-shard aggregation keys so
+        the single-env and sharded surfaces expose the same schema)."""
+        if self.breaker is None:
+            return {}
+        stats = self.breaker.stats()
+        stats.pop("state_code", None)  # per-shard; not summable
+        stats["open_shards"] = stats.pop("open")
+        stats["total_shards"] = 1
+        stats["short_circuited_requests"] = (
+            self.breaker_short_circuited_requests
+        )
+        return stats
+
     def run_batch(self, features: Mapping[str, Any]) -> dict[str, np.ndarray]:
         """Dispatch one encoded feature batch to the device; ONE device_get
         fetches every verdict."""
@@ -1116,7 +1206,7 @@ class EvaluationEnvironment:
             from policy_server_tpu.parallel import mesh as mesh_mod
 
             features = mesh_mod.shard_features(features, self._mesh)
-        packed = jax.device_get(self._fused(features))
+        packed = self._device_fetch(self._device_call(self._fused, features))
         return self._unpack(packed)
 
     def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
@@ -1136,6 +1226,7 @@ class EvaluationEnvironment:
         """Encode into the smallest shape bucket that fits; raises
         SchemaOverflow when even the widest schema cannot hold the
         request (→ oracle fallback)."""
+        failpoints.fire("encode.batch")
         last_error: SchemaOverflow | None = None
         for i, schema in enumerate(self.schemas):
             try:
@@ -1165,6 +1256,14 @@ class EvaluationEnvironment:
             return self._materialize_single(target, request.uid(), payload, {})
         if self.backend == "oracle":
             return self._materialize(target, request, self._oracle_outputs(payload, target))
+        if self.breaker is not None and not self.breaker.allow_device():
+            # tripped: the targeted host oracle serves (bit-exact by the
+            # differential guarantee) until a half-open probe closes it
+            with self._fallback_lock:
+                self.breaker_short_circuited_requests += 1
+            return self._materialize(
+                target, request, self._oracle_outputs_for(target, payload)
+            )
         try:
             bucket_idx, encoded = self.encode_bucketed(payload)
         except SchemaOverflow:
@@ -1405,6 +1504,17 @@ class EvaluationEnvironment:
         if self._closed:
             raise RuntimeError("environment closed")
         if prefer_host and self.backend == "jax":
+            return self._validate_batch_hostpath(items, run_hooks)
+        if (
+            self.backend == "jax"
+            and self.breaker is not None
+            and not self.breaker.allow_device()
+        ):
+            # breaker tripped: graceful degradation to the bit-exact host
+            # oracle — correct verdicts, zero device exposure; half-open
+            # probes re-enter through allow_device after the cooldown
+            with self._fallback_lock:
+                self.breaker_short_circuited_requests += len(items)
             return self._validate_batch_hostpath(items, run_hooks)
         if self.native_encoding and self.backend == "jax":
             # chunks to max_dispatch_batch internally, with pipelining
@@ -1693,6 +1803,10 @@ class EvaluationEnvironment:
             raise RuntimeError("environment closed")
         if not (self.native_encoding and self.backend == "jax"):
             return None
+        if self.breaker is not None and not self.breaker.allow_device():
+            # tripped: decline the split pipeline — the caller falls back
+            # to validate_batch, which routes host-side
+            return None
         deferred: list = []
         results = self._validate_batch_native(
             items, run_hooks, defer_sink=deferred
@@ -1773,6 +1887,7 @@ class EvaluationEnvironment:
         ckey_of_tid: list[tuple] = []
 
         def encode(chunk: list[int]):
+            failpoints.fire("encode.batch")
             t0 = time.perf_counter_ns()
             if blobs is None:
                 bl = [
@@ -2100,12 +2215,12 @@ class EvaluationEnvironment:
                 from policy_server_tpu.parallel import mesh as mesh_mod
 
                 features = mesh_mod.shard_features(features, self._mesh)
-            dev_out = self._fused(features)  # async dispatch
+            dev_out = self._device_call(self._fused, features)  # async dispatch
             self._profile_add(
                 dispatched_rows=n_dispatched, dispatched_chunks=1
             )
             entry = (
-                self._drain_pool.submit(jax.device_get, dev_out),
+                self._drain_pool.submit(self._device_fetch, dev_out),
                 slot_rows,
                 stash,
                 lru_inserts,

@@ -1,0 +1,213 @@
+"""Fault-injection failpoints — the chaos harness behind `make chaos`.
+
+The serving stack calls ``fire("site")`` at a handful of named failure
+sites (device fetch, batch encode, registry HTTP, cert reload). With no
+failpoints configured — the production state — ``fire`` is a single
+attribute test on a module global and returns immediately: zero
+allocations, no dict lookups, no locks on the hot path.
+
+Activation, either:
+
+* environment/config string (``FAILPOINTS`` env var, read at import and
+  re-readable via :func:`configure_from_env`)::
+
+      FAILPOINTS="device.fetch=sleep:5;fetch.http=raise:boom*3"
+
+  grammar per entry: ``site=action[:param][*count]`` —
+  ``raise[:message]`` raises :class:`FailpointError`, ``sleep:seconds``
+  blocks, ``off`` clears the site. ``*count`` disarms the action after
+  it fired ``count`` times (the retry-then-succeed shape chaos tests
+  need).
+
+* programmatic (tests): ``set_failpoint("site", fn, count=None)``
+  installs any callable — an Event-gated hang, a custom exception —
+  or use the :func:`active` context manager for scoped injection.
+
+Sites instrumented (grep for ``failpoints.fire``):
+
+==================  =====================================================
+``device.fetch``    device result fetch (environment._device_fetch) —
+                    ``sleep`` = hung transport, ``raise`` = dispatch fault
+``encode.batch``    host batch encode (native pipeline + bucketed encode)
+``fetch.http``      registry/HTTPS GET (fetch/downloader) — injected
+                    failures are retryable, like a real 5xx/timeout
+``certs.reload``    TLS identity reload (certs.py) — simulates corrupted
+                    on-disk cert material mid-rotation
+==================  =====================================================
+
+Every fire is counted (``fired_count(site)``) so chaos tests can assert
+an injection actually intercepted the path it claims to cover.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable
+
+ENV_VAR = "FAILPOINTS"
+
+
+class FailpointError(Exception):
+    """The injected fault for ``raise`` actions."""
+
+
+class _Point:
+    __slots__ = ("fn", "remaining")
+
+    def __init__(self, fn: Callable[[], None], remaining: int | None):
+        self.fn = fn
+        self.remaining = remaining  # None = unlimited
+
+
+_lock = threading.Lock()
+_points: dict[str, _Point] = {}
+_fired: dict[str, int] = {}
+# the ONE hot-path gate: False ⇒ fire() returns before touching any dict
+_armed = False
+
+
+def fire(site: str) -> None:
+    """Trigger the failpoint for ``site`` if one is armed; no-op (one
+    global check) otherwise. Called from serving hot paths — per batch,
+    never per row."""
+    if not _armed:
+        return
+    _fire_slow(site)
+
+
+def _fire_slow(site: str) -> None:
+    with _lock:
+        point = _points.get(site)
+        if point is None:
+            return
+        if point.remaining is not None:
+            if point.remaining <= 0:
+                return
+            point.remaining -= 1
+            if point.remaining == 0:
+                # leave the exhausted point in place (fired counts keep
+                # accumulating semantics simple); it no longer fires
+                pass
+        _fired[site] = _fired.get(site, 0) + 1
+        fn = point.fn
+    fn()  # OUTSIDE the lock: a sleeping/hanging action must not block
+    # concurrent fire() calls on other sites
+
+
+def set_failpoint(
+    site: str, fn: Callable[[], None], count: int | None = None
+) -> None:
+    """Install a callable to run on every ``fire(site)`` (at most
+    ``count`` times when given)."""
+    global _armed
+    with _lock:
+        _points[site] = _Point(fn, count)
+        _armed = True
+
+
+def clear(site: str | None = None) -> None:
+    """Remove one site's failpoint, or all of them (``site=None``)."""
+    global _armed
+    with _lock:
+        if site is None:
+            _points.clear()
+        else:
+            _points.pop(site, None)
+        _armed = bool(_points)
+
+
+def reset() -> None:
+    """Full reset: clear every failpoint AND the fired counters."""
+    clear()
+    with _lock:
+        _fired.clear()
+
+
+def fired_count(site: str) -> int:
+    with _lock:
+        return _fired.get(site, 0)
+
+
+class active:
+    """Scoped injection for tests::
+
+        with failpoints.active("device.fetch", lambda: time.sleep(2)):
+            ...
+    """
+
+    def __init__(
+        self, site: str, fn: Callable[[], None], count: int | None = None
+    ):
+        self.site = site
+        self.fn = fn
+        self.count = count
+
+    def __enter__(self) -> "active":
+        set_failpoint(self.site, self.fn, self.count)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        clear(self.site)
+
+
+# ---------------------------------------------------------------------------
+# String/env configuration
+# ---------------------------------------------------------------------------
+
+
+def _parse_action(spec: str) -> tuple[Callable[[], None], int | None]:
+    """``action[:param][*count]`` → (callable, count)."""
+    count: int | None = None
+    if "*" in spec:
+        spec, _, c = spec.rpartition("*")
+        count = int(c)
+    action, _, param = spec.partition(":")
+    action = action.strip().lower()
+    if action == "raise":
+        message = param or "injected fault"
+
+        def fn() -> None:
+            raise FailpointError(message)
+
+        return fn, count
+    if action == "sleep":
+        seconds = float(param or "1")
+
+        def fn() -> None:
+            time.sleep(seconds)
+
+        return fn, count
+    raise ValueError(f"unknown failpoint action {action!r}")
+
+
+def configure(spec: str) -> None:
+    """Install failpoints from a ``site=action;site=action`` string.
+    ``site=off`` clears that site; an empty string clears everything."""
+    spec = (spec or "").strip()
+    if not spec:
+        reset()
+        return
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, action = entry.partition("=")
+        if not sep:
+            raise ValueError(f"malformed failpoint entry {entry!r}")
+        site = site.strip()
+        if action.strip().lower() == "off":
+            clear(site)
+            continue
+        fn, count = _parse_action(action)
+        set_failpoint(site, fn, count)
+
+
+def configure_from_env() -> None:
+    spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        configure(spec)
+
+
+configure_from_env()

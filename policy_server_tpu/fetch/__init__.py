@@ -16,15 +16,31 @@ from policy_server_tpu.fetch.downloader import (
     Downloader,
     FetchedPolicies,
     FetchError,
+    # real when cryptography is available, loud degraded stubs otherwise
+    # (downloader.py owns the soft import): the fetch subsystem must stay
+    # usable for unverified acquisition in crypto-less environments
+    VerificationError,
     iter_module_urls,
+    verify_artifact,
 )
 from policy_server_tpu.telemetry.tracing import logger
-from policy_server_tpu.fetch.verify import (
-    VerificationError,
-    sign_artifact_bytes,
-    verify_artifact,
-    verify_local_checksum,
-)
+
+try:
+    from policy_server_tpu.fetch.verify import (
+        sign_artifact_bytes,
+        verify_local_checksum,
+    )
+except ImportError:  # pragma: no cover — cryptography unavailable
+
+    def sign_artifact_bytes(*args, **kwargs):  # type: ignore[misc]
+        raise VerificationError(
+            "artifact signing requires the 'cryptography' package"
+        )
+
+    def verify_local_checksum(*args, **kwargs):  # type: ignore[misc]
+        raise VerificationError(
+            "checksum verification requires the 'cryptography' package"
+        )
 
 if TYPE_CHECKING:
     from policy_server_tpu.config.config import Config

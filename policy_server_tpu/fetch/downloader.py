@@ -23,19 +23,36 @@ import hashlib
 import json
 import os
 import re
+import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Mapping
+from typing import Any, Callable, Mapping
 
 import requests
 
+from policy_server_tpu import failpoints
+from policy_server_tpu.resilience import retry_with_backoff
 from policy_server_tpu.config.sources import Sources
 from policy_server_tpu.config.verification import VerificationConfig
-from policy_server_tpu.fetch.verify import (
-    VerificationError,
-    verify_artifact,
-)
+
+try:
+    from policy_server_tpu.fetch.verify import (
+        VerificationError,
+        verify_artifact,
+    )
+except ImportError:  # cryptography unavailable: fetching still works,
+    # verification degrades LOUDLY — any configured verification fails
+    # per-policy instead of the whole fetch subsystem failing to import
+
+    class VerificationError(Exception):  # type: ignore[no-redef]
+        pass
+
+    def verify_artifact(*args, **kwargs):  # type: ignore[misc]
+        raise VerificationError(
+            "artifact verification requires the 'cryptography' package"
+        )
 from policy_server_tpu.models.policy import (
     Policy,
     PolicyGroup,
@@ -52,6 +69,39 @@ KUBEWARDEN_ARTIFACT_MEDIA_TYPES = (
 
 class FetchError(Exception):
     pass
+
+
+class RetryableFetchError(FetchError):
+    """A transient transport/registry failure (connect error, timeout,
+    HTTP 429/5xx): eligible for the capped-backoff retry policy. Still a
+    FetchError, so an exhausted retry budget surfaces through the same
+    error channel callers already handle."""
+
+
+# HTTP statuses worth retrying: rate limiting and server-side failures.
+# 4xx other than 429 are deterministic (auth, not-found) — retrying them
+# only delays the real error.
+RETRYABLE_HTTP_STATUS = frozenset({429, 500, 502, 503, 504})
+
+# process-wide retry accounting (the /metrics runtime collector reads
+# this; Downloader instances are transient — built at boot, hot-reload,
+# and per manifest_digest call — so the counters cannot live on them)
+_retry_lock = threading.Lock()
+_retry_totals = {"attempts": 0, "giveups": 0}
+
+
+def retry_stats() -> dict[str, int]:
+    """Cumulative fetch-retry counters: ``attempts`` (individual retries
+    performed) and ``giveups`` (operations that exhausted the budget)."""
+    with _retry_lock:
+        return dict(_retry_totals)
+
+
+def _count_retry(n: int = 1, giveup: bool = False) -> None:
+    with _retry_lock:
+        _retry_totals["attempts"] += n
+        if giveup:
+            _retry_totals["giveups"] += 1
 
 
 @dataclass
@@ -97,12 +147,57 @@ class Downloader:
         verification_config: VerificationConfig | None = None,
         docker_config_json_path: str | None = None,
         trust_root=None,  # fetch/keyless.TrustRoot for keyless kinds
+        retry_attempts: int = 4,
+        retry_base_seconds: float = 0.25,
+        retry_cap_seconds: float = 5.0,
+        retry_sleep: Callable[[float], None] = time.sleep,
     ) -> None:
         self.sources = sources or Sources()
         self.verification_config = verification_config
         self.trust_root = trust_root
         self._docker_auths = _load_docker_auths(docker_config_json_path)
         self._ca_bundles: dict[str, str] = {}  # host → bundle path (cached)
+        # transient-failure retry policy (applied to every registry/HTTPS
+        # round-trip at boot AND hot-reload): one 5xx blip must not be
+        # fatal, capped exponential backoff + full jitter keeps a fleet of
+        # rebooting servers from re-synchronizing on the registry
+        self.retry_attempts = max(1, int(retry_attempts))
+        self.retry_base_seconds = retry_base_seconds
+        self.retry_cap_seconds = retry_cap_seconds
+        self._retry_sleep = retry_sleep
+
+    def _with_retries(self, fn: Callable[[], Any], what: str) -> Any:
+        """Run one fetch operation under the retry policy. Retryable:
+        RetryableFetchError (connect/timeout/429/5xx) and injected
+        ``fetch.http`` failpoint faults; everything else propagates on
+        the first attempt."""
+
+        def on_retry(attempt: int, exc: BaseException, delay: float) -> None:
+            _count_retry()
+            logger.warning(
+                "transient fetch failure for %s (attempt %d/%d, retrying "
+                "in %.2fs): %s", what, attempt, self.retry_attempts, delay,
+                exc,
+            )
+
+        try:
+            return retry_with_backoff(
+                fn,
+                is_retryable=lambda e: isinstance(
+                    e, (RetryableFetchError, failpoints.FailpointError)
+                ),
+                attempts=self.retry_attempts,
+                base_seconds=self.retry_base_seconds,
+                cap_seconds=self.retry_cap_seconds,
+                sleep=self._retry_sleep,
+                on_retry=on_retry,
+            )
+        except RetryableFetchError:
+            _count_retry(0, giveup=True)
+            raise
+        except failpoints.FailpointError as e:
+            _count_retry(0, giveup=True)
+            raise FetchError(f"GET {what} failed: {e}") from e
 
     def download_policies(
         self,
@@ -224,15 +319,23 @@ class Downloader:
     def _http_get(
         self, url: str, host: str, headers: dict[str, str] | None = None
     ) -> bytes:
-        try:
-            resp = requests.get(
-                url, headers=headers or {}, timeout=30, **self._tls_kwargs(host)
-            )
-        except requests.RequestException as e:
-            raise FetchError(f"GET {url} failed: {e}") from e
-        if resp.status_code != 200:
-            raise FetchError(f"GET {url} -> HTTP {resp.status_code}")
-        return resp.content
+        def attempt() -> bytes:
+            failpoints.fire("fetch.http")
+            try:
+                resp = requests.get(
+                    url, headers=headers or {}, timeout=30,
+                    **self._tls_kwargs(host),
+                )
+            except requests.RequestException as e:
+                raise RetryableFetchError(f"GET {url} failed: {e}") from e
+            if resp.status_code != 200:
+                message = f"GET {url} -> HTTP {resp.status_code}"
+                if resp.status_code in RETRYABLE_HTTP_STATUS:
+                    raise RetryableFetchError(message)
+                raise FetchError(message)
+            return resp.content
+
+        return self._with_retries(attempt, url)
 
     def _fetch_oci(self, parsed: urllib.parse.ParseResult) -> tuple[bytes, str]:
         """OCI distribution pull: ref → token (if challenged) → manifest →
@@ -381,22 +484,34 @@ class Downloader:
         host: str,
         headers: dict[str, str],
     ) -> requests.Response:
-        try:
-            resp = session.get(url, headers=headers, timeout=30, **self._tls_kwargs(host))
-            if resp.status_code == 401:
-                challenge = resp.headers.get("WWW-Authenticate", "")
-                token = self._anonymous_token(session, challenge, host)
-                if token:
-                    headers = dict(headers)
-                    headers["Authorization"] = f"Bearer {token}"
-                    resp = session.get(
-                        url, headers=headers, timeout=30, **self._tls_kwargs(host)
-                    )
-        except requests.RequestException as e:
-            raise FetchError(f"GET {url} failed: {e}") from e
-        if resp.status_code != 200:
-            raise FetchError(f"GET {url} -> HTTP {resp.status_code}")
-        return resp
+        def attempt() -> requests.Response:
+            failpoints.fire("fetch.http")
+            req_headers = headers
+            try:
+                resp = session.get(
+                    url, headers=req_headers, timeout=30,
+                    **self._tls_kwargs(host),
+                )
+                if resp.status_code == 401:
+                    challenge = resp.headers.get("WWW-Authenticate", "")
+                    token = self._anonymous_token(session, challenge, host)
+                    if token:
+                        req_headers = dict(req_headers)
+                        req_headers["Authorization"] = f"Bearer {token}"
+                        resp = session.get(
+                            url, headers=req_headers, timeout=30,
+                            **self._tls_kwargs(host),
+                        )
+            except requests.RequestException as e:
+                raise RetryableFetchError(f"GET {url} failed: {e}") from e
+            if resp.status_code != 200:
+                message = f"GET {url} -> HTTP {resp.status_code}"
+                if resp.status_code in RETRYABLE_HTTP_STATUS:
+                    raise RetryableFetchError(message)
+                raise FetchError(message)
+            return resp
+
+        return self._with_retries(attempt, url)
 
     def _anonymous_token(
         self, session: requests.Session, challenge: str, host: str

@@ -272,20 +272,52 @@ def _build_chain_to_root(
     # breaks cross-signature cycles; `failed_at` memoizes the shallowest
     # depth at which a certificate dead-ended (failure with budget r
     # implies failure with any budget ≤ r), bounding the walk to
-    # O(pool × depth) expansions instead of exponential. The memo can
-    # only ever REJECT (fail closed) in pathological cross-signed cycles
-    # through an ancestor — it never widens what verifies.
+    # O(pool × depth) expansions instead of exponential.
+    #
+    # Memo soundness (ADVICE r6 #1): a dead end is only PATH-INDEPENDENT
+    # when the subtree walk never skipped a candidate via the `seen`
+    # ancestor prune. A failure that pruned an ancestor says "this cert
+    # fails when X is already on the path" — from a different starting
+    # path (valid cross-signed topologies have exactly this shape) the
+    # same cert can still reach the root, so memoizing that failure
+    # falsely rejected valid chains. ascend() therefore reports whether
+    # its subtree was pruned, and only prune-free failures enter the memo.
+    #
+    # The memo was ALSO the complexity bound, and prune-tainted subtrees
+    # now bypass it — a crafted bundle of mutually cross-signed
+    # same-subject intermediates could make every failure prune-tainted
+    # and the walk combinatorial. A flat expansion budget restores the
+    # bound: real chains spend well under pool×depth (≤ ~72) candidate
+    # expansions, so exhausting the budget means an adversarial topology
+    # and the walk FAILS CLOSED.
     failed_at: dict[bytes, int] = {}
+    budget = [512]  # candidate expansions (signature checks) remaining
 
-    def ascend(cur: x509.Certificate, depth: int, seen: frozenset) -> bool:
+    def ascend(
+        cur: x509.Certificate, depth: int, seen: frozenset
+    ) -> tuple[bool, bool]:
+        """Returns (reached_root, subtree_pruned): ``subtree_pruned``
+        means some candidate in this subtree was skipped because it was
+        an ancestor on the current path, making a failure here
+        path-dependent and unmemoizable."""
         if depth >= _MAX_CHAIN_LEN:
-            return False
+            return False, False  # pure depth exhaustion: monotonic, safe
+        pruned = False
         for cand in pool:
             if cand.subject != cur.issuer:
                 continue
             fp = cand.fingerprint(hashes.SHA256())
-            if fp in seen or depth >= failed_at.get(fp, _MAX_CHAIN_LEN + 1):
+            if fp in seen:
+                pruned = True
                 continue
+            if depth >= failed_at.get(fp, _MAX_CHAIN_LEN + 1):
+                continue
+            budget[0] -= 1
+            if budget[0] < 0:
+                raise KeylessError(
+                    "certificate chain walk budget exceeded "
+                    "(adversarial cross-signed topology)"
+                )
             try:
                 _verify_cert_signature(cur, cand)
             except (InvalidSignature, KeylessError):
@@ -293,7 +325,7 @@ def _build_chain_to_root(
             if not _valid_at(cand, at):
                 continue
             if fp in root_fps:
-                return True
+                return True, False
             # non-root parent must be a CA
             try:
                 bc = cand.extensions.get_extension_for_class(
@@ -303,12 +335,16 @@ def _build_chain_to_root(
                     continue
             except x509.ExtensionNotFound:
                 continue
-            if ascend(cand, depth + 1, seen | {fp}):
-                return True
-            failed_at[fp] = min(failed_at.get(fp, depth), depth)
-        return False
+            sub_found, sub_pruned = ascend(cand, depth + 1, seen | {fp})
+            if sub_found:
+                return True, False
+            if sub_pruned:
+                pruned = True  # cand might succeed from another path
+            else:
+                failed_at[fp] = min(failed_at.get(fp, depth), depth)
+        return False, pruned
 
-    if not ascend(leaf, 0, frozenset()):
+    if not ascend(leaf, 0, frozenset())[0]:
         raise KeylessError(
             "certificate chain does not verify up to a trust-root CA"
         )

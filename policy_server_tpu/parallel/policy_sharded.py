@@ -270,6 +270,41 @@ class PolicyShardedEvaluator:
     def oracle_fallbacks(self) -> int:
         return sum(env.oracle_fallbacks for env in self._routing.shards)
 
+    def record_dispatch_failure(self, policy_ids: Any = None) -> None:
+        """Route a batcher-observed device failure (watchdog abandonment,
+        device-future exception) to the breakers of the shards that owned
+        the batch's policies — per-shard containment: a hung shard trips
+        alone while the others keep their device path. Without
+        ``policy_ids`` (no attribution), every shard takes the mark."""
+        snap = self._routing
+        if not policy_ids:
+            for env in snap.shards:
+                env.record_dispatch_failure()
+            return
+        hit: set[int] = set()
+        for pid in policy_ids:
+            idx = snap.owner.get(str(pid).split("/")[0])
+            if idx is not None and idx not in hit:
+                hit.add(idx)
+                snap.shards[idx].record_dispatch_failure()
+
+    @property
+    def breaker_all_open(self) -> bool:
+        """True only when EVERY shard's device path is tripped — the
+        'tripped-everything' state the --degraded-mode policy keys on."""
+        shards = self._routing.shards
+        return bool(shards) and all(env.breaker_all_open for env in shards)
+
+    @property
+    def breaker_stats(self) -> dict[str, int]:
+        """Breaker counters summed across shards (open_shards counts the
+        currently-tripped subset; total_shards sizes it)."""
+        totals: dict[str, int] = {}
+        for env in self._routing.shards:
+            for k, v in env.breaker_stats.items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
+
     @property
     def warmup_dispatches(self) -> int:
         """Device dispatches ONE warmup((b,)) call issues: every shard

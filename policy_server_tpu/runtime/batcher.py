@@ -32,6 +32,7 @@ being torn down).
 from __future__ import annotations
 
 import asyncio
+import math
 import queue
 import threading
 import time
@@ -50,6 +51,24 @@ from policy_server_tpu.models import AdmissionResponse, ValidateRequest
 from policy_server_tpu.telemetry import otlp
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
+# a request whose propagated deadline passed while it sat in the queue:
+# the API server already timed out the webhook call, so its verdict is
+# unobservable — drop it BEFORE paying encode/dispatch (no dead work)
+EXPIRED_MESSAGE = "request deadline exceeded before evaluation"
+DEGRADED_MESSAGE = "policy server degraded: device backend unavailable"
+
+
+class ShedError(Exception):
+    """Load-shed signal raised at ADMISSION (submit/submit_async) when the
+    queue's estimated wait — from the batcher's measured device-RTT EWMA —
+    already exceeds the request's deadline budget: evaluating it would be
+    pure waste (the admission-webhook model: the API server enforces a
+    hard ``timeoutSeconds`` per review). The HTTP layer maps this to
+    429 + Retry-After."""
+
+    def __init__(self, retry_after_seconds: float):
+        super().__init__("policy server overloaded; retry later")
+        self.retry_after_seconds = max(0.001, retry_after_seconds)
 
 
 @dataclass
@@ -71,6 +90,10 @@ class _Pending:
     # serving profile, PROFILE.md round-3 follow-up)
     aio_loop: Any = None
     aio_future: Any = None
+    # propagated request deadline (absolute perf_counter time): stamped at
+    # submission from --request-timeout-ms; rows past it are dropped
+    # before encode/dispatch instead of evaluating dead work
+    deadline: float | None = None
 
 
 def _set_many(items: list) -> None:
@@ -127,11 +150,24 @@ class MicroBatcher:
         queue_capacity: int | None = None,
         host_fastpath_threshold: int = 64,
         latency_budget_ms: float = 50.0,
+        request_timeout_ms: float = 0.0,
+        degraded_mode: str = "oracle",
     ) -> None:
         self.env = env
         self.max_batch_size = max(1, int(max_batch_size))
         self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
         self.policy_timeout = policy_timeout
+        # Propagated request deadline (--request-timeout-ms; aligned to
+        # the webhook timeoutSeconds model, distinct from policy_timeout
+        # — the per-EVALUATION bound). ≤0 disables deadline propagation
+        # and load shedding entirely (the pre-round-7 behavior).
+        self.request_timeout = (
+            request_timeout_ms / 1e3 if request_timeout_ms > 0 else None
+        )
+        # what to serve while the device breaker is fully tripped:
+        # 'oracle' (default) = bit-exact host verdicts, 'monitor' =
+        # accept-all monitor-mode verdicts, 'reject' = in-band 503s
+        self.degraded_mode = degraded_mode
         # Deadline-aware routing (VERDICT r4 #2): beyond the static
         # fast-path count, a batch is answered host-side whenever the
         # MEASURED device round-trip estimate would blow the oldest
@@ -218,6 +254,14 @@ class MicroBatcher:
         # batches routed host-side by the latency-budget check (a strict
         # subset of host_fastpath_batches)
         self.budget_routed_batches = 0
+        # -- resilience counters (round 7; /metrics surface) --------------
+        # requests shed at admission (429 + Retry-After)
+        self.shed_requests = 0
+        # already-expired rows dropped before encode/dispatch
+        self.expired_dropped = 0
+        # requests answered by the --degraded-mode policy while the
+        # device breaker was fully tripped (monitor/reject modes only)
+        self.degraded_responses = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -281,6 +325,37 @@ class MicroBatcher:
         for the /metrics runtime gauges)."""
         return self._queue.qsize()
 
+    def estimated_wait(self) -> float:
+        """Rough seconds until a request enqueued NOW would dispatch:
+        queue depth in batches × the measured device-RTT EWMA for the
+        serving bucket, divided by the batch-pipeline width. This is the
+        load-shedding admission signal — deliberately cheap (two dict
+        reads, no locks) and deliberately pessimism-free: shedding on an
+        inflated estimate would turn a clearable burst into 429s."""
+        depth = self._queue.qsize()
+        if depth <= 0:
+            return 0.0
+        bucket = bucket_size(self.max_batch_size)
+        rtt = self._dev_rtt.get(bucket)
+        if rtt is None:
+            # no device measurement yet (cold boot / host-only traffic):
+            # fall back to the host-cost estimate for a full batch
+            rtt = self._host_cost_per_row * self.max_batch_size
+        batches = math.ceil(depth / self.max_batch_size)
+        return batches * rtt / self._batch_workers
+
+    def _shed_check(self, pending: "_Pending") -> None:
+        """Admission-time load shedding: raise ShedError when the queue's
+        estimated wait already exceeds this request's deadline budget.
+        No-op unless a request timeout is configured."""
+        if pending.deadline is None:
+            return
+        est = self.estimated_wait()
+        if est > pending.deadline - time.perf_counter():
+            with self._stats_lock:
+                self.shed_requests += 1
+            raise ShedError(est)
+
     def warmup(self) -> None:
         """Compile every batch bucket at boot (reference precompiles all
         policies via rayon at boot, src/lib.rs:287-307) and seed the
@@ -294,7 +369,7 @@ class MicroBatcher:
             b <<= 1
         sizes.append(bucket_size(self.max_batch_size))
         self.env.warmup(tuple(sizes))
-        if self.latency_budget is not None:
+        if self.latency_budget is not None or self.request_timeout is not None:
             # one warmup((b,)) call dispatches once per shape schema, per
             # SHARD (PolicyShardedEvaluator warms every shard
             # sequentially) — a serving batch dispatches exactly once, so
@@ -324,11 +399,16 @@ class MicroBatcher:
         raises EvaluationError. A full queue WAITS for space — the analog of
         the reference waiting on its semaphore (handlers.rs:262-266) — but
         bounded by the policy timeout, so a burst is absorbed and only
-        sustained overload degrades, with a clear in-band 429."""
+        sustained overload degrades, with a clear in-band 429. With a
+        request timeout configured, admission may instead raise ShedError
+        when the estimated wait already exceeds the deadline budget."""
         pending = _Pending(policy_id, request, origin, Future())
+        if self.request_timeout is not None:
+            pending.deadline = pending.enqueued_at + self.request_timeout
         if self._stopping:
             self._reject_stopping(pending)
             return pending.future
+        self._shed_check(pending)
         self._put_waiting(pending)
         return pending.future
 
@@ -348,14 +428,33 @@ class MicroBatcher:
             if self._stopping:
                 self._reject_stopping(pending)
                 return False
-            if self.policy_timeout is None:
+            bounds = []
+            if self.policy_timeout is not None:
+                bounds.append(
+                    pending.enqueued_at + self.policy_timeout
+                )
+            if pending.deadline is not None:
+                # waiting past the propagated request deadline is dead
+                # work — the webhook caller already gave up
+                bounds.append(pending.deadline)
+            if not bounds:
                 wait = self._WAIT_SLICE_SECONDS
             else:
-                remaining = self.policy_timeout - (
-                    time.perf_counter() - pending.enqueued_at
-                )
+                now = time.perf_counter()
+                remaining = min(bounds) - now
                 if remaining <= 0:
-                    self._reject_overloaded(pending)
+                    # same failure mode, same answer: a wait that ran out
+                    # the PROPAGATED deadline is an expired drop (504,
+                    # counted), not a generic overload 429 — the caller's
+                    # webhook timed out either way, and the expired-drop
+                    # counter must see every pre-dispatch deadline death
+                    if (
+                        pending.deadline is not None
+                        and now >= pending.deadline
+                    ):
+                        self._reject_expired(pending)
+                    else:
+                        self._reject_overloaded(pending)
                     return False
                 wait = min(self._WAIT_SLICE_SECONDS, remaining)
             try:
@@ -394,9 +493,12 @@ class MicroBatcher:
         pending = _Pending(policy_id, request, origin, Future())
         pending.aio_loop = loop
         pending.aio_future = loop.create_future()
+        if self.request_timeout is not None:
+            pending.deadline = pending.enqueued_at + self.request_timeout
         if self._stopping:
             self._reject_stopping(pending)
             return pending.aio_future
+        self._shed_check(pending)
         try:
             self._queue.put_nowait(pending)
             # same stranding window as the sync path (_put_waiting):
@@ -552,6 +654,79 @@ class MicroBatcher:
         except RuntimeError:  # loop closed
             pass
 
+    def _reject_expired(
+        self, p: _Pending, delivery: _DeliveryBatch | None = None
+    ) -> None:
+        """Drop an already-expired row BEFORE encode/dispatch (no dead
+        work): the propagated deadline passed while it queued, so the
+        webhook caller is gone — answer 504 in-band and count it."""
+        with self._stats_lock:
+            self.expired_dropped += 1
+        self._resolve(
+            p,
+            AdmissionResponse.reject(p.request.uid(), EXPIRED_MESSAGE, 504),
+            delivery,
+        )
+
+    def _serve_degraded(self, runnable: list[_Pending]) -> None:
+        """The tripped-everything answer per --degraded-mode: 'monitor'
+        serves accept-all monitor-style verdicts (fail-open, logged),
+        'reject' serves in-band 503s (fail-closed). The default 'oracle'
+        never reaches here — the environment routes host-side itself."""
+        from policy_server_tpu.telemetry.tracing import logger
+
+        with self._stats_lock:
+            self.degraded_responses += len(runnable)
+        logger.warning(
+            "device breaker fully open: serving %d request(s) in "
+            "degraded mode %r", len(runnable), self.degraded_mode,
+        )
+        delivery = _DeliveryBatch()
+        for p in runnable:
+            if self.degraded_mode == "reject":
+                self._resolve(
+                    p,
+                    AdmissionResponse.reject(
+                        p.request.uid(), DEGRADED_MESSAGE, 503
+                    ),
+                    delivery,
+                )
+            else:  # monitor: accept, no status — service.rs monitor shape
+                self._resolve(
+                    p,
+                    AdmissionResponse(uid=p.request.uid(), allowed=True),
+                    delivery,
+                )
+        delivery.flush()
+
+    def _record_device_failure(
+        self, batch: list[_Pending], waited: float
+    ) -> None:
+        """Report a watchdog abandonment to the environment's circuit
+        breaker(s) — the failure mode exceptions cannot see (the device
+        call HUNG). The sharded evaluator routes the report to the shards
+        owning the batch's policies.
+
+        ``waited`` is how long the device call was actually outstanding
+        before abandonment. A batch formed from queue-aged items can
+        expire moments after dispatch on a perfectly healthy device —
+        that is a QUEUEING failure, and attributing it to the breaker
+        would flip overloaded-but-healthy shards onto the slower host
+        path and deepen the overload. Only a wait consuming a meaningful
+        share of the evaluation deadline reads as a device hang."""
+        if (
+            self.policy_timeout is not None
+            and waited < 0.5 * self.policy_timeout
+        ):
+            return
+        rec = getattr(self.env, "record_dispatch_failure", None)
+        if rec is None:
+            return
+        try:
+            rec([p.policy_id for p in batch])
+        except Exception:  # noqa: BLE001 — accounting must not fail batches
+            pass
+
     def _reject_deadline(
         self, p: _Pending, delivery: _DeliveryBatch | None = None
     ) -> None:
@@ -580,6 +755,11 @@ class MicroBatcher:
         for p in batch:
             if p.future.cancelled():
                 continue
+            # no dead work: a row whose propagated deadline passed while
+            # queued is dropped HERE, before any encode/dispatch spend
+            if p.deadline is not None and time.perf_counter() >= p.deadline:
+                self._reject_expired(p)
+                continue
             try:
                 short = service.pre_evaluate(
                     self.env, p.policy_id, p.request, p.origin, p.enqueued_at
@@ -604,6 +784,16 @@ class MicroBatcher:
                 continue
             runnable.append(p)
         if not runnable:
+            return
+
+        # Degraded-mode gate: with every shard's breaker open and a
+        # non-default policy, answer per --degraded-mode instead of
+        # evaluating (the default 'oracle' keeps evaluating — the
+        # environment itself short-circuits to the host oracle).
+        if self.degraded_mode != "oracle" and getattr(
+            self.env, "breaker_all_open", False
+        ):
+            self._serve_degraded(runnable)
             return
 
         # Phase 2 (device): one fused dispatch for every runnable item.
@@ -715,7 +905,12 @@ class MicroBatcher:
                 if handle is None and not live:
                     # every item expired during the host half; the encode
                     # worker finishes (and its device work is discarded)
-                    # in the background
+                    # in the background. A long stall here IS a
+                    # device-path fault (the jit dispatch lives in begin)
+                    # — tell the breaker.
+                    self._record_device_failure(
+                        runnable, time.perf_counter() - dispatch_start
+                    )
                     self._observe_dispatch(
                         use_host, bucket, n,
                         time.perf_counter() - dispatch_start,
@@ -748,6 +943,12 @@ class MicroBatcher:
             if results is None:
                 # the elapsed time is a LOWER bound on this bucket's RTT —
                 # teach the router the device is slow right now
+                if not use_host:
+                    # a watchdog abandonment is the breaker's hang signal
+                    # (attributed only when the device wait was long)
+                    self._record_device_failure(
+                        runnable, time.perf_counter() - dispatch_start
+                    )
                 self._observe_dispatch(
                     use_host, bucket, n,
                     time.perf_counter() - dispatch_start, lower_bound=True,
@@ -813,8 +1014,12 @@ class MicroBatcher:
     ) -> None:
         """Feed the routing estimators with a measured dispatch. Racy
         float writes from concurrent batch workers are benign (last EWMA
-        step wins)."""
-        if self.latency_budget is None or n <= 0:
+        step wins). The estimators serve BOTH the latency-budget router
+        and the load-shedding admission check (estimated_wait), so they
+        stay live when either knob is on."""
+        if (
+            self.latency_budget is None and self.request_timeout is None
+        ) or n <= 0:
             return
         if use_host:
             if lower_bound:

@@ -47,6 +47,22 @@ ORIGIN_VALIDATE_PARSED, ORIGIN_AUDIT_PARSED = 3, 4
 MAX_FRAME = 32 * 1024 * 1024  # bridge frames (body + header + framing)
 
 
+def _shed_headers(status: int, payload: bytes) -> dict | None:
+    """Reconstruct the Retry-After header on the worker side of the
+    bridge: load-shed 429s carry ``retry_after_seconds`` in the JSON body
+    (the frame format has no header channel), and the HTTP answer a
+    worker serves must match the in-process one."""
+    if status != 429:
+        return None
+    try:
+        retry_after = json.loads(payload).get("retry_after_seconds")
+    except (ValueError, AttributeError):
+        return None
+    if not retry_after:
+        return None
+    return {"Retry-After": str(retry_after)}
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> bytes | None:
     try:
         raw_len = await reader.readexactly(_LEN.size)
@@ -479,6 +495,7 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
                     status=status,
                     body=payload,
                     content_type="application/json",
+                    headers=_shed_headers(status, payload),
                 )
 
         return handler
@@ -499,7 +516,8 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
                 )
             fields["response_code"] = status
             return web.Response(
-                status=status, body=payload, content_type="application/json"
+                status=status, body=payload, content_type="application/json",
+                headers=_shed_headers(status, payload),
             )
 
     from policy_server_tpu.api.handlers import MAX_BODY_BYTES

@@ -204,6 +204,13 @@ class PolicyServer:
             wasm_oci_digest_source=oci_digest_source,
             # bit-exact verdict cache / row dedup (0 disables)
             verdict_cache_size=config.verdict_cache_size,
+            # device circuit breaker thresholds (one breaker per shard
+            # environment; resilience.CircuitBreaker)
+            breaker_config=dict(
+                failure_threshold=config.breaker_failure_threshold,
+                window_seconds=config.breaker_window_seconds,
+                cooldown_seconds=config.breaker_cooldown_seconds,
+            ),
         )
         environment = _build_environment(config, builder_kwargs)
 
@@ -215,6 +222,8 @@ class PolicyServer:
             queue_capacity=config.pool_size * config.max_batch_size,
             host_fastpath_threshold=config.host_fastpath_threshold,
             latency_budget_ms=config.latency_budget_ms,
+            request_timeout_ms=config.request_timeout_ms,
+            degraded_mode=config.degraded_mode,
         )
         if config.warmup_at_boot and config.evaluation_backend == "jax":
             batcher.warmup()
@@ -331,6 +340,68 @@ class PolicyServer:
                 metrics_names.DISPATCHED_ROWS, "counter",
                 "Unique rows actually shipped to the device",
                 profile.get("dispatched_rows", 0),
+            )
+            # Resilience surface (round 7): shedding, deadline drops,
+            # breaker state/transitions, degraded answers, fetch retries
+            yield (
+                metrics_names.SHED_REQUESTS, "counter",
+                "Requests shed at admission (429 + Retry-After)",
+                batcher.shed_requests,
+            )
+            yield (
+                metrics_names.EXPIRED_DROPPED, "counter",
+                "Expired rows dropped before encode/dispatch (no dead "
+                "work)",
+                batcher.expired_dropped,
+            )
+            yield (
+                metrics_names.DEGRADED_RESPONSES, "counter",
+                "Requests answered by the --degraded-mode policy while "
+                "the device breaker was fully tripped",
+                batcher.degraded_responses,
+            )
+            breaker = getattr(environment, "breaker_stats", None) or {}
+            yield (
+                metrics_names.BREAKER_OPEN_SHARDS, "gauge",
+                "Device shards whose circuit breaker is currently "
+                "tripped (open or half-open)",
+                breaker.get("open_shards", 0),
+            )
+            yield (
+                metrics_names.BREAKER_TRIPS, "counter",
+                "Circuit breaker CLOSED/HALF_OPEN -> OPEN transitions",
+                breaker.get("trips", 0),
+            )
+            yield (
+                metrics_names.BREAKER_RECOVERIES, "counter",
+                "Circuit breaker HALF_OPEN -> CLOSED recoveries",
+                breaker.get("recoveries", 0),
+            )
+            yield (
+                metrics_names.BREAKER_PROBES, "counter",
+                "Half-open recovery probe dispatches admitted",
+                breaker.get("probes", 0),
+            )
+            yield (
+                metrics_names.BREAKER_SHORT_CIRCUITED, "counter",
+                "Requests served host-side because a breaker was open",
+                breaker.get("short_circuited_requests", 0),
+            )
+            try:
+                from policy_server_tpu.fetch.downloader import retry_stats
+
+                fetch_retries = retry_stats()
+            except ImportError:  # fetch subsystem unavailable
+                fetch_retries = {}
+            yield (
+                metrics_names.FETCH_RETRY_ATTEMPTS, "counter",
+                "Transient policy-fetch failures retried with backoff",
+                fetch_retries.get("attempts", 0),
+            )
+            yield (
+                metrics_names.FETCH_RETRY_GIVEUPS, "counter",
+                "Policy-fetch operations that exhausted the retry budget",
+                fetch_retries.get("giveups", 0),
             )
 
         from policy_server_tpu.telemetry import default_registry

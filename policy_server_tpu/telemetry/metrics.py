@@ -52,6 +52,16 @@ VERDICT_CACHE_MISSES = "policy_server_verdict_cache_misses"
 VERDICT_CACHE_BYTES = "policy_server_verdict_cache_bytes"
 BATCH_DEDUP_HITS = "policy_server_batch_dedup_hits"
 BUDGET_ROUTED_BATCHES = "policy_server_budget_routed_batches"
+SHED_REQUESTS = "policy_server_shed_requests"
+EXPIRED_DROPPED = "policy_server_expired_dropped_rows"
+DEGRADED_RESPONSES = "policy_server_degraded_responses"
+BREAKER_OPEN_SHARDS = "policy_server_breaker_open_shards"
+BREAKER_TRIPS = "policy_server_breaker_trips"
+BREAKER_RECOVERIES = "policy_server_breaker_recoveries"
+BREAKER_PROBES = "policy_server_breaker_probes"
+BREAKER_SHORT_CIRCUITED = "policy_server_breaker_short_circuited_requests"
+FETCH_RETRY_ATTEMPTS = "policy_server_fetch_retry_attempts"
+FETCH_RETRY_GIVEUPS = "policy_server_fetch_retry_giveups"
 HOST_ENCODE_SECONDS = "policy_server_host_encode_seconds_total"
 HOST_ENCODE_ROWS = "policy_server_host_encode_rows_total"
 HOST_BOOKKEEPING_SECONDS = "policy_server_host_bookkeeping_seconds_total"

@@ -320,6 +320,72 @@ def test_chain_backtracks_over_same_subject_dead_end(pki):
     assert identity.subject == SUBJECT
 
 
+def test_cross_signed_cycle_chain_verifies_regardless_of_order(pki):
+    """Cross-signed CA generations create CYCLES in the issuer graph:
+    new-signed-by-old and old-signed-by-new share one subject, so the
+    chain walk revisits ancestors and prunes them via `seen`. A dead end
+    caused by such a prune is path-DEPENDENT — from a sibling branch the
+    same certificate can still reach the root — so it must never enter
+    the ``failed_at`` memo (ADVICE r6 #1: the old unconditional memo
+    could blacklist a certificate after a prune-caused failure and
+    fail-closed on the valid branch explored next). This pins the
+    property on the canonical cross-sign square, under every adversarial
+    chain order."""
+    import datetime as dtm
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    ca_cert, ca_key = pki["ca"]
+    now = dtm.datetime.now(dtm.timezone.utc)
+
+    def make_ca_cert(subject_name, key, issuer_name, issuer_key):
+        return (
+            x509.CertificateBuilder()
+            .subject_name(subject_name)
+            .issuer_name(issuer_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - dtm.timedelta(days=1))
+            .not_valid_after(now + dtm.timedelta(days=365))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+            .sign(issuer_key, hashes.SHA256())
+        )
+
+    k_old = ec.generate_private_key(ec.SECP256R1())
+    k_new = ec.generate_private_key(ec.SECP256R1())
+    s1 = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "cross-signed-ca")]
+    )
+    # old generation, anchored in the trust root
+    a_old = make_ca_cert(s1, k_old, ca_cert.subject, ca_key)
+    # the cross pair — both subjects are s1, both issuers are s1: a cycle
+    x_no = make_ca_cert(s1, k_new, s1, k_old)  # new signed by old
+    x_on = make_ca_cert(s1, k_old, s1, k_new)  # old signed by new
+
+    # leaf issued by the NEW generation: the only root-reaching chain is
+    # leaf -> x_no -> a_old -> root; exploring x_on dead-ends through
+    # ancestor prunes (its parents are exactly the certs on the path)
+    orders = (
+        [x_on, x_no, a_old],  # decoy first: prune-failure precedes the
+        [x_no, x_on, a_old],  # valid continuation in the same walk
+        [a_old, x_on, x_no],
+    )
+    for chain in orders:
+        entry = make_keyless_entry(
+            ARTIFACT, x_no, k_new, pki["rekor_key"],
+            subject=SUBJECT, issuer_claim=ISSUER,
+            payload_type=SIGNATURE_PAYLOAD_TYPE,
+            chain_certs=chain,
+        )
+        identity, _ = verify_keyless_entry(
+            entry, DIGEST, pki["trust_root"], SIGNATURE_PAYLOAD_TYPE
+        )
+        assert identity.subject == SUBJECT
+
+
 def test_sha384_signed_chain_verifies(pki, tmp_path):
     """Certificate signatures declare their own digest — a CA signing
     with SHA-384 (real Fulcio intermediates do) must chain."""

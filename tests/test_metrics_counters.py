@@ -132,6 +132,18 @@ def test_dedup_and_pipeline_counters_after_served_batch(server):
     # routing counters exist (0 is fine — no budget pressure here)
     assert "policy_server_budget_routed_batches_total" in m
     assert "policy_server_host_fastpath_batches_total" in m
+    # round-7 resilience surface: shedding / deadline drops / breaker /
+    # degraded answers / fetch retries all scrape (zero on a healthy
+    # server — the chaos suite moves them)
+    assert m["policy_server_shed_requests_total"] == 0
+    assert m["policy_server_expired_dropped_rows_total"] == 0
+    assert m["policy_server_degraded_responses_total"] == 0
+    assert m["policy_server_breaker_open_shards"] == 0
+    assert "policy_server_breaker_trips_total" in m
+    assert "policy_server_breaker_recoveries_total" in m
+    assert "policy_server_breaker_short_circuited_requests_total" in m
+    assert "policy_server_fetch_retry_attempts_total" in m
+    assert "policy_server_fetch_retry_giveups_total" in m
 
 
 def test_counters_survive_otlp_conversion(server):

@@ -1,0 +1,710 @@
+"""Overload & failure resilience chaos suite (``make chaos``).
+
+Fault injection comes from policy_server_tpu.failpoints — the same sites
+production code carries (device fetch, batch encode, registry HTTP, cert
+reload) — so every scenario here exercises the REAL serving path, not a
+mock of it. The contract under test, end to end:
+
+* load shedding: admission rejects (429 + Retry-After) when the queue's
+  estimated wait exceeds the propagated request deadline;
+* no dead work: rows whose deadline passed while queued are dropped
+  BEFORE encode/dispatch (504 in-band, counted, encoder untouched);
+* device circuit breaker: repeated dispatch faults / watchdog trips trip
+  a shard to the bit-exact host-oracle fallback (correct verdicts, no
+  hangs), half-open probes recover it when the fault clears;
+* --degraded-mode: a fully-tripped breaker serves monitor-mode verdicts
+  or in-band 503s instead of evaluating;
+* fetch retry: transient registry 5xx/timeouts retry with capped
+  backoff + jitter; deterministic failures do not;
+* shutdown under load: graceful drain with hung in-flight batches plus
+  queued requests completes within the drain deadline, shedding the
+  remainder with 503 — never hanging.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.api.service import RequestOrigin
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironmentBuilder,
+    bucket_size,
+)
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.resilience import CircuitBreaker, retry_with_backoff
+from policy_server_tpu.runtime.batcher import (
+    DEADLINE_MESSAGE,
+    DEGRADED_MESSAGE,
+    EXPIRED_MESSAGE,
+    MicroBatcher,
+    ShedError,
+)
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from conftest import build_admission_review_dict
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+    metrics_mod.reset_metrics_for_tests()
+
+
+def review(namespace: str | None = None) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    if namespace is not None:
+        doc["request"]["namespace"] = namespace
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def make_env(**breaker_overrides):
+    breaker_config = dict(
+        failure_threshold=2, window_seconds=10.0, cooldown_seconds=0.3
+    )
+    breaker_config.update(breaker_overrides)
+    # verdict cache OFF: a cache hit would answer a half-open probe's
+    # batch without touching the device, leaving the probe outcome-less
+    # (recovery then waits for a cache-missing row — correct but slow,
+    # and nondeterministic in a test)
+    return EvaluationEnvironmentBuilder(
+        backend="jax", breaker_config=breaker_config, verdict_cache_size=0
+    ).build(
+        {
+            "ns": parse_policy_entry(
+                "ns",
+                {
+                    "module": "builtin://namespace-validate",
+                    "settings": {"denied_namespaces": ["blocked"]},
+                },
+            )
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    clock = {"t": 0.0}
+    b = CircuitBreaker(
+        failure_threshold=3, window_seconds=5.0, cooldown_seconds=2.0,
+        clock=lambda: clock["t"],
+    )
+    assert b.allow_device()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"  # under threshold
+    # failures outside the window age out
+    clock["t"] = 6.0
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open" and b.trips == 1
+    assert not b.allow_device()
+    assert b.short_circuits == 1
+    # cooldown elapses → half-open admits ONE probe, denies the second
+    clock["t"] = 8.5
+    assert b.allow_device()
+    assert b.state == "half_open" and b.probes == 1
+    assert not b.allow_device()
+    # probe failure → straight back to open, fresh cooldown
+    b.record_failure()
+    assert b.state == "open" and b.trips == 2
+    clock["t"] = 11.0
+    assert b.allow_device()
+    b.record_success()
+    assert b.state == "closed" and b.recoveries == 1
+    # late failures from abandoned work while open change nothing
+    b.record_failure()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open"
+    b.record_success()  # late success from abandoned work: no-op
+    assert b.state == "open"
+
+
+# ---------------------------------------------------------------------------
+# Breaker on the environment's dispatch path (fault injection)
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_to_oracle_and_recovers():
+    """Injected dispatch faults trip the environment's breaker; tripped
+    traffic serves CORRECT verdicts from the host oracle; clearing the
+    fault + cooldown recovers via a half-open probe."""
+    env = make_env()
+    try:
+        env.warmup((1, 4))
+        allowed = [("ns", review())]
+        denied = [("ns", review(namespace="blocked"))]
+
+        failpoints.configure("device.fetch=raise:injected-dispatch-fault")
+        for _ in range(2):
+            with pytest.raises(failpoints.FailpointError):
+                env.validate_batch(allowed)
+        stats = env.breaker_stats
+        assert stats["trips"] == 1 and stats["open_shards"] == 1
+
+        # tripped: host oracle answers, bit-exact — and the still-armed
+        # failpoint proves the device path is never touched
+        out = env.validate_batch(allowed + denied)
+        assert out[0].allowed is True
+        assert out[1].allowed is False
+        assert env.breaker_stats["short_circuited_requests"] >= 2
+
+        # fault clears → cooldown → half-open probe → recovery
+        failpoints.clear()
+        time.sleep(0.35)
+        out = env.validate_batch(allowed)
+        assert out[0].allowed is True
+        stats = env.breaker_stats
+        assert stats["recoveries"] == 1 and stats["open_shards"] == 0
+        assert stats["probes"] >= 1
+    finally:
+        env.close()
+
+
+def test_breaker_hung_shard_watchdog_trips_degrades_and_recovers():
+    """The acceptance scenario end to end: a HUNG device shard (fetch
+    never returns) is bounded by the dispatch watchdog, N trips open the
+    breaker, traffic degrades to the oracle path (correct verdicts, no
+    request ever hangs), and the shard recovers via a half-open probe
+    once the fault clears — all visible in the exported counters."""
+    env = make_env(cooldown_seconds=0.5)
+    env.warmup((1, 4))
+    release = threading.Event()
+    # first two fetches hang (bounded by release's own timeout so the
+    # abandoned daemon threads unwedge after the test)
+    failpoints.set_failpoint(
+        "device.fetch", lambda: release.wait(timeout=30), count=2
+    )
+    batcher = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=0.4,
+        host_fastpath_threshold=0, latency_budget_ms=0,
+    ).start()
+    try:
+        for expected_trips in (0, 1):
+            t0 = time.perf_counter()
+            resp = batcher.submit(
+                "ns", review(), RequestOrigin.VALIDATE
+            ).result(timeout=5)
+            assert resp.status.code == 500
+            assert DEADLINE_MESSAGE in resp.status.message
+            assert time.perf_counter() - t0 < 3.0  # watchdog, not the hang
+            assert env.breaker_stats["trips"] == expected_trips
+
+        assert env.breaker_stats["open_shards"] == 1
+        # degraded-but-correct: the oracle path answers instantly while
+        # the breaker is open; a denied namespace still denies
+        t0 = time.perf_counter()
+        ok = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+        bad = batcher.submit(
+            "ns", review(namespace="blocked"), RequestOrigin.VALIDATE
+        )
+        assert ok.result(timeout=5).allowed is True
+        assert bad.result(timeout=5).allowed is False
+        assert time.perf_counter() - t0 < 2.0
+
+        # fault cleared (count exhausted) → probe recovers the shard
+        time.sleep(0.6)
+        resp = batcher.submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert resp.allowed is True
+        stats = env.breaker_stats
+        assert stats["recoveries"] == 1 and stats["open_shards"] == 0
+    finally:
+        release.set()
+        batcher.shutdown()
+        env.close()
+
+
+def test_degraded_mode_reject_and_monitor():
+    """Tripped-everything behavior per --degraded-mode: 'reject' answers
+    in-band 503s, 'monitor' serves accept-all monitor verdicts; the
+    default 'oracle' path (previous tests) keeps real verdicts."""
+    env = make_env(failure_threshold=1, cooldown_seconds=60.0)
+    env.warmup((1,))
+    env.breaker.record_failure()  # trip: stays open for the whole test
+    assert env.breaker_all_open
+
+    batcher = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=2.0,
+        host_fastpath_threshold=0, latency_budget_ms=0,
+        degraded_mode="reject",
+    ).start()
+    try:
+        resp = batcher.submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=5)
+        assert resp.allowed is False
+        assert resp.status.code == 503
+        assert DEGRADED_MESSAGE in resp.status.message
+        assert batcher.degraded_responses == 1
+    finally:
+        batcher.shutdown()
+
+    monitor = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=2.0,
+        host_fastpath_threshold=0, latency_budget_ms=0,
+        degraded_mode="monitor",
+    ).start()
+    try:
+        resp = monitor.submit(
+            "ns", review(namespace="blocked"), RequestOrigin.VALIDATE
+        ).result(timeout=5)
+        assert resp.allowed is True  # monitor mode: accept, log, count
+        assert resp.status is None
+        assert monitor.degraded_responses == 1
+    finally:
+        monitor.shutdown()
+        env.close()
+
+
+def test_degraded_mode_recovers_after_fault_clears():
+    """The degraded gate must not wedge: once the cooldown makes a probe
+    due, breaker_all_open flips false, the batch proceeds to the normal
+    dispatch path, allow_device() runs the half-open probe, and a
+    healthy device closes the breaker — real verdicts resume (a gate
+    keyed on raw open-ness would serve monitor verdicts forever)."""
+    env = make_env(failure_threshold=1, cooldown_seconds=0.3)
+    env.warmup((1,))
+    env.breaker.record_failure()  # trip
+    assert env.breaker_all_open
+    batcher = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=2.0,
+        host_fastpath_threshold=0, latency_budget_ms=0,
+        degraded_mode="monitor",
+    ).start()
+    try:
+        # while cooling: monitor-mode accept-all (even a denied namespace)
+        resp = batcher.submit(
+            "ns", review(namespace="blocked"), RequestOrigin.VALIDATE
+        ).result(timeout=5)
+        assert resp.allowed is True and resp.status is None
+        assert batcher.degraded_responses == 1
+
+        time.sleep(0.35)  # cooldown elapses → probe due → gate opens
+        resp = batcher.submit(
+            "ns", review(namespace="blocked"), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert resp.allowed is False  # REAL verdict again
+        stats = env.breaker_stats
+        assert stats["recoveries"] == 1 and stats["open_shards"] == 0
+    finally:
+        batcher.shutdown()
+        env.close()
+
+
+def test_queue_aged_expiry_does_not_trip_breaker():
+    """A watchdog abandonment caused by QUEUE AGE (items near their
+    evaluation deadline before dispatch even starts) must not mark the
+    device breaker: the device is healthy, the queue is the problem, and
+    tripping would flip overload onto the slower host path."""
+    env = make_env(failure_threshold=1, cooldown_seconds=60.0)
+    env.warmup((1, 8))
+    failpoints.set_failpoint("device.fetch", lambda: time.sleep(0.5))
+    batcher = MicroBatcher(  # not started: items age in the queue first
+        env, max_batch_size=8, batch_timeout_ms=1.0, policy_timeout=1.0,
+        host_fastpath_threshold=0, latency_budget_ms=0,
+    )
+    try:
+        futs = [
+            batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+            for _ in range(3)
+        ]
+        time.sleep(0.7)  # ~0.3s of deadline left when dispatch starts
+        batcher.start()
+        for fut in futs:
+            resp = fut.result(timeout=5)
+            assert DEADLINE_MESSAGE in resp.status.message
+        # the watchdog DID abandon the batch...
+        assert batcher.deadline_abandoned_batches >= 1
+        # ...but the short device wait is not attributed as a hang
+        # (threshold-1 breaker: one false mark would trip it)
+        assert env.breaker_stats["open_shards"] == 0
+        assert env.breaker_stats["trips"] == 0
+    finally:
+        batcher.shutdown()
+        env.close()
+
+
+# ---------------------------------------------------------------------------
+# Load shedding + deadline propagation
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_when_estimated_wait_exceeds_budget():
+    """With a measured device RTT on record and a deep queue, a request
+    whose deadline cannot be met is rejected at ADMISSION with ShedError
+    (→ HTTP 429 + Retry-After) instead of queueing doomed work."""
+    env = make_env()
+    batcher = MicroBatcher(  # deliberately NOT started: the queue holds
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=5.0,
+        request_timeout_ms=50.0,
+    )
+    try:
+        # teach the estimator a slow device: 1 s per max-size batch
+        batcher._dev_rtt[bucket_size(4)] = 1.0
+        fut = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+        with pytest.raises(ShedError) as exc:
+            batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+        assert exc.value.retry_after_seconds > 0.05
+        assert batcher.shed_requests == 1
+        assert not fut.done()  # the admitted request is still queued
+    finally:
+        batcher.shutdown()
+    # shutdown resolved the admitted-but-unserved request in-band
+    assert fut.result(timeout=1).status.code == 503
+
+
+def test_expired_rows_dropped_pre_encode_no_dead_work():
+    """Rows whose propagated deadline passed while queued are dropped
+    BEFORE encode/dispatch: counted, answered 504 in-band, and the
+    encoder never sees them; fresh traffic on the same batcher is
+    unaffected (no dead work, no contamination)."""
+    env = make_env()
+    env.warmup((1, 8))
+    batcher = MicroBatcher(  # not started yet: requests age in the queue
+        env, max_batch_size=8, batch_timeout_ms=1.0, policy_timeout=5.0,
+        request_timeout_ms=100.0,
+        # device path only: the encoder-rows assertions below are the
+        # whole point, and the host fast-path would bypass the encoder
+        host_fastpath_threshold=0, latency_budget_ms=0,
+    )
+    try:
+        futs = [
+            batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+            for _ in range(5)
+        ]
+        time.sleep(0.25)  # every deadline (100 ms) is now past
+        encode_rows_before = env.host_profile["encode_rows"]
+        batcher.start()
+        for fut in futs:
+            resp = fut.result(timeout=5)
+            assert resp.status.code == 504
+            assert EXPIRED_MESSAGE in resp.status.message
+        assert batcher.expired_dropped == 5
+        # pre-encode is the whole point: the encoder saw none of them
+        assert env.host_profile["encode_rows"] == encode_rows_before
+
+        # the unexpired stream is unaffected
+        resp = batcher.submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert resp.allowed is True
+        assert env.host_profile["encode_rows"] > encode_rows_before
+    finally:
+        batcher.shutdown()
+        env.close()
+
+
+def test_request_timeout_disabled_keeps_legacy_behavior():
+    """request_timeout_ms=0 (or unset) disables deadlines and shedding:
+    no ShedError, no expired drops — the pre-round-7 contract."""
+    env = make_env()
+    env.warmup((1,))
+    batcher = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=5.0,
+    )
+    try:
+        batcher._dev_rtt[bucket_size(4)] = 100.0  # absurdly slow device
+        batcher.start()
+        resp = batcher.submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert resp.allowed is True
+        assert batcher.shed_requests == 0
+        assert batcher.expired_dropped == 0
+    finally:
+        batcher.shutdown()
+        env.close()
+
+
+def test_shed_error_maps_to_http_429_with_retry_after():
+    """The HTTP contract for shedding: 429, a Retry-After header, and
+    retry_after_seconds in the body (the body copy is what prefork
+    workers use to reconstruct the header across the bridge frame)."""
+    import asyncio
+    import json
+
+    from policy_server_tpu.api import handlers
+    from policy_server_tpu.runtime import frontend
+
+    class FakeBatcher:
+        async def submit_async(self, *args):
+            raise ShedError(2.3)
+
+    class FakeState:
+        batcher = FakeBatcher()
+
+    resp = asyncio.run(
+        handlers._evaluate(
+            FakeState(), "ns", review(), RequestOrigin.VALIDATE
+        )
+    )
+    assert resp.status == 429
+    assert resp.headers["Retry-After"] == "3"  # ceil(2.3)
+    body = json.loads(resp.body)
+    assert body["retry_after_seconds"] == 3
+    # worker-side header reconstruction from the bridge frame's body
+    assert frontend._shed_headers(429, resp.body) == {"Retry-After": "3"}
+    assert frontend._shed_headers(200, b"{}") is None
+
+
+# ---------------------------------------------------------------------------
+# Encoder fault containment
+# ---------------------------------------------------------------------------
+
+
+def test_encoder_fault_is_contained_and_next_request_serves():
+    """An injected encoder error fails its own batch in-band (the future
+    raises; the HTTP layer maps it to a JSON 500) and the NEXT request
+    is served normally — one poisoned batch never wedges the pipeline."""
+    env = make_env()
+    env.warmup((1,))
+    batcher = MicroBatcher(
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=2.0,
+        host_fastpath_threshold=0, latency_budget_ms=0,
+    ).start()
+    try:
+        failpoints.configure("encode.batch=raise:injected-encoder-fault*1")
+        fut = batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+        with pytest.raises(failpoints.FailpointError):
+            fut.result(timeout=5)
+        assert failpoints.fired_count("encode.batch") == 1
+        resp = batcher.submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert resp.allowed is True
+    finally:
+        batcher.shutdown()
+        env.close()
+
+
+# ---------------------------------------------------------------------------
+# Fetch retry / backoff
+# ---------------------------------------------------------------------------
+
+
+class _Resp:
+    def __init__(self, code: int, content: bytes = b"x"):
+        self.status_code = code
+        self.content = content
+
+
+def test_fetch_retries_transient_5xx_then_succeeds(monkeypatch):
+    from policy_server_tpu.fetch import downloader as dl
+
+    calls = {"n": 0}
+
+    def fake_get(url, **kw):
+        calls["n"] += 1
+        return _Resp(503) if calls["n"] < 3 else _Resp(200, b"payload")
+
+    monkeypatch.setattr(dl.requests, "get", fake_get)
+    sleeps: list[float] = []
+    d = dl.Downloader(
+        retry_attempts=4, retry_base_seconds=0.01, retry_cap_seconds=0.05,
+        retry_sleep=sleeps.append,
+    )
+    before = dl.retry_stats()["attempts"]
+    out = d._http_get("https://registry.example/p.wasm", "registry.example")
+    assert out == b"payload"
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert all(0 <= s <= 0.05 for s in sleeps)  # capped, jittered
+    assert dl.retry_stats()["attempts"] == before + 2
+
+
+def test_fetch_retry_budget_exhausts_with_fetch_error(monkeypatch):
+    from policy_server_tpu.fetch import downloader as dl
+
+    calls = {"n": 0}
+    monkeypatch.setattr(
+        dl.requests, "get",
+        lambda url, **kw: (calls.__setitem__("n", calls["n"] + 1), _Resp(503))[1],
+    )
+    d = dl.Downloader(
+        retry_attempts=2, retry_base_seconds=0.0, retry_sleep=lambda s: None
+    )
+    with pytest.raises(dl.FetchError, match="HTTP 503"):
+        d._http_get("https://registry.example/p.wasm", "registry.example")
+    assert calls["n"] == 2
+    assert dl.retry_stats()["giveups"] >= 1
+
+
+def test_fetch_deterministic_failures_do_not_retry(monkeypatch):
+    from policy_server_tpu.fetch import downloader as dl
+
+    calls = {"n": 0}
+    monkeypatch.setattr(
+        dl.requests, "get",
+        lambda url, **kw: (calls.__setitem__("n", calls["n"] + 1), _Resp(404))[1],
+    )
+    d = dl.Downloader(retry_attempts=4, retry_sleep=lambda s: None)
+    with pytest.raises(dl.FetchError, match="HTTP 404"):
+        d._http_get("https://registry.example/p.wasm", "registry.example")
+    assert calls["n"] == 1  # a 404 is deterministic: one attempt only
+
+
+def test_fetch_failpoint_injected_5xx_retries(monkeypatch):
+    """The chaos-harness shape: a failpoint injects registry faults for
+    the first two attempts; the retry policy rides them out."""
+    from policy_server_tpu.fetch import downloader as dl
+
+    monkeypatch.setattr(dl.requests, "get", lambda url, **kw: _Resp(200, b"ok"))
+    failpoints.configure("fetch.http=raise:injected-registry-5xx*2")
+    d = dl.Downloader(
+        retry_attempts=4, retry_base_seconds=0.0, retry_sleep=lambda s: None
+    )
+    assert d._http_get("https://r.example/p.wasm", "r.example") == b"ok"
+    assert failpoints.fired_count("fetch.http") == 2
+
+
+def test_retry_with_backoff_respects_cap():
+    delays: list[float] = []
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 5:
+            raise ValueError("transient")
+        return "done"
+
+    out = retry_with_backoff(
+        flaky, is_retryable=lambda e: isinstance(e, ValueError),
+        attempts=5, base_seconds=0.5, cap_seconds=1.0, sleep=delays.append,
+    )
+    assert out == "done"
+    assert len(delays) == 4
+    assert all(0 <= d <= 1.0 for d in delays)  # cap binds the tail
+
+
+# ---------------------------------------------------------------------------
+# Cert-reload corruption containment
+# ---------------------------------------------------------------------------
+
+
+def test_cert_reload_corruption_keeps_last_good_identity(tmp_path):
+    """An injected corruption during identity reload must keep the
+    last-good certificate serving (the reference's failed-reload rule,
+    certs.rs:86-161)."""
+    pytest.importorskip("cryptography")
+    import test_tls
+
+    from policy_server_tpu import certs as certs_mod
+    from policy_server_tpu.config.config import TlsConfig
+
+    key, cert = test_tls.make_cert("localhost", is_ca=False)
+    cert_file, key_file = test_tls.write_pem(tmp_path, "srv", key, cert)
+    ctx = certs_mod.ReloadableTlsContext(
+        TlsConfig(cert_file=str(cert_file), key_file=str(key_file))
+    )
+    reloads_before = ctx.reloads
+    failpoints.configure("certs.reload=raise:injected-corrupt-pem")
+    with pytest.raises(failpoints.FailpointError):
+        ctx._reload_identity()
+    assert ctx.reloads == reloads_before  # nothing swapped
+    failpoints.clear()
+    ctx._reload_identity()  # clean reload still works
+    assert ctx.reloads == reloads_before + 1
+
+
+# ---------------------------------------------------------------------------
+# Shutdown under load (satellite): drain without hanging
+# ---------------------------------------------------------------------------
+
+
+def test_shutdown_under_load_drains_and_sheds_without_hanging():
+    """Graceful drain with hung in-flight batches plus queued requests:
+    every future resolves in-band (watchdog 500s for the hung batch,
+    503s for the queued remainder) and shutdown() returns within the
+    drain deadline — it never waits for the wedged device call."""
+    env = make_env(failure_threshold=100)  # breaker out of the picture
+    env.warmup((1, 2))
+    release = threading.Event()
+    failpoints.set_failpoint("device.fetch", lambda: release.wait(timeout=30))
+    batcher = MicroBatcher(
+        env, max_batch_size=2, batch_timeout_ms=1.0, policy_timeout=0.5,
+        queue_capacity=4, host_fastpath_threshold=0, latency_budget_ms=0,
+    ).start()
+    try:
+        futs = [
+            batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+            for _ in range(6)
+        ]
+        time.sleep(0.1)  # let the first batches reach the hung device
+        t0 = time.perf_counter()
+        batcher.shutdown()
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 15.0, f"shutdown took {elapsed:.1f}s"
+        for fut in futs:
+            resp = fut.result(timeout=1)  # resolved — nothing hangs
+            assert resp.allowed is False
+            assert resp.status.code in (429, 500, 503)
+    finally:
+        release.set()
+        env.close()
+
+
+def test_shutdown_under_load_through_real_server():
+    """Server-level drain: stop() with in-flight HTTP requests against a
+    hung device completes inside its own deadline (bridge wait_closed
+    and batcher drain both bounded) and in-flight requests get answers,
+    not resets."""
+    import requests as rq
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    handle = ServerHandle(make_config(policy_timeout_seconds=0.5))
+    release = threading.Event()
+    results: list = []
+    try:
+        failpoints.set_failpoint(
+            "device.fetch", lambda: release.wait(timeout=30)
+        )
+
+        def fire():
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(False), timeout=10,
+                )
+                results.append(r.status_code)
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                results.append(e)
+
+        threads = [threading.Thread(target=fire) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # requests are in flight against the hung device
+    finally:
+        t0 = time.perf_counter()
+        handle.stop()
+        stop_elapsed = time.perf_counter() - t0
+        release.set()
+    assert stop_elapsed < 12.0, f"server stop took {stop_elapsed:.1f}s"
+    for t in threads:
+        t.join(timeout=5)
+    # every in-flight request got an HTTP answer (watchdog 500-in-200 or
+    # a shutdown 503-in-200) — none hung past stop
+    assert len(results) == 4
+    assert all(isinstance(code, int) for code in results), results
