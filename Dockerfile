@@ -31,6 +31,17 @@ RUN mkdir -p build && \
         csrc/fastenc.cpp -I/usr/local/include/python3.12 \
       || echo "WARNING: fastenc build failed; Python encoder fallback"; }
 
+# test stage: the graftcheck gate (static analysis + counter/OTLP/
+# dashboard consistency + failpoint and cli-docs drift) runs against the
+# exact tree being shipped. CI builds this stage first
+# (`docker build --target test .`); the runtime image below does not
+# inherit from it, so a skipped gate never reaches production layers.
+FROM build AS test
+COPY tools/ tools/
+COPY tests/ tests/
+COPY Makefile pytest.ini cli-docs.md kubewarden-dashboard.json ./
+RUN make check
+
 FROM python:3.12-slim
 
 COPY --from=build /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages

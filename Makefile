@@ -3,10 +3,11 @@
 
 IMG ?= policy-server-tpu:latest
 
-.PHONY: all test unit-tests integration-tests bench chaos docs docs-check \
-        fastenc image dev-stack dev-stack-down dryrun-multichip clean
+.PHONY: all test unit-tests integration-tests bench chaos check docs \
+        docs-check fastenc image dev-stack dev-stack-down dryrun-multichip \
+        clean
 
-all: test
+all: test check
 
 # full suite on the 8-virtual-device CPU backend (tests/conftest.py)
 test:
@@ -28,9 +29,21 @@ fuzz:
 
 # fault-injection chaos suite: shedding, deadline drops, breaker
 # trip/recover, fetch retry, shutdown-under-load (failpoints armed by the
-# tests themselves; slow-marked cases included)
+# tests themselves; slow-marked cases included). Runs with the graftcheck
+# lock-order sanitizer armed — tests/conftest.py instruments every
+# package lock, records per-thread acquisition stacks, and errors the
+# session on any lock-order inversion or cycle.
 chaos:
-	python -m pytest tests/test_resilience.py -q
+	GRAFTCHECK_LOCKSAN=1 python -m pytest tests/test_resilience.py -q
+
+# the graftcheck CI gate (tools/graftcheck/): concurrency lint
+# (guarded-by + lock-order cycles), trace-purity lint, observability
+# counter<->OTLP<->dashboard consistency, failpoint/docs drift, and the
+# cli-docs regeneration diff. Suppressions live in
+# tools/graftcheck/baseline.json (explicit + justified; stale entries
+# fail).
+check:
+	python -m tools.graftcheck
 
 # native host encoder (ops/fastenc.py compiles on demand into build/)
 fastenc:

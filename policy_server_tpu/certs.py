@@ -148,17 +148,17 @@ class ReloadableTlsContext:
         self.tls_config = tls_config
         # last-good identity snapshot: CA-only reloads rebuild from these
         # bytes, never from (possibly mid-rotation) files on disk
-        self._identity = (
+        self._identity = (  # guarded-by: _lock
             _validate_cert_file(tls_config.cert_file),
             _validate_key_file(tls_config.key_file),
         )
-        self._inner = build_tls_server_config(tls_config)
+        self._inner = build_tls_server_config(tls_config)  # guarded-by: _lock
         self.outer = build_tls_server_config(tls_config)
         self.outer.sni_callback = self._sni_callback
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards _identity/_inner/outer swaps + reloads
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.reloads = 0  # introspection for tests/metrics
+        self.reloads = 0  # guarded-by: _lock
 
     def _sni_callback(self, sslobj, server_name, _ctx):
         with self._lock:
@@ -273,7 +273,8 @@ class ReloadableTlsContext:
     def _reload_client_cas(self) -> None:
         """Rebuild trust state from current CA files + the last-good
         identity snapshot (identity files on disk are NOT consulted)."""
-        cert_bytes, key_bytes = self._identity
+        with self._lock:
+            cert_bytes, key_bytes = self._identity
         # one disk read for ALL CA files; validation happens on the inner
         # build below, so a file that fails to parse aborts BEFORE the live
         # outer context is touched (no partially-applied CA set)

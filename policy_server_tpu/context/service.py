@@ -274,13 +274,13 @@ class ContextSnapshotService:
             if watch is not None
             else hasattr(fetcher, "watch") and hasattr(fetcher, "list_with_version")
         )
-        self._snapshot = EMPTY_SNAPSHOT
+        self._snapshot = EMPTY_SNAPSHOT  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # watch mode: mutable per-kind object maps the watchers fold events
         # into; every publish snapshots them into immutable tuples
-        self._store: dict[str, dict[tuple, Any]] = {}
+        self._store: dict[str, dict[tuple, Any]] = {}  # graftcheck: lockfree — watcher-thread-confined; published into _snapshot under _lock
 
     def snapshot(self) -> ContextSnapshot:
         with self._lock:

@@ -457,7 +457,7 @@ class EvaluationEnvironment:
             )
         }
         self._fused = jax.jit(self._forward)
-        self.oracle_fallbacks = 0  # SchemaOverflow counter (metrics surface)
+        self._oracle_fallbacks = 0  # guarded-by: _fallback_lock
         # Device circuit breaker (resilience.py): repeated dispatch faults
         # or watchdog trips (reported by the batcher via
         # record_dispatch_failure) trip THIS environment — one breaker per
@@ -471,10 +471,10 @@ class EvaluationEnvironment:
             else None
         )
         # requests answered host-side because the breaker was open
-        self.breaker_short_circuited_requests = 0
+        self._breaker_short_circuited = 0  # guarded-by: _fallback_lock
         # Serving-layer host fast-path counter (validate_batch(prefer_host=
         # True) rows answered by the targeted host oracle; metrics surface)
-        self.host_fastpath_requests = 0
+        self._host_fastpath_requests = 0  # guarded-by: _fallback_lock
         # Two-tier bit-exact verdict cache + in-batch row dedup
         # (verdict_cache.py: blob tier dedups exact payload replays BEFORE
         # encode; row tier dedups uid/name-varying duplicates after).
@@ -491,12 +491,12 @@ class EvaluationEnvironment:
             else None
         )
         # rows answered by another identical row in the SAME batch
-        self.batch_dedup_hits = 0
+        self._batch_dedup_hits = 0  # guarded-by: _fallback_lock
         # Host-pipeline decomposition counters (PROFILE.md round-6): where
         # the per-row host time goes on the native dispatch path. All
         # nanosecond totals + row counts; bench/metrics divide.
         self._profile_lock = threading.Lock()
-        self._host_profile: dict[str, int] = {
+        self._host_profile: dict[str, int] = {  # guarded-by: _profile_lock
             "encode_ns": 0,          # _payload_blob + native encode_batch
             "encode_rows": 0,        # rows that went through the encoder
             "bookkeeping_ns": 0,     # dedup tiers + slot/LRU bookkeeping
@@ -842,6 +842,29 @@ class EvaluationEnvironment:
                 hp[k] += v
 
     @property
+    def oracle_fallbacks(self) -> int:
+        """SchemaOverflow host-oracle fallbacks (locked read: the
+        /metrics scrape and the sharded evaluator's sums see a value no
+        increment is mid-flight on)."""
+        with self._fallback_lock:
+            return self._oracle_fallbacks
+
+    @property
+    def host_fastpath_requests(self) -> int:
+        with self._fallback_lock:
+            return self._host_fastpath_requests
+
+    @property
+    def batch_dedup_hits(self) -> int:
+        with self._fallback_lock:
+            return self._batch_dedup_hits
+
+    @property
+    def breaker_short_circuited_requests(self) -> int:
+        with self._fallback_lock:
+            return self._breaker_short_circuited
+
+    @property
     def host_profile(self) -> dict[str, int]:
         """Host-pipeline decomposition counters (ns totals + row counts)
         for the native dispatch path: encode / dedup-bookkeeping /
@@ -884,7 +907,8 @@ class EvaluationEnvironment:
         )
         for k, v in blob.items():
             stats["blob_" + k] = v
-        stats["batch_dup_hits"] = self.batch_dedup_hits
+        with self._fallback_lock:
+            stats["batch_dup_hits"] = self._batch_dedup_hits
         return stats
 
     def has_policy(self, policy_id: str) -> bool:
@@ -1193,9 +1217,10 @@ class EvaluationEnvironment:
         stats.pop("state_code", None)  # per-shard; not summable
         stats["open_shards"] = stats.pop("open")
         stats["total_shards"] = 1
-        stats["short_circuited_requests"] = (
-            self.breaker_short_circuited_requests
-        )
+        with self._fallback_lock:
+            stats["short_circuited_requests"] = (
+                self._breaker_short_circuited
+            )
         return stats
 
     def run_batch(self, features: Mapping[str, Any]) -> dict[str, np.ndarray]:
@@ -1260,7 +1285,7 @@ class EvaluationEnvironment:
             # tripped: the targeted host oracle serves (bit-exact by the
             # differential guarantee) until a half-open probe closes it
             with self._fallback_lock:
-                self.breaker_short_circuited_requests += 1
+                self._breaker_short_circuited += 1
             return self._materialize(
                 target, request, self._oracle_outputs_for(target, payload)
             )
@@ -1268,7 +1293,7 @@ class EvaluationEnvironment:
             bucket_idx, encoded = self.encode_bucketed(payload)
         except SchemaOverflow:
             with self._fallback_lock:
-                self.oracle_fallbacks += 1
+                self._oracle_fallbacks += 1
             return self._materialize(target, request, self._oracle_outputs(payload, target))
         schema = self.schemas[bucket_idx]
         bucket = self.bucket_for(1)
@@ -1514,7 +1539,7 @@ class EvaluationEnvironment:
             # oracle — correct verdicts, zero device exposure; half-open
             # probes re-enter through allow_device after the cooldown
             with self._fallback_lock:
-                self.breaker_short_circuited_requests += len(items)
+                self._breaker_short_circuited += len(items)
             return self._validate_batch_hostpath(items, run_hooks)
         if self.native_encoding and self.backend == "jax":
             # chunks to max_dispatch_batch internally, with pipelining
@@ -1563,7 +1588,7 @@ class EvaluationEnvironment:
                 )
             except SchemaOverflow:
                 with self._fallback_lock:
-                    self.oracle_fallbacks += 1
+                    self._oracle_fallbacks += 1
                 results[i] = self._materialize(
                     target, request, self._oracle_outputs(payload, target)
                 )
@@ -1663,7 +1688,7 @@ class EvaluationEnvironment:
                 results[i] = e
         if n_host:
             with self._fallback_lock:
-                self.host_fastpath_requests += n_host
+                self._host_fastpath_requests += n_host
         return results  # type: ignore[return-value]
 
     def _validate_batch_native(
@@ -1771,7 +1796,7 @@ class EvaluationEnvironment:
 
         for i in pending:  # beyond the widest schema → oracle
             with self._fallback_lock:
-                self.oracle_fallbacks += 1
+                self._oracle_fallbacks += 1
             policy_id, request = items[i]
             results[i] = self._materialize(
                 targets[i], request,
@@ -2121,7 +2146,7 @@ class EvaluationEnvironment:
                         dup_hits = int(miss_rows.size - uniq_miss.size)
                         if dup_hits:
                             with self._fallback_lock:
-                                self.batch_dedup_hits += dup_hits
+                                self._batch_dedup_hits += dup_hits
                         keep_rows = miss_rows[miss_first]
                         keep_uncompacted = (
                             not wasm_pos

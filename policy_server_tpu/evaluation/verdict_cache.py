@@ -95,11 +95,11 @@ class VerdictCache:
         # key -> (row, cost)
         self._data: OrderedDict[Hashable, tuple[Mapping[str, Any], int]] = (
             OrderedDict()
-        )
-        self._bytes = 0
+        )  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     def get(self, key: Hashable) -> Mapping[str, Any] | None:
         with self._lock:
@@ -189,11 +189,15 @@ class VerdictCache:
                 self._put_locked(key, row, cost)
 
     def __len__(self) -> int:
-        return len(self._data)
+        # locked: len(OrderedDict) races a concurrent _put_locked's
+        # pop/reinsert (graftcheck GB01 finding, round 8)
+        with self._lock:
+            return len(self._data)
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def clear(self) -> None:
         with self._lock:

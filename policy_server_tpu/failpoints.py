@@ -62,9 +62,10 @@ class _Point:
 
 
 _lock = threading.Lock()
-_points: dict[str, _Point] = {}
-_fired: dict[str, int] = {}
+_points: dict[str, _Point] = {}  # guarded-by: _lock
+_fired: dict[str, int] = {}  # guarded-by: _lock
 # the ONE hot-path gate: False ⇒ fire() returns before touching any dict
+# graftcheck: lockfree — single bool, stale reads only delay (dis)arming
 _armed = False
 
 

@@ -48,9 +48,9 @@ class _Routing:
     ) -> None:
         self.shards = shards
         self.owner = owner
-        self.inflight = 0
-        self.retired = False
-        self.closed = False
+        self.inflight = 0  # guarded-by: PolicyShardedEvaluator._snapshot_lock
+        self.retired = False  # guarded-by: PolicyShardedEvaluator._snapshot_lock
+        self.closed = False  # guarded-by: PolicyShardedEvaluator._snapshot_lock
 
 
 class PolicyShardedEvaluator:
@@ -88,14 +88,15 @@ class PolicyShardedEvaluator:
         # snapshots retired by resize() that still have dispatches in
         # flight; each closes when its last dispatch drains — without this
         # every churn event leaks the old shards' worker pools
-        self._retired: list[_Routing] = []
+        self._retired: list[_Routing] = []  # guarded-by: _snapshot_lock
         self.mesh = mesh
         # the operator-configured policy parallelism: resize() re-factors
         # toward this cap, so a transient shrink can grow back
         self._configured_policy_axis = mesh.shape[mesh_mod.POLICY_AXIS]
-        self.resizes = 0  # introspection for tests/metrics
+        self.resizes = 0  # guarded-by: _resize_lock
         # shards+owner swap as ONE _Routing object so routing always reads
         # a consistent pair across a concurrent resize
+        # graftcheck: lockfree — one atomic attribute swap (resize)
         self._routing: _Routing = _Routing(*self._build_shards(mesh))
 
     def _build_shards(

@@ -190,7 +190,7 @@ class ImageSignatureVerifier:
             bundle_source = file_bundle_source(store) if store else None
         self.bundle_source = bundle_source
         # image ref → (verified, cached_at monotonic)
-        self._cache: "OrderedDict[str, tuple[bool, float]]" = OrderedDict()
+        self._cache: "OrderedDict[str, tuple[bool, float]]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def entries_for(self, image: str) -> list[SignatureEntry]:
@@ -199,7 +199,7 @@ class ImageSignatureVerifier:
     def matched(self, image: str) -> bool:
         return bool(self.entries_for(image))
 
-    def _cached_current(self, image: str) -> bool:
+    def _cached_current(self, image: str) -> bool:  # holds: _lock
         """Lock held: True when the cache answers for this image without
         re-verification (positive, or negative inside its TTL)."""
         hit = self._cache.get(image)

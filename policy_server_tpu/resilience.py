@@ -64,19 +64,19 @@ class CircuitBreaker:
         self.half_open_probes = max(1, int(half_open_probes))
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures: list[float] = []  # failure timestamps in window
-        self._opened_at = 0.0
-        self._probes_in_flight = 0
-        self._last_probe_at = 0.0
+        self._state = CLOSED  # guarded-by: _lock
+        self._failures: list[float] = []  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self._probes_in_flight = 0  # guarded-by: _lock
+        self._last_probe_at = 0.0  # guarded-by: _lock
         # counters (monotonic; metrics surface)
-        self.trips = 0  # CLOSED/HALF_OPEN → OPEN transitions
-        self.recoveries = 0  # HALF_OPEN → CLOSED transitions
-        self.probes = 0  # half-open probe dispatches admitted
+        self.trips = 0  # guarded-by: _lock
+        self.recoveries = 0  # guarded-by: _lock
+        self.probes = 0  # guarded-by: _lock
         # per-CALL denials while open (unit-test introspection only; the
         # exported metric is the environment's per-REQUEST
         # breaker_short_circuited_requests — one authority, not two)
-        self.short_circuits = 0
+        self.short_circuits = 0  # guarded-by: _lock
 
     # -- admission ---------------------------------------------------------
 
@@ -141,7 +141,7 @@ class CircuitBreaker:
                 self._failures.clear()
                 self.trips += 1
 
-    def _prune(self, now: float) -> None:
+    def _prune(self, now: float) -> None:  # holds: _lock
         cutoff = now - self.window_seconds
         self._failures = [t for t in self._failures if t >= cutoff]
 

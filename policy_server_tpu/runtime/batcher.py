@@ -247,21 +247,21 @@ class MicroBatcher:
         # _dispatch runs on concurrent batch-pool workers: counter updates
         # must be locked (+= is a racy read-modify-write).
         self._stats_lock = threading.Lock()
-        self.batches_dispatched = 0
-        self.requests_dispatched = 0
-        self.deadline_abandoned_batches = 0  # introspection for tests/metrics
-        self.host_fastpath_batches = 0  # batches answered host-side
+        self.batches_dispatched = 0  # guarded-by: _stats_lock
+        self.requests_dispatched = 0  # guarded-by: _stats_lock
+        self.deadline_abandoned_batches = 0  # guarded-by: _stats_lock
+        self.host_fastpath_batches = 0  # guarded-by: _stats_lock
         # batches routed host-side by the latency-budget check (a strict
         # subset of host_fastpath_batches)
-        self.budget_routed_batches = 0
+        self.budget_routed_batches = 0  # guarded-by: _stats_lock
         # -- resilience counters (round 7; /metrics surface) --------------
         # requests shed at admission (429 + Retry-After)
-        self.shed_requests = 0
+        self.shed_requests = 0  # guarded-by: _stats_lock
         # already-expired rows dropped before encode/dispatch
-        self.expired_dropped = 0
+        self.expired_dropped = 0  # guarded-by: _stats_lock
         # requests answered by the --degraded-mode policy while the
         # device breaker was fully tripped (monitor/reject modes only)
-        self.degraded_responses = 0
+        self.degraded_responses = 0  # guarded-by: _stats_lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -324,6 +324,24 @@ class MicroBatcher:
         """Requests currently waiting for batch formation (introspection
         for the /metrics runtime gauges)."""
         return self._queue.qsize()
+
+    def stats_snapshot(self) -> dict[str, int]:
+        """Every _stats_lock-guarded counter under ONE lock acquisition —
+        the /metrics scrape's consistent view (a bare attribute read from
+        another module would be the dirty cross-module read the
+        guarded-by annotations forbid; graftcheck is module-scoped, so
+        this method is how the contract survives the module boundary)."""
+        with self._stats_lock:
+            return {
+                "batches_dispatched": self.batches_dispatched,
+                "requests_dispatched": self.requests_dispatched,
+                "deadline_abandoned_batches": self.deadline_abandoned_batches,
+                "host_fastpath_batches": self.host_fastpath_batches,
+                "budget_routed_batches": self.budget_routed_batches,
+                "shed_requests": self.shed_requests,
+                "expired_dropped": self.expired_dropped,
+                "degraded_responses": self.degraded_responses,
+            }
 
     def estimated_wait(self) -> float:
         """Rough seconds until a request enqueued NOW would dispatch:

@@ -22,7 +22,7 @@ class DaemonExecutor:
 
     def __init__(self, max_workers: int, thread_name_prefix: str = "worker"):
         self._tasks: queue.Queue = queue.Queue()
-        self._shutdown = False
+        self._shutdown = False  # guarded-by: _lock
         self._lock = threading.Lock()
         self._threads = [
             threading.Thread(

@@ -237,45 +237,49 @@ class PolicyServer:
         )
 
         def runtime_stats():
+            # one locked snapshot per scrape: bare attribute reads from
+            # here would be the cross-module dirty reads the batcher's
+            # guarded-by annotations forbid
+            bstats = batcher.stats_snapshot()
             yield (
-                "policy_server_batches_dispatched", "counter",
+                metrics_names.BATCHES_DISPATCHED, "counter",
                 "Micro-batches dispatched to the device",
-                batcher.batches_dispatched,
+                bstats["batches_dispatched"],
             )
             yield (
-                "policy_server_requests_dispatched", "counter",
+                metrics_names.REQUESTS_DISPATCHED, "counter",
                 "Requests dispatched through the micro-batcher",
-                batcher.requests_dispatched,
+                bstats["requests_dispatched"],
             )
             yield (
-                "policy_server_deadline_abandoned_batches", "counter",
+                metrics_names.DEADLINE_ABANDONED_BATCHES, "counter",
                 "Device batches abandoned by the dispatch watchdog",
-                batcher.deadline_abandoned_batches,
+                bstats["deadline_abandoned_batches"],
             )
             yield (
-                "policy_server_queue_depth", "gauge",
+                metrics_names.QUEUE_DEPTH, "gauge",
                 "Requests waiting for batch formation",
                 batcher.queue_depth(),
             )
             yield (
-                "policy_server_oracle_fallbacks", "counter",
+                metrics_names.ORACLE_FALLBACKS, "counter",
                 "Requests routed to the host oracle (schema overflow)",
                 getattr(environment, "oracle_fallbacks", 0) or 0,
             )
             yield (
-                "policy_server_host_fastpath_batches", "counter",
+                metrics_names.HOST_FASTPATH_BATCHES, "counter",
                 "Micro-batches answered by the host latency fast-path",
-                batcher.host_fastpath_batches,
+                bstats["host_fastpath_batches"],
             )
             yield (
-                "policy_server_host_fastpath_requests", "counter",
+                metrics_names.HOST_FASTPATH_REQUESTS, "counter",
                 "Requests answered by the host latency fast-path",
                 getattr(environment, "host_fastpath_requests", 0) or 0,
             )
             yield (
                 metrics_names.BUDGET_ROUTED_BATCHES, "counter",
                 "Batches routed host-side by the latency-budget check",
-                batcher.budget_routed_batches,
+                bstats["budget_routed_batches"],
             )
             # Two-tier dedup + verdict cache (round 6): hit rate is the
             # cache's whole value proposition, so it must be visible on a
@@ -346,19 +350,19 @@ class PolicyServer:
             yield (
                 metrics_names.SHED_REQUESTS, "counter",
                 "Requests shed at admission (429 + Retry-After)",
-                batcher.shed_requests,
+                bstats["shed_requests"],
             )
             yield (
                 metrics_names.EXPIRED_DROPPED, "counter",
                 "Expired rows dropped before encode/dispatch (no dead "
                 "work)",
-                batcher.expired_dropped,
+                bstats["expired_dropped"],
             )
             yield (
                 metrics_names.DEGRADED_RESPONSES, "counter",
                 "Requests answered by the --degraded-mode policy while "
                 "the device breaker was fully tripped",
-                batcher.degraded_responses,
+                bstats["degraded_responses"],
             )
             breaker = getattr(environment, "breaker_stats", None) or {}
             yield (

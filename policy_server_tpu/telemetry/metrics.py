@@ -44,7 +44,15 @@ INIT_ERRORS_TOTAL = "kubewarden_policy_initialization_errors_total"
 # provider), so they appear on BOTH the Prometheus pull endpoint
 # (/metrics) and the OTLP push pipeline (otlp.prometheus_to_otlp walks
 # the same registry). Kept here so server, dashboard, and tests agree on
-# one spelling.
+# one spelling — graftcheck's observability checker (OB01) rejects any
+# runtime_stats yield whose name is not one of these constants.
+BATCHES_DISPATCHED = "policy_server_batches_dispatched"
+REQUESTS_DISPATCHED = "policy_server_requests_dispatched"
+DEADLINE_ABANDONED_BATCHES = "policy_server_deadline_abandoned_batches"
+QUEUE_DEPTH = "policy_server_queue_depth"
+ORACLE_FALLBACKS = "policy_server_oracle_fallbacks"
+HOST_FASTPATH_BATCHES = "policy_server_host_fastpath_batches"
+HOST_FASTPATH_REQUESTS = "policy_server_host_fastpath_requests"
 DEDUP_BLOB_HITS = "policy_server_dedup_blob_hits"
 DEDUP_BLOB_MISSES = "policy_server_dedup_blob_misses"
 VERDICT_CACHE_HITS = "policy_server_verdict_cache_hits"
@@ -187,22 +195,22 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+        self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}  # guarded-by: _lock
         # Bounded recent-sample window per label set (tests/self-tuning);
         # the Prometheus histogram carries the full aggregation.
-        self._latencies: dict[
+        self._latencies: dict[  # guarded-by: _lock
             tuple[tuple[str, str], ...], collections.deque[float]
         ] = {}
         # label-set → (counter child, histogram child); dict assignment is
         # atomic under the GIL, racing builders produce identical children
-        self._prom_children: dict[tuple, tuple] = {}
+        self._prom_children: dict[tuple, tuple] = {}  # graftcheck: lockfree — GIL-atomic dict ops; racing builders store identical children
         # metric dataclass → (sorted label key, children): the serving
         # path records TWO observations per request with the same frozen
         # dataclass — hashing it once replaces rebuilding + sorting the
         # 9-entry label dict on every call (measured ~2/3 of phase-3
         # post-processing time). Cardinality is bounded like the children
         # cache (policy set × verdict space).
-        self._resolved: dict[object, tuple] = {}
+        self._resolved: dict[object, tuple] = {}  # graftcheck: lockfree — same protocol as _prom_children
         # serving-runtime stats provider (attach_runtime_stats): yields
         # (name, kind, help, value) tuples scraped on collect — ONE
         # collector registered here, so re-attachment can never produce

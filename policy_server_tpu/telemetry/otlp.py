@@ -224,7 +224,11 @@ class BatchSpanProcessor:
         self._queue: queue.Queue[SpanData] = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._wake = threading.Event()
-        self.dropped = 0
+        # on_end runs on every request thread: the drop counter's += is a
+        # racy read-modify-write without a lock (graftcheck GB01, round 8);
+        # only taken on queue-full, so never on the healthy path
+        self._drop_lock = threading.Lock()
+        self.dropped = 0  # guarded-by: _drop_lock
         self._thread = threading.Thread(
             target=self._loop, name="otlp-span-export", daemon=True
         )
@@ -234,7 +238,8 @@ class BatchSpanProcessor:
         try:
             self._queue.put_nowait(span)
         except queue.Full:
-            self.dropped += 1
+            with self._drop_lock:
+                self.dropped += 1
         if self._queue.qsize() >= self.max_batch:
             self._wake.set()
 

@@ -32,16 +32,18 @@ class InternTable:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._ids: dict[str, int] = {_MISSING_SENTINEL: MISSING_ID}
-        self._strings: list[str] = [_MISSING_SENTINEL]
+        # guarded-by: _lock for WRITES; reads are lock-free by the
+        # append-only + publish-id-last protocol (inline ignores below)
+        self._ids: dict[str, int] = {_MISSING_SENTINEL: MISSING_ID}  # guarded-by: _lock
+        self._strings: list[str] = [_MISSING_SENTINEL]  # guarded-by: _lock
         # pred_key -> (fn, list[bool] aligned with self._strings)
-        self._preds: dict[str, tuple[Callable[[str], bool], list[bool]]] = {}
+        self._preds: dict[str, tuple[Callable[[str], bool], list[bool]]] = {}  # guarded-by: _lock
 
     def __len__(self) -> int:
-        return len(self._strings)
+        return len(self._strings)  # graftcheck: ignore — append-only, len is monotone
 
     def intern(self, s: str) -> int:
-        existing = self._ids.get(s)
+        existing = self._ids.get(s)  # graftcheck: ignore — lock-free fast path (publish-last)
         if existing is not None:
             return existing
         with self._lock:
@@ -60,12 +62,12 @@ class InternTable:
             return new_id
 
     def lookup(self, s: str) -> int | None:
-        return self._ids.get(s)
+        return self._ids.get(s)  # graftcheck: ignore — lock-free read (publish-last)
 
     def string_of(self, id_: int) -> str:
         if id_ == MISSING_ID:
             raise KeyError("MISSING id has no string")
-        return self._strings[id_]
+        return self._strings[id_]  # graftcheck: ignore — ids index the append-only prefix
 
     def register_pred(self, key: str, fn: Callable[[str], bool]) -> None:
         """Register a string predicate; backfills bits for existing strings.
@@ -81,7 +83,7 @@ class InternTable:
         MISSING)."""
         if id_ == MISSING_ID:
             return False
-        return self._preds[key][1][id_]
+        return self._preds[key][1][id_]  # graftcheck: ignore — bit exists before id is visible
 
     def pred_value(self, key: str, s: str) -> bool:
         return self.pred_bit(key, self.intern(s))
@@ -94,4 +96,4 @@ class InternTable:
             return False
 
     def strings(self) -> Iterator[str]:
-        yield from self._strings[1:]
+        yield from self._strings[1:]  # graftcheck: ignore — append-only snapshot read

@@ -15,6 +15,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# graftcheck lock-order sanitizer ("tsan-lite"): when armed (make chaos
+# sets GRAFTCHECK_LOCKSAN=1), every threading.Lock the package creates is
+# wrapped to record per-thread acquisition order; the session-scoped
+# fixture below errors the run on any inversion. Must install BEFORE any
+# package module constructs a lock. Zero-cost (never imported) when off.
+_LOCKSAN = os.environ.get("GRAFTCHECK_LOCKSAN", "") not in ("", "0")
+if _LOCKSAN:
+    from policy_server_tpu import locksan
+
+    locksan.install()
+
 # The axon site package (PYTHONPATH sitecustomize) pins jax_platforms to the
 # real TPU regardless of JAX_PLATFORMS; override it before backend init so
 # tests run on the 8-virtual-device CPU mesh.
@@ -23,6 +34,39 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _locksan_gate():
+    """When the lock-order sanitizer is armed, FAIL the run on any
+    lock-order inversion (a teardown assert in a session fixture errors
+    the run without touching individual tests). Long holds are reported
+    (pytest_terminal_summary below — fixture stdout is fd-captured and
+    would never be shown) but do not fail — chaos tests inject sleeps on
+    purpose; the invariant the gate enforces is acquisition ORDER."""
+    yield
+    if not _LOCKSAN:
+        return
+    from policy_server_tpu import locksan
+
+    rep = locksan.report()
+    assert not rep["inversions"], (
+        "graftcheck locksan: lock-order inversion(s) detected: "
+        f"{rep['inversions']}\n" + locksan.format_report(rep)
+    )
+
+
+def pytest_terminal_summary(terminalreporter):
+    """Locksan statistics (acquisitions, order edges, inversions, long
+    holds) on every armed run — the terminal reporter is the only
+    channel pytest's fd-level capture does not swallow."""
+    if not _LOCKSAN:
+        return
+    from policy_server_tpu import locksan
+
+    terminalreporter.write_line("")
+    for line in locksan.format_report().splitlines():
+        terminalreporter.write_line(line)
 
 
 @pytest.fixture

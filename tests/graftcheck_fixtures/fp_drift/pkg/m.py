@@ -1,0 +1,12 @@
+"""Failpoint fixture package: two compiled-in sites — one armed by the
+fixture tests, one not (FP02)."""
+
+from policy_server_tpu import failpoints
+
+
+def fetch():
+    failpoints.fire("site.armed")
+
+
+def encode():
+    failpoints.fire("site.unarmed")  # FP02: no test arms this

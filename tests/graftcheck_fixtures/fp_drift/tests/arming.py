@@ -1,0 +1,13 @@
+"""Fixture tests arming one real site and one phantom site (FP01)."""
+
+from policy_server_tpu import failpoints
+
+
+def test_armed():
+    with failpoints.active("site.armed", lambda: None):
+        pass
+
+
+def test_phantom():
+    failpoints.set_failpoint("site.phantom", lambda: None)  # FP01
+    failpoints.configure("site.armed=raise:boom*1")

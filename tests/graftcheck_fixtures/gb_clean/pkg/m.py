@@ -1,0 +1,49 @@
+"""Clean guarded-by fixture: every access of the annotated attribute is
+under the lock, via a holds-annotated helper, or construction-time."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def read(self):
+        with self._lock:
+            return self.value
+
+    def _double_locked(self):
+        self.value *= 2  # _locked suffix: caller holds the lock
+
+    def helper(self):  # holds: _lock
+        return self.value
+
+
+_glock = threading.Lock()
+_registry: dict = {}  # guarded-by: _glock
+# graftcheck: lockfree — single bool gate, stale reads acceptable
+_armed = False
+
+
+def register(k, v):
+    with _glock:
+        _registry[k] = v
+
+
+def read(k):
+    with _glock:
+        return _registry.get(k)
+
+
+def shadowing_local():
+    _registry = {}  # a LOCAL, shadows the global: not checked
+    return _registry
+
+
+def gate():
+    return _armed  # lockfree-annotated: not checked

@@ -1,0 +1,39 @@
+"""Seeded GB01 violation: annotated attribute read and written outside
+its lock (the check-then-set-outside-lock bug class)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+        self.snapshot = None  # graftcheck: lockfree — atomic swap
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def racy_read(self):
+        return self.value  # VIOLATION: read outside _lock
+
+    def racy_check_then_set(self):
+        if self.value == 0:  # VIOLATION: check outside _lock
+            with self._lock:
+                self.value = 1
+
+    def fine_lockfree(self):
+        return self.snapshot  # lockfree-annotated: not flagged
+
+
+_glock = threading.Lock()
+_registry: dict = {}  # guarded-by: _glock
+
+
+def register(k, v):
+    with _glock:
+        _registry[k] = v
+
+
+def racy_global_read(k):
+    return _registry.get(k)  # VIOLATION: module global outside _glock

@@ -1,0 +1,27 @@
+"""Clean lock-order fixture: a consistent A->B->C acquisition order
+(no back edge, no cycle)."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def path_ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def path_bc(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def path_abc(self):
+        with self._a:
+            with self._b:
+                with self._c:
+                    pass

@@ -1,0 +1,29 @@
+"""Seeded LO01 3-lock cycle: one path acquires A->B->C (the B->C edge
+through a method call), another C->A — the ABC/BCA inversion."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def path_ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def path_bc(self):
+        with self._b:
+            self._take_c()
+
+    def _take_c(self):
+        with self._c:
+            pass
+
+    def path_ca(self):  # closes the cycle: C held, then A
+        with self._c:
+            with self._a:
+                pass

@@ -1,0 +1,9 @@
+"""Observability fixture metrics module: one live constant, one dead
+constant (OB03), and the label schema tuples."""
+
+GOOD_COUNTER = "policy_server_fixture_good"
+GOOD_GAUGE = "policy_server_fixture_depth"
+DEAD_METRIC = "policy_server_fixture_dead"  # OB03: never registered
+
+_EVAL_LABELS = ("policy_name", "accepted")
+_INIT_LABELS = ("policy_name", "initialization_error")

@@ -1,0 +1,22 @@
+"""Observability fixture server module: a runtime_stats provider with a
+constant-named counter, a literal-named counter (OB01), a gauge, and a
+histogram-kind yield the collector cannot export (OB02)."""
+
+from tests.graftcheck_fixtures.obs import metrics_fix as metrics_names
+
+
+def runtime_stats():
+    yield (metrics_names.GOOD_COUNTER, "counter", "fine", 1)
+    yield (metrics_names.GOOD_GAUGE, "gauge", "fine", 2)
+    yield ("policy_server_fixture_literal", "counter", "OB01", 3)
+    yield (metrics_names.GOOD_COUNTER, "histogram", "OB02", 4)
+
+
+def runtime_stats_computed():
+    pass
+
+
+def _more():
+    # second provider shape: computed names must be rejected (OB01)
+    def runtime_stats():
+        yield ("policy_server_" + "computed", "counter", "OB01-computed", 5)

@@ -1,0 +1,16 @@
+"""Clean trace-purity fixture: pure jit root; device sync only inside
+the _device_fetch choke point."""
+
+import jax
+import jax.numpy as jnp
+
+
+def forward(features):
+    return jnp.where(features > 0, features, -features)
+
+
+fused = jax.jit(forward)
+
+
+def _device_fetch(dev_out):
+    return jax.device_get(dev_out)  # choke point: allowed

@@ -1,0 +1,24 @@
+"""Seeded trace-purity violations: a jit root that reads the wall clock
+through a helper (TP01), branches on a traced parameter (TP02), and a
+device fetch outside the choke points (TP03)."""
+
+import time
+
+import jax
+
+
+def _impure_helper(x):
+    return x * time.time()  # TP01: wall clock frozen into the trace
+
+
+def forward(features):
+    if features:  # TP02: Python branch on a traced parameter
+        return _impure_helper(features)
+    return features
+
+
+fused = jax.jit(forward)
+
+
+def sneaky_fetch(dev_out):
+    return jax.device_get(dev_out)  # TP03: outside _device_fetch/_device_call

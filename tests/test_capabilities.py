@@ -71,6 +71,7 @@ def test_kubernetes_lookup_outside_allowlist_fails():
 
 
 def test_sigstore_pub_key_capability(tmp_path):
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -106,6 +107,7 @@ def test_sigstore_pub_key_capability(tmp_path):
 
 
 def test_crypto_certificate_capability():
+    pytest.importorskip("cryptography")
     import datetime
 
     from cryptography import x509
@@ -322,6 +324,7 @@ def test_keyless_v2_verify_with_trust_root(tmp_path):
     """With an offline trust root and a cosign-style keyless bundle in
     the signature store, the v2/verify capability verifies the chain +
     rekor scaffolding and matches the requested (issuer, subject)."""
+    pytest.importorskip("cryptography")
     import json as _json
 
     from cryptography.hazmat.primitives.asymmetric import ec

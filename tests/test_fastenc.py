@@ -8,6 +8,10 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+# flagship_policies() builds signature-capability policies that need
+# cryptography at runtime; dependency-light containers skip the module
+pytest.importorskip("cryptography")
+
 from policy_server_tpu.evaluation.environment import EvaluationEnvironmentBuilder
 from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
 from policy_server_tpu.models.policy import parse_policy_entry

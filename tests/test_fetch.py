@@ -14,6 +14,10 @@ import threading
 
 import pytest
 
+# fetch/verify imports cryptography at module load: in dependency-light
+# containers the whole module must SKIP, not error (graftcheck round 8)
+pytest.importorskip("cryptography")
+
 from policy_server_tpu.config.sources import Sources
 from policy_server_tpu.config.verification import VerificationConfig
 from policy_server_tpu.fetch import (

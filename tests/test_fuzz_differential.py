@@ -10,6 +10,8 @@ hardest correctness surface, and random structure is what breaks codecs."""
 from __future__ import annotations
 
 import pytest
+
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

@@ -6,6 +6,9 @@ matching-glob-but-unsigned image must be REJECTED, not glob-accepted)."""
 from __future__ import annotations
 
 import pytest
+
+pytest.importorskip("cryptography")
+
 from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 from cryptography.hazmat.primitives.serialization import (
     Encoding,

@@ -13,6 +13,8 @@ import json
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from policy_server_tpu.config.verification import VerificationConfig
 from policy_server_tpu.fetch.keyless import (
     KeylessError,

@@ -12,6 +12,8 @@ import ssl
 import pytest
 import requests
 
+pytest.importorskip("cryptography")
+
 from policy_server_tpu import certs as certs_mod
 from policy_server_tpu.config.config import TlsConfig
 

@@ -1,0 +1,19 @@
+"""graftcheck — repo-native static analysis + consistency gates.
+
+Five checkers (see each module's docstring for rules):
+
+1. ``concurrency``    — guarded-by lint + lock-order cycle detection
+2. ``tracepurity``    — purity of jit-traced code, device-sync funnel
+3. ``observability``  — counter ↔ OTLP ↔ dashboard mapping totality
+4. ``failpoint_drift``— failpoint site ↔ chaos-test arming ↔ docs
+5. ``policy_server_tpu.locksan`` — the DYNAMIC lock-order sanitizer
+   (armed via ``GRAFTCHECK_LOCKSAN=1``, e.g. by ``make chaos``)
+
+Run with ``python -m tools.graftcheck`` (the ``make check`` gate).
+Suppressions live in ``tools/graftcheck/baseline.json`` — explicit,
+justified, and stale-checked.
+"""
+
+from tools.graftcheck.base import Finding, apply_baseline, load_baseline
+
+__all__ = ["Finding", "apply_baseline", "load_baseline"]

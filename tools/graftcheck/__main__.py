@@ -1,0 +1,117 @@
+"""graftcheck CLI — the ``make check`` gate.
+
+Runs the four static checkers over the repo, applies the baseline, and
+(unless ``--skip-docs``) the cli-docs drift gate: regenerate the CLI
+docs to a temp file and byte-compare against the committed cli-docs.md
+(no git needed, so the Dockerfile test stage can run it too).
+
+Exit 0 only when every finding is either fixed or baselined with a
+justification AND no baseline entry is stale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+from tools.graftcheck import concurrency, failpoint_drift, observability, tracepurity
+from tools.graftcheck.base import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def docs_drift(root: Path) -> list[Finding]:
+    """DC01 — cli-docs.md out of date vs `policy_server_tpu docs`."""
+    committed = root / "cli-docs.md"
+    with tempfile.NamedTemporaryFile(suffix=".md") as tmp:
+        proc = subprocess.run(
+            [sys.executable, "-m", "policy_server_tpu", "docs", "--output", tmp.name],
+            cwd=root,
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            return [
+                Finding(
+                    "docs", "DC00", "cli-docs.md", 0, "docs-generate",
+                    f"cli docs generation failed: {proc.stderr.strip()[-200:]}",
+                )
+            ]
+        fresh = Path(tmp.name).read_bytes()
+    if not committed.exists() or committed.read_bytes() != fresh:
+        return [
+            Finding(
+                "docs", "DC01", "cli-docs.md", 0, "docs-drift",
+                "cli-docs.md is stale — regenerate with `make docs`",
+            )
+        ]
+    return []
+
+
+def run_checkers(root: Path, skip_docs: bool = False) -> list[Finding]:
+    findings: list[Finding] = []
+    findings += concurrency.check(root)
+    findings += tracepurity.check(root)
+    findings += observability.check(root)
+    findings += failpoint_drift.check(root)
+    if not skip_docs:
+        findings += docs_drift(root)
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="graftcheck")
+    parser.add_argument("--root", default=str(REPO_ROOT))
+    parser.add_argument("--baseline", default=str(DEFAULT_BASELINE))
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="write every current finding into the baseline (then edit "
+        "the justifications before committing)",
+    )
+    parser.add_argument(
+        "--skip-docs", action="store_true",
+        help="skip the cli-docs regeneration drift gate",
+    )
+    args = parser.parse_args(argv)
+    root = Path(args.root)
+
+    findings = run_checkers(root, skip_docs=args.skip_docs)
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"baseline written: {len(findings)} suppressions -> {args.baseline}")
+        return 0
+
+    result = apply_baseline(findings, load_baseline(args.baseline))
+    for f in sorted(result.new, key=lambda f: (f.path, f.line, f.rule)):
+        print(f"FAIL {f.format()}")
+        print(f"     fingerprint: {f.fingerprint}")
+    if result.suppressed:
+        print(f"{len(result.suppressed)} finding(s) suppressed by baseline:")
+        for f, just in result.suppressed:
+            print(f"  ok   {f.fingerprint} — {just}")
+    for fp in result.stale:
+        print(f"STALE baseline entry suppresses nothing: {fp}")
+
+    checkers = sorted({f.checker for f in findings}) or ["(none)"]
+    print(
+        f"graftcheck: {len(findings)} finding(s) across "
+        f"{', '.join(checkers)}; {len(result.new)} new, "
+        f"{len(result.suppressed)} baselined, {len(result.stale)} stale"
+    )
+    if result.new or result.stale:
+        return 1
+    print("graftcheck: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,132 @@
+"""Shared finding/baseline plumbing for the graftcheck suite.
+
+A finding's fingerprint is deliberately line-number-free —
+``rule:path:symbol`` — so a baseline entry survives unrelated edits to
+the same file. The baseline file makes every suppression explicit and
+reviewed: each entry carries a ``justification`` string, and stale
+entries (suppressing nothing) fail the run so the file cannot rot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str  # concurrency | tracepurity | observability | failpoints | docs
+    rule: str  # e.g. "GB01"
+    path: str  # repo-relative
+    line: int
+    symbol: str  # stable anchor, e.g. "VerdictCache.__len__:_data"
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class BaselineResult:
+    new: list[Finding]
+    suppressed: list[tuple[Finding, str]]  # (finding, justification)
+    stale: list[str]  # baseline fingerprints that matched nothing
+
+
+def load_baseline(path: str | Path) -> dict[str, str]:
+    """fingerprint -> justification. Missing file = empty baseline."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    doc = json.loads(p.read_text())
+    out: dict[str, str] = {}
+    for entry in doc.get("suppressions", []):
+        out[entry["fingerprint"]] = entry.get("justification", "")
+    return out
+
+
+def save_baseline(path: str | Path, findings: list[Finding]) -> None:
+    doc = {
+        "suppressions": [
+            {
+                "fingerprint": f.fingerprint,
+                "justification": "TODO: justify or fix",
+                "message": f.message,
+            }
+            for f in sorted(set(findings), key=lambda f: f.fingerprint)
+        ]
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, str]
+) -> BaselineResult:
+    new: list[Finding] = []
+    suppressed: list[tuple[Finding, str]] = []
+    used: set[str] = set()
+    for f in findings:
+        just = baseline.get(f.fingerprint)
+        if just is None:
+            new.append(f)
+        else:
+            suppressed.append((f, just))
+            used.add(f.fingerprint)
+    stale = sorted(set(baseline) - used)
+    return BaselineResult(new=new, suppressed=suppressed, stale=stale)
+
+
+def resolve_callee(
+    cands: list,
+    caller_module_key,
+    caller_cls: str | None,
+    kind: str,
+    module_key,
+    cls_of,
+):
+    """The ONE name-based callee-resolution policy both checkers use
+    (concurrency lock summaries, trace-purity reachability): same class
+    first, then same module (module-level candidates preferred for plain
+    calls), then a package-unique bare name; ambiguity resolves to None
+    — under-approximation beats false fan-out. ``module_key``/``cls_of``
+    are accessors because the checkers carry different record types."""
+    if not cands:
+        return None
+    if kind == "self" and caller_cls:
+        same_cls = [
+            c
+            for c in cands
+            if module_key(c) == caller_module_key and cls_of(c) == caller_cls
+        ]
+        if same_cls:
+            return same_cls[0]
+    same_mod = [c for c in cands if module_key(c) == caller_module_key]
+    if kind == "plain" and same_mod:
+        no_cls = [c for c in same_mod if cls_of(c) is None]
+        return (no_cls or same_mod)[0]
+    if len(cands) == 1:
+        return cands[0]
+    return None
+
+
+def iter_py_files(root: str | Path, subdir: str) -> list[Path]:
+    """Sorted .py files under root/subdir, skipping caches, the committed
+    generated protobuf module (machine-written, lock-free), and the
+    seeded-violation fixture tree (scanned only by its own tests)."""
+    base = Path(root) / subdir
+    out = []
+    for p in sorted(base.rglob("*.py")):
+        rel_parts = p.relative_to(base).parts
+        if (
+            "__pycache__" in rel_parts
+            or "graftcheck_fixtures" in rel_parts
+            or p.name == "otlp_pb2.py"
+        ):
+            continue
+        out.append(p)
+    return out

@@ -1,0 +1,580 @@
+"""Checker 1 — concurrency lint (guarded-by + lock-order cycles).
+
+Two analyses over the package's ASTs:
+
+**Guarded-by.** A ``threading.Lock``/``RLock``/``Condition`` assigned to
+an attribute or module global is a *lock site*. Attributes annotated
+with a trailing ``# guarded-by: <lock>`` comment form the guarded-by
+map; every later read/write of an annotated attribute (matched by
+attribute NAME, module-wide — guarding is often cross-object, e.g. a
+router's snapshot fields guarded by the router's lock) must happen
+lexically inside ``with <expr>.<lock>:`` (or ``with <lock>:`` for
+module locks). The check is name-based and module-scoped on purpose:
+it is exactly strong enough to catch the check-then-set-outside-lock
+class (ADVICE r5 #3) without whole-program alias analysis.
+
+Annotation grammar (trailing comments, one per line):
+
+* ``self.attr = ...   # guarded-by: _lock`` — accesses require _lock.
+* ``self.attr = ...   # graftcheck: lockfree — <why>`` — intentionally
+  unsynchronized (atomic swap, monotonic dirty-read counter); never
+  flagged, but the why is reviewed in the diff.
+* ``def meth(self):   # holds: _lock`` — body assumed to run with
+  _lock held by the caller. A method name ending in ``_locked`` gets
+  the same assumption for every lock (the repo-native convention).
+* ``# graftcheck: ignore`` on an access line suppresses that line.
+
+``__init__``/``__new__``/``__del__`` bodies are construction/teardown
+time and exempt.
+
+**Lock order.** Within each function the checker tracks the stack of
+held locks through nested ``with`` blocks and records acquisition
+edges; calls made while holding a lock add edges to every lock the
+callee (transitively, via name-resolved summaries) acquires. Cycles in
+the resulting cross-module graph are potential deadlocks (rule LO01);
+acquiring a non-reentrant Lock that is already held is self-deadlock
+(LO02). Callee resolution is name-based: same class first, then same
+module, then a package-unique bare name; ambiguous names are skipped
+(under-approximation beats false fan-out).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from policy_server_tpu.utils.graphs import strongly_connected_components
+from tools.graftcheck.base import Finding, iter_py_files, resolve_callee
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_LOCKFREE_RE = re.compile(r"#\s*graftcheck:\s*lockfree")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_]\w*)")
+_IGNORE_RE = re.compile(r"#\s*graftcheck:\s*ignore")
+
+
+def _lock_factory_name(call: ast.expr) -> str | None:
+    """'Lock' / 'RLock' / 'Condition' when the expression constructs a
+    threading lock, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr in _LOCK_FACTORIES
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "threading"
+    ):
+        return f.attr
+    return None
+
+
+class _ModuleInfo:
+    def __init__(self, path: Path, relpath: str, tree: ast.Module, lines: list[str]):
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.lines = lines
+        self.module_locks: dict[str, str] = {}  # name -> factory
+        # class name -> {lock attr -> factory}
+        self.class_locks: dict[str, dict[str, str]] = {}
+        # attr name -> (guard lock name, declared-at line)
+        self.guarded: dict[str, tuple[str, int]] = {}
+        self.lockfree: set[str] = set()
+        # module-level globals: name -> (guard lock name, line) / lockfree
+        self.module_guarded: dict[str, tuple[str, int]] = {}
+        self.module_lockfree: set[str] = set()
+
+    def line(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+
+class _FuncInfo:
+    def __init__(self, key: str, module: _ModuleInfo, cls: str | None, name: str):
+        self.key = key  # "relpath::Class.meth" or "relpath::func"
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.direct_acquires: set[str] = set()  # lock ids
+        self.nested_edges: list[tuple[str, str, int]] = []  # (outer, inner, line)
+        # calls made while >=1 lock held: (held ids, callee kind, callee name, line)
+        self.calls_holding: list[tuple[tuple[str, ...], str, str, int]] = []
+        # EVERY call (held or not): summaries must see A->B->C chains
+        # where the middle function holds nothing
+        self.all_calls: list[tuple[str, str]] = []  # (kind, name)
+        self.self_acquires: list[tuple[str, int]] = []  # re-acquire while held
+
+
+def _parse_module(path: Path, root: Path) -> _ModuleInfo:
+    src = path.read_text()
+    tree = ast.parse(src)
+    info = _ModuleInfo(path, str(path.relative_to(root)), tree, src.splitlines())
+
+    def annotation_lines(node: ast.stmt) -> list[str]:
+        """The assignment's own line, plus the line above ONLY when it is
+        a standalone comment line — a neighboring assignment's TRAILING
+        annotation must never leak onto the next statement."""
+        out = [info.line(node.lineno)]
+        above = info.line(node.lineno - 1)
+        if above.strip().startswith("#"):
+            out.append(above)
+        return out
+
+    def scan_assign_line(node: ast.stmt, attr_or_name: str, in_class: str | None):
+        for text in annotation_lines(node):
+            if _LOCKFREE_RE.search(text):
+                info.lockfree.add(attr_or_name)
+                return
+            m = _GUARDED_RE.search(text)
+            if m:
+                info.guarded[attr_or_name] = (
+                    m.group(1).split(".")[-1], node.lineno
+                )
+                return
+
+    # module-level locks + annotated module globals. Annotation scanning
+    # covers the line of the assignment AND the line above it (a bare
+    # ``# graftcheck: lockfree — why`` comment line preceding the assign)
+    for node in info.tree.body:
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        fac = _lock_factory_name(node.value)
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if fac:
+                info.module_locks[t.id] = fac
+                continue
+            for text in annotation_lines(node):
+                if _LOCKFREE_RE.search(text):
+                    info.module_lockfree.add(t.id)
+                    break
+                m = _GUARDED_RE.search(text)
+                if m:
+                    info.module_guarded[t.id] = (
+                        m.group(1).split(".")[-1],
+                        node.lineno,
+                    )
+                    break
+
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.ClassDef):
+            locks = info.class_locks.setdefault(node.name, {})
+            for sub in ast.walk(node):
+                targets: list[ast.expr] = []
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets = [sub.target]
+                else:
+                    continue
+                fac = _lock_factory_name(sub.value)
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        if fac:
+                            locks[t.attr] = fac
+                        scan_assign_line(sub, t.attr, node.name)
+    return info
+
+
+class _LockIdResolver:
+    """Maps a ``with`` context expression to a stable lock identity."""
+
+    def __init__(self, module: _ModuleInfo, cls: str | None):
+        self.module = module
+        self.cls = cls
+
+    def resolve(self, expr: ast.expr) -> tuple[str, str] | None:
+        """(lock id, lock attr name) or None when not a known lock."""
+        # with self._lock: / with obj._lock:
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and self.cls
+                and name in self.module.class_locks.get(self.cls, {})
+            ):
+                return f"{self.module.relpath}::{self.cls}.{name}", name
+            # non-self attribute that is a known lock name in this
+            # module: attribute to the class ONLY when unambiguous —
+            # with two classes sharing the attr name, inventing a class
+            # identity would merge/misattribute graph nodes, so an
+            # explicit wildcard node keeps name-level held tracking
+            # without corrupting the order graph
+            owners = [
+                cls
+                for cls, locks in self.module.class_locks.items()
+                if name in locks
+            ]
+            if len(owners) == 1:
+                return f"{self.module.relpath}::{owners[0]}.{name}", name
+            if owners:
+                return f"{self.module.relpath}::?.{name}", name
+            if name in self.module.module_locks:
+                return f"{self.module.relpath}::{name}", name
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.module.module_locks:
+            return f"{self.module.relpath}::{expr.id}", expr.id
+        return None
+
+
+class _FuncWalker(ast.NodeVisitor):
+    def __init__(
+        self,
+        module: _ModuleInfo,
+        cls: str | None,
+        func: ast.FunctionDef | ast.AsyncFunctionDef,
+        factories: dict[str, str],
+        findings: list[Finding],
+    ):
+        self.module = module
+        self.cls = cls
+        self.func = func
+        self.factories = factories  # lock id -> factory kind
+        self.findings = findings
+        qual = f"{cls}.{func.name}" if cls else func.name
+        self.info = _FuncInfo(f"{module.relpath}::{qual}", module, cls, func.name)
+        self.qual = qual
+        self.resolver = _LockIdResolver(module, cls)
+        self.held: list[tuple[str, str]] = []  # (lock id, attr name)
+        self.exempt = func.name in _EXEMPT_METHODS
+        # caller-holds assumptions
+        text = module.line(func.lineno)
+        m = _HOLDS_RE.search(text)
+        self.assumed: set[str] = {m.group(1)} if m else set()
+        self.assume_all = func.name.endswith("_locked")
+        # name-resolution for module-global guarded checks: a name the
+        # function binds WITHOUT a ``global`` declaration is a local and
+        # shadows the module global (skip it); ``global``-declared names
+        # stay checkable even when stored
+        self.global_decls: set[str] = set()
+        self.local_names: set[str] = {
+            a.arg
+            for a in (
+                func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+            )
+        }
+        # walk THIS function's body only — a name bound inside a nested
+        # def is that closure's local, not ours, and must not exempt the
+        # outer function's module-global accesses from the check
+        stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Global):
+                self.global_decls.update(sub.names)
+            elif isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ):
+                self.local_names.add(sub.id)
+            stack.extend(ast.iter_child_nodes(sub))
+        self.local_names -= self.global_decls
+
+    # -- lock tracking -----------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._with(node)
+
+    def _with(self, node: ast.With | ast.AsyncWith) -> None:
+        # items enter left to right: each context expression is evaluated
+        # (visited) with only the PRECEDING items' locks held — visiting
+        # after pushing would attribute an earlier item's calls to locks
+        # acquired later in the same statement (phantom order edges)
+        acquired = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            got = self.resolver.resolve(item.context_expr)
+            if got is None:
+                continue
+            lock_id, attr = got
+            held_ids = [h for h, _ in self.held]
+            if lock_id in held_ids and self.factories.get(lock_id) == "Lock":
+                self.info.self_acquires.append((lock_id, node.lineno))
+            for held_id, _ in self.held:
+                if held_id != lock_id:
+                    self.info.nested_edges.append((held_id, lock_id, node.lineno))
+            self.info.direct_acquires.add(lock_id)
+            self.held.append((lock_id, attr))
+            acquired += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(acquired):
+            self.held.pop()
+
+    # nested defs get their own walker; don't descend
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+    # -- call recording ----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        kind = name = None
+        if isinstance(f, ast.Name):
+            kind, name = "plain", f.id
+        elif isinstance(f, ast.Attribute):
+            kind = (
+                "self"
+                if isinstance(f.value, ast.Name) and f.value.id == "self"
+                else "attr"
+            )
+            name = f.attr
+        if name is not None:
+            self.info.all_calls.append((kind, name))
+            if self.held:
+                held_ids = tuple(h for h, _ in self.held)
+                self.info.calls_holding.append(
+                    (held_ids, kind, name, node.lineno)
+                )
+        self.generic_visit(node)
+
+    # -- guarded-by access checks ------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = node.attr
+        guard = self.module.guarded.get(attr)
+        if (
+            guard is not None
+            and attr not in self.module.lockfree
+            and not self.exempt
+        ):
+            lock_name, _decl = guard
+            held_names = {a for _, a in self.held}
+            if (
+                lock_name not in held_names
+                and lock_name not in self.assumed
+                and not self.assume_all
+                and not _IGNORE_RE.search(self.module.line(node.lineno))
+            ):
+                kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+                self.findings.append(
+                    Finding(
+                        checker="concurrency",
+                        rule="GB01",
+                        path=self.module.relpath,
+                        line=node.lineno,
+                        symbol=f"{self.qual}:{attr}",
+                        message=(
+                            f"{kind} of '{attr}' (guarded-by {lock_name}) "
+                            f"outside 'with ...{lock_name}:' in {self.qual}"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        """Module-global guarded-by checks (same rules as attributes):
+        annotated globals must be accessed under their lock; names the
+        function binds locally shadow the global and are skipped."""
+        name = node.id
+        guard = self.module.module_guarded.get(name)
+        if (
+            guard is not None
+            and name not in self.module.module_lockfree
+            and name not in self.local_names
+            and not self.exempt
+        ):
+            lock_name, _decl = guard
+            held_names = {a for _, a in self.held}
+            if (
+                lock_name not in held_names
+                and lock_name not in self.assumed
+                and not self.assume_all
+                and not _IGNORE_RE.search(self.module.line(node.lineno))
+            ):
+                kind = (
+                    "write"
+                    if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read"
+                )
+                self.findings.append(
+                    Finding(
+                        checker="concurrency",
+                        rule="GB01",
+                        path=self.module.relpath,
+                        line=node.lineno,
+                        symbol=f"{self.qual}:{name}",
+                        message=(
+                            f"{kind} of module global '{name}' (guarded-by "
+                            f"{lock_name}) outside 'with {lock_name}:' in "
+                            f"{self.qual}"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+
+def _collect_functions(
+    module: _ModuleInfo, factories: dict[str, str], findings: list[Finding]
+) -> list[_FuncInfo]:
+    out: list[_FuncInfo] = []
+
+    def walk_body(body: list[ast.stmt], cls: str | None) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                w = _FuncWalker(module, cls, node, factories, findings)
+                for stmt in node.body:
+                    w.visit(stmt)
+                out.append(w.info)
+                walk_body(node.body, cls)  # nested defs
+            elif isinstance(node, ast.ClassDef):
+                walk_body(node.body, node.name)
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
+                # defs nested under control flow at module/class level
+                inner: list[ast.stmt] = list(getattr(node, "body", []))
+                inner += list(getattr(node, "orelse", []))
+                inner += list(getattr(node, "finalbody", []))
+                for h in getattr(node, "handlers", []):
+                    inner += h.body
+                walk_body(inner, cls)
+
+    walk_body(module.tree.body, None)
+    return out
+
+
+def _transitive_acquires(funcs: list[_FuncInfo]):
+    """(summaries, resolver): fixpoint lock-acquire summaries plus the
+    name-based callee resolver, returned together so the edge builder
+    reuses one resolution policy without module-global state."""
+    by_name: dict[str, list[_FuncInfo]] = {}
+    for f in funcs:
+        by_name.setdefault(f.name, []).append(f)
+
+    def resolve(caller: _FuncInfo, kind: str, name: str) -> _FuncInfo | None:
+        return resolve_callee(
+            by_name.get(name, []),
+            id(caller.module),
+            caller.cls,
+            kind,
+            module_key=lambda c: id(c.module),
+            cls_of=lambda c: c.cls,
+        )
+
+    summary: dict[str, set[str]] = {f.key: set(f.direct_acquires) for f in funcs}
+    # full call graph (held or not): summaries are transitive
+    callgraph: dict[str, set[str]] = {f.key: set() for f in funcs}
+    for f in funcs:
+        for kind, name in f.all_calls:
+            callee = resolve(f, kind, name)
+            if callee is not None:
+                callgraph[f.key].add(callee.key)
+    changed = True
+    while changed:
+        changed = False
+        for f in funcs:
+            s = summary[f.key]
+            before = len(s)
+            for callee_key in callgraph[f.key]:
+                s |= summary[callee_key]
+            if len(s) != before:
+                changed = True
+    return summary, resolve
+
+
+def check(root: str | Path, package: str = "policy_server_tpu") -> list[Finding]:
+    root = Path(root)
+    findings: list[Finding] = []
+    modules: list[_ModuleInfo] = []
+    for path in iter_py_files(root, package):
+        try:
+            modules.append(_parse_module(path, root))
+        except SyntaxError as e:  # pragma: no cover - repo must parse
+            findings.append(
+                Finding("concurrency", "GB00", str(path.relative_to(root)),
+                        e.lineno or 0, path.name, f"syntax error: {e.msg}")
+            )
+    factories: dict[str, str] = {}
+    for m in modules:
+        for name, fac in m.module_locks.items():
+            factories[f"{m.relpath}::{name}"] = fac
+        for cls, locks in m.class_locks.items():
+            for name, fac in locks.items():
+                factories[f"{m.relpath}::{cls}.{name}"] = fac
+
+    funcs: list[_FuncInfo] = []
+    for m in modules:
+        funcs.extend(_collect_functions(m, factories, findings))
+
+    # -- lock-order graph --------------------------------------------------
+    summary, resolve = _transitive_acquires(funcs)
+    edges: dict[tuple[str, str], tuple[str, int]] = {}  # -> (where, line)
+    for f in funcs:
+        for outer, inner, line in f.nested_edges:
+            edges.setdefault((outer, inner), (f.key, line))
+        for held_ids, kind, name, line in f.calls_holding:
+            callee = resolve(f, kind, name)
+            if callee is None:
+                continue
+            for inner in summary[callee.key]:
+                for outer in held_ids:
+                    if outer != inner:
+                        edges.setdefault((outer, inner), (f.key, line))
+        for lock_id, line in f.self_acquires:
+            rel = f.key.split("::")[0]
+            findings.append(
+                Finding(
+                    checker="concurrency",
+                    rule="LO02",
+                    path=rel,
+                    line=line,
+                    symbol=f"{f.cls or ''}.{f.name}:{lock_id.split('::')[-1]}",
+                    message=(
+                        f"non-reentrant Lock {lock_id} re-acquired while "
+                        f"already held in {f.name} (self-deadlock)"
+                    ),
+                )
+            )
+
+    graph: dict[str, set[str]] = {}
+    for (a, b), _where in edges.items():
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    for cycle in strongly_connected_components(graph):
+        # witness: any observed edge internal to the SCC (the sorted
+        # member list is not an edge path, so adjacency can't be used)
+        members = set(cycle)
+        where, line = "?", 0
+        for (a, b), w in sorted(edges.items()):
+            if a in members and b in members:
+                where, line = w
+                break
+        rel = where.split("::")[0] if where != "?" else ""
+        findings.append(
+            Finding(
+                checker="concurrency",
+                rule="LO01",
+                path=rel or cycle[0].split("::")[0],
+                line=line,
+                symbol="->".join(c.split("::")[-1] for c in cycle),
+                message=(
+                    "lock-order cycle (potential deadlock): "
+                    + " -> ".join(cycle)
+                    + " -> " + cycle[0]
+                ),
+            )
+        )
+    return findings

@@ -1,0 +1,175 @@
+"""Checker 4 — failpoint / docs drift.
+
+The chaos suite's value rests on two invariants: every failpoint a test
+arms actually intercepts a compiled-in site (an armed-but-nonexistent
+site silently tests nothing), and every compiled-in site is exercised
+by at least one test (an unexercised site is dead instrumentation).
+The failpoints module docstring's site table is the operator-facing
+contract, so it must list exactly the compiled sites.
+
+Rules:
+
+* **FP01** — a test arms a failpoint site with no ``failpoints.fire``
+  call anywhere in the package.
+* **FP02** — a compiled-in ``failpoints.fire`` site that no test arms.
+* **FP03** — the failpoints.py docstring site table is missing a
+  compiled site (or lists a stale one).
+
+Armed sites are recognized through every arming surface:
+``set_failpoint("site", ...)``, ``failpoints.active("site", ...)``,
+``configure("site=action;...")`` strings, and ``FAILPOINTS`` env
+assignments (``os.environ[...]`` / ``monkeypatch.setenv``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.graftcheck.base import Finding, iter_py_files
+
+# a table row: the line STARTS with a backticked site name (prose
+# references like ``failpoints.fire`` elsewhere must not count)
+_SITE_TABLE_RE = re.compile(r"^``([a-z_]+\.[a-z_]+)``\s", re.MULTILINE)
+_SPEC_SITE_RE = re.compile(r"([a-z_]+\.[a-z_]+)\s*=")
+
+
+def _const_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fired_sites(root: Path, package: str) -> dict[str, tuple[str, int]]:
+    out: dict[str, tuple[str, int]] = {}
+    for path in iter_py_files(root, package):
+        relpath = str(path.relative_to(root))
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "fire"
+                and node.args
+            ):
+                site = _const_str(node.args[0])
+                if site and "." in site:
+                    out.setdefault(site, (relpath, node.lineno))
+    return out
+
+
+def _parse_spec(spec: str) -> list[str]:
+    return [m.group(1) for m in _SPEC_SITE_RE.finditer(spec)]
+
+
+def _armed_sites(root: Path, tests_dir: str) -> dict[str, tuple[str, int]]:
+    out: dict[str, tuple[str, int]] = {}
+
+    def add(site: str, relpath: str, line: int) -> None:
+        if site and "." in site:
+            out.setdefault(site, (relpath, line))
+
+    for path in iter_py_files(root, tests_dir):
+        relpath = str(path.relative_to(root))
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id
+                if isinstance(node.func, ast.Name)
+                else None
+            )
+            if fname in ("set_failpoint", "active") and node.args:
+                site = _const_str(node.args[0])
+                if site:
+                    add(site, relpath, node.lineno)
+            elif fname == "configure" and node.args:
+                spec = _const_str(node.args[0])
+                if spec:
+                    for site in _parse_spec(spec):
+                        add(site, relpath, node.lineno)
+            elif fname == "setenv" and len(node.args) >= 2:
+                if _const_str(node.args[0]) == "FAILPOINTS":
+                    spec = _const_str(node.args[1])
+                    if spec:
+                        for site in _parse_spec(spec):
+                            add(site, relpath, node.lineno)
+        # os.environ["FAILPOINTS"] = "..." assignments
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+            ):
+                sub = node.targets[0]
+                key = _const_str(sub.slice)
+                if key == "FAILPOINTS":
+                    spec = _const_str(node.value)
+                    if spec:
+                        for site in _parse_spec(spec):
+                            add(site, relpath, node.lineno)
+    return out
+
+
+def check(
+    root: str | Path,
+    package: str = "policy_server_tpu",
+    tests_dir: str = "tests",
+    failpoints_rel: str = "policy_server_tpu/failpoints.py",
+) -> list[Finding]:
+    root = Path(root)
+    findings: list[Finding] = []
+    fired = _fired_sites(root, package)
+    armed = _armed_sites(root, tests_dir)
+
+    for site, (relpath, line) in sorted(armed.items()):
+        if site not in fired:
+            findings.append(
+                Finding(
+                    "failpoints", "FP01", relpath, line,
+                    f"armed:{site}",
+                    f"test arms failpoint '{site}' but no "
+                    f"failpoints.fire('{site}') site is compiled in — the "
+                    "injection tests nothing",
+                )
+            )
+    for site, (relpath, line) in sorted(fired.items()):
+        if site not in armed:
+            findings.append(
+                Finding(
+                    "failpoints", "FP02", relpath, line,
+                    f"fired:{site}",
+                    f"compiled-in failpoint site '{site}' is never armed "
+                    "by any test — dead instrumentation",
+                )
+            )
+
+    # FP03: the docstring site table
+    fp_path = root / failpoints_rel
+    if fp_path.exists():
+        tree = ast.parse(fp_path.read_text())
+        doc = ast.get_docstring(tree) or ""
+        documented = set(_SITE_TABLE_RE.findall(doc))
+        for site in sorted(set(fired) - documented):
+            findings.append(
+                Finding(
+                    "failpoints", "FP03", failpoints_rel, 1,
+                    f"doc-missing:{site}",
+                    f"failpoints.py docstring site table is missing "
+                    f"compiled site '{site}'",
+                )
+            )
+        for site in sorted(documented - set(fired)):
+            findings.append(
+                Finding(
+                    "failpoints", "FP03", failpoints_rel, 1,
+                    f"doc-stale:{site}",
+                    f"failpoints.py docstring documents site '{site}' "
+                    "which is not compiled in",
+                )
+            )
+    return findings
