@@ -224,7 +224,85 @@ async def validate_raw_handler(request: web.Request) -> web.Response:
 
 
 async def readiness_handler(request: web.Request) -> web.Response:
-    return web.Response(status=200)
+    """Honest readiness (round 9): 503 until the first policy epoch is
+    compiled+warmed, 200 on last-good during a background reload, 503
+    when every shard's breaker is open under --degraded-mode reject
+    (ApiServerState.readiness holds the verdict logic)."""
+    status, text = request.app[STATE_KEY].readiness()
+    return web.Response(status=status, text=text)
+
+
+# -- policy-lifecycle admin endpoints (lifecycle.py) ------------------------
+
+
+def _admin_gate(state: ApiServerState, request: web.Request) -> web.Response | None:
+    """Auth for the /policies/* admin endpoints: a bearer token must be
+    CONFIGURED (--reload-admin-token) and presented. Serving on the
+    plaintext readiness port keeps the surface cluster-internal like
+    /metrics; the token keeps it operator-only."""
+    if state.lifecycle is None:
+        return api_error(404, "policy hot reload is disabled")
+    if not state.admin_token:
+        return api_error(
+            403,
+            "policy admin endpoints disabled: --reload-admin-token is "
+            "not configured",
+        )
+    header = request.headers.get("Authorization", "")
+    import hmac
+
+    expected = f"Bearer {state.admin_token}"
+    if not hmac.compare_digest(header, expected):
+        return api_error(401, "invalid or missing bearer token")
+    return None
+
+
+async def policies_reload_handler(request: web.Request) -> web.Response:
+    state = request.app[STATE_KEY]
+    denied = _admin_gate(state, request)
+    if denied is not None:
+        return denied
+    started = state.lifecycle.request_reload("admin-endpoint")
+    return web.json_response(
+        {
+            "status": "reload started" if started else
+            "reload already in progress",
+            "epoch": state.lifecycle.current_epoch,
+        },
+        status=202,
+    )
+
+
+async def _lifecycle_action(
+    request: web.Request, action: str
+) -> web.Response:
+    """Shared body for the synchronous promote/rollback endpoints."""
+    from policy_server_tpu.lifecycle import ReloadRejected
+
+    state = request.app[STATE_KEY]
+    denied = _admin_gate(state, request)
+    if denied is not None:
+        return denied
+    fn = getattr(state.lifecycle, action)
+    try:
+        # promote/rollback build + start a batcher: off the event loop
+        outcome = await asyncio.get_running_loop().run_in_executor(None, fn)
+    except ReloadRejected as e:
+        return api_error(409, str(e))
+    except Exception as e:  # noqa: BLE001 — keep the JSON error contract
+        logger.error("policy %s failed: %s", action, e)
+        return something_went_wrong()
+    return web.json_response(
+        {"status": outcome, "epoch": state.lifecycle.current_epoch}
+    )
+
+
+async def policies_rollback_handler(request: web.Request) -> web.Response:
+    return await _lifecycle_action(request, "rollback")
+
+
+async def policies_promote_handler(request: web.Request) -> web.Response:
+    return await _lifecycle_action(request, "promote_staged")
 
 
 async def metrics_handler(request: web.Request) -> web.Response:
@@ -301,4 +379,9 @@ def build_readiness_router(state: ApiServerState) -> web.Application:
     app[STATE_KEY] = state
     app.router.add_get("/readiness", readiness_handler)
     app.router.add_get("/metrics", metrics_handler)
+    # policy-lifecycle admin surface (bearer-token gated; 404 when the
+    # lifecycle manager is absent, 403 when no token is configured)
+    app.router.add_post("/policies/reload", policies_reload_handler)
+    app.router.add_post("/policies/promote", policies_promote_handler)
+    app.router.add_post("/policies/rollback", policies_rollback_handler)
     return app

@@ -1,10 +1,17 @@
 """Shared API server state (reference src/api/state.rs:6-9 —
 ``ApiServerState{semaphore, evaluation_environment}``; here the semaphore's
-role is played by the micro-batcher's bounded queue)."""
+role is played by the micro-batcher's bounded queue).
+
+Round 9: the environment/batcher fields are the EPOCH POINTER of the
+policy-lifecycle manager (lifecycle.py) — a hot reload promotes a new
+epoch by rebinding them; handlers read them per request, so a request
+racing the flip lands on one serving epoch or the other, never on a
+torn pair that matters (the demoted epoch keeps draining)."""
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Any
 
 from policy_server_tpu.evaluation.environment import EvaluationEnvironment
 from policy_server_tpu.runtime.batcher import MicroBatcher
@@ -16,3 +23,36 @@ class ApiServerState:
     batcher: MicroBatcher
     hostname: str = ""
     enable_pprof: bool = False
+    # readiness honesty: False until the first policy epoch is compiled
+    # AND warmed (lifecycle.install_first_epoch flips it). Defaults True
+    # so directly-constructed states (tests, embedding) stay ready.
+    ready: bool = True
+    # the policy-lifecycle manager (lifecycle.PolicyLifecycleManager);
+    # None when --policy-reload-mode off or when embedding without one
+    lifecycle: Any = None
+    # bearer token gating the /policies/* admin endpoints; None disables
+    admin_token: str | None = None
+
+    def readiness(self) -> tuple[int, str]:
+        """The /readiness verdict (status code, body text). Honest on
+        three axes: 503 until the first epoch is compiled+warmed, 200 on
+        last-good while a background reload runs (the flip above never
+        un-readies), and 503 when EVERY device shard's breaker is open
+        under ``--degraded-mode reject`` — a server that would answer
+        every review with an in-band 503 must not advertise ready."""
+        if not self.ready:
+            return 503, "first policy epoch not yet compiled and warmed"
+        batcher = self.batcher
+        if (
+            batcher is not None
+            and getattr(batcher, "degraded_mode", None) == "reject"
+            and getattr(
+                self.evaluation_environment, "breaker_all_open", False
+            )
+        ):
+            return (
+                503,
+                "every device shard breaker is open and --degraded-mode "
+                "reject refuses traffic",
+            )
+        return 200, "ok"

@@ -159,6 +159,15 @@ class ReloadableTlsContext:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.reloads = 0  # guarded-by: _lock
+        # watched-file digests live on the instance (not watcher-loop
+        # locals) so the SIGHUP path (reload_now) shares one digest state
+        # with the poll loop — a forced reload must not retrigger the
+        # change detector one interval later
+        self._watched_cert = _WatchedFile.of(tls_config.cert_file)
+        self._watched_key = _WatchedFile.of(tls_config.key_file)
+        self._watched_cas = [
+            _WatchedFile.of(p) for p in tls_config.client_ca_file
+        ]
 
     def _sni_callback(self, sslobj, server_name, _ctx):
         with self._lock:
@@ -168,47 +177,83 @@ class ReloadableTlsContext:
     # -- reload rules (certs.rs:86-161) -----------------------------------
 
     def start_watching(self) -> "ReloadableTlsContext":
-        cert = _WatchedFile.of(self.tls_config.cert_file)
-        key = _WatchedFile.of(self.tls_config.key_file)
-        cas = [_WatchedFile.of(p) for p in self.tls_config.client_ca_file]
-
         def loop() -> None:
             while not self._stop.wait(WATCH_INTERVAL_SECONDS):
-                cert_changed, key_changed = cert.changed(), key.changed()
-                if cert_changed and key_changed:
-                    try:
-                        self._reload_identity()
-                        cert.refresh()
-                        key.refresh()
-                        logger.info(
-                            "TLS server identity reloaded",
-                            extra={"span_fields": {"server_identity": True}},
-                        )
-                    except Exception as e:  # noqa: BLE001 — keep old identity
-                        logger.error(
-                            "TLS identity reload failed, keeping previous: %s", e
-                        )
-                # a single cert-or-key change is ignored until its pair
-                # arrives (certs.rs:135-150)
-                if any(ca.changed() for ca in cas):
-                    try:
-                        self._reload_client_cas()
-                        for ca in cas:
-                            ca.refresh()
-                        logger.info(
-                            "TLS client CAs reloaded",
-                            extra={"span_fields": {"client_cas": True}},
-                        )
-                    except Exception as e:  # noqa: BLE001 — keep old CAs
-                        logger.error(
-                            "TLS client-CA reload failed, keeping previous: %s", e
-                        )
+                self._check_files_once()
 
         self._thread = threading.Thread(
             target=loop, name="tls-cert-watcher", daemon=True
         )
         self._thread.start()
         return self
+
+    def _check_files_once(self) -> None:
+        """One poll-loop iteration: apply the reload rules to whatever
+        changed on disk (certs.rs:86-161). Also the SIGHUP entry via
+        reload_now()."""
+        cert, key = self._watched_cert, self._watched_key
+        if cert.changed() and key.changed():
+            try:
+                self._reload_identity()
+                cert.refresh()
+                key.refresh()
+                logger.info(
+                    "TLS server identity reloaded",
+                    extra={"span_fields": {"server_identity": True}},
+                )
+            except Exception as e:  # noqa: BLE001 — keep old identity
+                logger.error(
+                    "TLS identity reload failed, keeping previous: %s", e
+                )
+        # a single cert-or-key change is ignored until its pair
+        # arrives (certs.rs:135-150)
+        if any(ca.changed() for ca in self._watched_cas):
+            try:
+                self._reload_client_cas()
+                for ca in self._watched_cas:
+                    ca.refresh()
+                logger.info(
+                    "TLS client CAs reloaded",
+                    extra={"span_fields": {"client_cas": True}},
+                )
+            except Exception as e:  # noqa: BLE001 — keep old CAs
+                logger.error(
+                    "TLS client-CA reload failed, keeping previous: %s", e
+                )
+
+    def reload_now(self) -> None:
+        """Forced reload for the SIGHUP contract (server.py wires one
+        handler that drives BOTH this and the policy reload): attempt an
+        identity + client-CA reload immediately, regardless of the
+        change detector — a failed attempt keeps the last-good material
+        serving, exactly like the poll path. Unlike the poll path the
+        identity reloads even when only one of cert/key changed: the
+        operator explicitly signaled that rotation is complete."""
+        try:
+            self._reload_identity()
+            self._watched_cert.refresh()
+            self._watched_key.refresh()
+            logger.info(
+                "TLS server identity reloaded (SIGHUP)",
+                extra={"span_fields": {"server_identity": True}},
+            )
+        except Exception as e:  # noqa: BLE001 — keep old identity
+            logger.error(
+                "TLS identity reload failed, keeping previous: %s", e
+            )
+        if self.tls_config.client_ca_file:
+            try:
+                self._reload_client_cas()
+                for ca in self._watched_cas:
+                    ca.refresh()
+                logger.info(
+                    "TLS client CAs reloaded (SIGHUP)",
+                    extra={"span_fields": {"client_cas": True}},
+                )
+            except Exception as e:  # noqa: BLE001 — keep old CAs
+                logger.error(
+                    "TLS client-CA reload failed, keeping previous: %s", e
+                )
 
     def _with_identity_files(self, cert_bytes: bytes, key_bytes: bytes, fn):
         """Run ``fn(cert_path, key_path)`` against temp files holding the

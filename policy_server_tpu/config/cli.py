@@ -192,6 +192,39 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "template working set — the default 256Mi holds tens of "
                    "thousands of templates. 0 disables caching AND "
                    "in-batch row dedup")),
+        ("--policy-reload-mode", "KUBEWARDEN_POLICY_RELOAD_MODE",
+         dict(default="auto", metavar="MODE",
+              choices=["off", "auto", "manual"],
+              help="Zero-downtime policy hot reload (epoch-based, "
+                   "lifecycle.py): 'auto' fetches+compiles+warms a new "
+                   "policy set in the background on SIGHUP / policies-file "
+                   "change / POST /policies/reload, shadow-canaries it "
+                   "against the host oracle, and promotes atomically only "
+                   "on a clean canary (last-good keeps serving otherwise); "
+                   "'manual' stages the validated candidate for an "
+                   "explicit POST /policies/promote; 'off' freezes the "
+                   "policy set at boot (pre-round-9 behavior)")),
+        ("--reload-canary-requests", "KUBEWARDEN_RELOAD_CANARY_REQUESTS",
+         dict(type=int, default=64, metavar="N",
+              help="Shadow-canary replay budget: the candidate epoch "
+                   "replays up to N recently served requests (a bounded "
+                   "ring recorded at dispatch, plus one synthetic review "
+                   "per candidate policy) and cross-checks every verdict "
+                   "against the host oracle before promotion")),
+        ("--reload-divergence-threshold",
+         "KUBEWARDEN_RELOAD_DIVERGENCE_THRESHOLD",
+         dict(type=float, default=0.0, metavar="FRACTION",
+              help="Fraction of canary replays allowed to diverge from "
+                   "the host oracle before the candidate policy set is "
+                   "rejected (default 0.0: any divergence, trap, or "
+                   "canary timeout keeps last-good serving and increments "
+                   "the rollback counter)")),
+        ("--reload-admin-token", "KUBEWARDEN_RELOAD_ADMIN_TOKEN",
+         dict(default=None, metavar="TOKEN",
+              help="Bearer token authenticating the policy-lifecycle "
+                   "admin endpoints (POST /policies/reload, /policies/"
+                   "promote, /policies/rollback on the readiness port); "
+                   "unset disables them")),
         ("--mesh", "KUBEWARDEN_MESH",
          dict(default="auto", metavar="MESH_SPEC",
               help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),

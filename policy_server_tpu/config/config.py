@@ -205,6 +205,24 @@ class Config:
     # what to serve while EVERY shard's breaker is tripped:
     # oracle (bit-exact host verdicts) | monitor (accept-all) | reject (503)
     degraded_mode: str = "oracle"
+    # zero-downtime policy lifecycle (lifecycle.py): 'auto' promotes a
+    # canaried candidate epoch automatically, 'manual' stages it for an
+    # explicit POST /policies/promote, 'off' restores the frozen-at-boot
+    # policy set (no watcher, no admin endpoints, no SIGHUP reload)
+    policy_reload_mode: str = "auto"
+    # shadow-canary replay budget: ring-buffer capacity of recently
+    # served requests (plus one synthetic review per candidate policy)
+    reload_canary_requests: int = 64
+    # fraction of canary replays allowed to diverge from the host oracle
+    # before the candidate epoch is rejected (0.0 = any divergence
+    # rejects)
+    reload_divergence_threshold: float = 0.0
+    # bearer token for POST /policies/reload|promote|rollback on the
+    # readiness port; None disables the admin endpoints
+    reload_admin_token: str | None = None
+    # the on-disk policies file backing hot reload (None when the config
+    # was built programmatically — reloads then reuse the in-memory set)
+    policies_path: str | None = None
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -270,6 +288,17 @@ class Config:
             )
         if self.http_workers < 1:
             raise ValueError("--http-workers must be >= 1")
+        if self.policy_reload_mode not in ("off", "auto", "manual"):
+            raise ValueError(
+                f"invalid policy reload mode {self.policy_reload_mode!r} "
+                "(expected off, auto, or manual)"
+            )
+        if self.reload_canary_requests < 0:
+            raise ValueError("--reload-canary-requests must be >= 0")
+        if not (0.0 <= self.reload_divergence_threshold <= 1.0):
+            raise ValueError(
+                "--reload-divergence-threshold must be in [0, 1]"
+            )
         if self.distributed_coordinator is None:
             if (
                 self.distributed_num_processes is not None
@@ -368,6 +397,13 @@ class Config:
             breaker_window_seconds=float(args.breaker_window_seconds),
             breaker_cooldown_seconds=float(args.breaker_cooldown_seconds),
             degraded_mode=args.degraded_mode,
+            policy_reload_mode=args.policy_reload_mode,
+            reload_canary_requests=int(args.reload_canary_requests),
+            reload_divergence_threshold=float(
+                args.reload_divergence_threshold
+            ),
+            reload_admin_token=args.reload_admin_token or None,
+            policies_path=str(policies_path) if policies_path.exists() else None,
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

@@ -33,6 +33,16 @@ Sites instrumented (grep for ``failpoints.fire``):
                     failures are retryable, like a real 5xx/timeout
 ``certs.reload``    TLS identity reload (certs.py) — simulates corrupted
                     on-disk cert material mid-rotation
+``reload.fetch``    policy hot-reload fetch stage (lifecycle.py) —
+                    ``raise`` = unreadable/unfetchable policies config;
+                    the reload rejects and last-good keeps serving
+``reload.compile``  policy hot-reload compile+warm stage (lifecycle.py)
+                    — ``raise`` = a candidate set that fails to build;
+                    ``sleep`` = a compile stall (reload stays
+                    background; serving is untouched)
+``reload.canary``   policy hot-reload shadow canary (lifecycle.py) —
+                    ``raise`` = canary infrastructure fault; the
+                    candidate is rejected, never promoted
 ==================  =====================================================
 
 Every fire is counted (``fired_count(site)``) so chaos tests can assert

@@ -152,8 +152,14 @@ class MicroBatcher:
         latency_budget_ms: float = 50.0,
         request_timeout_ms: float = 0.0,
         degraded_mode: str = "oracle",
+        shadow_recorder: Any = None,
     ) -> None:
         self.env = env
+        # policy-lifecycle shadow recorder (lifecycle.ShadowRecorder):
+        # every formed batch's (policy_id, request) pairs feed the
+        # hot-reload canary's replay ring. None = disabled (no reload
+        # machinery); one deque-extend per BATCH, never per request.
+        self.shadow_recorder = shadow_recorder
         self.max_batch_size = max(1, int(max_batch_size))
         self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
         self.policy_timeout = policy_timeout
@@ -765,6 +771,13 @@ class MicroBatcher:
         with self._stats_lock:
             self.batches_dispatched += 1
             self.requests_dispatched += len(batch)
+        if self.shadow_recorder is not None:
+            try:
+                self.shadow_recorder.observe(
+                    [(p.policy_id, p.request) for p in batch]
+                )
+            except Exception:  # noqa: BLE001 — recording must not fail
+                pass  # the batch (canary corpus just stays smaller)
 
         # Phase 1 (host): pre-evaluation — id parse, namespace shortcut,
         # bounded pre-eval hooks. Items that short-circuit or fail resolve

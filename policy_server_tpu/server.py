@@ -53,15 +53,11 @@ class PolicyServer:
     def __init__(
         self,
         config: Config,
-        environment: EvaluationEnvironment,
-        batcher: MicroBatcher,
         state: ApiServerState,
         tls_context: ssl.SSLContext | None,
     ) -> None:
         self.config = config
-        self.environment = environment
-        self.batcher = batcher
-        self.state = state
+        self.state = state  # carries the serving epoch's env + batcher
         self.tls_context = tls_context
         self._ready = asyncio.Event()
         self._runners: list[web.AppRunner] = []
@@ -71,6 +67,22 @@ class PolicyServer:
         self._bridge = None
         self._worker_procs: list = []
         self._bridge_socket: str | None = None
+
+    # The serving environment/batcher are the CURRENT EPOCH's — a hot
+    # reload (lifecycle.py) rebinds the state fields, so everything that
+    # reads them through the server (tests, stop(), logging) follows the
+    # promoted epoch automatically.
+    @property
+    def environment(self) -> EvaluationEnvironment:
+        return self.state.evaluation_environment
+
+    @property
+    def batcher(self) -> MicroBatcher:
+        return self.state.batcher
+
+    @property
+    def lifecycle(self):
+        return self.state.lifecycle
 
     # -- bootstrap (lib.rs:75-236) -----------------------------------------
 
@@ -214,17 +226,33 @@ class PolicyServer:
         )
         environment = _build_environment(config, builder_kwargs)
 
-        batcher = MicroBatcher(
-            environment,
-            max_batch_size=config.max_batch_size,
-            batch_timeout_ms=config.batch_timeout_ms,
-            policy_timeout=config.policy_timeout,
-            queue_capacity=config.pool_size * config.max_batch_size,
-            host_fastpath_threshold=config.host_fastpath_threshold,
-            latency_budget_ms=config.latency_budget_ms,
-            request_timeout_ms=config.request_timeout_ms,
-            degraded_mode=config.degraded_mode,
-        )
+        # shadow recorder: the hot-reload canary's replay ring (every
+        # epoch's batcher feeds the SAME ring, so a reload replays the
+        # traffic the previous epoch actually served)
+        reload_enabled = config.policy_reload_mode != "off"
+        recorder = None
+        if reload_enabled:
+            from policy_server_tpu.lifecycle import ShadowRecorder
+
+            recorder = ShadowRecorder(capacity=config.reload_canary_requests)
+
+        def build_batcher(env) -> MicroBatcher:
+            """One batcher construction path for boot AND every reload
+            epoch — the knobs must not drift between generations."""
+            return MicroBatcher(
+                env,
+                max_batch_size=config.max_batch_size,
+                batch_timeout_ms=config.batch_timeout_ms,
+                policy_timeout=config.policy_timeout,
+                queue_capacity=config.pool_size * config.max_batch_size,
+                host_fastpath_threshold=config.host_fastpath_threshold,
+                latency_budget_ms=config.latency_budget_ms,
+                request_timeout_ms=config.request_timeout_ms,
+                degraded_mode=config.degraded_mode,
+                shadow_recorder=recorder,
+            )
+
+        batcher = build_batcher(environment)
         if config.warmup_at_boot and config.evaluation_backend == "jax":
             batcher.warmup()
         batcher.start()
@@ -234,12 +262,70 @@ class PolicyServer:
             batcher=batcher,
             hostname=config.hostname,
             enable_pprof=config.enable_pprof,
+            ready=not reload_enabled,  # lifecycle flips it below
+            admin_token=config.reload_admin_token,
         )
+
+        if reload_enabled:
+            import dataclasses
+
+            from policy_server_tpu.config.config import read_policies_file
+            from policy_server_tpu.lifecycle import PolicyLifecycleManager
+
+            def build_epoch_environment(policies):
+                return _build_environment(
+                    dataclasses.replace(config, policies=dict(policies)),
+                    builder_kwargs,
+                )
+
+            def build_oracle_environment(policies):
+                # the canary referee: the host-oracle backend over the
+                # SAME candidate set, sharing the boot module resolver
+                oracle_builder = EvaluationEnvironmentBuilder(
+                    backend="oracle",
+                    continue_on_errors=config.continue_on_errors,
+                    **builder_kwargs,
+                )
+                return oracle_builder.build(dict(policies))
+
+            read_policies = None
+            if config.policies_path:
+                path = config.policies_path
+
+                def read_policies():
+                    return read_policies_file(path)
+
+            state.lifecycle = PolicyLifecycleManager(
+                state=state,
+                build_environment=build_epoch_environment,
+                build_oracle_environment=build_oracle_environment,
+                build_batcher=build_batcher,
+                recorder=recorder,
+                read_policies=read_policies,
+                policies_path=config.policies_path,
+                mode=config.policy_reload_mode,
+                canary_requests=config.reload_canary_requests,
+                divergence_threshold=config.reload_divergence_threshold,
+                warmup=(
+                    config.warmup_at_boot
+                    and config.evaluation_backend == "jax"
+                ),
+            )
+            # first epoch = the boot build; flips state.ready (readiness
+            # honesty: compiled + warmed before the probe says 200)
+            state.lifecycle.install_first_epoch(
+                environment, batcher, config.policies
+            )
+            state.lifecycle.start_watching()
 
         def runtime_stats():
             # one locked snapshot per scrape: bare attribute reads from
             # here would be the cross-module dirty reads the batcher's
-            # guarded-by annotations forbid
+            # guarded-by annotations forbid. Read through STATE, not the
+            # bootstrap locals: a hot reload rebinds the epoch pointer,
+            # and the scrape must follow the serving epoch.
+            batcher = state.batcher
+            environment = state.evaluation_environment
             bstats = batcher.stats_snapshot()
             yield (
                 metrics_names.BATCHES_DISPATCHED, "counter",
@@ -407,6 +493,49 @@ class PolicyServer:
                 "Policy-fetch operations that exhausted the retry budget",
                 fetch_retries.get("giveups", 0),
             )
+            # Policy-lifecycle surface (round 9): hot-reload promotions,
+            # rejected candidates, rollbacks, canary volume, and the
+            # serving epoch — a bad policy push must be LOUD on the
+            # dashboard even though last-good kept serving
+            lstats = (
+                state.lifecycle.stats() if state.lifecycle is not None
+                else {}
+            )
+            yield (
+                metrics_names.POLICY_RELOADS, "counter",
+                "Policy hot-reload promotions (new epoch serving)",
+                lstats.get("reloads", 0),
+            )
+            yield (
+                metrics_names.POLICY_RELOAD_FAILURES, "counter",
+                "Policy reload candidates rejected (fetch/compile/canary "
+                "failure) — last-good kept serving",
+                lstats.get("reload_failures", 0),
+            )
+            yield (
+                metrics_names.POLICY_RELOAD_ROLLBACKS, "counter",
+                "Reverts to the last-good policy set: rejected canaries "
+                "plus explicit POST /policies/rollback",
+                lstats.get("rollbacks", 0),
+            )
+            yield (
+                metrics_names.RELOAD_CANARY_REPLAYS, "counter",
+                "Recorded/synthetic requests replayed through candidate "
+                "epochs during shadow canary",
+                lstats.get("canary_replays", 0),
+            )
+            yield (
+                metrics_names.RELOAD_CANARY_DIVERGENCES, "counter",
+                "Canary replays whose candidate verdict diverged from "
+                "the host oracle",
+                lstats.get("canary_divergences", 0),
+            )
+            yield (
+                metrics_names.POLICY_EPOCH, "gauge",
+                "Monotonic number of the currently serving policy epoch "
+                "(0 = the boot set)",
+                lstats.get("epoch", 0),
+            )
 
         from policy_server_tpu.telemetry import default_registry
 
@@ -427,7 +556,7 @@ class PolicyServer:
                 config.tls_config
             )
 
-        return cls(config, environment, batcher, state, tls_context)
+        return cls(config, state, tls_context)
 
     # -- routers (lib.rs:282 router(); used directly by in-process tests) --
 
@@ -656,10 +785,15 @@ class PolicyServer:
         for runner in self._runners:
             await runner.cleanup()
         self._runners.clear()
-        self.batcher.shutdown()
-        # The server built the environment, so the server closes it — the
-        # batcher only borrows it (two batchers may share one env).
-        self.environment.close()
+        if self.lifecycle is not None:
+            # the lifecycle manager owns every epoch (current, pinned
+            # previous, staged): one teardown path closes them all
+            self.lifecycle.shutdown()
+        else:
+            self.batcher.shutdown()
+            # The server built the environment, so the server closes it —
+            # the batcher only borrows it (two batchers may share one env).
+            self.environment.close()
         # Flush buffered spans / final metric state to the collector (the
         # reference flushes its OTEL providers on shutdown). No-op when the
         # OTLP pipeline was never installed.
@@ -667,11 +801,32 @@ class PolicyServer:
 
         otlp.shutdown_pipeline()
 
+    def reload_signal(self) -> None:
+        """The SIGHUP contract: ONE signal drives both hot-reload paths —
+        the TLS identity/client-CA reload (certs.py reload_now, forced
+        regardless of the change detector) and the policy-set reload
+        (lifecycle.py, background fetch+compile+canary). Both keep
+        last-good state on any failure, so a SIGHUP can never make the
+        server worse. Safe to invoke from a signal handler context: all
+        real work happens on daemon threads."""
+        reloadable = getattr(self.tls_context, "_reloadable", None)
+        if reloadable is not None:
+            import threading
+
+            threading.Thread(
+                target=reloadable.reload_now,
+                name="sighup-cert-reload",
+                daemon=True,
+            ).start()
+        if self.lifecycle is not None:
+            self.lifecycle.request_reload("sighup")
+
     async def run_async(self) -> None:
         """Serve until cancelled or signalled. SIGTERM/SIGINT trigger the
         same graceful stop (drain batcher futures, close the environment,
         flush OTLP) — a pod rolling update must not drop buffered spans or
-        strand in-flight webhook calls."""
+        strand in-flight webhook calls. SIGHUP triggers the combined
+        cert + policy hot reload (reload_signal)."""
         import signal
 
         await self.start()
@@ -684,6 +839,16 @@ class PolicyServer:
                 registered.append(sig)
             except (NotImplementedError, RuntimeError):
                 pass  # non-main thread / platform without signal support
+        # SIGHUP → hot reload, same off-main-thread guard as above (a
+        # server embedded in a thread simply has no signal trigger; the
+        # admin endpoint and file watcher still drive reloads)
+        sighup = getattr(signal, "SIGHUP", None)
+        if sighup is not None:
+            try:
+                loop.add_signal_handler(sighup, self.reload_signal)
+                registered.append(sighup)
+            except (NotImplementedError, RuntimeError):
+                pass
         try:
             await stop_requested.wait()
             logger.info("shutdown signal received, stopping gracefully")

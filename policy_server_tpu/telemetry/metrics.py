@@ -70,6 +70,12 @@ BREAKER_PROBES = "policy_server_breaker_probes"
 BREAKER_SHORT_CIRCUITED = "policy_server_breaker_short_circuited_requests"
 FETCH_RETRY_ATTEMPTS = "policy_server_fetch_retry_attempts"
 FETCH_RETRY_GIVEUPS = "policy_server_fetch_retry_giveups"
+POLICY_RELOADS = "policy_server_policy_reloads"
+POLICY_RELOAD_FAILURES = "policy_server_policy_reload_failures"
+POLICY_RELOAD_ROLLBACKS = "policy_server_policy_reload_rollbacks"
+RELOAD_CANARY_REPLAYS = "policy_server_reload_canary_replays"
+RELOAD_CANARY_DIVERGENCES = "policy_server_reload_canary_divergences"
+POLICY_EPOCH = "policy_server_policy_epoch"
 HOST_ENCODE_SECONDS = "policy_server_host_encode_seconds_total"
 HOST_ENCODE_ROWS = "policy_server_host_encode_rows_total"
 HOST_BOOKKEEPING_SECONDS = "policy_server_host_bookkeeping_seconds_total"

@@ -1,0 +1,655 @@
+"""Zero-downtime policy lifecycle (lifecycle.py): epoch-based hot reload
+with shadow canary and last-good rollback.
+
+The contract under test, end to end:
+
+* a reload builds + warms + canaries the NEW policy set entirely in the
+  background; promotion is an atomic epoch-pointer flip and the old
+  epoch stays pinned (environment open) for one generation;
+* a candidate that fails ANY pipeline stage — fetch, compile,
+  settings validation, canary trap/timeout/divergence — never serves a
+  single request: last-good keeps serving and the rollback counter
+  increments;
+* verdict-cache and circuit-breaker state are scoped per epoch (a new
+  set can never observe the old set's cached verdicts or trip state);
+* rollback revives the pinned epoch instantly (fresh batcher over the
+  still-open environment);
+* /readiness is honest: 503 before the first epoch, 200 on last-good
+  during a background reload, 503 under --degraded-mode reject with
+  every shard breaker open.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.api.service import RequestOrigin
+from policy_server_tpu.api.state import ApiServerState
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironmentBuilder,
+)
+from policy_server_tpu.lifecycle import (
+    PolicyLifecycleManager,
+    ReloadRejected,
+    ShadowRecorder,
+)
+from policy_server_tpu.models import (
+    AdmissionResponse,
+    AdmissionReviewRequest,
+    ValidateRequest,
+)
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.runtime.batcher import MicroBatcher
+
+from conftest import build_admission_review_dict
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def review(namespace: str | None = None) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    if namespace is not None:
+        doc["request"]["namespace"] = namespace
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def policies_v1() -> dict:
+    return {
+        "ns": parse_policy_entry(
+            "ns",
+            {
+                "module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": ["blocked"]},
+            },
+        ),
+    }
+
+
+def policies_v2() -> dict:
+    out = policies_v1()
+    out["happy"] = parse_policy_entry(
+        "happy", {"module": "builtin://always-happy"}
+    )
+    return out
+
+
+class Harness:
+    """A lifecycle manager over REAL jax/oracle environments, wired the
+    same way server.py wires it (shared recorder, per-epoch batchers)."""
+
+    def __init__(self, mode: str = "auto", divergence_threshold: float = 0.0,
+                 oracle_wrapper=None):
+        self.recorder = ShadowRecorder(capacity=16)
+        self.built_oracles: list = []
+        self._oracle_wrapper = oracle_wrapper
+
+        env0 = self.build_env(policies_v1())
+        batcher0 = self.build_batcher(env0)
+        batcher0.start()
+        self.state = ApiServerState(
+            evaluation_environment=env0, batcher=batcher0, ready=False
+        )
+        self.manager = PolicyLifecycleManager(
+            state=self.state,
+            build_environment=self.build_env,
+            build_oracle_environment=self.build_oracle,
+            build_batcher=self.build_batcher,
+            recorder=self.recorder,
+            mode=mode,
+            canary_requests=16,
+            divergence_threshold=divergence_threshold,
+            warmup=False,  # envs compile lazily on first canary dispatch
+        )
+        self.state.lifecycle = self.manager
+        self.epoch0 = self.manager.install_first_epoch(
+            env0, batcher0, policies_v1()
+        )
+
+    def build_env(self, policies):
+        return EvaluationEnvironmentBuilder(
+            backend="jax", verdict_cache_size=0
+        ).build(dict(policies))
+
+    def build_oracle(self, policies):
+        env = EvaluationEnvironmentBuilder(backend="oracle").build(
+            dict(policies)
+        )
+        if self._oracle_wrapper is not None:
+            env = self._oracle_wrapper(env)
+        self.built_oracles.append(env)
+        return env
+
+    def build_batcher(self, env):
+        return MicroBatcher(
+            env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=5.0,
+            host_fastpath_threshold=64, shadow_recorder=self.recorder,
+        )
+
+    def serve(self, policy_id: str, namespace: str | None = None):
+        return self.state.batcher.submit(
+            policy_id, review(namespace), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+
+    def close(self):
+        self.manager.shutdown()
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.close()
+
+
+# ---------------------------------------------------------------------------
+# Shadow recorder
+# ---------------------------------------------------------------------------
+
+
+def test_shadow_recorder_ring_is_bounded():
+    rec = ShadowRecorder(capacity=4)
+    for i in range(10):
+        rec.observe([(f"p{i}", object())])
+    snap = rec.snapshot()
+    assert len(snap) == 4
+    assert [pid for pid, _ in snap] == ["p6", "p7", "p8", "p9"]
+    assert len(rec) == 4
+
+
+def test_batcher_feeds_the_recorder(harness):
+    assert harness.serve("ns").allowed is True
+    assert any(pid == "ns" for pid, _ in harness.recorder.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# Reload pipeline: promote / reject / epoch scoping
+# ---------------------------------------------------------------------------
+
+
+def test_reload_promotes_new_epoch_atomically(harness):
+    # old set serves; the new policy does not exist yet
+    assert harness.serve("ns", namespace="blocked").allowed is False
+    old_env = harness.state.evaluation_environment
+    old_batcher = harness.state.batcher
+
+    assert harness.manager.reload(policies=policies_v2()) == "promoted"
+
+    # the epoch pointer flipped: new env + new batcher, new policy serves
+    assert harness.state.evaluation_environment is not old_env
+    assert harness.state.batcher is not old_batcher
+    assert harness.serve("happy").allowed is True
+    assert harness.serve("ns", namespace="blocked").allowed is False
+    stats = harness.manager.stats()
+    assert stats["reloads"] == 1 and stats["epoch"] == 1
+    assert stats["reload_failures"] == 0 and stats["rollbacks"] == 0
+    assert stats["canary_replays"] > 0
+
+    # epoch scoping: the breaker and cache are the NEW environment's own
+    new_env = harness.state.evaluation_environment
+    assert new_env.breaker is not old_env.breaker
+
+    # the demoted epoch is PINNED: its environment stays open (rollback
+    # target), even after its batcher drain-retires
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not old_batcher._stopping:
+        time.sleep(0.05)
+    assert old_batcher._stopping, "demoted batcher should drain-retire"
+    assert not old_env._closed, "pinned epoch env must stay open"
+
+
+def test_second_promotion_closes_the_epoch_beyond_the_pin(harness):
+    env0 = harness.state.evaluation_environment
+    harness.manager.reload(policies=policies_v2())
+    assert not env0._closed
+    harness.manager.reload(policies=policies_v1())
+    # epoch 0 fell past the one-generation pin window
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not env0._closed:
+        time.sleep(0.05)
+    assert env0._closed
+    # the middle epoch is now pinned and still open
+    assert harness.manager.stats()["epoch"] == 2
+
+
+@pytest.mark.parametrize("site,stage", [
+    ("reload.fetch", "fetch"),
+    ("reload.compile", "compile"),
+    ("reload.canary", "canary"),
+])
+def test_failed_stage_keeps_last_good_and_counts_rollback(
+    harness, site, stage
+):
+    """A candidate that fails ANY pipeline stage never serves: the
+    current epoch is untouched and the rollback counter is loud."""
+    failpoints.configure(f"{site}=raise:injected-reload-fault")
+    env_before = harness.state.evaluation_environment
+    with pytest.raises(ReloadRejected) as exc:
+        harness.manager.reload(policies=policies_v2())
+    assert exc.value.stage == stage
+    assert failpoints.fired_count(site) == 1
+    # last-good serving, bit-exact
+    assert harness.state.evaluation_environment is env_before
+    assert harness.serve("ns").allowed is True
+    assert harness.serve("ns", namespace="blocked").allowed is False
+    with pytest.raises(Exception):
+        harness.serve("happy")  # the rejected set never served
+    stats = harness.manager.stats()
+    assert stats["reload_failures"] == 1
+    assert stats["rollbacks"] == 1
+    assert stats["reloads"] == 0 and stats["epoch"] == 0
+
+
+def test_settings_validation_failure_rejects_at_compile(harness):
+    bad = {
+        "ns": parse_policy_entry(
+            "ns",
+            {
+                "module": "builtin://namespace-validate",
+                # denied_namespaces must be a list — settings validation
+                # rejects this before any program is built
+                "settings": {"denied_namespaces": 17},
+            },
+        )
+    }
+    with pytest.raises(ReloadRejected) as exc:
+        harness.manager.reload(policies=bad)
+    assert exc.value.stage == "compile"
+    assert harness.serve("ns").allowed is True
+    assert harness.manager.stats()["rollbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Shadow canary: divergence, threshold, timeout
+# ---------------------------------------------------------------------------
+
+
+class _FlippingOracle:
+    """An oracle whose every verdict disagrees with the candidate —
+    the worst possible policy push."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def validate_batch(self, pairs, run_hooks=True, prefer_host=False):
+        out = self._inner.validate_batch(pairs, run_hooks=run_hooks)
+        flipped = []
+        for r in out:
+            if isinstance(r, Exception):
+                flipped.append(r)
+            else:
+                flipped.append(
+                    AdmissionResponse(uid=r.uid, allowed=not r.allowed)
+                )
+        return flipped
+
+    def close(self):
+        self._inner.close()
+
+
+def test_canary_divergence_rejects_candidate():
+    h = Harness(oracle_wrapper=_FlippingOracle)
+    try:
+        with pytest.raises(ReloadRejected) as exc:
+            h.manager.reload(policies=policies_v2())
+        assert exc.value.stage == "canary"
+        assert "divergence" in str(exc.value)
+        stats = h.manager.stats()
+        assert stats["canary_divergences"] > 0
+        assert stats["rollbacks"] == 1 and stats["epoch"] == 0
+        # last-good serving
+        assert h.serve("ns").allowed is True
+    finally:
+        h.close()
+
+
+def test_divergence_threshold_tolerates_configured_fraction():
+    """threshold=1.0 admits any divergence level — the operator's knob
+    for sets that intentionally change verdicts."""
+    h = Harness(oracle_wrapper=_FlippingOracle, divergence_threshold=1.0)
+    try:
+        assert h.manager.reload(policies=policies_v2()) == "promoted"
+        assert h.manager.stats()["canary_divergences"] > 0
+        assert h.serve("happy").allowed is True
+    finally:
+        h.close()
+
+
+def test_hung_canary_rejects_by_timeout(harness):
+    harness.manager.canary_timeout_seconds = 0.3
+    failpoints.set_failpoint("reload.canary", lambda: time.sleep(5))
+    t0 = time.perf_counter()
+    with pytest.raises(ReloadRejected) as exc:
+        harness.manager.reload(policies=policies_v2())
+    assert exc.value.stage == "canary"
+    assert time.perf_counter() - t0 < 4.0
+    assert harness.serve("ns").allowed is True
+
+
+def test_slow_oracle_replay_rejected_by_timeout():
+    """The timeout bounds the WHOLE replay (candidate and oracle side):
+    a wedged comparison can never gate promotion forever."""
+    h = Harness()
+    try:
+        h.manager.canary_timeout_seconds = 0.3
+
+        real_validate = {}
+
+        def slow_oracle(env):
+            real = env.validate_batch
+
+            def slow(pairs, run_hooks=True, prefer_host=False):
+                time.sleep(5)
+                return real(pairs, run_hooks=run_hooks)
+
+            env.validate_batch = slow
+            real_validate["fn"] = real
+            return env
+
+        h._oracle_wrapper = slow_oracle
+        with pytest.raises(ReloadRejected) as exc:
+            h.manager.reload(policies=policies_v2())
+        assert exc.value.stage == "canary"
+        assert "hung candidate" in str(exc.value)
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# Manual mode + rollback
+# ---------------------------------------------------------------------------
+
+
+def test_manual_mode_stages_then_promotes():
+    h = Harness(mode="manual")
+    try:
+        assert h.manager.reload(policies=policies_v2()) == "staged"
+        # staged ≠ serving: the new policy is not reachable yet
+        with pytest.raises(Exception):
+            h.serve("happy")
+        assert h.manager.stats()["staged"] == 1
+        assert h.manager.stats()["epoch"] == 0
+        assert h.manager.promote_staged() == "promoted"
+        assert h.serve("happy").allowed is True
+        assert h.manager.stats()["epoch"] == 1
+        # nothing staged anymore
+        with pytest.raises(ReloadRejected):
+            h.manager.promote_staged()
+    finally:
+        h.close()
+
+
+def test_rollback_restores_previous_epoch(harness):
+    harness.manager.reload(policies=policies_v2())
+    assert harness.serve("happy").allowed is True
+    assert harness.manager.rollback() == "rolled-back"
+    # back on the v1 set: happy is gone, ns still bit-exact
+    with pytest.raises(Exception):
+        harness.serve("happy")
+    assert harness.serve("ns", namespace="blocked").allowed is False
+    stats = harness.manager.stats()
+    assert stats["rollbacks"] == 1 and stats["epoch"] == 0
+    # symmetric: the demoted (v2) epoch is pinned — roll forward again
+    assert harness.manager.rollback() == "rolled-back"
+    assert harness.serve("happy").allowed is True
+
+
+def test_rollback_without_previous_epoch_rejects(harness):
+    with pytest.raises(ReloadRejected):
+        harness.manager.rollback()
+
+
+def test_request_reload_coalesces(harness):
+    """Concurrent triggers coalesce onto one in-flight reload."""
+    release = __import__("threading").Event()
+    failpoints.set_failpoint("reload.fetch", lambda: release.wait(10))
+    try:
+        assert harness.manager.request_reload("t1") is True
+        time.sleep(0.1)
+        assert harness.manager.request_reload("t2") is False
+    finally:
+        release.set()
+    deadline = time.monotonic() + 10
+    while (
+        time.monotonic() < deadline
+        and harness.manager.stats()["reloads"] == 0
+    ):
+        time.sleep(0.05)
+    assert harness.manager.stats()["reloads"] == 1
+
+
+# ---------------------------------------------------------------------------
+# File-watch trigger
+# ---------------------------------------------------------------------------
+
+
+def test_policies_file_watch_triggers_reload(tmp_path, monkeypatch):
+    import yaml
+
+    from policy_server_tpu import lifecycle as lifecycle_mod
+    from policy_server_tpu.config.config import read_policies_file
+
+    monkeypatch.setattr(lifecycle_mod, "WATCH_INTERVAL_SECONDS", 0.05)
+    path = tmp_path / "policies.yml"
+    path.write_text(yaml.safe_dump(
+        {"ns": {"module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": ["blocked"]}}}
+    ))
+
+    h = Harness()
+    try:
+        h.manager._read_policies = lambda: read_policies_file(path)
+        h.manager._policies_path = str(path)
+        h.manager.start_watching()
+        time.sleep(0.2)  # watcher sees the initial digest
+        path.write_text(yaml.safe_dump(
+            {"ns": {"module": "builtin://namespace-validate",
+                    "settings": {"denied_namespaces": ["blocked"]}},
+             "happy": {"module": "builtin://always-happy"}}
+        ))
+        deadline = time.monotonic() + 15
+        while (
+            time.monotonic() < deadline
+            and h.manager.stats()["reloads"] == 0
+        ):
+            time.sleep(0.05)
+        assert h.manager.stats()["reloads"] == 1
+        assert h.serve("happy").allowed is True
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# Readiness honesty (ApiServerState.readiness)
+# ---------------------------------------------------------------------------
+
+
+class _FakeEnv:
+    breaker_all_open = False
+
+    def close(self):
+        pass
+
+
+class _FakeBatcher:
+    degraded_mode = "oracle"
+
+    def shutdown(self):
+        pass
+
+
+def test_readiness_honest_states():
+    env, batcher = _FakeEnv(), _FakeBatcher()
+    state = ApiServerState(
+        evaluation_environment=env, batcher=batcher, ready=False
+    )
+    assert state.readiness()[0] == 503  # first epoch not warmed yet
+    state.ready = True
+    assert state.readiness()[0] == 200
+    # degraded reject + every breaker open: the server would 503 every
+    # review, so readiness must say so
+    batcher.degraded_mode = "reject"
+    env.breaker_all_open = True
+    assert state.readiness()[0] == 503
+    # oracle mode keeps serving bit-exact host verdicts → still ready
+    batcher.degraded_mode = "oracle"
+    assert state.readiness()[0] == 200
+
+
+def test_readiness_stays_200_on_last_good_during_background_reload(harness):
+    """A background reload (even one that eventually fails) must not
+    un-ready the server: last-good serves throughout."""
+    harness.state.ready = True
+    release = __import__("threading").Event()
+    failpoints.set_failpoint("reload.compile", lambda: release.wait(10))
+    try:
+        assert harness.manager.request_reload("bg") is True
+        time.sleep(0.1)  # reload parked mid-compile
+        assert harness.state.readiness()[0] == 200
+        assert harness.serve("ns").allowed is True
+    finally:
+        release.set()
+
+
+def test_default_auto_mode_wires_lifecycle_into_server_config():
+    """Config defaults: hot reload on (auto), canary budget present, no
+    admin token (endpoints disabled), programmatic configs carry no
+    policies path (no watcher)."""
+    from policy_server_tpu.config.config import Config, TlsConfig
+
+    cfg = Config(policies={}, tls_config=TlsConfig())
+    assert cfg.policy_reload_mode == "auto"
+    assert cfg.reload_canary_requests == 64
+    assert cfg.reload_divergence_threshold == 0.0
+    assert cfg.reload_admin_token is None
+    assert cfg.policies_path is None
+    cfg.validate()
+    cfg.policy_reload_mode = "sometimes"
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening regressions (round 9)
+# ---------------------------------------------------------------------------
+
+
+def test_hung_canary_does_not_poison_the_next_reload(harness):
+    """A canary abandoned at its timeout runs on a throwaway daemon
+    thread: the NEXT reload gets a fresh one and must promote cleanly
+    (a fixed one-worker pool would stay wedged behind the hung replay
+    and time out every future canary)."""
+    harness.manager.canary_timeout_seconds = 0.3
+    failpoints.set_failpoint(
+        "reload.canary", lambda: time.sleep(5), count=1
+    )
+    with pytest.raises(ReloadRejected):
+        harness.manager.reload(policies=policies_v2())
+    # fault exhausted: the very next reload must succeed
+    assert harness.manager.reload(policies=policies_v2()) == "promoted"
+    assert harness.serve("happy").allowed is True
+
+
+def test_rollback_answers_409_during_inflight_reload(harness):
+    """The emergency endpoints never hang behind a compile: a rollback
+    racing an in-flight reload gets a bounded-wait rejection (HTTP 409)
+    instead of blocking for the whole pipeline."""
+    import threading as _threading
+
+    harness.manager._ADMIN_LOCK_TIMEOUT_SECONDS = 0.2
+    release = _threading.Event()
+    failpoints.set_failpoint("reload.compile", lambda: release.wait(10))
+    try:
+        assert harness.manager.request_reload("bg") is True
+        time.sleep(0.1)  # the reload holds _reload_lock mid-compile
+        with pytest.raises(ReloadRejected, match="in progress"):
+            harness.manager.rollback()
+    finally:
+        release.set()
+
+
+def test_corpus_synthetics_are_never_capped(harness):
+    """Every policy in the candidate set gets at least one canary
+    replay, regardless of --reload-canary-requests; the cap bounds only
+    the recorded-traffic portion (and 0 disables recorded replay, not
+    the cap)."""
+    for i in range(10):
+        harness.recorder.observe([("ns", review())])
+    harness.manager.canary_requests = 2
+    many = {
+        f"p{i}": parse_policy_entry(
+            f"p{i}", {"module": "builtin://always-happy"}
+        )
+        for i in range(5)
+    }
+    corpus = harness.manager._corpus(many)
+    assert len(corpus) == 2 + 5  # 2 recorded (capped) + one per policy
+    assert [pid for pid, _ in corpus[:2]] == ["ns", "ns"]
+    assert {pid for pid, _ in corpus[2:]} == set(many)
+
+    harness.manager.canary_requests = 0
+    corpus = harness.manager._corpus(many)
+    assert {pid for pid, _ in corpus} == set(many)  # synthetics only
+
+
+def test_file_watch_redetects_change_landing_during_inflight_reload(
+    tmp_path, monkeypatch
+):
+    """A policies.yml write landing while a reload is already in flight
+    must not be lost: the watcher re-detects it once the running reload
+    settles (the digest baseline only advances when a trigger lands)."""
+    import threading as _threading
+
+    import yaml
+
+    from policy_server_tpu import lifecycle as lifecycle_mod
+    from policy_server_tpu.config.config import read_policies_file
+
+    monkeypatch.setattr(lifecycle_mod, "WATCH_INTERVAL_SECONDS", 0.05)
+    path = tmp_path / "policies.yml"
+    path.write_text(yaml.safe_dump(
+        {"ns": {"module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": ["blocked"]}}}
+    ))
+    h = Harness()
+    try:
+        h.manager._read_policies = lambda: read_policies_file(path)
+        h.manager._policies_path = str(path)
+        h.manager.start_watching()
+        time.sleep(0.2)
+
+        # park a reload mid-compile, then write the REAL change
+        release = _threading.Event()
+        failpoints.set_failpoint(
+            "reload.compile", lambda: release.wait(15), count=1
+        )
+        assert h.manager.request_reload("occupant") is True
+        time.sleep(0.1)
+        path.write_text(yaml.safe_dump(
+            {"ns": {"module": "builtin://namespace-validate",
+                    "settings": {"denied_namespaces": ["blocked"]}},
+             "happy": {"module": "builtin://always-happy"}}
+        ))
+        time.sleep(0.3)  # watcher ticks see the change but cannot land it
+        release.set()  # the occupant reload finishes (old content)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                if h.serve("happy").allowed is True:
+                    break
+            except Exception:
+                time.sleep(0.1)
+        assert h.serve("happy").allowed is True, (
+            "the change written during the in-flight reload was lost"
+        )
+    finally:
+        h.close()

@@ -144,6 +144,14 @@ def test_dedup_and_pipeline_counters_after_served_batch(server):
     assert "policy_server_breaker_short_circuited_requests_total" in m
     assert "policy_server_fetch_retry_attempts_total" in m
     assert "policy_server_fetch_retry_giveups_total" in m
+    # round-9 policy-lifecycle surface: reload counters + epoch gauge
+    # scrape (zero on a boot set; the lifecycle chaos tests move them)
+    assert m["policy_server_policy_reloads_total"] == 0
+    assert m["policy_server_policy_reload_failures_total"] == 0
+    assert m["policy_server_policy_reload_rollbacks_total"] == 0
+    assert m["policy_server_policy_epoch"] == 0
+    assert "policy_server_reload_canary_replays_total" in m
+    assert "policy_server_reload_canary_divergences_total" in m
 
 
 def test_counters_survive_otlp_conversion(server):
@@ -164,5 +172,9 @@ def test_counters_survive_otlp_conversion(server):
         metrics_mod.DISPATCH_WAIT_SECONDS,
         metrics_mod.DISPATCHED_ROWS,
         metrics_mod.VERDICT_CACHE_BYTES,
+        metrics_mod.POLICY_RELOADS,
+        metrics_mod.POLICY_RELOAD_ROLLBACKS,
+        metrics_mod.RELOAD_CANARY_REPLAYS,
+        metrics_mod.POLICY_EPOCH,
     ):
         assert any(expected in n for n in names), (expected, names)

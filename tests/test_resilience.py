@@ -708,3 +708,182 @@ def test_shutdown_under_load_through_real_server():
     # a shutdown 503-in-200) — none hung past stop
     assert len(results) == 4
     assert all(isinstance(code, int) for code in results), results
+
+
+# ---------------------------------------------------------------------------
+# Policy hot reload under load (round 9): zero drops, bit-exact, and a
+# bad push never serves (lifecycle.py; failpoints reload.*)
+# ---------------------------------------------------------------------------
+
+
+def _lifecycle_config():
+    from policy_server_tpu.models.policy import parse_policy_entry as ppe
+    from test_server import make_config
+
+    policies = {
+        "pod-privileged": ppe(
+            "pod-privileged", {"module": "builtin://pod-privileged"}
+        ),
+    }
+    return make_config(
+        policies=policies,
+        policy_timeout_seconds=5.0,
+        max_batch_size=4,
+        reload_admin_token="chaos-token",
+    ), policies
+
+
+def test_hot_reload_under_load_zero_drops_bit_exact():
+    """The acceptance scenario: sustained traffic across >=3 back-to-back
+    hot reloads with ZERO non-2xx responses and bit-exact verdicts (a
+    privileged pod always denies, an unprivileged one always allows —
+    through every swap), the epoch gauge advancing each promotion, and a
+    subsequent bad-policy push (injected compile fault, then a canary
+    fault) leaving last-good serving with the rollback counter
+    incremented."""
+    import requests as rq
+
+    from policy_server_tpu.models.policy import parse_policy_entry as ppe
+    from test_server import ServerHandle, pod_review_body
+
+    config, policies = _lifecycle_config()
+    handle = ServerHandle(config)
+    lifecycle = handle.server.lifecycle
+    stop = threading.Event()
+    results: list[tuple[int, bool | None, bool]] = []
+    errors: list[Exception] = []
+
+    def traffic(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            privileged = (i + worker) % 2 == 0
+            i += 1
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(privileged), timeout=30,
+                )
+                allowed = (
+                    r.json()["response"]["allowed"]
+                    if r.status_code == 200 else None
+                )
+                results.append((r.status_code, allowed, privileged))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=traffic, args=(w,), daemon=True)
+        for w in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # traffic flowing before the first swap
+
+        # three back-to-back reloads under load, alternating the set so
+        # every swap is a REAL rebuild (policy added / removed / added)
+        extra = dict(policies)
+        extra["happy"] = ppe("happy", {"module": "builtin://always-happy"})
+        for reload_no, policy_set in enumerate(
+            (extra, policies, extra), start=1
+        ):
+            assert lifecycle.reload(policies=policy_set) == "promoted"
+            assert lifecycle.stats()["epoch"] == reload_no
+            time.sleep(0.2)  # traffic rides the fresh epoch between swaps
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, f"transport-level failures under reload: {errors}"
+        assert len(results) > 20, "traffic generator barely ran"
+        # ZERO dropped/erroneous responses across every swap...
+        non_2xx = [r for r in results if r[0] != 200]
+        assert not non_2xx, f"non-2xx under reload: {non_2xx[:5]}"
+        # ...and every verdict bit-exact wrt the policy semantics
+        for status, allowed, privileged in results:
+            assert allowed == (not privileged), (status, allowed, privileged)
+
+        stats = lifecycle.stats()
+        assert stats["reloads"] == 3
+        assert stats["reload_failures"] == 0 and stats["rollbacks"] == 0
+
+        # -- bad-policy pushes: compile fault, then canary fault ----------
+        from policy_server_tpu.lifecycle import ReloadRejected
+
+        failpoints.configure("reload.compile=raise:injected-bad-compile*1")
+        with pytest.raises(ReloadRejected):
+            lifecycle.reload(policies=extra)
+        assert failpoints.fired_count("reload.compile") == 1
+
+        failpoints.configure("reload.canary=raise:injected-canary-fault*1")
+        with pytest.raises(ReloadRejected):
+            lifecycle.reload(policies=extra)
+        assert failpoints.fired_count("reload.canary") == 1
+
+        failpoints.configure("reload.fetch=raise:injected-fetch-fault*1")
+        with pytest.raises(ReloadRejected):
+            lifecycle.reload(policies=extra)
+        assert failpoints.fired_count("reload.fetch") == 1
+
+        stats = lifecycle.stats()
+        assert stats["rollbacks"] == 3 and stats["reload_failures"] == 3
+        assert stats["epoch"] == 3  # last-good: the third promoted epoch
+
+        # last-good keeps serving bit-exactly after every rejection
+        r = rq.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(True), timeout=30,
+        )
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is False
+        r = rq.post(
+            handle.url("/validate/happy"),
+            json=pod_review_body(False), timeout=30,
+        )
+        assert r.status_code == 200  # the promoted epoch's added policy
+        assert r.json()["response"]["allowed"] is True
+    finally:
+        stop.set()
+        handle.stop()
+
+
+def test_reload_counters_reach_metrics_endpoint():
+    """All reload counters + the epoch gauge are operator-visible on the
+    Prometheus pull endpoint after real promotions and rejections."""
+    import requests as rq
+
+    from policy_server_tpu.models.policy import parse_policy_entry as ppe
+    from policy_server_tpu.lifecycle import ReloadRejected
+    from test_server import ServerHandle
+
+    config, policies = _lifecycle_config()
+    handle = ServerHandle(config)
+    try:
+        lifecycle = handle.server.lifecycle
+        extra = dict(policies)
+        extra["happy"] = ppe("happy", {"module": "builtin://always-happy"})
+        assert lifecycle.reload(policies=extra) == "promoted"
+        failpoints.configure("reload.compile=raise:injected*1")
+        with pytest.raises(ReloadRejected):
+            lifecycle.reload(policies=policies)
+
+        r = rq.get(handle.readiness_url("/metrics"), timeout=10)
+        assert r.status_code == 200
+        metrics: dict[str, float] = {}
+        for line in r.text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, _, value = line.rpartition(" ")
+            try:
+                metrics[name.split("{")[0].strip()] = float(value)
+            except ValueError:
+                continue
+        assert metrics["policy_server_policy_reloads_total"] == 1
+        assert metrics["policy_server_policy_reload_failures_total"] == 1
+        assert metrics["policy_server_policy_reload_rollbacks_total"] == 1
+        assert metrics["policy_server_policy_epoch"] == 1
+        assert metrics["policy_server_reload_canary_replays_total"] > 0
+        assert "policy_server_reload_canary_divergences_total" in metrics
+    finally:
+        handle.stop()

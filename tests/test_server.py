@@ -10,6 +10,7 @@ import asyncio
 import base64
 import json
 import threading
+import time
 
 import pytest
 import requests
@@ -274,3 +275,175 @@ def test_pprof_endpoints(server):
     assert r.status_code == 200
     doc = r.json()
     assert "devices" in doc and len(doc["devices"]) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Policy lifecycle over HTTP (round 9): admin auth, SIGHUP, readiness,
+# worker-respawn epoch coherence
+# ---------------------------------------------------------------------------
+
+
+def test_admin_endpoints_disabled_without_token(server):
+    """The lifecycle manager is wired (default --policy-reload-mode auto)
+    but no --reload-admin-token is configured: every admin endpoint is a
+    403, token or not."""
+    for path in ("/policies/reload", "/policies/promote",
+                 "/policies/rollback"):
+        r = requests.post(server.readiness_url(path), timeout=10)
+        assert r.status_code == 403, path
+        r = requests.post(
+            server.readiness_url(path),
+            headers={"Authorization": "Bearer guess"}, timeout=10,
+        )
+        assert r.status_code == 403, path
+
+
+def test_sighup_drives_policy_reload(server):
+    """The SIGHUP contract: one handler (reload_signal) drives the policy
+    reload (and the cert reload when TLS is on). The reload runs in the
+    background; readiness stays 200 on last-good throughout, and the
+    epoch advances on promotion."""
+    lifecycle = server.server.lifecycle
+    assert lifecycle is not None
+    before = lifecycle.stats()["reloads"]
+    server.server.reload_signal()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if lifecycle.stats()["reloads"] > before:
+            break
+        r = requests.get(server.readiness_url("/readiness"), timeout=10)
+        assert r.status_code == 200  # last-good stays ready mid-reload
+        time.sleep(0.2)
+    stats = lifecycle.stats()
+    assert stats["reloads"] == before + 1
+    assert stats["reload_failures"] == 0
+    # the promoted epoch serves the same set bit-exactly
+    r = requests.post(
+        server.url("/validate/pod-privileged"), json=pod_review_body(True),
+        timeout=30,
+    )
+    assert r.status_code == 200
+    assert r.json()["response"]["allowed"] is False
+
+
+def test_run_async_signal_registration_safe_off_main_thread():
+    """run_async registers SIGTERM/SIGINT/SIGHUP through the event loop;
+    on a non-main thread that raises, and the guard must swallow it —
+    the server serves anyway (admin endpoint + watcher still drive
+    reloads)."""
+    import asyncio as aio
+
+    server = PolicyServer.new_from_config(
+        make_config(policies={
+            "pod-privileged": parse_policy_entry(
+                "pod-privileged", {"module": "builtin://pod-privileged"}
+            ),
+        })
+    )
+    loop = aio.new_event_loop()
+    task_box: dict = {}
+
+    def run() -> None:
+        aio.set_event_loop(loop)
+        task_box["task"] = loop.create_task(server.run_async())
+        try:
+            loop.run_until_complete(task_box["task"])
+        except aio.CancelledError:
+            pass
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and server.api_port is None:
+            time.sleep(0.05)
+        assert server.api_port is not None, "server failed to start"
+        r = requests.post(
+            f"http://127.0.0.1:{server.api_port}/validate/pod-privileged",
+            json=pod_review_body(False), timeout=30,
+        )
+        assert r.status_code == 200
+    finally:
+        loop.call_soon_threadsafe(task_box["task"].cancel)
+        thread.join(timeout=30)
+    assert not thread.is_alive(), "run_async did not stop after cancel"
+
+
+def test_worker_respawn_serves_promoted_epoch():
+    """Satellite: a prefork frontend worker that dies and respawns
+    mid-swap must come back serving the PROMOTED epoch, never the
+    retired one — workers are stateless (they bridge to the evaluation
+    process, whose epoch pointer the reload flips), and the respawned
+    worker must inherit that. Also covers the authenticated admin
+    reload endpoint (202 + bearer token)."""
+    policies = {
+        "pod-privileged": parse_policy_entry(
+            "pod-privileged", {"module": "builtin://pod-privileged"}
+        ),
+    }
+    handle = ServerHandle(make_config(
+        policies=policies,
+        http_workers=3,
+        policy_timeout_seconds=5.0,
+        reload_admin_token="resp-token",
+    ))
+    try:
+        # wait for the worker processes to bind the shared port
+        deadline = time.time() + 30
+        while (
+            time.time() < deadline
+            and len(handle.server._worker_procs) < 2
+        ):
+            time.sleep(0.05)
+        assert len(handle.server._worker_procs) == 2
+
+        # promote a new epoch that ADDS a policy, via the authenticated
+        # admin endpoint (the HTTP trigger is async: poll the epoch)
+        new_policies = dict(policies)
+        new_policies["happy"] = parse_policy_entry(
+            "happy", {"module": "builtin://always-happy"}
+        )
+        lifecycle = handle.server.lifecycle
+        # kill a worker, then promote while its slot is respawning — the
+        # respawn must come back on the promoted epoch
+        victim = handle.server._worker_procs[0]
+        victim.kill()
+        r = requests.post(
+            handle.readiness_url("/policies/reload"),
+            headers={"Authorization": "Bearer resp-token"}, timeout=10,
+        )
+        assert r.status_code == 202  # trigger accepted (coalesced reload)
+        # drive the actual swap deterministically with the new set
+        assert lifecycle.reload(policies=new_policies) == "promoted"
+        assert lifecycle.stats()["epoch"] >= 1
+
+        # wait until the killed slot respawned (supervise interval 2 s)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            procs = handle.server._worker_procs
+            if all(p is not None and p.poll() is None for p in procs):
+                break
+            time.sleep(0.1)
+        procs = handle.server._worker_procs
+        assert all(p is not None and p.poll() is None for p in procs), (
+            "worker was not respawned"
+        )
+
+        # every process behind the SO_REUSEPORT pool — the survivor, the
+        # main process, and the RESPAWNED worker — must serve the
+        # promoted epoch: the new policy answers on every connection
+        for i in range(20):
+            r = requests.post(
+                handle.url("/validate/happy"), json=pod_review_body(False),
+                timeout=30,
+            )
+            assert r.status_code == 200, (i, r.status_code, r.text)
+            assert r.json()["response"]["allowed"] is True
+        # and the retired epoch's set still answers bit-exactly too
+        r = requests.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(True), timeout=30,
+        )
+        assert r.json()["response"]["allowed"] is False
+    finally:
+        handle.stop()
