@@ -199,6 +199,18 @@ async def audit_handler(request: web.Request) -> web.Response:
         return web.json_response(AdmissionReviewResponse(result).to_dict())
 
 
+async def audit_reports_handler(request: web.Request) -> web.Response:
+    """GET /audit/reports[/{namespace}] — the background audit scanner's
+    PolicyReport-style output (round 10): per-resource × per-policy raw
+    verdicts stamped with the policy epoch that produced them, plus
+    summary counters and scanner freshness. 404 when --audit-mode off."""
+    state = request.app[STATE_KEY]
+    if state.audit is None:
+        return api_error(404, "the background audit scanner is disabled")
+    namespace = request.match_info.get("namespace")
+    return web.json_response(state.audit.report_payload(namespace))
+
+
 async def validate_raw_handler(request: web.Request) -> web.Response:
     state = request.app[STATE_KEY]
     policy_id = request.match_info["policy_id"]
@@ -365,6 +377,10 @@ def build_router(state: ApiServerState) -> web.Application:
     app[STATE_KEY] = state
     app.router.add_post("/validate/{policy_id}", validate_handler)
     app.router.add_post("/validate_raw/{policy_id}", validate_raw_handler)
+    # literal /audit/reports routes BEFORE the /audit/{policy_id}
+    # wildcard so the report listing wins path resolution
+    app.router.add_get("/audit/reports", audit_reports_handler)
+    app.router.add_get("/audit/reports/{namespace}", audit_reports_handler)
     app.router.add_post("/audit/{policy_id}", audit_handler)
     if state.enable_pprof:
         app.router.add_get("/debug/pprof/cpu", pprof_cpu_handler)
@@ -384,4 +400,9 @@ def build_readiness_router(state: ApiServerState) -> web.Application:
     app.router.add_post("/policies/reload", policies_reload_handler)
     app.router.add_post("/policies/promote", policies_promote_handler)
     app.router.add_post("/policies/rollback", policies_rollback_handler)
+    # audit reports ALSO on the readiness port: always served by the
+    # main process (prefork workers only proxy the validate/audit POST
+    # surface), cluster-internal like /metrics
+    app.router.add_get("/audit/reports", audit_reports_handler)
+    app.router.add_get("/audit/reports/{namespace}", audit_reports_handler)
     return app

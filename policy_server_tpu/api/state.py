@@ -32,6 +32,9 @@ class ApiServerState:
     lifecycle: Any = None
     # bearer token gating the /policies/* admin endpoints; None disables
     admin_token: str | None = None
+    # the background audit scanner (audit.AuditScanner); None when
+    # --audit-mode off — the GET /audit/reports endpoints then 404
+    audit: Any = None
 
     def readiness(self) -> tuple[int, str]:
         """The /readiness verdict (status code, body text). Honest on

@@ -1,0 +1,20 @@
+"""In-process background audit scanner (round 10).
+
+The reference relies on an external companion (Kubewarden's
+audit-scanner) to continuously replay existing cluster resources through
+the policy set; this package keeps that loop in-process, riding the
+micro-batcher's best-effort audit lane so live admission traffic
+strictly preempts it. See scanner.py for the full contract.
+"""
+
+from policy_server_tpu.audit.reports import PolicyReportStore
+from policy_server_tpu.audit.scanner import AUDIT_MODES, AuditScanner
+from policy_server_tpu.audit.snapshot import SnapshotStore, resource_key
+
+__all__ = [
+    "AUDIT_MODES",
+    "AuditScanner",
+    "PolicyReportStore",
+    "SnapshotStore",
+    "resource_key",
+]

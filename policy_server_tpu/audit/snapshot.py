@@ -1,0 +1,233 @@
+"""Snapshot store — the audit scanner's view of the cluster.
+
+The reference delegates continuous re-scanning to an external companion
+(Kubewarden's audit-scanner) that LISTs cluster resources and replays
+them through ``POST /audit/{policy_id}``. This build keeps the scan
+in-process, so it needs its own resource inventory. Two feeds populate
+it:
+
+* **Dirty-set tracking** — every object served through ``/validate`` is
+  recorded per formed batch by :class:`~policy_server_tpu.runtime.
+  batcher.MicroBatcher` (the same one-call-per-batch discipline as the
+  round-9 shadow-canary ring), keyed by GVK + namespace + name so a
+  later admission of the same object SUPERSEDES the earlier snapshot —
+  the store always holds the newest served generation. A ``DELETE``
+  admission evicts the key (the object is gone; re-auditing it would
+  report on a resource the cluster no longer has).
+* **File seeding** (``--audit-resources-file``) — a YAML/JSON list of
+  Kubernetes objects (or a ``List``-style ``{items: [...]}`` document)
+  synthesized into CREATE admission reviews, the stand-in for the
+  companion scanner's initial cluster LIST when no traffic has been
+  served yet.
+
+Rows are kept payload-encoded (``ValidateRequest.payload_json`` is
+memoized, and the live path computed it already), so a sweep re-submits
+pre-encoded rows and the verdict-cache/dedup tiers make re-scans of
+unchanged objects nearly free. Memory is bounded by
+``--audit-max-snapshot-bytes`` with LRU eviction on the recording order.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Iterable
+
+from policy_server_tpu.models import (
+    AdmissionRequest,
+    GroupVersionKind,
+    ValidateRequest,
+)
+from policy_server_tpu.telemetry.tracing import logger
+
+
+def resource_key(request: ValidateRequest) -> str | None:
+    """GVK + namespace + name identity of the object an admission review
+    targets; ``None`` for rows the store cannot track (raw requests,
+    nameless reviews with no uid to fall back on)."""
+    adm = request.admission_request
+    if adm is None:
+        return None
+    kind = adm.kind or GroupVersionKind()
+    name = adm.name or adm.uid
+    if not name:
+        return None
+    return "/".join(
+        (kind.group, kind.version, kind.kind, adm.namespace or "", name)
+    )
+
+
+class SnapshotStore:
+    """Bounded, dirty-tracking inventory of cluster resources as
+    admission requests (see module docstring). Thread-safe: the
+    micro-batcher records from its dispatch workers while the scanner
+    collects from its sweep thread."""
+
+    def __init__(self, max_bytes: int = 64 * 1024 * 1024):
+        self.max_bytes = max(0, int(max_bytes))
+        self._lock = threading.Lock()
+        # key -> (request, nbytes); insertion order is the LRU axis
+        self._rows: collections.OrderedDict[
+            str, tuple[ValidateRequest, int]
+        ] = collections.OrderedDict()  # guarded-by: _lock
+        self._dirty: set[str] = set()  # guarded-by: _lock
+        # keys evicted by an observed DELETE since the last sweep — the
+        # scanner drains these to prune the objects' report rows
+        self._pending_deletions: set[str] = set()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._recorded = 0  # guarded-by: _lock
+        self._superseded = 0  # guarded-by: _lock
+        self._evicted = 0  # guarded-by: _lock
+        self._deleted = 0  # guarded-by: _lock
+
+    # -- recording (the batcher's dirty-set tracker) -----------------------
+
+    def observe(self, requests: Iterable[ValidateRequest]) -> None:
+        """Record a batch of served ``/validate`` requests. Called once
+        per formed batch from the dispatch worker — sizes are computed
+        OUTSIDE the lock (payload_json is memoized; the encoder reuses
+        it, so this is not wasted work)."""
+        prepared: list[tuple[str, ValidateRequest | None, int]] = []
+        for request in requests:
+            key = resource_key(request)
+            if key is None:
+                continue
+            adm = request.admission_request
+            if adm is not None and (adm.operation or "").upper() == "DELETE":
+                prepared.append((key, None, 0))
+                continue
+            prepared.append((key, request, len(request.payload_json())))
+        if not prepared:
+            return
+        with self._lock:
+            for key, request, nbytes in prepared:
+                if request is None:
+                    old = self._rows.pop(key, None)
+                    if old is not None:
+                        self._bytes -= old[1]
+                        self._deleted += 1
+                    self._dirty.discard(key)
+                    self._pending_deletions.add(key)
+                    continue
+                self._pending_deletions.discard(key)  # re-created object
+                old = self._rows.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[1]
+                    self._superseded += 1
+                self._rows[key] = (request, nbytes)
+                self._bytes += nbytes
+                self._recorded += 1
+                self._dirty.add(key)
+            self._evict_over_budget_locked()
+
+    def _evict_over_budget_locked(self) -> None:
+        # holds: _lock
+        if self.max_bytes <= 0:
+            return
+        while self._bytes > self.max_bytes and self._rows:
+            key, (_req, nbytes) = self._rows.popitem(last=False)
+            self._bytes -= nbytes
+            self._dirty.discard(key)
+            self._evicted += 1
+
+    # -- seeding -----------------------------------------------------------
+
+    def seed_from_file(self, path: str) -> int:
+        """Load a YAML/JSON resources file (a list of objects or a
+        ``{items: [...]}`` List document) and record one synthetic
+        CREATE review per object. Returns the number of rows seeded."""
+        import yaml
+
+        with open(path, "r", encoding="utf-8") as f:
+            doc = yaml.safe_load(f)
+        if isinstance(doc, dict) and "items" in doc:
+            objects = doc["items"]
+        elif isinstance(doc, list):
+            objects = doc
+        else:
+            raise ValueError(
+                f"audit resources file {path!r} must hold a list of "
+                "objects or a List document with an 'items' field"
+            )
+        seeded = 0
+        batch: list[ValidateRequest] = []
+        for i, obj in enumerate(objects):
+            req = self._synthesize(obj, i)
+            if req is not None:
+                batch.append(req)
+                seeded += 1
+        self.observe(batch)
+        logger.info(
+            "audit snapshot seeded from resources file",
+            extra={"span_fields": {"path": path, "resources": seeded}},
+        )
+        return seeded
+
+    @staticmethod
+    def _synthesize(obj: Any, index: int) -> ValidateRequest | None:
+        if not isinstance(obj, dict) or "kind" not in obj:
+            return None
+        api_version = obj.get("apiVersion", "v1") or "v1"
+        group, _, version = api_version.rpartition("/")
+        meta = obj.get("metadata") or {}
+        gvk = GroupVersionKind(
+            group=group, version=version, kind=obj.get("kind", "")
+        )
+        req = AdmissionRequest(
+            uid=f"audit-seed-{index}",
+            kind=gvk,
+            name=meta.get("name") or f"audit-seed-{index}",
+            namespace=meta.get("namespace"),
+            operation="CREATE",
+            user_info={"username": "system:policy-server-audit"},
+            object=obj,
+            dry_run=True,
+        )
+        return ValidateRequest.from_admission(req)
+
+    # -- collection (the scanner's sweep feed) -----------------------------
+
+    def collect(
+        self, dirty_only: bool = False
+    ) -> list[tuple[str, ValidateRequest]]:
+        """Snapshot the sweep corpus and clear the dirty set: the FULL
+        inventory, or only the keys touched since the last collect.
+        A failed sweep re-marks its unscanned keys via
+        :meth:`remark_dirty` so the next sweep picks them back up."""
+        with self._lock:
+            if dirty_only:
+                keys = [k for k in self._dirty if k in self._rows]
+            else:
+                keys = list(self._rows)
+            self._dirty.clear()
+            return [(k, self._rows[k][0]) for k in keys]
+
+    def remark_dirty(self, keys: Iterable[str]) -> None:
+        with self._lock:
+            self._dirty.update(k for k in keys if k in self._rows)
+
+    def take_deletions(self) -> set[str]:
+        """Drain the keys evicted by observed DELETEs since the last
+        call — the scanner prunes their report rows."""
+        with self._lock:
+            out = self._pending_deletions
+            self._pending_deletions = set()
+            return out
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "resources": len(self._rows),
+                "bytes": self._bytes,
+                "dirty": len(self._dirty),
+                "recorded": self._recorded,
+                "superseded": self._superseded,
+                "evicted": self._evicted,
+                "deleted": self._deleted,
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)

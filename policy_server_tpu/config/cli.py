@@ -219,6 +219,41 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "rejected (default 0.0: any divergence, trap, or "
                    "canary timeout keeps last-good serving and increments "
                    "the rollback counter)")),
+        ("--audit-mode", "KUBEWARDEN_AUDIT_MODE",
+         dict(default="off", metavar="MODE",
+              choices=["off", "interval", "on-promote"],
+              help="Background audit scanner (audit/scanner.py): "
+                   "continuously re-scans a snapshot of cluster resources "
+                   "(seeded from --audit-resources-file and from every "
+                   "object served through /validate) through the live "
+                   "policy epoch on the micro-batcher's best-effort lane "
+                   "— live traffic strictly preempts audit work. "
+                   "'interval' sweeps the dirty set on a cadence and "
+                   "fully on every policy-epoch promotion; 'on-promote' "
+                   "sweeps fully on epoch flips only; 'off' disables the "
+                   "scanner and the GET /audit/reports endpoints")),
+        ("--audit-interval-seconds", "KUBEWARDEN_AUDIT_INTERVAL_SECONDS",
+         dict(type=float, default=30.0, metavar="SECONDS",
+              help="Dirty-set sweep cadence for --audit-mode interval "
+                   "(objects served through /validate since the last "
+                   "sweep are re-judged)")),
+        ("--audit-batch-size", "KUBEWARDEN_AUDIT_BATCH_SIZE",
+         dict(type=int, default=256, metavar="N",
+              help="Rows per best-effort audit-lane batch (audit rides "
+                   "idle device slots in large batches; at most one "
+                   "audit dispatch is ever in flight)")),
+        ("--audit-max-snapshot-bytes", "KUBEWARDEN_AUDIT_MAX_SNAPSHOT_BYTES",
+         dict(default="64Mi", metavar="BYTES",
+              help="Byte budget of the audit snapshot store holding "
+                   "cluster resources as pre-encoded admission rows "
+                   "(accepts K/M/G[i] suffixes; least-recently-recorded "
+                   "rows evict beyond it)")),
+        ("--audit-resources-file", "KUBEWARDEN_AUDIT_RESOURCES_FILE",
+         dict(default=None, metavar="RESOURCES_FILE",
+              help="YAML/JSON file of Kubernetes objects (a list or a "
+                   "List document) seeding the audit snapshot store at "
+                   "boot — the stand-in for the companion audit "
+                   "scanner's cluster LIST")),
         ("--reload-admin-token", "KUBEWARDEN_RELOAD_ADMIN_TOKEN",
          dict(default=None, metavar="TOKEN",
               help="Bearer token authenticating the policy-lifecycle "

@@ -223,6 +223,20 @@ class Config:
     # the on-disk policies file backing hot reload (None when the config
     # was built programmatically — reloads then reuse the in-memory set)
     policies_path: str | None = None
+    # background audit scanner (audit/scanner.py): 'interval' sweeps the
+    # dirty set on a cadence AND fully on every epoch promotion,
+    # 'on-promote' sweeps fully on epoch flips only, 'off' disables the
+    # scanner (the reference's external-companion model)
+    audit_mode: str = "off"
+    # dirty-sweep cadence for --audit-mode interval
+    audit_interval_seconds: float = 30.0
+    # rows per best-effort audit-lane batch
+    audit_batch_size: int = 256
+    # byte budget of the audit snapshot store (LRU-evicted beyond it)
+    audit_max_snapshot_bytes: int = 64 * 1024 * 1024
+    # optional YAML/JSON resources file seeding the snapshot store at
+    # boot (the stand-in for the companion scanner's cluster LIST)
+    audit_resources_file: str | None = None
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -295,6 +309,17 @@ class Config:
             )
         if self.reload_canary_requests < 0:
             raise ValueError("--reload-canary-requests must be >= 0")
+        if self.audit_mode not in ("off", "interval", "on-promote"):
+            raise ValueError(
+                f"invalid audit mode {self.audit_mode!r} "
+                "(expected off, interval, or on-promote)"
+            )
+        if self.audit_interval_seconds <= 0:
+            raise ValueError("--audit-interval-seconds must be > 0")
+        if self.audit_batch_size < 1:
+            raise ValueError("--audit-batch-size must be >= 1")
+        if self.audit_max_snapshot_bytes < 0:
+            raise ValueError("--audit-max-snapshot-bytes must be >= 0")
         if not (0.0 <= self.reload_divergence_threshold <= 1.0):
             raise ValueError(
                 "--reload-divergence-threshold must be in [0, 1]"
@@ -404,6 +429,11 @@ class Config:
             ),
             reload_admin_token=args.reload_admin_token or None,
             policies_path=str(policies_path) if policies_path.exists() else None,
+            audit_mode=args.audit_mode,
+            audit_interval_seconds=float(args.audit_interval_seconds),
+            audit_batch_size=int(args.audit_batch_size),
+            audit_max_snapshot_bytes=parse_size(args.audit_max_snapshot_bytes),
+            audit_resources_file=args.audit_resources_file or None,
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

@@ -43,6 +43,11 @@ Sites instrumented (grep for ``failpoints.fire``):
 ``reload.canary``   policy hot-reload shadow canary (lifecycle.py) —
                     ``raise`` = canary infrastructure fault; the
                     candidate is rejected, never promoted
+``audit.sweep``     background audit sweep head (audit/scanner.py) —
+                    ``raise`` = sweep infrastructure fault; the sweep
+                    aborts (un-judged keys re-marked dirty), the error
+                    is counted, and the scanner retries on the next
+                    trigger; live serving is untouched
 ==================  =====================================================
 
 Every fire is counted (``fired_count(site)``) so chaos tests can assert

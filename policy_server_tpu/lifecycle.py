@@ -227,6 +227,33 @@ class PolicyLifecycleManager:
         self._stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
         self._reload_inflight = threading.BoundedSemaphore(1)
+        # epoch-transition observers (round 10: the audit scanner) —
+        # set via set_epoch_hooks; fired AFTER the pointer flip, outside
+        # _swap_lock, and exceptions are contained (a broken observer
+        # must never fail a promotion or rollback)
+        self._on_promote: Callable[[int], None] | None = None
+        self._on_rollback: Callable[[int, int], None] | None = None
+
+    def set_epoch_hooks(
+        self,
+        on_promote: Callable[[int], None] | None = None,
+        on_rollback: Callable[[int, int], None] | None = None,
+    ) -> None:
+        """Register epoch-transition observers: ``on_promote(epoch)``
+        after every promotion (including a staged manual promote), and
+        ``on_rollback(rolled_back_epoch, serving_epoch)`` after a
+        rollback — the audit scanner uses these to trigger a full
+        re-scan and to invalidate reports from the revoked epoch."""
+        self._on_promote = on_promote
+        self._on_rollback = on_rollback
+
+    def _fire_hook(self, hook: Callable | None, *args) -> None:
+        if hook is None:
+            return
+        try:
+            hook(*args)
+        except Exception as e:  # noqa: BLE001 — observers must not fail
+            logger.error("epoch-transition hook failed: %s", e)
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -561,6 +588,9 @@ class PolicyLifecycleManager:
             # one generation is the pin window: the epoch demoted two
             # promotions ago closes for good
             self._retire(beyond_pin, close_env=True)
+        # post-promote observers (audit scanner: full re-scan under the
+        # newly serving set)
+        self._fire_hook(self._on_promote, epoch.number)
 
     def _retire(self, epoch: Epoch, close_env: bool) -> None:
         """Background drain-then-stop of a demoted epoch's batcher (and
@@ -659,6 +689,13 @@ class PolicyLifecycleManager:
             self.state.batcher = revived.batcher
             if demoted is not None:
                 self._retire(demoted, close_env=False)
+            # post-rollback observers (audit scanner: reports stamped by
+            # the rolled-back epoch go stale, then full re-scan)
+            self._fire_hook(
+                self._on_rollback,
+                demoted.number if demoted is not None else -1,
+                revived.number,
+            )
             logger.warning(
                 "policy set ROLLED BACK to epoch %d; the rejected epoch "
                 "stays pinned for forensic promote", revived.number,

@@ -32,6 +32,7 @@ being torn down).
 from __future__ import annotations
 
 import asyncio
+import collections
 import math
 import queue
 import threading
@@ -136,10 +137,32 @@ class _DeliveryBatch:
         self._by_loop.clear()
 
 
+class _AuditJob:
+    """One best-effort audit-lane batch: ``pairs`` of (policy_id,
+    request), resolved as a list of raw verdicts (constraints never
+    applied — audit-origin semantics)."""
+
+    __slots__ = ("pairs", "future")
+
+    def __init__(self, pairs: list, future: Future):
+        self.pairs = pairs
+        self.future = future
+
+
 class MicroBatcher:
     """Thread-safe evaluation front: ``submit()`` returns a Future resolved
     by the dispatch thread with a final AdmissionResponse (service-layer
-    constraints and metrics applied) or an EvaluationError."""
+    constraints and metrics applied) or an EvaluationError.
+
+    Round 10 adds a second, BEST-EFFORT priority lane
+    (:meth:`submit_audit`) for the background audit scanner: audit
+    batches dispatch only when the live lane is empty and the measured
+    device-RTT estimate fits inside the deadline slack, at most ONE
+    audit dispatch is in flight at any moment, audit work runs on its
+    own single-thread pool (never occupying the live lane's
+    encode/dispatch double-buffer pools), and a popped-but-undispatched
+    audit batch is re-queued the instant live work arrives — so live p99
+    can degrade by at most one in-flight audit dispatch, ever."""
 
     def __init__(
         self,
@@ -153,6 +176,7 @@ class MicroBatcher:
         request_timeout_ms: float = 0.0,
         degraded_mode: str = "oracle",
         shadow_recorder: Any = None,
+        audit_tracker: Any = None,
     ) -> None:
         self.env = env
         # policy-lifecycle shadow recorder (lifecycle.ShadowRecorder):
@@ -160,6 +184,12 @@ class MicroBatcher:
         # hot-reload canary's replay ring. None = disabled (no reload
         # machinery); one deque-extend per BATCH, never per request.
         self.shadow_recorder = shadow_recorder
+        # audit dirty-set tracker (audit.SnapshotStore): every VALIDATE
+        # request in a formed batch is recorded (keyed GVK+ns+name, later
+        # admissions supersede) so the background scanner re-judges what
+        # was actually admitted. Same one-call-per-batch discipline as
+        # the shadow recorder. None = audit disabled.
+        self.audit_tracker = audit_tracker
         self.max_batch_size = max(1, int(max_batch_size))
         self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
         self.policy_timeout = policy_timeout
@@ -268,6 +298,28 @@ class MicroBatcher:
         # requests answered by the --degraded-mode policy while the
         # device breaker was fully tripped (monitor/reject modes only)
         self.degraded_responses = 0  # guarded-by: _stats_lock
+        # -- audit lane counters (round 10; /metrics surface) -------------
+        # best-effort audit batches actually dispatched
+        self.audit_batches_dispatched = 0  # guarded-by: _stats_lock
+        # rows those batches carried
+        self.audit_rows_dispatched = 0  # guarded-by: _stats_lock
+        # audit batches popped for dispatch but re-queued because live
+        # work arrived first (the preemption contract in action)
+        self.audit_preemptions = 0  # guarded-by: _stats_lock
+        # -- the best-effort audit lane -----------------------------------
+        # Jobs wait in a deque (appendleft on preemption so a re-queued
+        # batch keeps its place at the head); dispatch happens on a
+        # DEDICATED single-thread pool so audit work can never occupy a
+        # live batch-pipeline/encode/device pool slot, and the pool width
+        # (1) IS the one-in-flight cap.
+        self._audit_lock = threading.Lock()
+        self._audit_jobs: collections.deque[_AuditJob] = (
+            collections.deque()
+        )  # guarded-by: _audit_lock
+        self._audit_inflight = False  # guarded-by: _audit_lock
+        self._audit_pool = DaemonExecutor(
+            max_workers=1, thread_name_prefix="audit-dispatch"
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -312,6 +364,10 @@ class MicroBatcher:
         # futures were already resolved by the watchdog.
         self._device_pool.shutdown(wait=False)
         self._encode_pool.shutdown(wait=False)
+        # audit lane: queued jobs reject (the scanner catches and re-marks
+        # its keys dirty); an in-flight dispatch is abandoned, never joined
+        self._drain_audit_rejecting()
+        self._audit_pool.shutdown(wait=False)
 
     def _drain_rejecting(self) -> None:
         while True:
@@ -347,6 +403,9 @@ class MicroBatcher:
                 "shed_requests": self.shed_requests,
                 "expired_dropped": self.expired_dropped,
                 "degraded_responses": self.degraded_responses,
+                "audit_batches_dispatched": self.audit_batches_dispatched,
+                "audit_rows_dispatched": self.audit_rows_dispatched,
+                "audit_preemptions": self.audit_preemptions,
             }
 
     def estimated_wait(self) -> float:
@@ -565,10 +624,177 @@ class MicroBatcher:
         """Blocking convenience wrapper around submit()."""
         return self.submit(policy_id, request, origin).result(timeout=timeout)
 
+    # -- best-effort audit lane (round 10) ---------------------------------
+
+    def submit_audit(self, pairs: list) -> Future:
+        """Enqueue one audit batch on the best-effort lane. The Future
+        resolves to ``validate_batch``-shaped results (raw verdicts /
+        per-item Exceptions) once an idle slot dispatches it — which may
+        be arbitrarily later under sustained live load; the lane offers
+        NO latency promise, that is the point. Raises nothing: a
+        stopping batcher rejects via the future."""
+        future: Future = Future()
+        job = _AuditJob(list(pairs), future)
+        if self._stopping:
+            future.set_exception(
+                RuntimeError("batcher shutting down; audit lane closed")
+            )
+            return future
+        with self._audit_lock:
+            self._audit_jobs.append(job)
+        # close the stranding window: shutdown may have drained the lane
+        # between the check above and the append — self-drain if so (the
+        # same discipline as _put_waiting on the live lane)
+        if self._stopping:
+            self._drain_audit_rejecting()
+        return future
+
+    def audit_lane_depth(self) -> int:
+        """Audit batches waiting for an idle slot (the /metrics gauge)."""
+        with self._audit_lock:
+            return len(self._audit_jobs)
+
+    def cancel_audit(self, future: Future) -> bool:
+        """Remove a not-yet-dispatched audit job from the lane — the
+        scanner abandons a job it timed out waiting on, and without
+        this removal every retry would stack a duplicate job that later
+        burns an idle dispatch on results nobody reads. Returns False
+        when the job is gone (already dispatched or drained); the one
+        in-flight dispatch it may be burning is the bounded waste the
+        lane already accepts."""
+        with self._audit_lock:
+            for job in self._audit_jobs:
+                if job.future is future:
+                    self._audit_jobs.remove(job)
+                    break
+            else:
+                return False
+        try:
+            future.set_exception(
+                RuntimeError("audit job cancelled by its submitter")
+            )
+        except Exception:  # noqa: BLE001 — already-done race
+            pass
+        return True
+
+    def _audit_slack_ok(self, audit_rows: int) -> bool:
+        """True when dispatching one audit batch of ``audit_rows`` NOW
+        cannot break a live request that arrives right after: the live
+        lane is already empty (caller checked, so the EWMA queue-wait
+        estimate is zero), the device breaker is not fully open (open
+        shards pause audit instead of burning oracle capacity), and the
+        estimated device hold time OF THAT BATCH — the per-bucket RTT
+        EWMA scaled by how many max-size chunks the audit rows span,
+        since --audit-batch-size may exceed the live batch size — fits
+        inside half the propagated request-deadline budget, so a live
+        batch formed behind the single in-flight audit dispatch still
+        admits and meets its deadline. The SOFT latency budget
+        deliberately does not gate here: a live batch that forms while
+        an audit dispatch holds the device is re-routed host-side by the
+        latency-budget router, so the p99 target defends itself."""
+        if getattr(self.env, "breaker_all_open", False):
+            return False
+        if self.request_timeout is None:
+            return True
+        bucket = bucket_size(self.max_batch_size)
+        rtt = self._dev_rtt.get(bucket)
+        if rtt is None:
+            # no device measurement yet: the first audit dispatch IS the
+            # measurement (warmup normally seeds this before serving)
+            return True
+        hold_est = rtt * max(1, math.ceil(audit_rows / bucket))
+        return hold_est <= 0.5 * self.request_timeout
+
+    def _maybe_dispatch_audit(self) -> None:
+        """Called by the dispatch loop ONLY when the live queue came up
+        empty: admit at most one audit batch onto the (width-1) audit
+        pool. Slack is evaluated before taking the lane lock — it reads
+        the environment's breaker state, and lock-order discipline keeps
+        _audit_lock innermost."""
+        if self._stopping:
+            return
+        with self._audit_lock:
+            if self._audit_inflight or not self._audit_jobs:
+                return
+            head_rows = len(self._audit_jobs[0].pairs)
+        if not self._audit_slack_ok(head_rows):
+            return
+        with self._audit_lock:
+            if self._audit_inflight or not self._audit_jobs:
+                return
+            job = self._audit_jobs.popleft()
+            self._audit_inflight = True
+        try:
+            self._audit_pool.submit(self._run_audit_job, job)
+        except RuntimeError:  # pool shut down (stop race)
+            with self._audit_lock:
+                self._audit_inflight = False
+            try:
+                job.future.set_exception(
+                    RuntimeError("batcher shutting down; audit lane closed")
+                )
+            except Exception:  # noqa: BLE001 — already-done race
+                pass
+
+    def _run_audit_job(self, job: _AuditJob) -> None:
+        try:
+            # preemption: live work arrived between the pop and this
+            # worker starting — the audit batch goes BACK to the head of
+            # the lane and the live batch proceeds unimpeded
+            if self._queue.qsize() > 0 and not self._stopping:
+                with self._stats_lock:
+                    self.audit_preemptions += 1
+                with self._audit_lock:
+                    self._audit_jobs.appendleft(job)
+                return
+            if self._stopping:
+                job.future.set_exception(
+                    RuntimeError("batcher shutting down; audit lane closed")
+                )
+                return
+            try:
+                # raw verdicts (audit-origin semantics: constraints never
+                # applied); run_hooks=False — the scan judges policy
+                # logic, not hook latency, exactly like the reload canary
+                results = self.env.validate_batch(job.pairs, run_hooks=False)
+            except Exception as e:  # noqa: BLE001 — the job carries it
+                job.future.set_exception(e)
+                return
+            with self._stats_lock:
+                self.audit_batches_dispatched += 1
+                self.audit_rows_dispatched += len(job.pairs)
+            job.future.set_result(results)
+        finally:
+            with self._audit_lock:
+                self._audit_inflight = False
+
+    def _drain_audit_rejecting(self) -> None:
+        while True:
+            with self._audit_lock:
+                if not self._audit_jobs:
+                    return
+                job = self._audit_jobs.popleft()
+            try:
+                job.future.set_exception(
+                    RuntimeError("batcher shutting down; audit lane closed")
+                )
+            except Exception:  # noqa: BLE001 — already-done race
+                pass
+
     # -- dispatch loop -----------------------------------------------------
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            # live lane MOMENTARILY empty: this — and only this — is
+            # when the best-effort audit lane may claim an idle slot.
+            # Checked at the loop top (not just on get-timeout): under
+            # steady load the queue drains to zero between bursts for
+            # milliseconds at a time, and those gaps ARE the idle
+            # capacity audit rides; a 50 ms fully-quiet window would
+            # never occur. The audit dispatch runs on its own pool, so
+            # the live get below is not delayed.
+            if self._queue.qsize() == 0:
+                self._maybe_dispatch_audit()
             try:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
@@ -778,6 +1004,20 @@ class MicroBatcher:
                 )
             except Exception:  # noqa: BLE001 — recording must not fail
                 pass  # the batch (canary corpus just stays smaller)
+        if self.audit_tracker is not None:
+            try:
+                # dirty-set tracking for the background audit scanner:
+                # only objects ADMITTED through /validate belong in the
+                # cluster snapshot (audit-origin replays must not feed
+                # themselves back in)
+                self.audit_tracker.observe(
+                    [
+                        p.request for p in batch
+                        if p.origin is service.RequestOrigin.VALIDATE
+                    ]
+                )
+            except Exception:  # noqa: BLE001 — tracking must not fail
+                pass  # the batch (the scan corpus just stays smaller)
 
         # Phase 1 (host): pre-evaluation — id parse, namespace shortcut,
         # bounded pre-eval hooks. Items that short-circuit or fail resolve

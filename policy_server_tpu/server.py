@@ -236,6 +236,20 @@ class PolicyServer:
 
             recorder = ShadowRecorder(capacity=config.reload_canary_requests)
 
+        # audit snapshot store: the background scanner's cluster view,
+        # fed by every epoch's batcher (dirty-set tracking survives hot
+        # reloads for the same reason the canary ring does)
+        audit_enabled = config.audit_mode != "off"
+        snapshot_store = None
+        if audit_enabled:
+            from policy_server_tpu.audit import SnapshotStore
+
+            snapshot_store = SnapshotStore(
+                max_bytes=config.audit_max_snapshot_bytes
+            )
+            if config.audit_resources_file:
+                snapshot_store.seed_from_file(config.audit_resources_file)
+
         def build_batcher(env) -> MicroBatcher:
             """One batcher construction path for boot AND every reload
             epoch — the knobs must not drift between generations."""
@@ -250,6 +264,7 @@ class PolicyServer:
                 request_timeout_ms=config.request_timeout_ms,
                 degraded_mode=config.degraded_mode,
                 shadow_recorder=recorder,
+                audit_tracker=snapshot_store,
             )
 
         batcher = build_batcher(environment)
@@ -317,6 +332,29 @@ class PolicyServer:
                 environment, batcher, config.policies
             )
             state.lifecycle.start_watching()
+
+        if audit_enabled:
+            from policy_server_tpu.audit import (
+                AuditScanner,
+                PolicyReportStore,
+            )
+
+            state.audit = AuditScanner(
+                state=state,
+                snapshot=snapshot_store,
+                reports=PolicyReportStore(),
+                mode=config.audit_mode,
+                interval_seconds=config.audit_interval_seconds,
+                batch_size=config.audit_batch_size,
+            )
+            if state.lifecycle is not None:
+                # epoch coherence: a promotion re-judges everything under
+                # the new set; a rollback stales the revoked epoch's rows
+                state.lifecycle.set_epoch_hooks(
+                    on_promote=state.audit.on_promote,
+                    on_rollback=state.audit.on_rollback,
+                )
+            state.audit.start()
 
         def runtime_stats():
             # one locked snapshot per scrape: bare attribute reads from
@@ -535,6 +573,80 @@ class PolicyServer:
                 "Monotonic number of the currently serving policy epoch "
                 "(0 = the boot set)",
                 lstats.get("epoch", 0),
+            )
+            # Background audit scanner (round 10): lane throughput and
+            # preemptions from the batcher, sweep cadence / report
+            # freshness / snapshot footprint from the scanner. All zero
+            # with --audit-mode off (the families still export so the
+            # dashboard panels resolve on every deployment).
+            yield (
+                metrics_names.AUDIT_BATCHES_DISPATCHED, "counter",
+                "Best-effort audit-lane batches dispatched on idle slots",
+                bstats["audit_batches_dispatched"],
+            )
+            yield (
+                metrics_names.AUDIT_PREEMPTIONS, "counter",
+                "Audit batches re-queued because live work arrived first",
+                bstats["audit_preemptions"],
+            )
+            yield (
+                metrics_names.AUDIT_LANE_DEPTH, "gauge",
+                "Audit batches waiting for an idle dispatch slot",
+                batcher.audit_lane_depth(),
+            )
+            astats = state.audit.stats() if state.audit is not None else {}
+            yield (
+                metrics_names.AUDIT_ROWS_SCANNED, "counter",
+                "Resource x policy rows the audit scanner has judged",
+                astats.get("rows_scanned", 0),
+            )
+            yield (
+                metrics_names.AUDIT_FULL_SWEEPS, "counter",
+                "Completed full audit sweeps (boot, epoch promotions, "
+                "rollbacks)",
+                astats.get("full_sweeps", 0),
+            )
+            yield (
+                metrics_names.AUDIT_DIRTY_SWEEPS, "counter",
+                "Completed dirty-set audit sweeps (interval cadence)",
+                astats.get("dirty_sweeps", 0),
+            )
+            yield (
+                metrics_names.AUDIT_SWEEP_ERRORS, "counter",
+                "Audit sweeps aborted by a fault (retried on the next "
+                "trigger)",
+                astats.get("sweep_errors", 0),
+            )
+            yield (
+                metrics_names.AUDIT_PAUSED_SWEEPS, "counter",
+                "Audit sweeps skipped while the device breaker was open",
+                astats.get("paused_sweeps", 0),
+            )
+            yield (
+                metrics_names.AUDIT_REPORT_FRESHNESS, "gauge",
+                "Seconds since the last completed full audit sweep "
+                "(-1 before the first)",
+                astats.get("freshness_seconds", -1.0),
+            )
+            yield (
+                metrics_names.AUDIT_REPORTS_RESIDENT, "gauge",
+                "Audit report rows currently held",
+                astats.get("reports_resident", 0),
+            )
+            yield (
+                metrics_names.AUDIT_REPORTS_STALE, "gauge",
+                "Audit report rows stamped by a rolled-back policy epoch",
+                astats.get("reports_stale", 0),
+            )
+            yield (
+                metrics_names.AUDIT_SNAPSHOT_RESOURCES, "gauge",
+                "Cluster resources held in the audit snapshot store",
+                astats.get("snapshot_resources", 0),
+            )
+            yield (
+                metrics_names.AUDIT_SNAPSHOT_BYTES, "gauge",
+                "Resident bytes of the audit snapshot store",
+                astats.get("snapshot_bytes", 0),
             )
 
         from policy_server_tpu.telemetry import default_registry
@@ -785,6 +897,10 @@ class PolicyServer:
         for runner in self._runners:
             await runner.cleanup()
         self._runners.clear()
+        if self.state.audit is not None:
+            # stop sweeping BEFORE epochs tear down: a sweep racing the
+            # batcher shutdown would only burn its retry budget
+            self.state.audit.shutdown()
         if self.lifecycle is not None:
             # the lifecycle manager owns every epoch (current, pinned
             # previous, staged): one teardown path closes them all

@@ -76,6 +76,21 @@ POLICY_RELOAD_ROLLBACKS = "policy_server_policy_reload_rollbacks"
 RELOAD_CANARY_REPLAYS = "policy_server_reload_canary_replays"
 RELOAD_CANARY_DIVERGENCES = "policy_server_reload_canary_divergences"
 POLICY_EPOCH = "policy_server_policy_epoch"
+# round 10 — background audit scanner (audit/) + the batcher's
+# best-effort audit lane (runtime/batcher.py)
+AUDIT_ROWS_SCANNED = "policy_server_audit_rows_scanned"
+AUDIT_BATCHES_DISPATCHED = "policy_server_audit_batches_dispatched"
+AUDIT_PREEMPTIONS = "policy_server_audit_preemptions"
+AUDIT_LANE_DEPTH = "policy_server_audit_lane_depth"
+AUDIT_FULL_SWEEPS = "policy_server_audit_full_sweeps"
+AUDIT_DIRTY_SWEEPS = "policy_server_audit_dirty_sweeps"
+AUDIT_SWEEP_ERRORS = "policy_server_audit_sweep_errors"
+AUDIT_PAUSED_SWEEPS = "policy_server_audit_paused_sweeps"
+AUDIT_REPORT_FRESHNESS = "policy_server_audit_report_freshness_seconds"
+AUDIT_REPORTS_RESIDENT = "policy_server_audit_reports_resident"
+AUDIT_REPORTS_STALE = "policy_server_audit_reports_stale"
+AUDIT_SNAPSHOT_RESOURCES = "policy_server_audit_snapshot_resources"
+AUDIT_SNAPSHOT_BYTES = "policy_server_audit_snapshot_bytes"
 HOST_ENCODE_SECONDS = "policy_server_host_encode_seconds_total"
 HOST_ENCODE_ROWS = "policy_server_host_encode_rows_total"
 HOST_BOOKKEEPING_SECONDS = "policy_server_host_bookkeeping_seconds_total"

@@ -554,7 +554,14 @@ def test_hung_canary_does_not_poison_the_next_reload(harness):
     )
     with pytest.raises(ReloadRejected):
         harness.manager.reload(policies=policies_v2())
-    # fault exhausted: the very next reload must succeed
+    # Fault exhausted: the very next reload must succeed. The timeout is
+    # restored to 4 s first — the harness builds candidates warmup=False,
+    # so this canary pays a cold jit compile that 0.3 s cannot absorb on
+    # a loaded box (the old value made the test flake on compile time,
+    # not on the property under test). 4 s still distinguishes the
+    # regression this guards: a wedged one-worker pool would sit behind
+    # the ~4.7 s remaining of the abandoned replay's sleep and time out.
+    harness.manager.canary_timeout_seconds = 4.0
     assert harness.manager.reload(policies=policies_v2()) == "promoted"
     assert harness.serve("happy").allowed is True
 

@@ -152,6 +152,22 @@ def test_dedup_and_pipeline_counters_after_served_batch(server):
     assert m["policy_server_policy_epoch"] == 0
     assert "policy_server_reload_canary_replays_total" in m
     assert "policy_server_reload_canary_divergences_total" in m
+    # round-10 audit surface: the families export on EVERY deployment
+    # (zero with --audit-mode off, this server's state — the audit suite
+    # moves them); freshness reads -1 before any full sweep
+    assert m["policy_server_audit_rows_scanned_total"] == 0
+    assert m["policy_server_audit_batches_dispatched_total"] == 0
+    assert m["policy_server_audit_preemptions_total"] == 0
+    assert m["policy_server_audit_lane_depth"] == 0
+    assert m["policy_server_audit_report_freshness_seconds"] == -1
+    assert m["policy_server_audit_reports_resident"] == 0
+    assert m["policy_server_audit_reports_stale"] == 0
+    assert m["policy_server_audit_snapshot_resources"] == 0
+    assert m["policy_server_audit_snapshot_bytes"] == 0
+    assert "policy_server_audit_full_sweeps_total" in m
+    assert "policy_server_audit_dirty_sweeps_total" in m
+    assert "policy_server_audit_sweep_errors_total" in m
+    assert "policy_server_audit_paused_sweeps_total" in m
 
 
 def test_counters_survive_otlp_conversion(server):
@@ -176,5 +192,9 @@ def test_counters_survive_otlp_conversion(server):
         metrics_mod.POLICY_RELOAD_ROLLBACKS,
         metrics_mod.RELOAD_CANARY_REPLAYS,
         metrics_mod.POLICY_EPOCH,
+        metrics_mod.AUDIT_ROWS_SCANNED,
+        metrics_mod.AUDIT_PREEMPTIONS,
+        metrics_mod.AUDIT_REPORT_FRESHNESS,
+        metrics_mod.AUDIT_SNAPSHOT_BYTES,
     ):
         assert any(expected in n for n in names), (expected, names)

@@ -695,7 +695,16 @@ def test_shutdown_under_load_through_real_server():
         threads = [threading.Thread(target=fire) for _ in range(4)]
         for t in threads:
             t.start()
-        time.sleep(0.2)  # requests are in flight against the hung device
+        # wait until every request actually REACHED the batcher (a fixed
+        # sleep raced slow client-thread scheduling on a loaded box: a
+        # thread still connecting when stop() closed the listener got
+        # connection-refused, which is not the property under test)
+        deadline = time.monotonic() + 10
+        while (
+            handle.server.batcher.stats_snapshot()["requests_dispatched"] < 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
     finally:
         t0 = time.perf_counter()
         handle.stop()
@@ -886,4 +895,117 @@ def test_reload_counters_reach_metrics_endpoint():
         assert metrics["policy_server_reload_canary_replays_total"] > 0
         assert "policy_server_reload_canary_divergences_total" in metrics
     finally:
+        handle.stop()
+
+
+def test_audit_scanner_chaos_under_load_reload_and_sweep_fault():
+    """Round-10 chaos acceptance: the background audit scanner running
+    under sustained live traffic, through a mid-sweep policy reload AND
+    an armed ``audit.sweep`` fault — zero live non-2xx, bit-exact live
+    verdicts, the scanner resumes sweeping after the fault clears, and
+    post-reload reports are stamped with the promoted epoch. Runs under
+    the locksan gate via ``make chaos`` (0 inversions)."""
+    import requests as rq
+
+    from policy_server_tpu.models.policy import parse_policy_entry as ppe
+    from test_server import ServerHandle, pod_review_body
+
+    config, policies = _lifecycle_config()
+    config.audit_mode = "interval"
+    config.audit_interval_seconds = 0.2
+    config.audit_batch_size = 16
+    handle = ServerHandle(config)
+    scanner = handle.server.state.audit
+    assert scanner is not None
+    stop = threading.Event()
+    results: list[tuple[int, bool | None, bool]] = []
+    errors: list[Exception] = []
+
+    def traffic(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            privileged = (i + worker) % 2 == 0
+            i += 1
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(privileged), timeout=30,
+                )
+                allowed = (
+                    r.json()["response"]["allowed"]
+                    if r.status_code == 200 else None
+                )
+                results.append((r.status_code, allowed, privileged))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=traffic, args=(w,), daemon=True)
+        for w in range(2)
+    ]
+
+    def wait_until(predicate, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.05)
+        return False
+
+    try:
+        for t in threads:
+            t.start()
+        # the dirty-set tracker sees the served objects and the cadence
+        # sweeps them while traffic flows
+        assert wait_until(lambda: scanner.stats()["rows_scanned"] > 0)
+
+        # armed sweep fault: the next 2 sweeps abort loudly...
+        failpoints.configure("audit.sweep=raise:injected-sweep-fault*2")
+        assert wait_until(lambda: scanner.stats()["sweep_errors"] >= 2)
+        assert failpoints.fired_count("audit.sweep") >= 2
+        # ...and the scanner RESUMES once the fault exhausts
+        resumed_from = scanner.stats()["rows_scanned"]
+        rq.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(False), timeout=30,
+        )  # dirty the snapshot so the next sweep has work
+        assert wait_until(
+            lambda: scanner.stats()["rows_scanned"] > resumed_from
+        )
+
+        # mid-sweep policy reload: promote a rebuilt set while the
+        # cadence keeps sweeping; the post-promote full re-scan stamps
+        # reports with the new epoch
+        lifecycle = handle.server.lifecycle
+        extra = dict(policies)
+        extra["happy"] = ppe("happy", {"module": "builtin://always-happy"})
+        assert lifecycle.reload(policies=extra) == "promoted"
+        assert wait_until(
+            lambda: (
+                lambda body: bool(body["reports"]) and all(
+                    x["epoch"] == 1 and not x["stale"]
+                    for x in body["reports"]
+                )
+            )(scanner.report_payload())
+        ), scanner.report_payload()["summary"]
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, f"transport failures under audit chaos: {errors}"
+        assert len(results) > 20, "traffic generator barely ran"
+        non_2xx = [r for r in results if r[0] != 200]
+        assert not non_2xx, f"live non-2xx with scanner armed: {non_2xx[:5]}"
+        for status, allowed, privileged in results:
+            assert allowed == (not privileged), (status, allowed, privileged)
+        # preemption discipline held: audit work flowed on idle slots
+        snap = handle.server.batcher.stats_snapshot()
+        final = scanner.stats()
+        assert final["rows_scanned"] > 0
+        assert final["sweep_errors"] >= 2
+        assert snap["audit_batches_dispatched"] >= 1
+    finally:
+        stop.set()
+        failpoints.reset()
         handle.stop()
