@@ -29,7 +29,11 @@ RUN mkdir -p build && \
     { g++ -O3 -shared -fPIC -std=c++17 \
         -o build/fastenc-cpython-312-x86_64-linux-gnu.so \
         csrc/fastenc.cpp -I/usr/local/include/python3.12 \
-      || echo "WARNING: fastenc build failed; Python encoder fallback"; }
+      || echo "WARNING: fastenc build failed; Python encoder fallback"; } && \
+    { g++ -O2 -shared -fPIC -std=c++17 -pthread \
+        -o build/httpfront-cpython-312-x86_64-linux-gnu.so \
+        csrc/httpfront.cpp \
+      || echo "WARNING: httpfront build failed; --frontend native will fall back to python"; }
 
 # test stage: the graftcheck gate (static analysis + counter/OTLP/
 # dashboard consistency + failpoint and cli-docs drift) runs against the

@@ -4,10 +4,10 @@
 IMG ?= policy-server-tpu:latest
 
 .PHONY: all test unit-tests integration-tests bench chaos check docs \
-        docs-check fastenc image dev-stack dev-stack-down dryrun-multichip \
-        clean
+        docs-check fastenc httpfront natives image dev-stack \
+        dev-stack-down dryrun-multichip clean
 
-all: test check
+all: natives test check
 
 # full suite on the 8-virtual-device CPU backend (tests/conftest.py)
 test:
@@ -47,7 +47,16 @@ check:
 
 # native host encoder (ops/fastenc.py compiles on demand into build/)
 fastenc:
-	python -c "from policy_server_tpu.ops import fastenc; print(fastenc._build_library())"
+	python -c "import sys; from policy_server_tpu.ops import fastenc; p = fastenc._build_library(); print(p); sys.exit(0 if p else 1)"
+
+# native HTTP front-end (runtime/native_frontend.py compiles on demand)
+httpfront:
+	python -c "import sys; from policy_server_tpu.runtime import native_frontend; p = native_frontend._build_library(); print(p); sys.exit(0 if p else 1)"
+
+# both native extensions, loudly: the runtime soft-fails to Python
+# fallbacks, so these targets exit nonzero on a failed build — CI sees
+# the breakage instead of silently shipping the fallback
+natives: fastenc httpfront
 
 docs:
 	python -m policy_server_tpu docs --output cli-docs.md

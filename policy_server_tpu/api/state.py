@@ -35,6 +35,11 @@ class ApiServerState:
     # the background audit scanner (audit.AuditScanner); None when
     # --audit-mode off — the GET /audit/reports endpoints then 404
     audit: Any = None
+    # the native HTTP front-end (runtime/native_frontend.NativeFrontend);
+    # None under --frontend python or after native-load fallback — the
+    # /metrics framing counters read it through the state so the scrape
+    # follows whatever is actually serving
+    native_frontend: Any = None
 
     def readiness(self) -> tuple[int, str]:
         """The /readiness verdict (status code, body text). Honest on

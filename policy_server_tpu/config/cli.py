@@ -278,6 +278,26 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "over a unix socket (1 = serve in-process; raises the "
                    "~1.3k req/s per-event-loop framing ceiling, see "
                    "PROFILE.md)")),
+        ("--frontend", "KUBEWARDEN_FRONTEND",
+         dict(default="python", metavar="IMPL", choices=["python", "native"],
+              help="HTTP framing implementation for the evaluation POST "
+                   "surface (/validate, /validate_raw, /audit): 'native' "
+                   "serves it from the GIL-free C++ epoll front-end "
+                   "(csrc/httpfront.cpp) that parses AdmissionReviews "
+                   "straight into packed batch rows and serializes "
+                   "verdicts natively — breaking the ~1.3k rps/process "
+                   "Python framing ceiling (PROFILE.md); 'python' keeps "
+                   "aiohttp framing, the always-available fallback and "
+                   "differential correctness oracle. With 'native', the "
+                   "API port serves ONLY the evaluation POSTs — "
+                   "/audit/reports, /metrics, and the /policies/* admin "
+                   "surface stay on the readiness port, and the pprof "
+                   "endpoints require --frontend python; a native build "
+                   "that fails to load falls back to 'python' with a "
+                   "loud warning. Under --http-workers, the "
+                   "policy_server_native_* /metrics families count the "
+                   "main process's loop only (worker processes export "
+                   "no metrics, matching the python prefork mode)")),
         ("--context-refresh-seconds", "KUBEWARDEN_CONTEXT_REFRESH_SECONDS",
          dict(type=float, default=30.0, metavar="SECONDS",
               help="Context-aware snapshot freshness: the re-LIST period in "

@@ -243,6 +243,12 @@ class Config:
     # prefork HTTP frontend (runtime/frontend.py): worker processes
     # sharing the API port via SO_REUSEPORT; 1 = in-process serving
     http_workers: int = 1
+    # HTTP framing implementation for the /validate|/validate_raw|/audit
+    # POST surface: 'native' serves them from the GIL-free C++ epoll
+    # front-end (csrc/httpfront.cpp; falls back to 'python' loudly when
+    # the extension cannot build/load), 'python' keeps aiohttp framing —
+    # the always-available fallback and differential correctness oracle
+    frontend: str = "python"
     # context-aware snapshot freshness (see the staleness contract in
     # context/service.py): watch keeps snapshots event-fresh; the refresh
     # period bounds poll-mode staleness and watch-mode backoff/resync
@@ -302,6 +308,11 @@ class Config:
             )
         if self.http_workers < 1:
             raise ValueError("--http-workers must be >= 1")
+        if self.frontend not in ("python", "native"):
+            raise ValueError(
+                f"invalid frontend {self.frontend!r} "
+                "(expected python or native)"
+            )
         if self.policy_reload_mode not in ("off", "auto", "manual"):
             raise ValueError(
                 f"invalid policy reload mode {self.policy_reload_mode!r} "
@@ -438,6 +449,7 @@ class Config:
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,
             http_workers=int(args.http_workers),
+            frontend=args.frontend,
             context_refresh_seconds=float(args.context_refresh_seconds),
             context_watch=not args.context_no_watch,
             distributed_coordinator=args.distributed_coordinator,

@@ -298,6 +298,10 @@ class MicroBatcher:
         # requests answered by the --degraded-mode policy while the
         # device breaker was fully tripped (monitor/reject modes only)
         self.degraded_responses = 0  # guarded-by: _stats_lock
+        # cumulative ns spent between submission and batch formation —
+        # the queue leg of the framing-vs-queue-vs-device decomposition
+        # the bench http lines report (round 11)
+        self.queue_wait_ns = 0  # guarded-by: _stats_lock
         # -- audit lane counters (round 10; /metrics surface) -------------
         # best-effort audit batches actually dispatched
         self.audit_batches_dispatched = 0  # guarded-by: _stats_lock
@@ -403,6 +407,7 @@ class MicroBatcher:
                 "shed_requests": self.shed_requests,
                 "expired_dropped": self.expired_dropped,
                 "degraded_responses": self.degraded_responses,
+                "queue_wait_ns": self.queue_wait_ns,
                 "audit_batches_dispatched": self.audit_batches_dispatched,
                 "audit_rows_dispatched": self.audit_rows_dispatched,
                 "audit_preemptions": self.audit_preemptions,
@@ -552,6 +557,40 @@ class MicroBatcher:
             if self._stopping and not pending.future.done():
                 self._drain_rejecting()
             return True
+
+    def submit_nowait(
+        self,
+        policy_id: str,
+        request: ValidateRequest,
+        origin: service.RequestOrigin,
+    ) -> Future:
+        """submit() for callers that must never block (the native
+        frontend's drainer thread): sheds exactly like submit(), but a
+        full queue parks the bounded overload wait on the batcher's own
+        executor and returns the Future immediately — the caller's
+        done-callback sees the verdict, the bounded-wait 429, or the
+        shutdown 503."""
+        pending = _Pending(policy_id, request, origin, Future())
+        if self.request_timeout is not None:
+            pending.deadline = pending.enqueued_at + self.request_timeout
+        if self._stopping:
+            self._reject_stopping(pending)
+            return pending.future
+        self._shed_check(pending)
+        try:
+            self._queue.put_nowait(pending)
+            # same stranding window as _put_waiting: shutdown may have
+            # finished both drains between the check above and this put
+            if self._stopping and not pending.future.done():
+                self._drain_rejecting()
+            return pending.future
+        except queue.Full:
+            pass
+        try:
+            self._overload_pool.submit(self._put_waiting, pending)
+        except RuntimeError:  # pool already shut down (stop race)
+            self._reject_stopping(pending)
+        return pending.future
 
     async def submit_async(
         self,
@@ -994,9 +1033,13 @@ class MicroBatcher:
         )
 
     def _dispatch(self, batch: list[_Pending]) -> None:
+        formed_at = time.perf_counter()
         with self._stats_lock:
             self.batches_dispatched += 1
             self.requests_dispatched += len(batch)
+            self.queue_wait_ns += int(
+                sum(formed_at - p.enqueued_at for p in batch) * 1e9
+            )
         if self.shadow_recorder is not None:
             try:
                 self.shadow_recorder.observe(

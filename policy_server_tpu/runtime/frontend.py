@@ -536,12 +536,56 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
 
 
 async def worker_main(
-    socket_path: str, addr: str, port: int, hostname: str
+    socket_path: str, addr: str, port: int, hostname: str,
+    frontend: str = "python",
 ) -> None:
-    from aiohttp import web
-
     bridge = BridgeClient(socket_path)
     await bridge.connect()
+    if frontend == "native":
+        # the worker as a THIN owner of a native event loop: HTTP framing
+        # + AdmissionReview parsing run GIL-free (csrc/httpfront.cpp);
+        # this asyncio loop only forwards parsed frames over the bridge
+        sock = None
+        try:
+            from policy_server_tpu.api.handlers import MAX_BODY_BYTES
+            from policy_server_tpu.runtime import native_frontend as nf
+
+            if not nf.native_available():
+                raise RuntimeError(
+                    "csrc/httpfront.cpp failed to build or load"
+                )
+            sock = nf.make_listen_socket(addr, port)
+            front = nf.NativeFrontend(
+                sock,
+                nf.BridgeSink(bridge, asyncio.get_running_loop()),
+                max_body=MAX_BODY_BYTES,
+            )
+            front.start()
+            try:
+                while True:  # serve until the parent terminates us
+                    await asyncio.sleep(3600)
+            finally:
+                front.stop_accepting()
+                front.shutdown()
+            return
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — soft-dep fallback
+            import contextlib
+
+            from policy_server_tpu.telemetry.tracing import logger
+
+            if sock is not None:
+                # a leaked SO_REUSEPORT listener would keep receiving a
+                # share of connections that nothing ever accepts
+                with contextlib.suppress(OSError):
+                    sock.close()
+            logger.warning(
+                "native HTTP frontend unavailable in worker (%s); "
+                "falling back to the Python frontend", e,
+            )
+    from aiohttp import web
+
     app = build_worker_app(bridge, hostname)
     runner = web.AppRunner(app)
     await runner.setup()
@@ -564,11 +608,17 @@ def main() -> int:
     parser.add_argument("--hostname", default="worker")
     parser.add_argument("--log-level", default="info")
     parser.add_argument("--log-fmt", default="text")
+    parser.add_argument(
+        "--frontend", default="python", choices=["python", "native"]
+    )
     args = parser.parse_args()
     setup_tracing(args.log_level, args.log_fmt)
     try:
         asyncio.run(
-            worker_main(args.socket, args.addr, args.port, args.hostname)
+            worker_main(
+                args.socket, args.addr, args.port, args.hostname,
+                args.frontend,
+            )
         )
     except KeyboardInterrupt:
         pass

@@ -1,0 +1,704 @@
+"""ctypes bindings + runtime owner for the native HTTP front-end
+(csrc/httpfront.cpp).
+
+The native front-end moves HTTP framing off the Python event loop: epoll
+event loops on native threads accept connections, parse HTTP/1.1
+(keep-alive, chunked bodies, pipelining), canonicalize AdmissionReview JSON
+into the exact compact bytes ``json.dumps(AdmissionRequest.to_dict(),
+separators=(",", ":"))`` would produce, and serialize responses — all
+GIL-free. Python's per-request work shrinks to: pop a parsed record from a
+lock-free ring, submit it to the MicroBatcher, and complete the request
+when the batch verdict lands (the common verdict shape is serialized back
+to JSON natively; anything with patches/warnings/exotic status fields is
+rendered by Python for bit-exactness).
+
+Build model mirrors ops/fastenc.py: compiled on demand with g++ into
+``build/httpfront-<py>.so`` and cached; any failure (no compiler,
+unsupported platform) must degrade loudly-but-gracefully — the server
+falls back to the Python (aiohttp) frontend, which stays the correctness
+oracle for the differential framing corpus
+(tests/test_native_frontend.py).
+
+Two sinks consume parsed records:
+
+* :class:`BatcherSink` — the evaluation process: records feed the
+  MicroBatcher directly (``submit_nowait``), responses complete through
+  the batcher futures' done-callbacks on the dispatch threads.
+* :class:`BridgeSink` — a prefork worker (runtime/frontend.py): the
+  worker becomes a thin owner of a native event loop, forwarding parsed
+  frames over the unix-socket evaluation bridge.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import math
+import socket
+import struct
+import subprocess
+import sys
+import sysconfig
+import threading
+from pathlib import Path
+from typing import Any
+
+from policy_server_tpu.telemetry.tracing import logger
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "csrc" / "httpfront.cpp"
+
+# default request-body cap for DIRECT construction (tests, embedding).
+# The server and prefork workers pass api.handlers.MAX_BODY_BYTES
+# explicitly (server._start_native_frontend asserts the two agree) so
+# the 413 thresholds cannot drift apart behind SO_REUSEPORT; the
+# constant is not imported here to keep this module aiohttp-free.
+MAX_BODY_BYTES = 8 * 1024**2
+
+# record kinds (csrc/httpfront.cpp)
+K_VALIDATE, K_AUDIT, K_RAW, K_VALIDATE_FB, K_AUDIT_FB = 0, 1, 2, 3, 4
+
+# u32 total | u64 req_id | u8 kind | u8 flags | u16 policy/uid/ns/op/gvk/pad
+# | u32 payload_len
+_REC = struct.Struct("<IQBB6HI")
+
+_STAT_NAMES = (
+    "connections_accepted",
+    "http_requests",
+    "requests_parsed_native",
+    "parse_fallbacks",
+    "responses_native_serialized",
+    "responses_python_serialized",
+    "ring_full_rejections",
+    "bad_requests",
+    "route_misses",
+    "oversized_rejections",
+    "bytes_in",
+    "bytes_out",
+    "framing_ns",
+    "inflight",
+    "midbody_disconnects",
+)
+
+_lib_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_pylib: ctypes.PyDLL | None = None
+_lib_failed = False
+
+
+def _build_library() -> Path | None:
+    out_dir = _REPO_ROOT / "build"
+    out_dir.mkdir(exist_ok=True)
+    tag = sysconfig.get_config_var("SOABI") or (
+        f"py{sys.version_info[0]}{sys.version_info[1]}"
+    )
+    out = out_dir / f"httpfront-{tag}.so"
+    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        return out
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        str(_SRC), "-o", str(out),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    except Exception:
+        return None
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _pylib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        path = _build_library()
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+            # completion calls are pure memory ops (lock-free stack push,
+            # no syscalls): binding them through PyDLL keeps the GIL held
+            # for the ~1.5us call instead of paying a release/reacquire
+            # bounce per request — under 4 concurrent delivery threads
+            # that bounce dominated the serving profile
+            pylib = ctypes.PyDLL(str(path))
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.httpfront_create.restype = ctypes.c_void_p
+        lib.httpfront_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.httpfront_set_static.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+        ]
+        lib.httpfront_start.restype = ctypes.c_int
+        lib.httpfront_start.argtypes = [ctypes.c_void_p]
+        lib.httpfront_stop_accepting.argtypes = [ctypes.c_void_p]
+        lib.httpfront_stop.argtypes = [ctypes.c_void_p]
+        lib.httpfront_destroy.argtypes = [ctypes.c_void_p]
+        lib.httpfront_poll.restype = ctypes.c_int64
+        lib.httpfront_poll.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+        ]
+        pylib.httpfront_complete.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int,
+        ]
+        pylib.httpfront_complete_verdict.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int,
+        ]
+        pylib.httpfront_outstanding.restype = ctypes.c_int64
+        pylib.httpfront_outstanding.argtypes = [ctypes.c_void_p]
+        pylib.httpfront_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        _pylib = pylib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def server_header() -> str:
+    """The Server header the aiohttp frontend sends — the native frontend
+    emits the same string so the two are byte-identical behind
+    SO_REUSEPORT (only the Date value differs)."""
+    try:
+        from aiohttp.http import SERVER_SOFTWARE
+
+        return SERVER_SOFTWARE
+    except ImportError:  # aiohttp-less deployment: still serve
+        return "policy-server-tpu"
+
+
+def make_listen_socket(addr: str, port: int, backlog: int = 1024) -> socket.socket:
+    """Bound+listening non-blocking socket with SO_REUSEPORT, so the main
+    process and prefork workers can all own native event loops on the one
+    API port (the kernel load-balances accepted connections)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((addr, port))
+    s.listen(backlog)
+    s.setblocking(False)
+    return s
+
+
+class NativeFrontend:
+    """Owns one native httpfront instance: the listen socket, the event
+    loop threads, the drainer thread, and the completion calls."""
+
+    _POLL_TIMEOUT_MS = 200
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        sink: Any,
+        *,
+        loops: int = 1,
+        max_body: int = MAX_BODY_BYTES,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native frontend unavailable (csrc/httpfront.cpp failed to "
+                "build or load)"
+            )
+        self._lib = lib
+        self._pylib = _pylib  # GIL-holding bindings for the hot non-blocking calls
+        self._sock = sock
+        self._sink = sink
+        self._max_body = max_body
+        # poll buffer must hold the largest single record (a fallback
+        # record carries the whole raw body)
+        self._poll_cap = max_body + 64 * 1024
+        self._lock = threading.Lock()
+        handle = lib.httpfront_create(
+            sock.fileno(), int(loops), int(max_body),
+            server_header().encode(), 12,
+        )
+        if not handle:
+            raise RuntimeError("httpfront_create failed")
+        self._handle = handle  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self._drainer: threading.Thread | None = None
+        self._set_statics(handle)
+
+    # -- static response parity (aiohttp shapes, probed + pinned by the
+    #    differential corpus) --------------------------------------------
+
+    def _set_statics(self, handle) -> None:
+        text = b"text/plain; charset=utf-8"
+        js = b"application/json; charset=utf-8"
+
+        def set_static(slot, status, ct, body, extra=b""):
+            self._lib.httpfront_set_static(
+                handle, slot, status, ct, body, len(body), extra
+            )
+
+        set_static(0, 404, text, b"404: Not Found")
+        set_static(1, 405, text, b"405: Method Not Allowed", b"Allow: POST\r\n")
+        set_static(
+            2, 413, text,
+            (
+                f"Maximum request body size {self._max_body} exceeded, "
+                "actual body size %lld"
+            ).encode(),
+        )
+        set_static(
+            3, 503, js,
+            json.dumps({"message": "evaluation backend unavailable"}).encode(),
+        )
+        set_static(4, 400, text, b"Bad Request")
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "NativeFrontend":
+        with self._lock:
+            handle = self._handle
+        rc = self._lib.httpfront_start(handle)
+        if rc != 0:
+            raise RuntimeError("httpfront_start failed")
+        self._drainer = threading.Thread(
+            target=self._drain_loop, name="httpfront-drain", daemon=True
+        )
+        self._drainer.start()
+        return self
+
+    def stop_accepting(self) -> None:
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._lib.httpfront_stop_accepting(self._handle)
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Stop serving: wait for every in-flight request's completion to
+        flush (the batcher/bridge shutdown resolved their futures before
+        this is called), then stop the loops and free the instance."""
+        import time as _time
+
+        with self._lock:
+            if self._closed:
+                return
+            handle = self._handle
+        deadline = _time.monotonic() + timeout
+        while (
+            _time.monotonic() < deadline
+            and self._pylib.httpfront_outstanding(handle) > 0
+        ):
+            _time.sleep(0.02)
+        self._lib.httpfront_stop(handle)
+        drainer_alive = False
+        if self._drainer is not None:
+            self._drainer.join(timeout=10)
+            drainer_alive = self._drainer.is_alive()
+            self._drainer = None
+        with self._lock:
+            self._closed = True
+            self._handle = None
+        if drainer_alive:
+            # the drainer is wedged inside its sink (e.g. a slow Python
+            # parse of a huge fallback body): destroying the instance it
+            # will poll next would be a use-after-free — leak it instead
+            logger.warning(
+                "native frontend drainer did not exit within the stop "
+                "deadline; leaking the native instance rather than "
+                "freeing it under the thread"
+            )
+        else:
+            self._lib.httpfront_destroy(handle)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- completions (any thread) ----------------------------------------
+
+    def complete(
+        self, req_id: int, status: int, body: bytes, retry_after: int = 0
+    ) -> None:
+        with self._lock:
+            if self._closed or not self._handle:
+                return  # response raced shutdown: the socket is gone anyway
+            self._pylib.httpfront_complete(
+                self._handle, req_id, status, body, len(body),
+                int(retry_after),
+            )
+
+    def complete_verdict(
+        self,
+        req_id: int,
+        uid: str,
+        allowed: bool,
+        code: int | None,
+        message: str | None,
+        raw_shape: bool,
+    ) -> None:
+        uid_b = uid.encode()
+        msg_b = message.encode() if message is not None else None
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._pylib.httpfront_complete_verdict(
+                self._handle, req_id, uid_b, len(uid_b),
+                1 if allowed else 0,
+                -1 if code is None else int(code),
+                msg_b, -1 if msg_b is None else len(msg_b),
+                1 if raw_shape else 0,
+            )
+
+    # -- introspection ----------------------------------------------------
+
+    def stats(self) -> dict[str, int]:
+        out = (ctypes.c_int64 * 16)()
+        with self._lock:
+            if self._closed or not self._handle:
+                return {name: 0 for name in _STAT_NAMES}
+            self._pylib.httpfront_stats(
+                self._handle, ctypes.cast(out, ctypes.POINTER(ctypes.c_int64))
+            )
+        return {name: int(out[i]) for i, name in enumerate(_STAT_NAMES)}
+
+    # -- the drainer ------------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        buf = ctypes.create_string_buffer(self._poll_cap)
+        lib = self._lib
+        with self._lock:
+            # the handle outlives this thread by construction: shutdown()
+            # stops the loops and joins the drainer BEFORE destroy
+            handle = self._handle
+        sink = self._sink
+        unpack_from = _REC.unpack_from
+        rec_size = _REC.size
+        while True:
+            n = lib.httpfront_poll(
+                handle, buf, self._poll_cap, self._POLL_TIMEOUT_MS
+            )
+            if n < 0:
+                return  # stopped and fully drained
+            if n == 0:
+                continue
+            # string_at copies exactly n bytes — buf.raw[:n] would copy
+            # the full poll buffer (max_body-sized) per drain cycle
+            data = ctypes.string_at(buf, n)
+            off = 0
+            while off < n:
+                (
+                    total, req_id, kind, flags, plen, ulen, nslen, oplen,
+                    glen, _pad, paylen,
+                ) = unpack_from(data, off)
+                p = off + rec_size
+                policy = data[p : p + plen].decode()
+                p += plen
+                uid = data[p : p + ulen].decode()
+                p += ulen
+                ns = data[p : p + nslen].decode() if flags & 1 else None
+                p += nslen
+                op = data[p : p + oplen].decode()
+                p += oplen
+                gvk = data[p : p + glen].decode()
+                p += glen
+                payload = data[p : p + paylen]
+                off += total
+                try:
+                    sink.handle(
+                        self, req_id, kind, policy, uid, ns, op, gvk, payload
+                    )
+                except Exception as e:  # noqa: BLE001 — a broken record
+                    # must answer, not hang its HTTP request
+                    logger.error("native frontend sink failed: %s", e)
+                    self.complete(
+                        req_id, 500,
+                        json.dumps(
+                            {"message": "Something went wrong", "status": 500}
+                        ).encode(),
+                    )
+
+
+def _shed_body(retry_after: int) -> bytes:
+    # byte parity with api/handlers._evaluate's 429 json_response
+    return json.dumps(
+        {
+            "message": "policy server overloaded; retry later",
+            "retry_after_seconds": retry_after,
+        }
+    ).encode()
+
+
+def _api_error_body(status: int, message: str) -> bytes:
+    # byte parity with api/api_error.api_error
+    return json.dumps({"message": message, "status": status}).encode()
+
+
+def _verdict_is_native(r: Any) -> bool:
+    """True when the native serializer reproduces json.dumps of this
+    AdmissionResponse byte-for-byte: uid/allowed plus at most a
+    status{message, code} — no patch, warnings, annotations, reason,
+    details, and no empty-status edge case."""
+    if (
+        r.patch is not None
+        or r.patch_type is not None
+        or r.audit_annotations is not None
+        or r.warnings is not None
+    ):
+        return False
+    st = r.status
+    if st is None:
+        return True
+    if st.reason is not None or st.details is not None:
+        return False
+    return st.message is not None or st.code is not None
+
+
+class BatcherSink:
+    """Evaluation-process sink: parsed records feed the MicroBatcher
+    directly; responses complete from the batcher's delivery threads."""
+
+    def __init__(self, state: Any):
+        self.state = state  # ApiServerState: epoch flips rebind .batcher
+
+    def handle(
+        self,
+        frontend: NativeFrontend,
+        req_id: int,
+        kind: int,
+        policy_id: str,
+        uid: str,
+        ns: str | None,
+        op: str,
+        gvk: str,
+        payload: bytes,
+    ) -> None:
+        from policy_server_tpu.api.service import RequestOrigin
+        from policy_server_tpu.models import ValidateRequest
+        from policy_server_tpu.runtime.frontend import WireValidateRequest
+
+        raw_shape = False
+        if kind in (K_VALIDATE, K_AUDIT):
+            header = {
+                "uid": uid,
+                "namespace": ns,
+                "operation": op,
+                "kind": gvk or None,
+            }
+            request: Any = WireValidateRequest(header, payload)
+            origin = (
+                RequestOrigin.AUDIT if kind == K_AUDIT
+                else RequestOrigin.VALIDATE
+            )
+        elif kind in (K_VALIDATE_FB, K_AUDIT_FB):
+            # the native parser declined (float, dup key, bad syntax, …):
+            # Python is the parse oracle, 422 bodies are bit-exact
+            from policy_server_tpu.api.handlers import (
+                BodyError,
+                parse_admission_review_bytes,
+            )
+
+            try:
+                review = parse_admission_review_bytes(payload)
+            except BodyError as e:
+                frontend.complete(
+                    req_id, 422, _api_error_body(422, e.message)
+                )
+                return
+            request = ValidateRequest.from_admission(review.request)
+            origin = (
+                RequestOrigin.AUDIT if kind == K_AUDIT_FB
+                else RequestOrigin.VALIDATE
+            )
+        else:  # K_RAW — mirror the bridge's raw-path parse errors exactly
+            from policy_server_tpu.models import RawReviewRequest
+
+            raw_shape = True
+            try:
+                raw_review = RawReviewRequest.from_dict(json.loads(payload))
+                request = ValidateRequest.from_raw(raw_review.request)
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                frontend.complete(
+                    req_id, 422,
+                    _api_error_body(
+                        422, f"Failed to parse the request body as JSON: {e}"
+                    ),
+                )
+                return
+            except (KeyError, TypeError, ValueError, AttributeError) as e:
+                frontend.complete(
+                    req_id, 422,
+                    _api_error_body(
+                        422, f"Failed to deserialize the JSON body: {e}"
+                    ),
+                )
+                return
+            origin = RequestOrigin.VALIDATE
+        self._submit(frontend, req_id, policy_id, request, origin, raw_shape)
+
+    def _submit(
+        self, frontend, req_id, policy_id, request, origin, raw_shape
+    ) -> None:
+        from policy_server_tpu.runtime.batcher import ShedError
+
+        try:
+            fut = self.state.batcher.submit_nowait(policy_id, request, origin)
+        except ShedError as e:
+            retry = max(1, math.ceil(e.retry_after_seconds))
+            frontend.complete(req_id, 429, _shed_body(retry), retry)
+            return
+        fut.add_done_callback(
+            lambda f: _deliver(frontend, req_id, raw_shape, f)
+        )
+
+
+def _deliver(frontend: NativeFrontend, req_id: int, raw_shape: bool, fut) -> None:
+    """Map a resolved batcher future to the HTTP answer — the native
+    analog of api/handlers._evaluate's error mapping."""
+    from policy_server_tpu.evaluation.errors import PolicyNotFoundError
+
+    exc = fut.exception()
+    if exc is not None:
+        if isinstance(exc, PolicyNotFoundError):
+            frontend.complete(req_id, 404, _api_error_body(404, str(exc)))
+        else:
+            logger.error("Evaluation error: %s", exc)
+            frontend.complete(
+                req_id, 500, _api_error_body(500, "Something went wrong")
+            )
+        return
+    r = fut.result()
+    if _verdict_is_native(r):
+        try:
+            frontend.complete_verdict(
+                req_id, r.uid, r.allowed,
+                r.status.code if r.status else None,
+                r.status.message if r.status else None,
+                raw_shape,
+            )
+            return
+        except UnicodeEncodeError:
+            pass  # surrogates in uid/message: Python json handles them
+    from policy_server_tpu.models import (
+        AdmissionReviewResponse,
+        RawReviewResponse,
+    )
+
+    env = RawReviewResponse(r) if raw_shape else AdmissionReviewResponse(r)
+    frontend.complete(req_id, 200, json.dumps(env.to_dict()).encode())
+
+
+class BridgeSink:
+    """Prefork-worker sink: the worker owns a native event loop and
+    forwards parsed frames over the unix-socket evaluation bridge. The
+    bridge client is asyncio; the drainer hops onto the worker's loop via
+    run_coroutine_threadsafe (frame forwarding is cheap — the HTTP
+    framing this worker used to spend its loop on is already done)."""
+
+    def __init__(self, bridge: Any, loop: Any):
+        self.bridge = bridge
+        self.loop = loop
+
+    def handle(
+        self,
+        frontend: NativeFrontend,
+        req_id: int,
+        kind: int,
+        policy_id: str,
+        uid: str,
+        ns: str | None,
+        op: str,
+        gvk: str,
+        payload: bytes,
+    ) -> None:
+        import asyncio
+
+        coro = self._forward(
+            frontend, req_id, kind, policy_id, uid, ns, op, gvk, payload
+        )
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    async def _forward(
+        self, frontend, req_id, kind, policy_id, uid, ns, op, gvk, payload
+    ) -> None:
+        from policy_server_tpu.runtime import frontend as fr
+
+        try:
+            if kind in (K_VALIDATE, K_AUDIT):
+                header = json.dumps(
+                    {
+                        "uid": uid,
+                        "namespace": ns,
+                        "operation": op,
+                        "kind": gvk or None,
+                    }
+                ).encode()
+                status, body = await self.bridge.call_parsed(
+                    fr.ORIGIN_AUDIT_PARSED if kind == K_AUDIT
+                    else fr.ORIGIN_VALIDATE_PARSED,
+                    policy_id, header, payload,
+                )
+            elif kind in (K_VALIDATE_FB, K_AUDIT_FB):
+                # worker-side parse (422s never cross the bridge), then the
+                # canonical to_dict() payload — same as the aiohttp worker
+                from policy_server_tpu.api.handlers import (
+                    BodyError,
+                    parse_admission_review_bytes,
+                )
+
+                try:
+                    review = parse_admission_review_bytes(payload)
+                except BodyError as e:
+                    frontend.complete(
+                        req_id, 422, _api_error_body(422, e.message)
+                    )
+                    return
+                adm = review.request
+                header = json.dumps(
+                    {
+                        "uid": adm.uid,
+                        "namespace": adm.namespace,
+                        "operation": adm.operation,
+                        "kind": adm.request_kind.kind
+                        if adm.request_kind
+                        else None,
+                    }
+                ).encode()
+                payload_bytes = json.dumps(
+                    adm.to_dict(), separators=(",", ":")
+                ).encode()
+                status, body = await self.bridge.call_parsed(
+                    fr.ORIGIN_AUDIT_PARSED if kind == K_AUDIT_FB
+                    else fr.ORIGIN_VALIDATE_PARSED,
+                    policy_id, header, payload_bytes,
+                )
+            else:  # K_RAW
+                status, body = await self.bridge.call(
+                    fr.ORIGIN_RAW, policy_id, payload
+                )
+        except ConnectionError:
+            frontend.complete(
+                req_id, 503,
+                json.dumps(
+                    {"message": "evaluation backend unavailable"}
+                ).encode(),
+            )
+            return
+        except Exception as e:  # noqa: BLE001 — same contract as the
+            # aiohttp worker: every failure maps to a JSON 500
+            logger.error("bridge forward failed: %s", e)
+            frontend.complete(
+                req_id, 500, _api_error_body(500, "Something went wrong")
+            )
+            return
+        retry_after = 0
+        if status == 429:
+            headers = fr._shed_headers(status, body)  # noqa: SLF001
+            if headers:
+                retry_after = int(headers["Retry-After"])
+        frontend.complete(req_id, status, body, retry_after)

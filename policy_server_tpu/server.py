@@ -67,6 +67,9 @@ class PolicyServer:
         self._bridge = None
         self._worker_procs: list = []
         self._bridge_socket: str | None = None
+        # native HTTP frontend (runtime/native_frontend.py); None under
+        # --frontend python or after a native-load fallback
+        self._native_frontend = None
 
     # The serving environment/batcher are the CURRENT EPOCH's — a hot
     # reload (lifecycle.py) rebinds the state fields, so everything that
@@ -648,6 +651,64 @@ class PolicyServer:
                 "Resident bytes of the audit snapshot store",
                 astats.get("snapshot_bytes", 0),
             )
+            # Native HTTP front-end (round 11): framing throughput, parse
+            # fallbacks (Python stays the parse oracle), serialization
+            # split, and the framing/queue legs of the per-stage time
+            # decomposition. All zero with --frontend python (families
+            # still export so the dashboard panels resolve everywhere).
+            nstats = (
+                state.native_frontend.stats()
+                if state.native_frontend is not None
+                else {}
+            )
+            yield (
+                metrics_names.NATIVE_HTTP_REQUESTS, "counter",
+                "HTTP requests framed by the native (GIL-free C++) "
+                "front-end",
+                nstats.get("http_requests", 0),
+            )
+            yield (
+                metrics_names.NATIVE_PARSE_FALLBACKS, "counter",
+                "Requests the native AdmissionReview parser declined and "
+                "shipped to the Python parse oracle (floats, duplicate "
+                "keys, malformed bodies)",
+                nstats.get("parse_fallbacks", 0),
+            )
+            yield (
+                metrics_names.NATIVE_RING_FULL, "counter",
+                "Requests answered 503 because the native submission "
+                "ring was full (drainer overrun)",
+                nstats.get("ring_full_rejections", 0),
+            )
+            yield (
+                metrics_names.NATIVE_VERDICTS_SERIALIZED, "counter",
+                "Responses serialized natively (common verdict shape)",
+                nstats.get("responses_native_serialized", 0),
+            )
+            yield (
+                metrics_names.NATIVE_PYTHON_SERIALIZED, "counter",
+                "Responses rendered by Python behind the native frontend "
+                "(errors, mutations, exotic status fields)",
+                nstats.get("responses_python_serialized", 0),
+            )
+            yield (
+                metrics_names.NATIVE_FRAMING_SECONDS, "counter",
+                "Native-thread time in HTTP framing, AdmissionReview "
+                "canonicalization, and response serialization",
+                nstats.get("framing_ns", 0) / 1e9,
+            )
+            yield (
+                metrics_names.NATIVE_INFLIGHT, "gauge",
+                "Requests accepted by the native frontend still awaiting "
+                "their completion",
+                nstats.get("inflight", 0),
+            )
+            yield (
+                metrics_names.QUEUE_WAIT_SECONDS, "counter",
+                "Cumulative time requests spent queued between batcher "
+                "submission and batch formation",
+                bstats["queue_wait_ns"] / 1e9,
+            )
 
         from policy_server_tpu.telemetry import default_registry
 
@@ -689,16 +750,26 @@ class PolicyServer:
                 "--http-workers is not supported with TLS yet (workers "
                 "would each need the cert material); serving in-process"
             )
-        api_runner = web.AppRunner(self.router())
-        await api_runner.setup()
-        api_site = web.TCPSite(
-            api_runner, self.config.addr, self.config.port,
-            ssl_context=self.tls_context,
-            reuse_port=prefork or None,
-        )
-        await api_site.start()
-        self.api_port = _bound_port(api_runner) or self.config.port
-        self._runners.append(api_runner)
+        native = False
+        if self.config.frontend == "native":
+            if self.tls_context is not None:
+                logger.warning(
+                    "--frontend native is not supported with TLS yet; "
+                    "serving with the Python frontend"
+                )
+            else:
+                native = self._start_native_frontend()
+        if not native:
+            api_runner = web.AppRunner(self.router())
+            await api_runner.setup()
+            api_site = web.TCPSite(
+                api_runner, self.config.addr, self.config.port,
+                ssl_context=self.tls_context,
+                reuse_port=prefork or None,
+            )
+            await api_site.start()
+            self.api_port = _bound_port(api_runner) or self.config.port
+            self._runners.append(api_runner)
         if prefork:
             await self._start_frontend_workers()
 
@@ -728,6 +799,59 @@ class PolicyServer:
                 }
             },
         )
+
+    def _start_native_frontend(self) -> bool:
+        """Bind the GIL-free C++ HTTP front-end on the API port (it then
+        OWNS the evaluation POST surface; pprof and /audit/reports GETs
+        live on the readiness port). Returns False — with ONE loud line —
+        on any build/load/bind failure, and the caller serves through the
+        always-available Python frontend instead (the round-7 soft-dep
+        pattern: degraded, never broken)."""
+        sock = None
+        try:
+            from policy_server_tpu.api.handlers import MAX_BODY_BYTES
+            from policy_server_tpu.runtime import native_frontend as nf
+
+            if not nf.native_available():
+                raise RuntimeError(
+                    "csrc/httpfront.cpp failed to build or load"
+                )
+            # one body cap across every process that can accept the API
+            # socket — a drift here would make 413s nondeterministic
+            # behind SO_REUSEPORT
+            assert nf.MAX_BODY_BYTES == MAX_BODY_BYTES
+            sock = nf.make_listen_socket(self.config.addr, self.config.port)
+            front = nf.NativeFrontend(
+                sock, nf.BatcherSink(self.state), max_body=MAX_BODY_BYTES
+            )
+            front.start()
+        except Exception as e:  # noqa: BLE001 — fall back, never refuse boot
+            if sock is not None:
+                import contextlib
+
+                with contextlib.suppress(OSError):
+                    sock.close()
+            logger.warning(
+                "native HTTP frontend unavailable (%s); falling back to "
+                "the Python frontend", e,
+            )
+            return False
+        self._native_frontend = front
+        self.state.native_frontend = front
+        self.api_port = sock.getsockname()[1]
+        if self.config.enable_pprof:
+            logger.warning(
+                "--enable-pprof with --frontend native: the native "
+                "frontend serves only the evaluation POST surface; hit "
+                "the pprof endpoints with --frontend python"
+            )
+        logger.info(
+            "native HTTP frontend started",
+            extra={"span_fields": {
+                "addr": self.config.addr, "port": self.api_port,
+            }},
+        )
+        return True
 
     async def _start_frontend_workers(self) -> None:
         """Spawn the prefork HTTP workers (runtime/frontend.py): the
@@ -763,6 +887,7 @@ class PolicyServer:
             self.config.log_fmt
             if self.config.log_fmt != "otlp"
             else "json",  # workers log; spans stay in-process
+            "--frontend", self.config.frontend,
         ]
         for i in range(n):
             self._worker_procs.append(subprocess.Popen(self._worker_cmd))
@@ -856,6 +981,10 @@ class PolicyServer:
         import contextlib
         import os as _os
 
+        if self._native_frontend is not None:
+            # stop ACCEPTING first; in-flight native requests drain below
+            # once the batcher shutdown resolves their futures
+            self._native_frontend.stop_accepting()
         supervisor = getattr(self, "_worker_supervisor", None)
         if supervisor is not None:
             supervisor.cancel()
@@ -910,6 +1039,15 @@ class PolicyServer:
             # The server built the environment, so the server closes it —
             # the batcher only borrows it (two batchers may share one env).
             self.environment.close()
+        if self._native_frontend is not None:
+            # every submitted future is resolved by now (batcher shutdown
+            # drains rejecting), so this just flushes the last completions
+            # out of the sockets, then stops the native loops
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._native_frontend.shutdown
+            )
+            self._native_frontend = None
+            self.state.native_frontend = None
         # Flush buffered spans / final metric state to the collector (the
         # reference flushes its OTEL providers on shutdown). No-op when the
         # OTLP pipeline was never installed.

@@ -91,6 +91,17 @@ AUDIT_REPORTS_RESIDENT = "policy_server_audit_reports_resident"
 AUDIT_REPORTS_STALE = "policy_server_audit_reports_stale"
 AUDIT_SNAPSHOT_RESOURCES = "policy_server_audit_snapshot_resources"
 AUDIT_SNAPSHOT_BYTES = "policy_server_audit_snapshot_bytes"
+# round 11 — native HTTP front-end (csrc/httpfront.cpp +
+# runtime/native_frontend.py): GIL-free framing counters, plus the
+# batcher queue-wait leg of the framing/queue/device decomposition
+NATIVE_HTTP_REQUESTS = "policy_server_native_http_requests"
+NATIVE_PARSE_FALLBACKS = "policy_server_native_parse_fallbacks"
+NATIVE_RING_FULL = "policy_server_native_ring_full_rejections"
+NATIVE_VERDICTS_SERIALIZED = "policy_server_native_serialized_verdicts"
+NATIVE_PYTHON_SERIALIZED = "policy_server_native_python_serialized_responses"
+NATIVE_FRAMING_SECONDS = "policy_server_native_framing_seconds_total"
+NATIVE_INFLIGHT = "policy_server_native_inflight_requests"
+QUEUE_WAIT_SECONDS = "policy_server_queue_wait_seconds_total"
 HOST_ENCODE_SECONDS = "policy_server_host_encode_seconds_total"
 HOST_ENCODE_ROWS = "policy_server_host_encode_rows_total"
 HOST_BOOKKEEPING_SECONDS = "policy_server_host_bookkeeping_seconds_total"

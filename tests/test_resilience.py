@@ -1009,3 +1009,190 @@ def test_audit_scanner_chaos_under_load_reload_and_sweep_fault():
         stop.set()
         failpoints.reset()
         handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# Native frontend chaos (round 11): the GIL-free C++ framing path under
+# shutdown-under-load, SIGHUP hot reload, and armed device failpoints
+# ---------------------------------------------------------------------------
+
+
+def _native_or_skip():
+    nf = pytest.importorskip("policy_server_tpu.runtime.native_frontend")
+    if not nf.native_available():
+        pytest.skip("httpfront.cpp failed to build (no g++?)")
+    return nf
+
+
+def test_native_shutdown_under_load_resolves_every_inflight():
+    """stop() with in-flight requests parked on a hung device behind the
+    NATIVE frontend: every accepted request gets an HTTP answer (watchdog
+    500-in-200 or shutdown 503-in-200) before the native loops stop —
+    no resets, no hangs, and stop() stays inside its own deadline."""
+    import requests as rq
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    _native_or_skip()
+    handle = ServerHandle(
+        make_config(frontend="native", policy_timeout_seconds=0.5)
+    )
+    assert handle.server._native_frontend is not None
+    release = threading.Event()
+    results: list = []
+    try:
+        failpoints.set_failpoint(
+            "device.fetch", lambda: release.wait(timeout=30)
+        )
+
+        def fire():
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(False), timeout=10,
+                )
+                results.append(r.status_code)
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                results.append(e)
+
+        threads = [threading.Thread(target=fire) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while (
+            handle.server.batcher.stats_snapshot()["requests_dispatched"] < 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+    finally:
+        t0 = time.perf_counter()
+        handle.stop()
+        stop_elapsed = time.perf_counter() - t0
+        release.set()
+    assert stop_elapsed < 12.0, f"server stop took {stop_elapsed:.1f}s"
+    for t in threads:
+        t.join(timeout=5)
+    assert len(results) == 4
+    assert all(isinstance(code, int) for code in results), results
+
+
+def test_native_sighup_reload_under_load_zero_non_2xx():
+    """Sustained traffic through the native frontend across a SIGHUP-
+    triggered policy hot reload: zero non-2xx, bit-exact verdicts through
+    the epoch flip (the reload machinery swaps state.batcher under the
+    drainer's feet — BatcherSink must follow the epoch pointer)."""
+    import requests as rq
+
+    from test_server import ServerHandle, pod_review_body
+
+    _native_or_skip()
+    config, _policies = _lifecycle_config()
+    config.frontend = "native"
+    handle = ServerHandle(config)
+    assert handle.server._native_frontend is not None
+    lifecycle = handle.server.lifecycle
+    stop = threading.Event()
+    results: list[tuple[int, bool | None, bool]] = []
+    errors: list[Exception] = []
+
+    def traffic(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            privileged = (i + worker) % 2 == 0
+            i += 1
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(privileged), timeout=30,
+                )
+                allowed = (
+                    r.json()["response"]["allowed"]
+                    if r.status_code == 200 else None
+                )
+                results.append((r.status_code, allowed, privileged))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=traffic, args=(w,), daemon=True)
+        for w in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        before = lifecycle.stats()["reloads"]
+        # the SIGHUP contract entry point (server.reload_signal), not a
+        # raw kill(): ServerHandle's loop thread can't take signals
+        handle.server.reload_signal()
+        deadline = time.monotonic() + 60
+        while (
+            lifecycle.stats()["reloads"] == before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert lifecycle.stats()["reloads"] > before, "reload never promoted"
+        time.sleep(0.3)  # traffic THROUGH the promoted epoch
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        handle.stop()
+    assert not errors, errors
+    assert len(results) > 20
+    non_2xx = [r for r in results if r[0] != 200]
+    assert not non_2xx, f"non-2xx during native SIGHUP reload: {non_2xx[:5]}"
+    for _code, allowed, privileged in results:
+        assert allowed is (not privileged)  # bit-exact through the flip
+
+
+def test_native_armed_failpoint_breaker_degrades_to_oracle():
+    """An armed raising device failpoint behind the native frontend:
+    the breaker trips, traffic degrades to the bit-exact host oracle —
+    every HTTP answer stays 200 with the correct verdict."""
+    import requests as rq
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    _native_or_skip()
+    handle = ServerHandle(
+        make_config(
+            frontend="native",
+            policy_timeout_seconds=5.0,
+            breaker_failure_threshold=2,
+            breaker_window_seconds=10.0,
+            breaker_cooldown_seconds=30.0,
+            verdict_cache_size=0,
+            host_fastpath_threshold=0,
+            latency_budget_ms=0.0,
+        )
+    )
+    assert handle.server._native_frontend is not None
+    try:
+        def boom():
+            raise RuntimeError("injected device fault")
+
+        failpoints.set_failpoint("device.fetch", boom)
+        statuses = []
+        for privileged in (True, False) * 6:
+            r = rq.post(
+                handle.url("/validate/pod-privileged"),
+                json=pod_review_body(privileged), timeout=30,
+            )
+            statuses.append(r.status_code)
+            if r.status_code == 200:
+                body = r.json()["response"]
+                # in-band faults (pre-trip) reject with 5xx status codes;
+                # post-trip oracle answers carry the true verdict
+                if not (body.get("status") or {}).get("code"):
+                    assert body["allowed"] is (not privileged)
+        # the breaker tripped and the oracle served: the tail of the
+        # stream must be clean 200s with true verdicts
+        tail = statuses[-6:]
+        assert tail == [200] * 6, statuses
+        breaker = handle.server.environment.breaker_stats
+        assert breaker["trips"] >= 1
+        assert handle.server._native_frontend.stats()["http_requests"] >= 12
+    finally:
+        handle.stop()
