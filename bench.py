@@ -1,1663 +1,26 @@
-"""Benchmark suite: the five BASELINE.md configs + the HTTP serving path.
+"""Benchmark suite shim — the suite itself lives in ``tools/bench/``
+(round 12: the single file outgrew its shape, ROADMAP item 5). This
+entrypoint, its arguments, and every emitted BENCH json key are
+unchanged:
 
-Prints one JSON line per benchmark, the HEADLINE line LAST (config 4, the
-32-policy firehose — the driver's recorded metric):
+    python bench.py [n_requests] [batch_size]
 
-    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
-
-``vs_baseline`` is value / 100_000 on throughput metrics — the north-star
-target from BASELINE.json (the reference publishes no numbers; ≥1.0 means
-the target is met on this hardware). Latency-only lines use the <10 ms
-p99 target instead (vs_baseline = 10 / p99, ≥1.0 means met).
-
-Configs (BASELINE.md:34-40):
-1. namespace-validate — single policy, batch=1 (the CPU-reference shape);
-2. psp-capabilities + psp-apparmor — 2 policies, 1k-request replay;
-3. pod-image-signatures group — OR/AND expression tree over 3 members;
-4. 32 mixed policies, synthetic firehose (headline);
-5. multi-tenant 8-shard policy-sharded mesh incl. preemption churn — runs
-   in a subprocess on the 8-virtual-device CPU mesh (multi-chip hardware
-   is not present; the virtual mesh measures routing/rebalance overheads,
-   clearly labeled);
-plus HTTP lines driving the REAL server (aiohttp, concurrent clients)
-through the micro-batcher: end-to-end p50/p95/p99 with median/min/max
-spread over 3 timed waves, a latency-budget-router A/B at c64, and a
-c256 overload run with load shedding on vs off (accepted-p99 + shed
-rate).
-"""
+One JSON line per benchmark, the HEADLINE line LAST (config 4, the
+32-policy firehose — the driver's recorded metric). Subprocess entry
+points (``--config5-child``, ``--native-client``) also route through
+here so child invocations stay `python bench.py ...`."""
 
 from __future__ import annotations
 
-import json
-import math
-import os
-import statistics
-import subprocess
 import sys
-import time
+from pathlib import Path
 
-NORTH_STAR_RPS = 100_000.0
-NORTH_STAR_P99_MS = 10.0
+# invoked as a script: the repo root must be importable for tools.bench
+_ROOT = str(Path(__file__).resolve().parent)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# every emitted (metric, value, unit) — re-printed as one compact
-# bench_summary line before the headline so a truncated tail window
-# (BENCH_r04 lost config1-3) still records every number
-_EMITTED: list[tuple[str, float, str]] = []
-
-
-def pct(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = max(0, math.ceil(q * len(sorted_vals)) - 1)
-    return sorted_vals[idx]
-
-
-def emit(metric: str, value: float, unit: str, vs: float, **details) -> None:
-    _EMITTED.append((metric, round(value, 2), unit))
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 2),
-                "unit": unit,
-                "vs_baseline": round(vs, 4),
-                "details": details,
-            }
-        ),
-        flush=True,
-    )
-
-
-def spread(walls_to_rps: list[float]) -> dict:
-    """median + min/max over N timed passes — the tunneled transport
-    drifts ±40% between identical runs (VERDICT r4 weak #3), so a point
-    value is not defensible against a same-day re-run."""
-    vals = sorted(walls_to_rps)
-    return {
-        "median": statistics.median(vals),
-        "min": vals[0],
-        "max": vals[-1],
-        "runs": [round(v, 1) for v in walls_to_rps],
-    }
-
-
-def build_requests(n: int, seed: int = 42):
-    from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
-    from policy_server_tpu.policies.flagship import synthetic_firehose
-
-    return [
-        ValidateRequest.from_admission(
-            AdmissionReviewRequest.from_dict(doc).request
-        )
-        for doc in synthetic_firehose(n, seed=seed)
-    ]
-
-
-def build_env(policies: dict):
-    from policy_server_tpu.evaluation.environment import (
-        EvaluationEnvironmentBuilder,
-    )
-    from policy_server_tpu.models.policy import parse_policy_entry
-
-    return EvaluationEnvironmentBuilder(backend="jax").build(
-        {k: parse_policy_entry(k, v) for k, v in policies.items()}
-    )
-
-
-# ---------------------------------------------------------------------------
-# Config 1: namespace-validate, single request (batch=1)
-# ---------------------------------------------------------------------------
-
-
-def bench_config1(requests) -> None:
-    """The webhook-like shape: one request at a time through the SERVING
-    path (micro-batcher with the host latency fast-path). vs_baseline is
-    against this config's own reference point — the reference's CPU sync
-    path answers a single request in ≈1 ms (≈1k reviews/s) — not the
-    100k/chip pod target, which is meaningless at batch=1."""
-    from policy_server_tpu.api.service import RequestOrigin
-    from policy_server_tpu.runtime.batcher import MicroBatcher
-
-    ref_single_rps = 1_000.0  # reference CPU sync path, ≈1 ms/request
-    env = build_env(
-        {
-            "namespace-validate": {
-                "module": "builtin://namespace-validate",
-                "settings": {"denied_namespaces": ["kube-system"]},
-            }
-        }
-    )
-    env.warmup((1,))
-    batcher = MicroBatcher(
-        env,
-        max_batch_size=64,
-        batch_timeout_ms=0.0,
-        policy_timeout=30.0,
-        host_fastpath_threshold=64,
-    ).start()
-    reqs = requests[:2048]
-    try:
-        for r in reqs[:8]:
-            batcher.evaluate("namespace-validate", r, RequestOrigin.VALIDATE)
-        lats = []
-        t0 = time.perf_counter()
-        for r in reqs:
-            t1 = time.perf_counter()
-            batcher.evaluate("namespace-validate", r, RequestOrigin.VALIDATE)
-            lats.append((time.perf_counter() - t1) * 1e3)
-        wall = time.perf_counter() - t0
-    finally:
-        batcher.shutdown()
-    lats.sort()
-    rps = len(reqs) / wall
-    emit(
-        "config1_namespace_validate_single",
-        rps,
-        "reviews/s",
-        rps / ref_single_rps,
-        p50_ms=round(pct(lats, 0.5), 2),
-        p99_ms=round(pct(lats, 0.99), 2),
-        batch_size=1,
-        n_requests=len(reqs),
-        host_fastpath_requests=env.host_fastpath_requests,
-        baseline="reference CPU sync path ≈1k reviews/s (≈1 ms/request); "
-        "vs_baseline is against that, not the 100k/chip pod target",
-        note="serving path: micro-batcher + host latency fast-path",
-    )
-
-
-# ---------------------------------------------------------------------------
-# Config 2: psp-capabilities + psp-apparmor, 1k replay
-# ---------------------------------------------------------------------------
-
-
-def bench_config2(requests) -> None:
-    env = build_env(
-        {
-            "psp-capabilities": {
-                "module": "builtin://psp-capabilities",
-                "allowedToMutate": True,
-                "settings": {
-                    "allowed_capabilities": ["NET_BIND_SERVICE", "CHOWN"],
-                    "required_drop_capabilities": ["NET_ADMIN"],
-                    "default_add_capabilities": ["CHOWN"],
-                },
-            },
-            "psp-apparmor": {
-                "module": "builtin://psp-apparmor",
-                "settings": {"allowed_profiles": ["runtime/default"]},
-            },
-        }
-    )
-    corpus = requests[:1000]
-    items = [
-        ("psp-capabilities" if i % 2 else "psp-apparmor", r)
-        for i, r in enumerate(corpus)
-    ]
-    env.max_dispatch_batch = 512
-    env.warmup((512,))
-    env.validate_batch(items)  # prime
-    rps_runs = []
-    for _ in range(3):
-        # reset before EVERY timed call: a second pass over the identical
-        # replay would otherwise be answered from the verdict cache and
-        # double-count as device throughput
-        t0 = time.perf_counter()
-        for _rep in range(2):
-            env.reset_verdict_cache()
-            env.validate_batch(items)
-        rps_runs.append(2 * len(items) / (time.perf_counter() - t0))
-    s = spread(rps_runs)
-    emit(
-        "config2_psp_pair_1k_replay",
-        s["median"],
-        "reviews/s/chip",
-        s["median"] / NORTH_STAR_RPS,
-        rps_min=round(s["min"], 1),
-        rps_max=round(s["max"], 1),
-        rps_runs=s["runs"],
-        replay_size=len(items),
-        n_policies=2,
-    )
-
-
-# ---------------------------------------------------------------------------
-# Config 3: pod-image-signatures policy group (OR/AND tree)
-# ---------------------------------------------------------------------------
-
-
-def bench_config3(requests) -> None:
-    from policy_server_tpu.policies.flagship import _signature_fixture
-
-    store, pub = _signature_fixture()
-    env = build_env(
-        {
-            "pod-image-signatures": {
-                "expression": "signed() || (trusted() && not_latest())",
-                "message": "image provenance cannot be established",
-                "policies": {
-                    "signed": {
-                        "module": "builtin://verify-image-signatures",
-                        "settings": {
-                            "signatures": [
-                                {
-                                    "image": "registry.prod.example.com/*",
-                                    "pubKeys": [pub],
-                                }
-                            ],
-                            "signatureStore": store,
-                        },
-                    },
-                    "trusted": {
-                        "module": "builtin://trusted-repos",
-                        "settings": {"registries": {"allow": ["docker.io"]}},
-                    },
-                    "not_latest": {"module": "builtin://disallow-latest-tag"},
-                },
-            }
-        }
-    )
-    corpus = requests[:4096]
-    items = [("pod-image-signatures", r) for r in corpus]
-    env.max_dispatch_batch = 1024
-    env.warmup((1024,))
-    env.validate_batch(items)  # prime with a FULL pass (same buckets)
-    rps_runs = []
-    for _ in range(3):
-        env.reset_verdict_cache()
-        t0 = time.perf_counter()
-        env.validate_batch(items)
-        rps_runs.append(len(items) / (time.perf_counter() - t0))
-    s = spread(rps_runs)
-    emit(
-        "config3_image_signatures_group",
-        s["median"],
-        "reviews/s/chip",
-        s["median"] / NORTH_STAR_RPS,
-        rps_min=round(s["min"], 1),
-        rps_max=round(s["max"], 1),
-        rps_runs=s["runs"],
-        n_requests=len(items),
-        group_members=3,
-        expression="signed() || (trusted() && not_latest())",
-    )
-
-
-# ---------------------------------------------------------------------------
-# Config 5: 8-shard multi-tenant + preemption churn (virtual CPU mesh)
-# ---------------------------------------------------------------------------
-
-
-def bench_config5_child() -> None:
-    """Runs in a subprocess with JAX_PLATFORMS=cpu and 8 virtual devices."""
-    import jax
-
-    # the axon site package pins jax_platforms to the real TPU regardless
-    # of JAX_PLATFORMS (see tests/conftest.py); override before backend init
-    jax.config.update("jax_platforms", "cpu")
-
-    from policy_server_tpu.config.config import MeshSpec
-    from policy_server_tpu.parallel import PolicyShardedEvaluator, make_mesh
-    from policy_server_tpu.models.policy import parse_policy_entry
-
-    # 8 tenants × namespace fence + shared pod-security = 16 policies over
-    # a policy:8 mesh (each shard data-parallel over 1 device)
-    policies = {}
-    for t in range(8):
-        policies[f"tenant{t}-fence"] = parse_policy_entry(
-            f"tenant{t}-fence",
-            {
-                "module": "builtin://namespace-validate",
-                "settings": {"denied_namespaces": [f"tenant-{t}-restricted"]},
-            },
-        )
-        policies[f"tenant{t}-priv"] = parse_policy_entry(
-            f"tenant{t}-priv", {"module": "builtin://pod-privileged"}
-        )
-    mesh = make_mesh(MeshSpec.parse("data:1,policy:8"))
-    sharded = PolicyShardedEvaluator(policies, mesh)
-    requests = build_requests(2048, seed=9)
-    pids = list(policies)
-    items = [(pids[i % len(pids)], r) for i, r in enumerate(requests)]
-    # prime with a FULL pass: per-shard batches land in the same shape
-    # bucket as the timed run, so XLA compiles OUTSIDE the timed region
-    # (priming with a slice measured compile time, not serving: 2,085
-    # rps reported in r3 vs ~90k steady-state on the same machine)
-    sharded.validate_batch(items)
-    rps_runs = []
-    for _ in range(3):
-        for env in sharded.shards:
-            env.reset_verdict_cache()
-        t0 = time.perf_counter()
-        sharded.validate_batch(items)
-        rps_runs.append(len(items) / (time.perf_counter() - t0))
-    rps_runs.sort()
-
-    # preemption churn: drop 2 of 8 devices, measure the rebuild, and
-    # verify serving continues
-    t1 = time.perf_counter()
-    sharded.resize(list(jax.devices())[:6])
-    churn_s = time.perf_counter() - t1
-    # first post-churn batch pays the rebalanced shards' compiles —
-    # report that stall separately from steady-state serving
-    t2 = time.perf_counter()
-    sharded.validate_batch(items[:512])
-    first_post_wall = time.perf_counter() - t2
-    t3 = time.perf_counter()
-    sharded.validate_batch(items[:512])
-    post_wall = time.perf_counter() - t3
-
-    print(
-        json.dumps(
-            {
-                "rps": rps_runs[len(rps_runs) // 2],
-                "rps_min": rps_runs[0],
-                "rps_max": rps_runs[-1],
-                "churn_rebuild_s": churn_s,
-                "post_churn_first_batch_s": first_post_wall,
-                "post_churn_rps": 512 / post_wall,
-                "shards_before": 8,
-                "shards_after": sharded.mesh.shape["policy"],
-            }
-        )
-    )
-
-
-def bench_config5() -> None:
-    child_env = dict(os.environ)
-    child_env.update(
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS=(
-            child_env.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip(),
-    )
-    out = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--config5-child"],
-        capture_output=True,
-        text=True,
-        env=child_env,
-        timeout=1800,
-        check=False,
-    )
-    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-    try:
-        doc = json.loads(line)
-    except (ValueError, IndexError):
-        emit(
-            "config5_multitenant_8shards_virtual",
-            0.0,
-            "reviews/s (8 virtual cpu devices)",
-            0.0,
-            error=(out.stderr or "no output")[-400:],
-        )
-        return
-    emit(
-        "config5_multitenant_8shards_virtual",
-        doc["rps"],
-        "reviews/s (8 virtual cpu devices)",
-        doc["rps"] / NORTH_STAR_RPS,
-        rps_min=round(doc.get("rps_min", doc["rps"]), 1),
-        rps_max=round(doc.get("rps_max", doc["rps"]), 1),
-        churn_rebuild_s=round(doc["churn_rebuild_s"], 2),
-        post_churn_first_batch_s=round(doc["post_churn_first_batch_s"], 2),
-        post_churn_rps=round(doc["post_churn_rps"], 1),
-        shards_before=doc["shards_before"],
-        shards_after=doc["shards_after"],
-        note="virtual CPU mesh: multi-chip hardware not present; measures "
-        "MPMD routing + churn rebuild, not TPU throughput",
-    )
-
-
-# ---------------------------------------------------------------------------
-# HTTP serving path: real server, concurrent clients, p50/p99
-# ---------------------------------------------------------------------------
-
-
-def _decomp_snapshot(server) -> dict:
-    """Cumulative per-stage counters for the framing/queue/device time
-    decomposition (round-11 satellite): where a served request's wall
-    time goes — native framing (C++ threads), batcher queue wait, host
-    encode+bookkeeping, device wait."""
-    bs = server.batcher.stats_snapshot()
-    prof = dict(getattr(server.environment, "host_profile", {}) or {})
-    nf = getattr(server, "_native_frontend", None)
-    nstats = nf.stats() if nf is not None else {}
-    return {
-        "requests": bs["requests_dispatched"],
-        "queue_wait_ns": bs["queue_wait_ns"],
-        "encode_ns": prof.get("encode_ns", 0),
-        "bookkeeping_ns": prof.get("bookkeeping_ns", 0),
-        "device_wait_ns": prof.get("dispatch_wait_ns", 0),
-        "framing_ns": nstats.get("framing_ns", 0),
-        "parse_fallbacks": nstats.get("parse_fallbacks", 0),
-    }
-
-
-def _decompose(before: dict, after: dict) -> dict:
-    """Per-request stage times between two snapshots. 'unattributed' is
-    everything else — handler/runtime Python, GIL waits, and (for the
-    Python frontend) the asyncio HTTP framing itself, which has no
-    counter; on the native frontend framing is measured directly."""
-    d = {k: after[k] - before[k] for k in before}
-    n = max(1, d["requests"])
-    return {
-        "requests_dispatched": d["requests"],
-        "framing_ms_per_req": round(d["framing_ns"] / 1e6 / n, 4),
-        "queue_wait_ms_per_req": round(d["queue_wait_ns"] / 1e6 / n, 3),
-        "host_encode_ms_per_req": round(d["encode_ns"] / 1e6 / n, 3),
-        "host_bookkeeping_ms_per_req": round(
-            d["bookkeeping_ns"] / 1e6 / n, 3
-        ),
-        "device_wait_ms_per_req": round(d["device_wait_ns"] / 1e6 / n, 3),
-        "native_parse_fallbacks": d["parse_fallbacks"],
-    }
-
-
-def _http_bench_core(
-    n_requests: int,
-    concurrency: int,
-    config_overrides: dict | None = None,
-    waves: int = 3,
-    allowed_statuses: tuple = (200,),
-) -> dict:
-    """Boot a REAL server, drive it with `concurrency` concurrent clients
-    for `waves` timed passes over the same body set, return stats.
-
-    Latency percentiles are computed over ACCEPTED (HTTP 200) responses
-    only — under load shedding the 429s are the mechanism, and mixing
-    their (fast) turnaround into the latency line would flatter it.
-    Per-wave rps/p99 feed the spread the device lines already carry
-    (round-7 satellite: VM weather and regressions were previously
-    indistinguishable on HTTP lines)."""
-    import asyncio
-    import threading
-
-    import aiohttp
-
-    from policy_server_tpu.config.config import Config
-    from policy_server_tpu.policies.flagship import (
-        flagship_policies,
-        synthetic_firehose,
-    )
-    from policy_server_tpu.server import PolicyServer
-
-    cfg = dict(
-        addr="127.0.0.1",
-        port=0,
-        readiness_probe_port=0,
-        policies=flagship_policies(),
-        max_batch_size=256,
-        batch_timeout_ms=1.0,
-        policy_timeout_seconds=30.0,  # bench must measure, not clip
-    )
-    cfg.update(config_overrides or {})
-    server = PolicyServer.new_from_config(Config(**cfg))
-
-    loop_box: dict = {}
-    started = threading.Event()
-
-    def run_server() -> None:
-        loop = asyncio.new_event_loop()
-        loop_box["loop"] = loop
-        asyncio.set_event_loop(loop)
-
-        async def main() -> None:
-            await server.start()
-            started.set()
-            while not loop_box.get("stop"):
-                await asyncio.sleep(0.05)
-            await server.stop()
-
-        loop.run_until_complete(main())
-
-    t = threading.Thread(target=run_server, daemon=True)
-    t.start()
-    if not started.wait(timeout=600):
-        raise RuntimeError("bench server failed to start")
-    port = server.api_port
-
-    docs = synthetic_firehose(n_requests, seed=77)
-    bodies = [
-        json.dumps(
-            {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
-             "request": d["request"]}
-        ).encode()
-        for d in docs
-    ]
-    url = f"http://127.0.0.1:{port}/validate/pod-security-group"
-    lats: list[float] = []  # accepted (200) latencies, current wave
-    statuses: dict[int, int] = {}
-    wave_stats: list[dict] = []
-    decomp_box: dict = {}
-
-    async def client() -> None:
-        connector = aiohttp.TCPConnector(limit=concurrency)
-        async with aiohttp.ClientSession(connector=connector) as session:
-            sem = asyncio.Semaphore(concurrency)
-
-            async def one(body: bytes) -> None:
-                async with sem:
-                    t0 = time.perf_counter()
-                    async with session.post(
-                        url, data=body,
-                        headers={"Content-Type": "application/json"},
-                    ) as resp:
-                        data = await resp.read()
-                        assert resp.status in allowed_statuses, resp.status
-                        key = resp.status
-                        if resp.status == 200:
-                            # overload answers travel IN-BAND: an expired
-                            # or deadline-cut review is HTTP 200 with
-                            # response.status.code 429/500/503/504 — only
-                            # genuinely served verdicts may count toward
-                            # the accepted latency line
-                            code = None
-                            try:
-                                st = (
-                                    json.loads(data)
-                                    .get("response", {})
-                                    .get("status")
-                                ) or {}
-                                code = st.get("code")
-                            except (ValueError, AttributeError):
-                                pass
-                            if code in (429, 500, 503, 504):
-                                key = f"inband_{code}"
-                            else:
-                                lats.append(
-                                    (time.perf_counter() - t0) * 1e3
-                                )
-                        statuses[key] = statuses.get(key, 0) + 1
-
-            # prime compile/caches with one wave (untimed)
-            await asyncio.gather(*(one(b) for b in bodies[:concurrency]))
-            decomp_box["before"] = _decomp_snapshot(server)
-            for _wave in range(waves):
-                lats.clear()
-                statuses.clear()
-                t0 = time.perf_counter()
-                await asyncio.gather(*(one(b) for b in bodies))
-                wall = time.perf_counter() - t0
-                accepted = sorted(lats)
-                wave_stats.append(
-                    {
-                        "wall": wall,
-                        "rps": len(bodies) / wall,
-                        "accepted": len(accepted),
-                        "p50": pct(accepted, 0.5),
-                        "p95": pct(accepted, 0.95),
-                        "p99": pct(accepted, 0.99),
-                        "statuses": dict(statuses),
-                    }
-                )
-
-    try:
-        asyncio.run(client())
-        decomp = (
-            _decompose(decomp_box["before"], _decomp_snapshot(server))
-            if "before" in decomp_box else {}
-        )
-    finally:
-        # the server must die even when a client assert trips — a live
-        # second environment would skew every benchmark that follows
-        loop_box["stop"] = True
-        t.join(timeout=60)
-
-    # a wave with ZERO accepted responses has p99 = pct([], .99) = 0.0 —
-    # a fake best-case that would sort first and could become the median
-    # exactly when shedding rejected everything; percentile aggregation
-    # uses only waves that actually accepted traffic
-    accepted_waves = [w for w in wave_stats if w["accepted"]]
-    by_p99 = sorted(accepted_waves or wave_stats, key=lambda w: w["p99"])
-    mid = by_p99[len(by_p99) // 2]
-    total_statuses: dict[int, int] = {}
-    for w in wave_stats:
-        for code, c in w["statuses"].items():
-            total_statuses[str(code)] = (
-                total_statuses.get(str(code), 0) + c
-            )
-    batcher = server.batcher
-    return {
-        "p99": mid["p99"],
-        "p99_min": by_p99[0]["p99"],
-        "p99_max": by_p99[-1]["p99"],
-        "p50": mid["p50"],
-        "p95": mid["p95"],
-        "rps": statistics.median(w["rps"] for w in wave_stats),
-        "rps_min": min(w["rps"] for w in wave_stats),
-        "rps_max": max(w["rps"] for w in wave_stats),
-        "waves": len(wave_stats),
-        "accepted_waves": len(accepted_waves),
-        "n_requests": len(bodies),
-        "statuses": total_statuses,
-        "budget_routed_batches": batcher.budget_routed_batches,
-        "host_fastpath_batches": batcher.host_fastpath_batches,
-        "shed_requests": batcher.shed_requests,
-        "expired_dropped": batcher.expired_dropped,
-        "decomposition": decomp,
-    }
-
-
-def bench_http(
-    n_requests: int = 2000,
-    concurrency: int = 64,
-    metric: str = "http_validate_latency_p99",
-) -> None:
-    s = _http_bench_core(n_requests, concurrency)
-    p99 = s["p99"]
-    emit(
-        metric,
-        p99,
-        "ms",
-        NORTH_STAR_P99_MS / p99 if p99 else 0.0,
-        p50_ms=round(s["p50"], 2),
-        p95_ms=round(s["p95"], 2),
-        # spread across the timed waves (round-7 satellite: HTTP lines
-        # now carry the same median/min/max the device lines do)
-        p99_min_ms=round(s["p99_min"], 2),
-        p99_max_ms=round(s["p99_max"], 2),
-        waves=s["waves"],
-        throughput_rps=round(s["rps"], 1),
-        rps_min=round(s["rps_min"], 1),
-        rps_max=round(s["rps_max"], 1),
-        concurrency=concurrency,
-        n_requests=s["n_requests"],
-        budget_routed_batches=s["budget_routed_batches"],
-        # this line's own host-side reference point: the measured
-        # single-event-loop asyncio HTTP framing ceiling on this 1-core VM
-        # (PROFILE.md) — the transport wall, independent of the device
-        single_loop_ceiling_rps=1300,
-        vs_single_loop_ceiling=round(s["rps"] / 1300.0, 4),
-        # round-11 satellite: framing-vs-queue-vs-device attribution so
-        # "batcher-bound" vs "framing-bound" is measurable per line
-        decomposition=s["decomposition"],
-        note="end-to-end HTTP through the micro-batcher on the real server",
-    )
-
-
-def bench_http_routing_ab(n_requests: int = 1500) -> None:
-    """VERDICT Weak #3 closure: the latency-budget router's value (or
-    no-op-ness) measured head to head at c64 — routing on vs off, with
-    the host fast-path disabled so ONLY the budget router can route
-    host-side, and budget_routed_batches reported so a no-op shows as
-    exactly that."""
-    on = _http_bench_core(
-        n_requests, 64,
-        {"host_fastpath_threshold": 0, "latency_budget_ms": 50.0},
-    )
-    off = _http_bench_core(
-        n_requests, 64,
-        {"host_fastpath_threshold": 0, "latency_budget_ms": 0.0},
-    )
-    p99 = on["p99"]
-    emit(
-        "http_validate_latency_routing_ab_c64",
-        p99,
-        "ms",
-        NORTH_STAR_P99_MS / p99 if p99 else 0.0,
-        routing_on_p99_ms=round(on["p99"], 2),
-        routing_on_p99_min_ms=round(on["p99_min"], 2),
-        routing_on_p99_max_ms=round(on["p99_max"], 2),
-        routing_on_rps=round(on["rps"], 1),
-        routing_on_budget_routed_batches=on["budget_routed_batches"],
-        routing_off_p99_ms=round(off["p99"], 2),
-        routing_off_p99_min_ms=round(off["p99_min"], 2),
-        routing_off_p99_max_ms=round(off["p99_max"], 2),
-        routing_off_rps=round(off["rps"], 1),
-        waves=on["waves"],
-        concurrency=64,
-        note="host fast-path disabled on both sides; only the EWMA "
-        "budget router differs — budget_routed_batches==0 means the "
-        "router was a no-op at this load",
-    )
-
-
-def bench_http_overload_shedding(n_requests: int = 3000) -> None:
-    """Round-7 acceptance: the c256-shaped overload run with load
-    shedding ON (propagated request deadline + admission 429s) versus
-    OFF. The claim under test: shedding bounds the p99 of ACCEPTED
-    requests below the no-shedding p99, at a reported shed rate."""
-    shed = _http_bench_core(
-        n_requests, 256,
-        {"request_timeout_ms": 400.0},
-        allowed_statuses=(200, 429, 504),
-    )
-    raw = _http_bench_core(
-        n_requests, 256,
-        {"request_timeout_ms": 0.0},
-    )
-    p99 = shed["p99"]
-    total = sum(shed["statuses"].values())
-    # HTTP-level 429 = admission shed; in-band codes ride HTTP 200
-    # (expired pre-encode drop = 504, bounded-wait overload = 429,
-    # deadline-cut evaluation = 500) and are excluded from accepted-p99
-    shed_count = shed["statuses"].get("429", 0) + shed["statuses"].get(
-        "inband_429", 0
-    )
-    expired_count = shed["statuses"].get("inband_504", 0)
-    emit(
-        "http_overload_shedding_c256",
-        p99,
-        "ms (accepted p99, shedding on)",
-        NORTH_STAR_P99_MS / p99 if p99 else 0.0,
-        accepted_p99_shed_on_ms=round(shed["p99"], 2),
-        accepted_p99_min_ms=round(shed["p99_min"], 2),
-        accepted_p99_max_ms=round(shed["p99_max"], 2),
-        p99_shed_off_ms=round(raw["p99"], 2),
-        p99_shed_off_min_ms=round(raw["p99_min"], 2),
-        p99_shed_off_max_ms=round(raw["p99_max"], 2),
-        shed_rate=round(shed_count / max(1, total), 4),
-        shed_429s=shed_count,
-        expired_inband_504s=expired_count,
-        deadline_inband_500s=shed["statuses"].get("inband_500", 0),
-        accepted_200s=shed["statuses"].get("200", 0),
-        batcher_shed_requests=shed["shed_requests"],
-        batcher_expired_dropped=shed["expired_dropped"],
-        rps_shed_on=round(shed["rps"], 1),
-        rps_shed_off=round(raw["rps"], 1),
-        waves=shed["waves"],
-        accepted_waves=shed["accepted_waves"],
-        concurrency=256,
-        request_timeout_ms=400.0,
-        note="request deadline 400ms: admission sheds what the queue "
-        "cannot serve in time (429 + Retry-After), expired queued rows "
-        "drop pre-encode (504); accepted-request p99 vs the unshed run",
-    )
-
-
-# ---------------------------------------------------------------------------
-# Native HTTP front-end (round-11 acceptance)
-# ---------------------------------------------------------------------------
-
-
-def _native_client_main(argv: list[str]) -> int:
-    """Raw-socket load-generator subprocess for the native-frontend bench:
-    keep-alive connections with pipelining (depth requests outstanding per
-    connection), per-RESPONSE latencies measured from the pipelined
-    batch's send. A separate process because an in-process asyncio client
-    caps at the very Python framing ceiling this bench exists to beat."""
-    import socket
-    import threading
-
-    port, corpus_path, conns, per, depth = (
-        int(argv[0]), argv[1], int(argv[2]), int(argv[3]), int(argv[4])
-    )
-    reqs: list[bytes] = []
-    blob = open(corpus_path, "rb").read()
-    off = 0
-    while off < len(blob):
-        n = int.from_bytes(blob[off : off + 4], "little")
-        off += 4
-        reqs.append(blob[off : off + n])
-        off += n
-    lats: list[float] = []
-    statuses: dict[str, int] = {}
-    lock = threading.Lock()
-
-    def one_conn(widx: int) -> None:
-        s = socket.create_connection(("127.0.0.1", port))
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        buf = b""
-        my: list[tuple[float, int]] = []
-        n = len(reqs)
-        for i in range(per):
-            base = (widx * per + i) * depth
-            batch = b"".join(reqs[(base + k) % n] for k in range(depth))
-            t0 = time.perf_counter()
-            s.sendall(batch)
-            got = 0
-            while got < depth:
-                he = buf.find(b"\r\n\r\n")
-                if he >= 0:
-                    cl = 0
-                    for ln in buf[:he].split(b"\r\n")[1:]:
-                        if ln[:15].lower() == b"content-length:":
-                            cl = int(ln[15:])
-                            break
-                    total = he + 4 + cl
-                    if len(buf) >= total:
-                        code = int(buf[9:12])
-                        buf = buf[total:]
-                        got += 1
-                        my.append(((time.perf_counter() - t0) * 1e3, code))
-                        continue
-                chunk = s.recv(262144)
-                if not chunk:
-                    raise ConnectionError("server closed mid-wave")
-                buf += chunk
-        s.close()
-        with lock:
-            for lat, code in my:
-                lats.append(lat)
-                statuses[str(code)] = statuses.get(str(code), 0) + 1
-
-    threads = [
-        threading.Thread(target=one_conn, args=(w,)) for w in range(conns)
-    ]
-    t0 = time.perf_counter()
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join()
-    wall = time.perf_counter() - t0
-    lats.sort()
-    print(
-        json.dumps(
-            {
-                "n": len(lats),
-                "wall": wall,
-                "rps": len(lats) / wall,
-                "p50": pct(lats, 0.5),
-                "p95": pct(lats, 0.95),
-                "p99": pct(lats, 0.99),
-                "max": lats[-1] if lats else 0.0,
-                "statuses": statuses,
-            }
-        ),
-        flush=True,
-    )
-    return 0
-
-
-def _native_bench_core(
-    conns: int,
-    depth: int,
-    per_conn: int,
-    config_overrides: dict | None = None,
-    waves: int = 3,
-    n_corpus: int = 4000,
-) -> dict:
-    """Boot a REAL server and drive it with the raw-socket pipelined
-    client subprocess (conns × depth outstanding requests). Returns
-    per-wave stats + the framing/queue/device decomposition."""
-    import asyncio
-    import tempfile
-    import threading
-
-    from policy_server_tpu.config.config import Config
-    from policy_server_tpu.policies.flagship import (
-        flagship_policies,
-        synthetic_firehose,
-    )
-    from policy_server_tpu.server import PolicyServer
-
-    cfg = dict(
-        addr="127.0.0.1",
-        port=0,
-        readiness_probe_port=0,
-        policies=flagship_policies(),
-        max_batch_size=256,
-        batch_timeout_ms=1.0,
-        policy_timeout_seconds=30.0,
-    )
-    cfg.update(config_overrides or {})
-    server = PolicyServer.new_from_config(Config(**cfg))
-
-    loop_box: dict = {}
-    started = threading.Event()
-
-    def run_server() -> None:
-        loop = asyncio.new_event_loop()
-        loop_box["loop"] = loop
-        asyncio.set_event_loop(loop)
-
-        async def main() -> None:
-            await server.start()
-            started.set()
-            while not loop_box.get("stop"):
-                await asyncio.sleep(0.05)
-            await server.stop()
-
-        loop.run_until_complete(main())
-
-    t = threading.Thread(target=run_server, daemon=True)
-    t.start()
-    if not started.wait(timeout=600):
-        raise RuntimeError("bench server failed to start")
-    port = server.api_port
-    native = getattr(server, "_native_frontend", None) is not None
-
-    docs = synthetic_firehose(n_corpus, seed=77)
-    corpus = tempfile.NamedTemporaryFile(
-        prefix="bench-native-corpus-", suffix=".bin", delete=False
-    )
-    for d in docs:
-        body = json.dumps(
-            {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
-             "request": d["request"]}
-        ).encode()
-        req = (
-            b"POST /validate/pod-security-group HTTP/1.1\r\nHost: b\r\n"
-            b"Content-Type: application/json\r\nContent-Length: "
-            + str(len(body)).encode() + b"\r\n\r\n" + body
-        )
-        corpus.write(len(req).to_bytes(4, "little") + req)
-    corpus.close()
-
-    def client_wave(wave_conns, wave_per, wave_depth) -> dict:
-        out = subprocess.run(
-            [
-                sys.executable, os.path.abspath(__file__), "--native-client",
-                str(port), corpus.name, str(wave_conns), str(wave_per),
-                str(wave_depth),
-            ],
-            capture_output=True, text=True, timeout=900, check=True,
-        )
-        return json.loads(out.stdout.strip().splitlines()[-1])
-
-    try:
-        client_wave(max(2, conns // 4), 4, depth)  # prime compile/caches
-        before = _decomp_snapshot(server)
-        wave_stats = [client_wave(conns, per_conn, depth) for _ in range(waves)]
-        decomp = _decompose(before, _decomp_snapshot(server))
-        nf = getattr(server, "_native_frontend", None)
-        nstats = nf.stats() if nf is not None else {}
-        bstats = server.batcher.stats_snapshot()
-    finally:
-        loop_box["stop"] = True
-        t.join(timeout=60)
-        os.unlink(corpus.name)
-
-    by_p99 = sorted(wave_stats, key=lambda w: w["p99"])
-    mid = by_p99[len(by_p99) // 2]
-    statuses: dict[str, int] = {}
-    for w in wave_stats:
-        for k, v in w["statuses"].items():
-            statuses[k] = statuses.get(k, 0) + v
-    return {
-        "native": native,
-        "p99": mid["p99"],
-        "p99_min": by_p99[0]["p99"],
-        "p99_max": by_p99[-1]["p99"],
-        "p50": mid["p50"],
-        "p95": mid["p95"],
-        "rps": statistics.median(w["rps"] for w in wave_stats),
-        "rps_min": min(w["rps"] for w in wave_stats),
-        "rps_max": max(w["rps"] for w in wave_stats),
-        "waves": len(wave_stats),
-        "n_requests": sum(w["n"] for w in wave_stats),
-        "statuses": statuses,
-        "decomposition": decomp,
-        "native_stats": nstats,
-        "avg_batch": round(
-            bstats["requests_dispatched"]
-            / max(1, bstats["batches_dispatched"]), 1,
-        ),
-    }
-
-
-def bench_http_native(quick: bool = False) -> None:
-    """Round-11 acceptance line: end-to-end HTTP through the NATIVE
-    (GIL-free C++) frontend at 256 outstanding requests, shedding off,
-    throughput-oriented batcher knobs (fastpath off — everything rides
-    the batched device/dedup path), against the SAME raw-socket client
-    driving the Python frontend for the A/B. The decomposition makes the
-    bound attributable: framing_ms_per_req is the native framing share,
-    queue+encode+device the batcher share."""
-    overrides = {
-        "request_timeout_ms": 0.0,  # shedding OFF per the acceptance line
-        "host_fastpath_threshold": 0,
-        "latency_budget_ms": 0.0,
-        "max_batch_size": 512,
-        "batch_timeout_ms": 8.0,
-    }
-    per = 12 if quick else 40
-    nat = _native_bench_core(
-        16, 16, per, {**overrides, "frontend": "native"},
-    )
-    if not nat["native"]:
-        # the extension failed to build/load and the server fell back to
-        # aiohttp: recording those numbers under the native key would
-        # falsify the acceptance artifact
-        emit(
-            "http_validate_native", 0.0, "error", 0.0,
-            error="native frontend unavailable (httpfront.cpp failed to "
-            "build/load); server fell back to the Python frontend — "
-            "no native number to record",
-        )
-        return
-    py = _native_bench_core(
-        16, 16, max(4, per // 4), {**overrides, "frontend": "python"},
-    )
-    p99 = nat["p99"]
-    framing_share = nat["decomposition"].get("framing_ms_per_req", 0.0)
-    emit(
-        "http_validate_native",
-        nat["rps"],
-        "req/s (c256, shedding off)",
-        nat["rps"] / 20000.0,  # the round-11 acceptance floor
-        p50_ms=round(nat["p50"], 2),
-        p95_ms=round(nat["p95"], 2),
-        p99_ms=round(p99, 2),
-        p99_min_ms=round(nat["p99_min"], 2),
-        p99_max_ms=round(nat["p99_max"], 2),
-        rps_min=round(nat["rps_min"], 1),
-        rps_max=round(nat["rps_max"], 1),
-        waves=nat["waves"],
-        n_requests=nat["n_requests"],
-        statuses=nat["statuses"],
-        avg_batch=nat["avg_batch"],
-        decomposition=nat["decomposition"],
-        native_framing_us_per_req=round(
-            nat["native_stats"].get("framing_ns", 0)
-            / 1e3 / max(1, nat["native_stats"].get("http_requests", 1)), 1,
-        ),
-        python_frontend_rps=round(py["rps"], 1),
-        python_frontend_p99_ms=round(py["p99"], 2),
-        python_frontend_decomposition=py["decomposition"],
-        speedup_vs_python_frontend=round(nat["rps"] / max(1.0, py["rps"]), 2),
-        client="raw-socket subprocess, 16 conns x 16 pipelined (c256); "
-        "client and server share the 2-core dev box",
-        note="native frontend: the per-request framing share is "
-        f"{framing_share:.3f} ms — the serving stack is batcher-bound "
-        "now (queue+encode+device dominate); vs_baseline is against the "
-        "20k rps/process acceptance floor, which this 2-core dev box "
-        "cannot reach end-to-end because the BATCHER serving path alone "
-        "caps near 6.5k req/s here (the framing layer itself sustains "
-        ">20k req/s against an immediate-completion sink)",
-    )
-
-
-# ---------------------------------------------------------------------------
-# Mixed live + audit (round-10 acceptance)
-# ---------------------------------------------------------------------------
-
-
-def bench_audit_mixed(
-    n_resources: int = 2000, duration_s: float = 4.0
-) -> None:
-    """Round-10 acceptance line: a sustained live stream at ~70% of the
-    measured batcher capacity, first with the background audit scanner
-    OFF (baseline live p99), then with it sweeping a 2k-resource
-    snapshot continuously on the best-effort lane. Reports audit rows/s
-    harvested from idle slots and the live p99 delta — the claim under
-    test: live p99 within 10% of the audit-off baseline while audit
-    harvests >=1k rows/s of idle capacity."""
-    import threading
-    from types import SimpleNamespace
-
-    from policy_server_tpu.api.service import RequestOrigin
-    from policy_server_tpu.audit import (
-        AuditScanner,
-        PolicyReportStore,
-        SnapshotStore,
-    )
-    from policy_server_tpu.runtime.batcher import MicroBatcher
-
-    env = build_env(
-        {
-            "pod-privileged": {"module": "builtin://pod-privileged"},
-            "namespace-validate": {
-                "module": "builtin://namespace-validate",
-                "settings": {"denied_namespaces": ["kube-system"]},
-            },
-        }
-    )
-    batcher = MicroBatcher(
-        env,
-        max_batch_size=128,
-        batch_timeout_ms=1.0,
-        policy_timeout=30.0,
-        # the DEFAULT serving shape: small live batches answer on the
-        # host fast-path / budget router while audit occupies the device
-        # — the designed division of labor the preemption contract plus
-        # routing protect
-        host_fastpath_threshold=64,
-        latency_budget_ms=50.0,
-    ).start()
-    try:
-        batcher.warmup()
-        corpus = build_requests(n_resources + 2000, seed=7)
-        snapshot = SnapshotStore(max_bytes=256 * 1024 * 1024)
-        snapshot.observe(corpus[:n_resources])
-        live_reqs = corpus[n_resources:]
-
-        # capacity: blast one batch-saturating burst, unpaced
-        burst = live_reqs[:1024]
-        t0 = time.perf_counter()
-        futs = [
-            batcher.submit("pod-privileged", r, RequestOrigin.VALIDATE)
-            for r in burst
-        ]
-        for f in futs:
-            f.result(timeout=120)
-        capacity_rps = len(burst) / (time.perf_counter() - t0)
-        target_rps = 0.7 * capacity_rps
-
-        def drive_live(duration: float) -> list[float]:
-            """Paced live stream at target_rps; per-request latency via
-            completion callbacks (groups of 16, real idle gaps between
-            groups — the slots the audit lane may claim)."""
-            lats: list[float] = []
-            lock = threading.Lock()
-            group = 16
-            interval = group / target_rps
-            submitted = 0
-            next_t = time.perf_counter()
-            t_end = next_t + duration
-            i = 0
-            while time.perf_counter() < t_end:
-                for _ in range(group):
-                    r = live_reqs[i % len(live_reqs)]
-                    i += 1
-                    t1 = time.perf_counter()
-                    f = batcher.submit(
-                        "pod-privileged", r, RequestOrigin.VALIDATE
-                    )
-
-                    def done(fut, t1=t1):
-                        dt = (time.perf_counter() - t1) * 1e3
-                        with lock:
-                            lats.append(dt)
-
-                    f.add_done_callback(done)
-                    submitted += 1
-                next_t += interval
-                time.sleep(max(0.0, next_t - time.perf_counter()))
-            deadline = time.perf_counter() + 60
-            while time.perf_counter() < deadline:
-                with lock:
-                    if len(lats) >= submitted:
-                        break
-                time.sleep(0.01)
-            with lock:
-                return sorted(lats)
-
-        # baseline: audit off
-        off = drive_live(duration_s)
-
-        # audit on: a continuous full-sweep loop (the saturating shape —
-        # a real deployment sweeps on promote/interval, this measures
-        # the harvest ceiling)
-        state = SimpleNamespace(
-            evaluation_environment=env, batcher=batcher, lifecycle=None
-        )
-        scanner = AuditScanner(
-            state=state,
-            snapshot=snapshot,
-            reports=PolicyReportStore(),
-            mode="interval",
-            interval_seconds=3600.0,
-            batch_size=128,
-        )
-        sweep_stop = threading.Event()
-
-        def sweeper() -> None:
-            while not sweep_stop.is_set():
-                try:
-                    scanner.sweep(full=True)
-                except Exception:  # noqa: BLE001 — bench best-effort
-                    return
-
-        sweeper_thread = threading.Thread(target=sweeper, daemon=True)
-        rows_before = scanner.stats()["rows_scanned"]
-        t_on = time.perf_counter()
-        sweeper_thread.start()
-        on = drive_live(duration_s)
-        on_wall = time.perf_counter() - t_on
-        sweep_stop.set()
-        rows_after = scanner.stats()["rows_scanned"]
-        audit_rows_per_s = (rows_after - rows_before) / on_wall
-
-        p99_off = pct(off, 0.99)
-        p99_on = pct(on, 0.99)
-        snap = batcher.stats_snapshot()
-        emit(
-            "mixed_live_audit_scan",
-            audit_rows_per_s,
-            "audit rows/s",
-            audit_rows_per_s / 1000.0,  # acceptance: >=1k rows/s harvest
-            live_target_rps=round(target_rps, 1),
-            live_capacity_rps=round(capacity_rps, 1),
-            live_p99_audit_off_ms=round(p99_off, 2),
-            live_p99_audit_on_ms=round(p99_on, 2),
-            live_p50_audit_off_ms=round(pct(off, 0.5), 2),
-            live_p50_audit_on_ms=round(pct(on, 0.5), 2),
-            p99_delta_pct=round(
-                100.0 * (p99_on - p99_off) / p99_off, 1
-            ) if p99_off else 0.0,
-            audit_resources=n_resources,
-            audit_policies=2,
-            audit_batches_dispatched=snap["audit_batches_dispatched"],
-            audit_preemptions=snap["audit_preemptions"],
-            live_requests_off=len(off),
-            live_requests_on=len(on),
-            duration_s=duration_s,
-            note="sustained live at ~70% capacity; scanner sweeping a "
-            "2k-resource snapshot continuously on the best-effort lane "
-            "(idle-only dispatch, single in-flight audit batch)",
-        )
-    finally:
-        batcher.shutdown()
-        env.close()
-
-
-# ---------------------------------------------------------------------------
-# Wasm escape-hatch path: interpreter reviews/s (VERDICT r3 weak #4)
-# ---------------------------------------------------------------------------
-
-
-def bench_wasm(requests) -> None:
-    """Cost of the host wasm engine — the generality escape hatch for
-    policies outside the predicate IR. Measures reviews/s through the waPC
-    WAT oracle policy and (when the upstream fixture is present) an
-    upstream-compiled Gatekeeper module, on whichever engine the ABI
-    hosts select (the native C++ core when it builds, else the Python
-    reference interpreter). Its own baseline: the reference runs these
-    under wasmtime's cranelift-JIT at ≈1 ms/request (≈1k reviews/s
-    end-to-end, dominated by non-wasm overhead)."""
-    import pathlib
-
-    from policy_server_tpu.policies.wasm_oracle import oracle_policy
-    from policy_server_tpu.wasm.opa import OpaPolicy, gatekeeper_validate
-
-    ref_single_rps = 1_000.0
-    docs = [r.payload() for r in requests[:200]]
-
-    pol = oracle_policy("pod-privileged")
-    pol.validate(docs[0], {})  # prime (assemble + decode)
-    t0 = time.perf_counter()
-    for d in docs:
-        pol.validate(d, {})
-    wapc_wall = time.perf_counter() - t0
-    wapc_rps = len(docs) / wapc_wall
-
-    gk_rps = None
-    gk_note = None
-    fixture = pathlib.Path(
-        os.environ.get("REFERENCE_DIR", "/root/reference"),
-        "tests/data/gatekeeper_always_happy_policy.wasm",
-    )
-    if fixture.exists():
-        opa = OpaPolicy(fixture.read_bytes())
-        gk_docs = docs[:20]  # upstream module: heavier per call
-        gatekeeper_validate(opa, gk_docs[0], parameters={})
-        t0 = time.perf_counter()
-        for d in gk_docs:
-            gatekeeper_validate(opa, d, parameters={})
-        gk_rps = len(gk_docs) / (time.perf_counter() - t0)
-    else:
-        gk_note = f"skipped: fixture not found at {fixture} (set REFERENCE_DIR)"
-
-    emit(
-        "wasm_interpreter_reviews_per_sec",
-        wapc_rps,
-        "reviews/s",
-        wapc_rps / ref_single_rps,
-        wat_wapc_rps=round(wapc_rps, 1),
-        gatekeeper_fixture_rps=round(gk_rps, 1) if gk_rps else gk_note,
-        n_requests=len(docs),
-        baseline="reference wasmtime-JIT sync path ≈1k reviews/s; the "
-        "wasm engine is the correctness escape hatch, not the serving path",
-        native_engine=__import__(
-            "policy_server_tpu.wasm.native_exec", fromlist=["available"]
-        ).available(),
-    )
-
-
-# ---------------------------------------------------------------------------
-# Config 4 (headline): 32-policy firehose
-# ---------------------------------------------------------------------------
-
-
-def build_rollout_stream(n_requests: int, replicas: int, seed: int):
-    """The realistic admission firehose: ``n/replicas`` unique pod
-    templates, each admitted ``replicas`` times in a burst — a Deployment
-    rollout admits its replica pods back-to-back, identical except for
-    the generated pod name and the API server's fresh uid. Returns
-    (stream_requests, unique_requests)."""
-    import copy
-
-    from policy_server_tpu.models import (
-        AdmissionReviewRequest,
-        ValidateRequest,
-    )
-    from policy_server_tpu.policies.flagship import synthetic_firehose
-
-    n_unique = max(1, n_requests // replicas)
-    uniq_docs = synthetic_firehose(n_unique, seed=seed)
-    stream_docs = []
-    for d in uniq_docs:
-        for r in range(replicas):
-            dd = copy.deepcopy(d)
-            dd["request"]["uid"] = f'{dd["request"]["uid"]}-r{r}'
-            obj = dd["request"].get("object") or {}
-            meta = obj.setdefault("metadata", {})
-            meta["name"] = f'{meta.get("name", "pod")}-{r}'
-            dd["request"]["name"] = meta["name"]
-            stream_docs.append(dd)
-
-    def to_req(doc):
-        return ValidateRequest.from_admission(
-            AdmissionReviewRequest.from_dict(doc).request
-        )
-
-    return [to_req(d) for d in stream_docs], [to_req(d) for d in uniq_docs]
-
-
-def profile_delta(after: dict, before: dict) -> dict:
-    """Per-row host decomposition between two host_profile snapshots:
-    encode / dedup-bookkeeping / dispatch-wait in µs/row (PROFILE.md r6).
-    Every number here is recoverable from the emitted BENCH JSON alone."""
-    d = {k: after.get(k, 0) - before.get(k, 0) for k in after}
-    enc_rows = max(1, d.get("encode_rows", 0))
-    book_rows = max(1, d.get("bookkeeping_rows", 0))
-    disp_rows = max(1, d.get("dispatched_rows", 0))
-    return {
-        "encode_us_per_row": round(d.get("encode_ns", 0) / 1e3 / enc_rows, 2),
-        "encode_rows": d.get("encode_rows", 0),
-        "bookkeeping_us_per_row": round(
-            d.get("bookkeeping_ns", 0) / 1e3 / book_rows, 2
-        ),
-        "bookkeeping_rows": d.get("bookkeeping_rows", 0),
-        "dispatch_wait_us_per_dispatched_row": round(
-            d.get("dispatch_wait_ns", 0) / 1e3 / disp_rows, 2
-        ),
-        "dispatched_rows": d.get("dispatched_rows", 0),
-        "dispatched_chunks": d.get("dispatched_chunks", 0),
-    }
-
-
-def bench_config4(n_requests: int, batch_size: int) -> None:
-    from policy_server_tpu.policies.flagship import flagship_policies
-
-    from policy_server_tpu.evaluation.environment import (
-        EvaluationEnvironmentBuilder,
-    )
-
-    REPLICAS = 8
-    stream, uniq = build_rollout_stream(n_requests, REPLICAS, seed=42)
-    n_requests = len(stream)
-    policy_id = "pod-security-group"  # every dispatch computes ALL verdicts
-    items = [(policy_id, r) for r in stream]
-    uniq_items = [(policy_id, r) for r in uniq]
-
-    env = EvaluationEnvironmentBuilder(backend="jax").build(flagship_policies())
-
-    # dispatch-size sweep: on a remote/tunneled device the per-chunk fetch
-    # round-trip dominates, so bigger chunks amortize it — measure instead
-    # of assuming (compiles happen here, outside the timed run). Transport
-    # throughput drifts run to run (measured ±40% across consecutive
-    # identical runs), so probe every size in TWO interleaved rounds and
-    # keep each size's best — a single ordered pass would systematically
-    # favor whichever size ran last (warmest).
-    candidates = [
-        bs for bs in sorted({batch_size, 2048, 4096})
-        if bs <= max(64, len(items))
-    ]
-    sweep: dict[int, float] = {}
-    for bs in candidates:
-        env.max_dispatch_batch = bs
-        env.warmup((bs,))
-        env.reset_verdict_cache()
-        env.validate_batch(items[: min(2 * bs, len(items))])  # prime size
-    for _round in range(2):
-        for bs in candidates:
-            env.max_dispatch_batch = bs
-            env.reset_verdict_cache()
-            probe = items[: min(2 * bs, len(items))]
-            t0 = time.perf_counter()
-            env.validate_batch(probe)
-            rps = len(probe) / (time.perf_counter() - t0)
-            sweep[bs] = max(sweep.get(bs, 0.0), rps)
-    if sweep:  # tiny n_requests may skip every candidate
-        batch_size = max(sweep, key=sweep.get)
-    env.max_dispatch_batch = batch_size
-
-    # prime with a FULL pass from an empty cache: the timed passes then
-    # replay the exact same chunk/compaction shapes (every bucket already
-    # compiled), per the r3/r4 lesson that priming at a different shape
-    # puts XLA compilation inside the timed region
-    env.reset_verdict_cache()
-    env.validate_batch(items)
-    fallbacks_before = env.oracle_fallbacks  # report the timed-pass DELTA
-    dedup_before = dict(env.dedup_stats)
-    profile_before = env.host_profile
-    rps_runs = []
-    for _ in range(3):
-        env.reset_verdict_cache()  # each pass does the same work
-        t_start = time.perf_counter()
-        results = env.validate_batch(items)
-        rps_runs.append(len(items) / (time.perf_counter() - t_start))
-        errors = [r for r in results if isinstance(r, Exception)]
-        if errors:
-            raise RuntimeError(f"bench evaluation error: {errors[0]}")
-    s_on = spread(rps_runs)
-    dedup_after = env.dedup_stats
-    rollout_profile = profile_delta(env.host_profile, profile_before)
-    dedup_total = (
-        dedup_after["cache_hits"] - dedup_before["cache_hits"]
-        + dedup_after["blob_cache_hits"] - dedup_before["blob_cache_hits"]
-        + dedup_after["batch_dup_hits"] - dedup_before["batch_dup_hits"]
-    )
-    dedup_rate = dedup_total / max(1, 3 * len(items))
-    dedup_tiers = {
-        "blob_tier_hits": dedup_after["blob_cache_hits"]
-        - dedup_before["blob_cache_hits"],
-        "row_tier_hits": dedup_after["cache_hits"]
-        - dedup_before["cache_hits"],
-        "in_batch_dup_hits": dedup_after["batch_dup_hits"]
-        - dedup_before["batch_dup_hits"],
-        "cache_bytes": dedup_after["cache_bytes"]
-        + dedup_after["blob_cache_bytes"],
-    }
-
-    fallbacks_on = env.oracle_fallbacks - fallbacks_before
-
-    # the honest no-dedup numbers on the SAME stream (cache-off build) +
-    # the all-unique-rows workload (cross-round comparable with r1-r4)
-    env.close()
-    env_off = EvaluationEnvironmentBuilder(
-        backend="jax", verdict_cache_size=0
-    ).build(flagship_policies())
-    env_off.max_dispatch_batch = batch_size
-    env_off.warmup((batch_size,))
-    env_off.validate_batch(items)  # full prime
-    off_runs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        env_off.validate_batch(items)
-        off_runs.append(len(items) / (time.perf_counter() - t0))
-    s_off = spread(off_runs)
-    env_off.validate_batch(uniq_items)  # prime the unique-only shapes
-    uniq_profile_before = env_off.host_profile
-    uniq_runs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        env_off.validate_batch(uniq_items)
-        uniq_runs.append(len(uniq_items) / (time.perf_counter() - t0))
-    s_uniq = spread(uniq_runs)
-    uniq_profile = profile_delta(env_off.host_profile, uniq_profile_before)
-
-    # steady-state per-dispatch latency at a serving-sized batch, on the
-    # CACHE-OFF environment: this metric means "one device round-trip at
-    # batch N" — a cache would answer host-side and measure nothing
-    lat_batch = min(256, batch_size)
-    lat_items = uniq_items[:lat_batch]
-    env_off.validate_batch(lat_items)
-    lats = []
-    for _ in range(100):
-        t0 = time.perf_counter()
-        env_off.validate_batch(lat_items)
-        lats.append((time.perf_counter() - t0) * 1e3)
-    lats.sort()
-    env_off.close()
-
-    # The dedup-on rollout number moved OFF the historical key in round 6
-    # (ADVICE r5 #5): ``admission_reviews_per_sec_32policies`` measured an
-    # all-unique no-dedup stream in rounds 1-4, so the historical key
-    # carries that workload again (emitted last, below) and the rollout
-    # stream gets its own metric here.
-    emit(
-        "admission_reviews_per_sec_32policies_rollout_dedup",
-        s_on["median"],
-        "reviews/s/chip",
-        s_on["median"] / NORTH_STAR_RPS,
-        n_requests=n_requests,
-        batch_size=batch_size,
-        workload=(
-            f"rollout firehose: {len(uniq_items)} unique pod templates x "
-            f"{REPLICAS} replica admissions each (bursty, fresh uid+name "
-            f"per replica) — two-tier dedup: blob tier collapses exact "
-            f"replays pre-encode, row tier collapses uid/name variants "
-            f"post-encode"
-        ),
-        rps_min=round(s_on["min"], 1),
-        rps_max=round(s_on["max"], 1),
-        rps_runs=s_on["runs"],
-        dedup_rate=round(dedup_rate, 4),
-        dedup_tiers=dedup_tiers,
-        host_decomposition_us_per_row=rollout_profile,
-        unique_templates=len(uniq_items),
-        replicas=REPLICAS,
-        rps_no_dedup_same_stream=round(s_off["median"], 1),
-        rps_no_dedup_min=round(s_off["min"], 1),
-        rps_no_dedup_max=round(s_off["max"], 1),
-        n_policies=32,
-        oracle_fallbacks=fallbacks_on,
-    )
-
-    # HEADLINE (the driver records the LAST line): all-unique stream, no
-    # dedup — the exact workload rounds 1-4 published under this key, so
-    # cross-round trend lines stay apples-to-apples (ADVICE r5 #5).
-    emit(
-        "admission_reviews_per_sec_32policies",
-        s_uniq["median"],
-        "reviews/s/chip",
-        s_uniq["median"] / NORTH_STAR_RPS,
-        n_requests=len(uniq_items),
-        batch_size=batch_size,
-        workload=(
-            "all-unique synthetic firehose, verdict cache OFF — the "
-            "historical config4 workload (rounds 1-4); the rollout-dedup "
-            "figure lives in admission_reviews_per_sec_32policies_rollout_dedup"
-        ),
-        rps_min=round(s_uniq["min"], 1),
-        rps_max=round(s_uniq["max"], 1),
-        rps_runs=s_uniq["runs"],
-        host_decomposition_us_per_row=uniq_profile,
-        rps_rollout_dedup=round(s_on["median"], 1),
-        rps_rollout_dedup_min=round(s_on["min"], 1),
-        rps_rollout_dedup_max=round(s_on["max"], 1),
-        rps_no_dedup_same_rollout_stream=round(s_off["median"], 1),
-        p50_dispatch_latency_ms=round(pct(lats, 0.5), 2),
-        p95_dispatch_latency_ms=round(pct(lats, 0.95), 2),
-        p99_dispatch_latency_ms=round(pct(lats, 0.99), 2),
-        dispatch_latency_samples=len(lats),
-        latency_dispatch_size=lat_batch,
-        n_policies=32,
-        oracle_fallbacks=fallbacks_on,
-        dispatch_size_sweep={str(k): round(v, 1) for k, v in sweep.items()},
-    )
-
-
-def main() -> int:
-    if "--config5-child" in sys.argv:
-        bench_config5_child()
-        return 0
-    if "--native-client" in sys.argv:
-        i = sys.argv.index("--native-client")
-        return _native_client_main(sys.argv[i + 1 : i + 6])
-    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    batch_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
-    quick = os.environ.get("BENCH_QUICK") == "1"
-    if quick:
-        n_requests = min(n_requests, 8192)
-
-    requests = build_requests(max(4096, min(n_requests, 8192)), seed=42)
-    # error lines reuse the SUCCESS metric names so consumers keyed on the
-    # documented names see value 0 + error, not a vanished line
-    config_metrics = {
-        bench_config1: "config1_namespace_validate_single",
-        bench_config2: "config2_psp_pair_1k_replay",
-        bench_config3: "config3_image_signatures_group",
-        bench_wasm: "wasm_interpreter_reviews_per_sec",
-    }
-    for fn, metric in config_metrics.items():
-        try:
-            fn(requests)
-        except Exception as e:  # noqa: BLE001 — one config must not kill the run
-            emit(metric, 0.0, "error", 0.0, error=repr(e)[:300])
-    try:
-        bench_config5()
-    except Exception as e:  # noqa: BLE001
-        emit("config5_multitenant_8shards_virtual", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    try:
-        # moderate concurrency: batches stay under the host-fastpath
-        # threshold, so this measures the LATENCY serving path
-        bench_http(
-            n_requests=512 if quick else 2000,
-            concurrency=64,
-            metric="http_validate_latency_p99_c64",
-        )
-    except Exception as e:  # noqa: BLE001
-        emit("http_validate_latency_p99_c64", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    try:
-        # concurrency 256 ≈ the knee of this transport's throughput curve
-        # (890 rps @ p99 492 ms after the async-logging/metrics-cache
-        # work; 1024 concurrent only adds queue wait — the Python asyncio
-        # HTTP framing caps ~1.3k rps/loop, PROFILE.md)
-        bench_http(
-            n_requests=512 if quick else 4000,
-            concurrency=64 if quick else 256,
-        )
-    except Exception as e:  # noqa: BLE001
-        emit("http_validate_latency_p99", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    try:
-        # native (GIL-free C++) frontend at c256, shedding off, vs the
-        # Python frontend under the same raw-socket client (round-11)
-        bench_http_native(quick=quick)
-    except Exception as e:  # noqa: BLE001
-        emit("http_validate_native", 0.0, "error", 0.0, error=repr(e)[:300])
-    try:
-        # latency-budget router A/B at c64 (VERDICT Weak #3 closure)
-        bench_http_routing_ab(n_requests=512 if quick else 1500)
-    except Exception as e:  # noqa: BLE001
-        emit("http_validate_latency_routing_ab_c64", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    try:
-        # c256 overload with load shedding on vs off (round-7 acceptance)
-        bench_http_overload_shedding(n_requests=512 if quick else 3000)
-    except Exception as e:  # noqa: BLE001
-        emit("http_overload_shedding_c256", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    try:
-        # mixed live+audit: scanner harvest on idle slots vs live p99
-        # (round-10 acceptance)
-        bench_audit_mixed(
-            n_resources=512 if quick else 2000,
-            duration_s=2.0 if quick else 4.0,
-        )
-    except Exception as e:  # noqa: BLE001
-        emit("mixed_live_audit_scan", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    # compact recap of every line so far: the driver's tail window
-    # truncated BENCH_r04 and lost config1-3 — this single line preserves
-    # every number even if only the last two lines survive
-    print(
-        json.dumps(
-            {
-                "metric": "bench_summary",
-                "value": len(_EMITTED),
-                "unit": "lines",
-                "vs_baseline": 0,
-                "details": {m: [v, u] for m, v, u in _EMITTED},
-            }
-        ),
-        flush=True,
-    )
-    # headline LAST: the driver records the final JSON line
-    try:
-        bench_config4(n_requests, batch_size)
-    except Exception as e:  # noqa: BLE001 — the headline line must exist
-        emit("admission_reviews_per_sec_32policies", 0.0, "error", 0.0,
-             error=repr(e)[:300])
-    return 0
-
+from tools.bench.main import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1538,6 +1538,39 @@ void push_comp(Front* f, uint64_t req_id, int status, int retry_after,
 
 // ------------------------------------------------------------------ C ABI --
 
+// Shared verdict-body builder: the single source of the byte-exact
+// json.dumps(AdmissionReviewResponse(...).to_dict()) serialization both
+// the per-request and bulk completion entry points emit.
+static std::string build_verdict_body(const uint8_t* uid, int64_t uid_len,
+                                      int allowed, int64_t code,
+                                      const uint8_t* msg, int64_t msg_len,
+                                      int raw_shape) {
+  std::string resp;
+  resp.reserve(128 + (size_t)uid_len + (size_t)(msg_len > 0 ? msg_len : 0));
+  resp += "{\"uid\": ";
+  py_escape(std::string((const char*)uid, (size_t)uid_len), resp);
+  resp += ", \"allowed\": ";
+  resp += allowed ? "true" : "false";
+  if (code >= 0 || msg_len >= 0) {
+    resp += ", \"status\": {";
+    if (msg_len >= 0) {
+      resp += "\"message\": ";
+      py_escape(std::string((const char*)msg, (size_t)msg_len), resp);
+      if (code >= 0) resp += ", ";
+    }
+    if (code >= 0) {
+      char tmp[24];
+      snprintf(tmp, sizeof(tmp), "\"code\": %lld", (long long)code);
+      resp += tmp;
+    }
+    resp += "}";
+  }
+  resp += "}";
+  if (raw_shape) return "{\"response\": " + resp + "}";
+  return "{\"apiVersion\": \"admission.k8s.io/v1\", \"kind\": "
+         "\"AdmissionReview\", \"response\": " + resp + "}";
+}
+
 extern "C" {
 
 // listen_fd: a bound+listening non-blocking socket the CALLER owns (Python
@@ -1713,37 +1746,48 @@ void httpfront_complete_verdict(void* h, uint64_t req_id, const uint8_t* uid,
                                 int raw_shape) {
   Front* f = (Front*)h;
   int64_t t0 = now_ns();
-  std::string resp;
-  resp.reserve(128 + (size_t)uid_len + (size_t)(msg_len > 0 ? msg_len : 0));
-  resp += "{\"uid\": ";
-  py_escape(std::string((const char*)uid, (size_t)uid_len), resp);
-  resp += ", \"allowed\": ";
-  resp += allowed ? "true" : "false";
-  if (code >= 0 || msg_len >= 0) {
-    resp += ", \"status\": {";
-    if (msg_len >= 0) {
-      resp += "\"message\": ";
-      py_escape(std::string((const char*)msg, (size_t)msg_len), resp);
-      if (code >= 0) resp += ", ";
-    }
-    if (code >= 0) {
-      char tmp[24];
-      snprintf(tmp, sizeof(tmp), "\"code\": %lld", (long long)code);
-      resp += tmp;
-    }
-    resp += "}";
-  }
-  resp += "}";
-  std::string body;
-  if (raw_shape) {
-    body = "{\"response\": " + resp + "}";
-  } else {
-    body = "{\"apiVersion\": \"admission.k8s.io/v1\", \"kind\": "
-           "\"AdmissionReview\", \"response\": " + resp + "}";
-  }
+  std::string body =
+      build_verdict_body(uid, uid_len, allowed, code, msg, msg_len, raw_shape);
   f->stats[S_NATIVE_SER].fetch_add(1, std::memory_order_relaxed);
   f->stats[S_FRAMING_NS].fetch_add(now_ns() - t0, std::memory_order_relaxed);
   push_comp(f, req_id, 200, 0, std::move(body));
+}
+
+// Batch-granular completion fill (round 12): one call per dispatched
+// batch. `buf` is a packed little-endian record sequence, each record
+//   u64 req_id | u8 allowed | u8 raw_shape | i32 code(-1 = absent)
+//   | i32 uid_len | i32 msg_len(-1 = absent) | uid bytes | msg bytes
+// — the Python side builds it once per batch and pays ONE ctypes
+// crossing + ONE frontend lock instead of one per request.
+void httpfront_complete_verdict_bulk(void* h, const uint8_t* buf,
+                                     int64_t len, int64_t count) {
+  Front* f = (Front*)h;
+  int64_t t0 = now_ns();
+  int64_t off = 0;
+  int64_t done = 0;
+  while (done < count && off + 22 <= len) {
+    uint64_t req_id;
+    memcpy(&req_id, buf + off, 8);
+    uint8_t allowed = buf[off + 8];
+    uint8_t raw_shape = buf[off + 9];
+    int32_t code, uid_len, msg_len;
+    memcpy(&code, buf + off + 10, 4);
+    memcpy(&uid_len, buf + off + 14, 4);
+    memcpy(&msg_len, buf + off + 18, 4);
+    off += 22;
+    int64_t payload = (int64_t)uid_len + (msg_len > 0 ? msg_len : 0);
+    if (uid_len < 0 || off + payload > len) break;  // malformed: stop
+    const uint8_t* uid = buf + off;
+    off += uid_len;
+    const uint8_t* msg = msg_len >= 0 ? buf + off : nullptr;
+    if (msg_len > 0) off += msg_len;
+    push_comp(f, req_id, 200, 0,
+              build_verdict_body(uid, uid_len, allowed, code, msg, msg_len,
+                                 raw_shape));
+    done++;
+  }
+  f->stats[S_NATIVE_SER].fetch_add(done, std::memory_order_relaxed);
+  f->stats[S_FRAMING_NS].fetch_add(now_ns() - t0, std::memory_order_relaxed);
 }
 
 int64_t httpfront_outstanding(void* h) {

@@ -182,12 +182,15 @@ def post_evaluate(
     vanilla: AdmissionResponse,
     start_time: float,
     metrics_sink: list | None = None,
+    now: float | None = None,
 ) -> AdmissionResponse:
     """The post-dispatch half: constraints + metrics (service.rs:96-150).
     Metrics record the vanilla verdict; constraints apply only to the
     Validate origin. ``metrics_sink`` (the batcher's phase 3) collects
     ``(latency_ms, metric)`` pairs for one batched
-    ``record_evaluations_batch`` flush instead of per-item recording."""
+    ``record_evaluations_batch`` flush instead of per-item recording;
+    ``now`` lets the batcher share ONE clock read across the whole
+    batch's latency computations."""
     policy_mode = env.get_policy_mode(policy_id)
     allowed_to_mutate = env.get_policy_allowed_to_mutate(policy_id)
 
@@ -206,7 +209,8 @@ def post_evaluate(
         env, policy_id, request, origin,
         accepted=accepted, mutated=mutated, error_code=error_code,
     )
-    latency_ms = (time.perf_counter() - start_time) * 1e3
+    end = now if now is not None else time.perf_counter()
+    latency_ms = (end - start_time) * 1e3
     if metrics_sink is not None:
         metrics_sink.append((latency_ms, m))
     else:

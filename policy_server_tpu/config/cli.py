@@ -156,6 +156,21 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "budget, the batch is answered by the bit-exact host "
                    "oracle instead (0 disables; distinct from "
                    "--policy-timeout, the hard in-band deadline)")),
+        ("--columnar", "KUBEWARDEN_COLUMNAR",
+         dict(default="on", metavar="MODE", choices=["on", "off"],
+              help="Columnar device transport (round 12): ship encoded "
+                   "batches as bit-packed / dictionary-narrowed column "
+                   "PLANES with all-zero columns elided (steady-state "
+                   "traffic ships only delta columns; elided planes are "
+                   "reconstructed from device-resident zero constants). "
+                   "'off' restores the row-packed transport. Mesh-sharded "
+                   "programs always use the packed transport")),
+        ("--donate-buffers", "KUBEWARDEN_DONATE_BUFFERS",
+         dict(default="on", metavar="MODE", choices=["on", "off"],
+              help="Donate columnar input buffers on dispatch "
+                   "(jax donate_argnums) so the device transport does not "
+                   "round-trip dead input buffers; 'off' disables "
+                   "donation (diagnostic)")),
         ("--breaker-failure-threshold", "KUBEWARDEN_BREAKER_FAILURE_THRESHOLD",
          dict(type=int, default=5, metavar="N",
               help="Device circuit breaker: dispatch faults / watchdog "

@@ -205,6 +205,12 @@ class Config:
     # what to serve while EVERY shard's breaker is tripped:
     # oracle (bit-exact host verdicts) | monitor (accept-all) | reject (503)
     degraded_mode: str = "oracle"
+    # columnar device transport (round 12): ship encoded batches as
+    # bit-packed / dictionary-narrowed column planes with all-zero
+    # columns elided; False restores the row-packed transport
+    columnar: bool = True
+    # donate columnar input buffers on dispatch (jax donate_argnums)
+    donate_buffers: bool = True
     # zero-downtime policy lifecycle (lifecycle.py): 'auto' promotes a
     # canaried candidate epoch automatically, 'manual' stages it for an
     # explicit POST /policies/promote, 'off' restores the frozen-at-boot
@@ -432,6 +438,8 @@ class Config:
             breaker_failure_threshold=int(args.breaker_failure_threshold),
             breaker_window_seconds=float(args.breaker_window_seconds),
             breaker_cooldown_seconds=float(args.breaker_cooldown_seconds),
+            columnar=args.columnar == "on",
+            donate_buffers=args.donate_buffers == "on",
             degraded_mode=args.degraded_mode,
             policy_reload_mode=args.policy_reload_mode,
             reload_canary_requests=int(args.reload_canary_requests),

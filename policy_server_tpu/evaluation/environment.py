@@ -105,6 +105,30 @@ WASM_BITS_KEY = "__wasm_bits__"
 DEFAULT_VERDICT_CACHE_SIZE = 256 * 1024 * 1024
 
 
+_donation_warning_silenced = False
+
+
+def _silence_donation_decline_warning() -> None:
+    """XLA:CPU declines to alias donated inputs larger than every output
+    (the usual case here: verdict outputs are tiny) and warns once per
+    compile; on TPU transports the donation is what frees the input
+    buffers without a round-trip. The decline is by design — silence
+    exactly this warning, once per process (epoch flips rebuild
+    environments, and re-appending the filter per build would grow the
+    global warnings registry)."""
+    global _donation_warning_silenced
+    if _donation_warning_silenced:
+        return
+    _donation_warning_silenced = True
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore",
+        message="Some donated buffers were not usable",
+        category=UserWarning,
+    )
+
+
 class _RowView:
     """Zero-copy row view over the batched output arrays — materializers
     index ``outputs[key][row]`` lazily instead of copying a per-row dict of
@@ -205,6 +229,8 @@ class EvaluationEnvironmentBuilder:
         wasm_oci_digest_source: Callable[[str], str] | None = None,
         verdict_cache_size: int = DEFAULT_VERDICT_CACHE_SIZE,
         breaker_config: Mapping[str, Any] | None = None,
+        columnar: bool = True,
+        donate_buffers: bool = True,
     ) -> None:
         self.backend = backend
         self.continue_on_errors = continue_on_errors
@@ -230,6 +256,14 @@ class EvaluationEnvironmentBuilder:
         # per-environment device circuit breaker thresholds
         # (resilience.CircuitBreaker kwargs); None = defaults
         self.breaker_config = breaker_config
+        # columnar device transport (round 12): ship bit-packed /
+        # narrowed PLANES with all-zero columns elided instead of one
+        # row-packed buffer; False restores the packed transport
+        self.columnar = columnar
+        # donate delta-plane input buffers on dispatch
+        # (jax.jit donate_argnums) so the transport stops round-tripping
+        # dead buffers
+        self.donate_buffers = donate_buffers
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
         cache = ProgramCache()
@@ -348,6 +382,8 @@ class EvaluationEnvironmentBuilder:
             context_service=self.context_service,
             verdict_cache_size=self.verdict_cache_size,
             breaker_config=self.breaker_config,
+            columnar=self.columnar,
+            donate_buffers=self.donate_buffers,
         )
 
 
@@ -372,6 +408,8 @@ class EvaluationEnvironment:
         context_service: Any = None,
         verdict_cache_size: int = DEFAULT_VERDICT_CACHE_SIZE,
         breaker_config: Mapping[str, Any] | None = None,
+        columnar: bool = True,
+        donate_buffers: bool = True,
     ) -> None:
         self.backend = backend
         self.always_accept_namespace = always_accept_namespace
@@ -457,6 +495,29 @@ class EvaluationEnvironment:
             )
         }
         self._fused = jax.jit(self._forward)
+        # Columnar serving transport (round 12, ROADMAP item 3): the wide
+        # packed batch splits into bit-packed / uint16 / int32 PLANES and
+        # only all-nonzero ("delta") columns ship — all-zero planes and
+        # columns are reconstructed on device from resident zero
+        # constants, and the shipped buffers are DONATED so the transport
+        # never round-trips dead input buffers. ``spec`` (static arg 0)
+        # carries (schema index, batch, narrow); the delta dict's pytree
+        # structure + shapes key the jit cache per plane subset. The root
+        # itself is branch-free (TP02); structure branching lives in the
+        # _features_from_planes helper.
+        self.columnar = bool(columnar) and backend == "jax"
+        self.donate_buffers = bool(donate_buffers)
+        if self.donate_buffers and self.columnar:
+            _silence_donation_decline_warning()
+        self._fused_planes = jax.jit(
+            self._forward_planes,
+            static_argnums=(0,),
+            donate_argnums=(1,) if self.donate_buffers else (),
+        )
+        # (spec, structure, shapes) combos already dispatched — sizes the
+        # resident zero-constant accounting (first dispatch of a new
+        # combo materializes its skipped planes as device constants)
+        self._plane_combos: set = set()  # guarded-by: _profile_lock
         self._oracle_fallbacks = 0  # guarded-by: _fallback_lock
         # Device circuit breaker (resilience.py): repeated dispatch faults
         # or watchdog trips (reported by the batcher via
@@ -504,6 +565,14 @@ class EvaluationEnvironment:
             "dispatch_wait_ns": 0,   # blocked in device_get at materialize
             "dispatched_rows": 0,    # unique rows actually shipped
             "dispatched_chunks": 0,
+            # -- columnar transport (round 12) ----------------------------
+            "wire_bytes_shipped": 0,     # bytes actually transferred
+            "wire_bytes_packed_equiv": 0,  # what the packed transport
+            "wire_rows": 0,                # form would have shipped
+            "delta_cols_shipped": 0,   # 32-bit columns shipped (delta)
+            "delta_cols_total": 0,     # 32-bit columns in the schema
+            "donated_dispatches": 0,   # dispatches with donated inputs
+            "resident_const_bytes": 0,  # device-resident zero-plane bytes
         }
         # memoized service-layer lookups (immutable registry; unknown ids
         # still raise through the uncached path)
@@ -875,9 +944,12 @@ class EvaluationEnvironment:
     @property
     def warmup_dispatches(self) -> int:
         """Device dispatches ONE ``warmup((b,))`` call issues — warmup
-        runs every shape schema, a serving batch dispatches exactly one,
-        so RTT seeds divide by this (runtime/batcher.py; ADVICE r5 #4)."""
-        return max(1, len(self.schemas))
+        runs every shape schema (twice per schema on the columnar path:
+        the all-elided and the dense structures), a serving batch
+        dispatches exactly one, so RTT seeds divide by this
+        (runtime/batcher.py; ADVICE r5 #4)."""
+        per_schema = 2 if (self.columnar and self._mesh is None) else 1
+        return max(1, len(self.schemas) * per_schema)
 
     @property
     def dedup_stats(self) -> dict[str, int]:
@@ -1049,6 +1121,112 @@ class EvaluationEnvironment:
         masks (B,G,Mmax)) so the host fetches the whole result in a single
         device_get — per-key fetches pay one transport roundtrip each."""
         features = self._unpack_features(features)
+        return self._eval_features(features)
+
+    def _forward_planes(self, spec: tuple, delta: Mapping[str, Any]):
+        """Columnar jit root: ``spec`` is static (schema index, batch,
+        narrow); ``delta`` holds only the shipped planes/columns. The
+        body is deliberately branch-free — plane reconstruction (which
+        branches on the delta STRUCTURE at trace time) lives in the
+        helper."""
+        features = self._features_from_planes(spec, delta)
+        return self._eval_features(features)
+
+    def _features_from_planes(
+        self, spec: tuple, delta: Mapping[str, Any]
+    ) -> dict[str, Any]:
+        """Reconstruct the per-key feature dict from columnar delta
+        planes. Planes/columns absent from ``delta`` were all-zero on the
+        host: they come back as device-generated zero constants (resident
+        across dispatches — XLA materializes them once per compiled
+        program), so steady-state traffic ships only the columns that
+        actually carry data. Delta 32-bit columns scatter into the zero
+        base by their shipped column-index vector; padded index slots
+        repeat a real column with identical values, so duplicate scatter
+        writes are value-identical (deterministic)."""
+        schema_idx, batch, narrow = spec
+        schema = self.schemas[schema_idx]
+        layout = schema.packed_layout()
+        out: dict[str, Any] = {BATCH_KEY: jnp.zeros((batch,), jnp.bool_)}
+
+        def plane(name: str, n_cols: int, zero_dtype):
+            full = delta.get(name + "_full")
+            if full is not None:
+                return jnp.asarray(full)
+            vals = delta.get(name)
+            base = jnp.zeros((batch, n_cols), zero_dtype)
+            if vals is None:
+                return base
+            cols = jnp.asarray(delta[name + "_cols"])
+            return base.at[:, cols].set(jnp.asarray(vals))
+
+        # -- byte region: bit-packed 8:1 on the wire, delta'd at LANE
+        #    (bool column) granularity — only lanes with any nonzero
+        #    value ship, bit-packed, and scatter into a resident zero
+        #    lane matrix on device -----------------------------------
+        lanes = None
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        if "bits_full" in delta:
+            bits = jnp.asarray(delta["bits_full"])
+            expanded = (bits[:, :, None] >> shifts) & jnp.uint8(1)
+            lanes = expanded.reshape(batch, layout.bits_bytes * 8)
+        elif "bits" in delta:
+            bits = jnp.asarray(delta["bits"])
+            cols = jnp.asarray(delta["bits_cols"])
+            k = delta["bits_cols"].shape[0]
+            expanded = (bits[:, :, None] >> shifts) & jnp.uint8(1)
+            shipped_lanes = expanded.reshape(batch, -1)[:, :k]
+            lanes = (
+                jnp.zeros((batch, layout.total8), jnp.uint8)
+                .at[:, cols]
+                .set(shipped_lanes)
+            )
+        for e in layout.entries8:
+            if e.key == BATCH_KEY:
+                continue
+            if lanes is None:
+                out[e.key] = jnp.zeros((batch, *e.caps), jnp.bool_)
+            else:
+                block = jax.lax.slice_in_dim(
+                    lanes, e.offset, e.offset + e.elems, axis=1
+                )
+                out[e.key] = block.reshape((batch, *e.caps)) != 0
+        # -- 32-bit region: uint16 id plane + int32 tail plane ------------
+        n_id = layout.u16_count if narrow else 0
+        n_other = layout.total32 - n_id
+        if n_id:
+            ids = plane("ids", n_id, jnp.uint16).astype(jnp.int32)
+        if n_other:
+            other = plane("i32", n_other, jnp.int32)
+        id_off = other_off = 0
+        for e in layout.entries32:
+            if narrow and e.is_id:
+                block = jax.lax.slice_in_dim(
+                    ids, id_off, id_off + e.elems, axis=1
+                )
+                id_off += e.elems
+            else:
+                block = jax.lax.slice_in_dim(
+                    other, other_off, other_off + e.elems, axis=1
+                )
+                other_off += e.elems
+            block = block.reshape((batch, *e.caps))
+            if e.is_f32:
+                block = jax.lax.bitcast_convert_type(block, jnp.float32)
+            out[e.key] = block
+        # -- side channel: host-computed wasm member verdict bits ---------
+        if self._wasm_member_order:
+            wb = delta.get(WASM_BITS_KEY)
+            out[WASM_BITS_KEY] = (
+                jnp.zeros((batch, len(self._wasm_member_order)), jnp.bool_)
+                if wb is None
+                else jnp.asarray(wb)
+            )
+        return out
+
+    def _eval_features(self, features: Mapping[str, Any]):
+        """The fused predicate + group-reduction body shared by the packed
+        (_forward) and columnar (_forward_planes) roots."""
         per_policy: dict[str, tuple[Any, Any]] = {}
         for pid, fn in self._compiled.items():
             per_policy[pid] = fn(features)
@@ -1154,6 +1332,196 @@ class EvaluationEnvironment:
                 return s.to_transport(features, vocab_size=len(self.table))
         return features  # already transport width (or side-channel only)
 
+    # Ship a delta plane as full when the shipped-column bucket would be
+    # at least this fraction of the plane — the scatter then buys nothing.
+    _DELTA_FULL_FRACTION = 0.75
+
+    def _schema_index_for(self, features: Mapping[str, Any]) -> int | None:
+        """Schema index for a WIDE packed buffer (None for per-key dicts
+        or buffers already in a transport width — those keep the packed
+        path)."""
+        buf = features.get(PACKED_KEY)
+        if buf is None:
+            return None
+        width = np.asarray(buf).shape[1]
+        for i, s in enumerate(self.schemas):
+            if s.packed_layout().width == width:
+                return i
+        return None
+
+    @staticmethod
+    def _select_delta_cols(
+        live: np.ndarray, n_cols: int, full_frac: float
+    ) -> np.ndarray | None:
+        """The ONE column-selection rule every plane uses: given the
+        indices of columns with any nonzero value, return the shipped
+        column vector — padded to a power-of-two count by repeating the
+        last real column (value-identical duplicate scatter writes are
+        deterministic) — or None when the padded count is dense enough
+        that shipping the whole plane beats the scatter."""
+        k = int(live.size)
+        kb = bucket_size(k)
+        if kb >= full_frac * n_cols:
+            return None
+        if kb == k:
+            return live
+        return np.concatenate(
+            [live, np.full(kb - k, live[-1], dtype=live.dtype)]
+        )
+
+    @classmethod
+    def _delta_plane(
+        cls, delta: dict, name: str, mat: np.ndarray, full_frac: float
+    ) -> None:
+        """Add one 32-bit plane to the delta dict: elided entirely when
+        all-zero, shipped whole when dense, otherwise only the selected
+        delta columns plus their index vector."""
+        nz = np.flatnonzero(mat.any(axis=0))
+        if not nz.size:
+            return
+        cols = cls._select_delta_cols(nz, mat.shape[1], full_frac)
+        if cols is None:
+            delta[name + "_full"] = np.ascontiguousarray(mat)
+            return
+        delta[name + "_cols"] = cols.astype(np.int32)
+        delta[name] = np.ascontiguousarray(mat[:, cols])
+
+    def _build_delta(
+        self, schema_idx: int, features: Mapping[str, Any]
+    ) -> tuple[tuple, dict]:
+        """Wide packed batch (+ side channels) → (spec, delta planes) for
+        the columnar dispatch. Pure numpy; one vectorized pass per
+        plane."""
+        schema = self.schemas[schema_idx]
+        layout = schema.packed_layout()
+        buf = np.asarray(features[PACKED_KEY])
+        batch = buf.shape[0]
+        narrow = layout.u16_count > 0 and len(self.table) <= 65536
+        delta: dict[str, np.ndarray] = {}
+        byte_region = buf[:, : layout.total8]
+        live_lanes = np.flatnonzero(byte_region.any(axis=0))
+        if live_lanes.size:
+            cols = self._select_delta_cols(
+                live_lanes, layout.total8, self._DELTA_FULL_FRACTION
+            )
+            if cols is None:
+                delta["bits_full"] = np.packbits(
+                    byte_region != 0, axis=1, bitorder="little"
+                )
+            else:
+                delta["bits_cols"] = cols.astype(np.int32)
+                delta["bits"] = np.packbits(
+                    byte_region[:, cols] != 0, axis=1, bitorder="little"
+                )
+        if layout.total32:
+            region32 = np.ascontiguousarray(
+                buf[
+                    :,
+                    layout.off32_bytes : layout.off32_bytes
+                    + layout.total32 * 4,
+                ]
+            ).view(np.int32)
+            if narrow:
+                id_cols, other_cols = schema._transport_col_split()
+                self._delta_plane(
+                    delta, "ids",
+                    region32[:, id_cols].astype(np.uint16),
+                    self._DELTA_FULL_FRACTION,
+                )
+                if other_cols:
+                    self._delta_plane(
+                        delta, "i32", region32[:, other_cols],
+                        self._DELTA_FULL_FRACTION,
+                    )
+            else:
+                self._delta_plane(
+                    delta, "i32", region32, self._DELTA_FULL_FRACTION
+                )
+        # wasm member bits ALWAYS ship when present (tiny: batch × the
+        # member count): eliding the all-zero case would flap the jit
+        # structure between wasm-present and wasm-absent programs per
+        # batch AND leave warmup (whose bits are zero) compiling only
+        # the absent variant — the first real wasm verdict would then
+        # pay a compile stall on the serving path
+        wb = features.get(WASM_BITS_KEY)
+        if wb is not None:
+            delta[WASM_BITS_KEY] = np.asarray(wb)
+        return (schema_idx, batch, narrow), delta
+
+    def _plane_dispatch(self, schema_idx: int, features: Mapping[str, Any]) -> Any:
+        """Columnar device dispatch: build delta planes, account wire
+        bytes / delta columns / donation / resident constants, and launch
+        the donated columnar program (async — caller fetches through
+        _device_fetch)."""
+        spec, delta = self._build_delta(schema_idx, features)
+        layout = self.schemas[schema_idx].packed_layout()
+        batch = spec[1]
+        narrow = spec[2]
+        shipped = sum(int(a.nbytes) for a in delta.values())
+        packed_equiv = batch * (
+            layout.transport16_width if narrow else layout.transport_width
+        )
+        cols_shipped = sum(
+            a.shape[1]
+            for k, a in delta.items()
+            if k in ("ids", "i32", "ids_full", "i32_full")
+        )
+        # shapes in the key: a new power-of-two column bucket with the
+        # same key set is a NEW compiled program whose resident
+        # constants must be counted too
+        combo = (
+            spec,
+            tuple(sorted((k, a.shape) for k, a in delta.items())),
+        )
+        with self._profile_lock:
+            hp = self._host_profile
+            hp["wire_bytes_shipped"] += shipped
+            hp["wire_bytes_packed_equiv"] += packed_equiv
+            hp["wire_rows"] += batch
+            hp["delta_cols_shipped"] += cols_shipped
+            hp["delta_cols_total"] += layout.total32
+            if self.donate_buffers:
+                hp["donated_dispatches"] += 1
+            if combo not in self._plane_combos:
+                self._plane_combos.add(combo)
+                # planes reconstructed on device are resident zero
+                # constants of this compiled program: the elided
+                # byte-columns plus every unshipped 32-bit column
+                # resident byte-region zeros count in DEVICE lane units
+                # (one uint8 lane per bool column), not packed wire
+                # bytes: the device materializes (batch, total8) lanes
+                # and everything not scattered from the shipped subset
+                # is constant zero
+                if "bits_full" in delta:
+                    elided_lanes = 0
+                elif "bits_cols" in delta:
+                    elided_lanes = layout.total8 - delta["bits_cols"].shape[0]
+                else:
+                    elided_lanes = layout.total8
+                resident = batch * max(0, elided_lanes)
+                resident += batch * 4 * max(
+                    0, layout.total32 - cols_shipped
+                )
+                hp["resident_const_bytes"] += resident
+        return self._device_call(self._fused_planes, spec, delta)
+
+    def _dispatch_features(self, features: Mapping[str, Any]) -> Any:
+        """The one device-dispatch funnel for full batches: columnar when
+        enabled and the features are a wide packed buffer on a
+        single-device program; otherwise the packed (row-major,
+        bit-packed transport) path. Mesh-sharded programs keep the packed
+        path — plane sharding constraints are not implemented."""
+        if self.columnar and self._mesh is None:
+            schema_idx = self._schema_index_for(features)
+            if schema_idx is not None:
+                return self._plane_dispatch(schema_idx, features)
+        features = self._transport(features)
+        if self._mesh is not None:
+            from policy_server_tpu.parallel import mesh as mesh_mod
+
+            features = mesh_mod.shard_features(features, self._mesh)
+        return self._device_call(self._fused, features)
+
     def _device_call(self, fn: Callable, *args: Any) -> Any:
         """Run a synchronous device-path call (the jit dispatch itself),
         feeding dispatch-time raises — driver errors, RESOURCE_EXHAUSTED
@@ -1226,12 +1594,7 @@ class EvaluationEnvironment:
     def run_batch(self, features: Mapping[str, Any]) -> dict[str, np.ndarray]:
         """Dispatch one encoded feature batch to the device; ONE device_get
         fetches every verdict."""
-        features = self._transport(features)
-        if self._mesh is not None:
-            from policy_server_tpu.parallel import mesh as mesh_mod
-
-            features = mesh_mod.shard_features(features, self._mesh)
-        packed = self._device_fetch(self._device_call(self._fused, features))
+        packed = self._device_fetch(self._dispatch_features(features))
         return self._unpack(packed)
 
     def warmup(self, batch_sizes: tuple[int, ...] = (1,)) -> None:
@@ -1244,6 +1607,18 @@ class EvaluationEnvironment:
                 batch = schema.empty_batch_packed(b)
                 self._add_wasm_bits(batch, b)
                 self.run_batch(batch)
+                if self.columnar and self._mesh is None:
+                    # also compile the DENSE columnar structure (every
+                    # plane shipped full): the all-zero batch above only
+                    # compiles the all-elided program, and the first real
+                    # batch must not pay a compile stall for the shipped
+                    # shape. Sparse delta-column variants still compile
+                    # lazily (watchdog-bounded, like any cold bucket).
+                    full = {
+                        PACKED_KEY: np.ones_like(batch[PACKED_KEY])
+                    }
+                    self._add_wasm_bits(full, b)
+                    self.run_batch(full)
 
     def encode_bucketed(
         self, payload: Any
@@ -2235,12 +2610,7 @@ class EvaluationEnvironment:
             stash = self._add_wasm_bits(
                 features, features[PACKED_KEY].shape[0], wasm_rows
             )
-            features = self._transport(features)
-            if self._mesh is not None:
-                from policy_server_tpu.parallel import mesh as mesh_mod
-
-                features = mesh_mod.shard_features(features, self._mesh)
-            dev_out = self._device_call(self._fused, features)  # async dispatch
+            dev_out = self._dispatch_features(features)  # async dispatch
             self._profile_add(
                 dispatched_rows=n_dispatched, dispatched_chunks=1
             )

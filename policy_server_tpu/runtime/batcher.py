@@ -77,7 +77,11 @@ class _Pending:
     policy_id: str
     request: ValidateRequest
     origin: service.RequestOrigin
-    future: Future
+    # per-request completion. None for bulk-submitted rows delivered
+    # through a CompletionSink (submit_many): those skip the Future's
+    # per-request lock/condition entirely and fan out batch-granular —
+    # one sink call per dispatched batch.
+    future: Future | None
     enqueued_at: float = field(default_factory=time.perf_counter)
     # captured at submission on the handler's thread; worker threads parent
     # their child spans to it (trace-id propagation through the batcher)
@@ -95,6 +99,11 @@ class _Pending:
     # submission from --request-timeout-ms; rows past it are dropped
     # before encode/dispatch instead of evaluating dead work
     deadline: float | None = None
+    # batch-granular completion (submit_many): ``sink.deliver_many``
+    # receives [(token, response, exc)] — one call per batch instead of
+    # one future resolution per row
+    sink: Any = None
+    token: Any = None
 
 
 def _set_many(items: list) -> None:
@@ -115,17 +124,24 @@ def _set_many(items: list) -> None:
 
 
 class _DeliveryBatch:
-    """Accumulates asyncio completions per target loop; flush() wakes each
-    loop once for the whole batch."""
+    """Accumulates asyncio completions per target loop and sink
+    completions per CompletionSink; flush() wakes each loop / calls each
+    sink ONCE for the whole batch."""
 
-    __slots__ = ("_by_loop",)
+    __slots__ = ("_by_loop", "_by_sink")
 
     def __init__(self) -> None:
         self._by_loop: dict = {}
+        self._by_sink: dict = {}
 
     def add(self, p: "_Pending", result=None, exc=None) -> None:
         self._by_loop.setdefault(p.aio_loop, []).append(
             (p.aio_future, result, exc)
+        )
+
+    def add_sink(self, p: "_Pending", result=None, exc=None) -> None:
+        self._by_sink.setdefault(p.sink, []).append(
+            (p.token, result, exc)
         )
 
     def flush(self) -> None:
@@ -135,6 +151,20 @@ class _DeliveryBatch:
             except RuntimeError:  # loop closed: nothing awaits anymore
                 pass
         self._by_loop.clear()
+        for sink, items in self._by_sink.items():
+            _deliver_sink(sink, items)
+        self._by_sink.clear()
+
+
+def _deliver_sink(sink, items: list) -> None:
+    """One batch-granular completion call; a broken sink must never take
+    down the dispatch path."""
+    try:
+        sink.deliver_many(items)
+    except Exception:  # noqa: BLE001 — delivery is best-effort
+        from policy_server_tpu.telemetry.tracing import logger
+
+        logger.exception("completion sink failed; batch dropped on floor")
 
 
 class _AuditJob:
@@ -302,6 +332,19 @@ class MicroBatcher:
         # the queue leg of the framing-vs-queue-vs-device decomposition
         # the bench http lines report (round 11)
         self.queue_wait_ns = 0  # guarded-by: _stats_lock
+        # -- bulk submission (round 12) -----------------------------------
+        # submit_many calls and the rows they carried (avg burst size =
+        # rows / calls — the array-at-a-time admission metric)
+        self.bulk_submits = 0  # guarded-by: _stats_lock
+        self.bulk_submitted_rows = 0  # guarded-by: _stats_lock
+        # -- phase-1 memos (immutable post-boot registry; an epoch flip
+        # builds a NEW batcher, so staleness is impossible) ---------------
+        # policy ids whose PolicyID.parse is known-good (pre_evaluate's
+        # only per-row work when no always-accept namespace is configured)
+        self._preparsed_ok: set[str] = set()  # graftcheck: lockfree — GIL-atomic set add; racing adders insert the same id
+        # policy id -> True when the target has NO pre-eval hooks (the
+        # common case: the whole hook machinery is skipped per batch)
+        self._hookless: dict[str, bool] = {}  # graftcheck: lockfree — GIL-atomic dict ops; racing builders store identical values
         # -- audit lane counters (round 10; /metrics surface) -------------
         # best-effort audit batches actually dispatched
         self.audit_batches_dispatched = 0  # guarded-by: _stats_lock
@@ -408,6 +451,8 @@ class MicroBatcher:
                 "expired_dropped": self.expired_dropped,
                 "degraded_responses": self.degraded_responses,
                 "queue_wait_ns": self.queue_wait_ns,
+                "bulk_submits": self.bulk_submits,
+                "bulk_submitted_rows": self.bulk_submitted_rows,
                 "audit_batches_dispatched": self.audit_batches_dispatched,
                 "audit_rows_dispatched": self.audit_rows_dispatched,
                 "audit_preemptions": self.audit_preemptions,
@@ -553,8 +598,11 @@ class MicroBatcher:
             # of its drains between our _stopping check and this put — the
             # item would then sit in a never-again-drained queue. Re-check
             # and self-drain; duplicate rejection is harmless (_resolve
-            # tolerates already-done futures).
-            if self._stopping and not pending.future.done():
+            # tolerates already-done futures, sink delivery double-sends
+            # at worst a late 503 the frontend drops).
+            if self._stopping and (
+                pending.future is None or not pending.future.done()
+            ):
                 self._drain_rejecting()
             return True
 
@@ -591,6 +639,103 @@ class MicroBatcher:
         except RuntimeError:  # pool already shut down (stop race)
             self._reject_stopping(pending)
         return pending.future
+
+    def submit_many(
+        self,
+        items: list[tuple[str, ValidateRequest]],
+        origin: service.RequestOrigin,
+        sink: Any = None,
+        tokens: list | None = None,
+    ) -> list[Future] | None:
+        """Array-at-a-time admission (round 12): enqueue a whole burst
+        with ONE deadline stamp, ONE shed estimate, and ONE queue-lock
+        acquisition instead of per-row submit_nowait calls — the
+        ring-pop → submit hop was the dominant per-request Python in the
+        round-11 profile.
+
+        Two completion modes:
+
+        * ``sink=None`` — returns one Future per item (submit_nowait
+          parity; a shed burst resolves every future with ShedError
+          instead of raising, since a bulk call cannot raise per row).
+        * ``sink`` + ``tokens`` — batch-granular completion:
+          ``sink.deliver_many([(token, response, exc), ...])`` fires once
+          per dispatched batch (the native frontend's MPSC fill becomes
+          one call per batch). No Futures are allocated at all.
+
+        Deadline/shed semantics match submit_nowait: every row is
+        stamped with the same admission instant, so the burst sheds or
+        admits as a unit; rows that outlive their deadline in the queue
+        still drop pre-encode per row."""
+        now = time.perf_counter()
+        deadline = (
+            now + self.request_timeout
+            if self.request_timeout is not None
+            else None
+        )
+        trace_ctx = otlp.current_span_context()
+        pendings: list[_Pending] = []
+        futures: list[Future] | None = [] if sink is None else None
+        for i, (policy_id, request) in enumerate(items):
+            p = _Pending(
+                policy_id, request, origin,
+                Future() if sink is None else None,
+                enqueued_at=now, trace_ctx=trace_ctx,
+            )
+            p.deadline = deadline
+            if sink is not None:
+                p.sink = sink
+                p.token = tokens[i]
+            else:
+                futures.append(p.future)
+            pendings.append(p)
+        with self._stats_lock:
+            self.bulk_submits += 1
+            self.bulk_submitted_rows += len(pendings)
+        if self._stopping:
+            for p in pendings:
+                self._reject_stopping(p)
+            return futures
+        if deadline is not None:
+            est = self.estimated_wait()
+            if est > self.request_timeout:
+                with self._stats_lock:
+                    self.shed_requests += len(pendings)
+                err = ShedError(est)
+                for p in pendings:
+                    self._fail(p, err)
+                return futures
+        overflow = self._put_burst(pendings)
+        # same stranding window as submit_nowait: shutdown may have
+        # finished both drains between the check above and the burst put
+        if self._stopping:
+            self._drain_rejecting()
+        for p in overflow:
+            try:
+                self._overload_pool.submit(self._put_waiting, p)
+            except RuntimeError:  # pool already shut down (stop race)
+                self._reject_stopping(p)
+        return futures
+
+    def _put_burst(self, pendings: list[_Pending]) -> list[_Pending]:
+        """Enqueue as many rows as fit under ONE acquisition of the
+        queue's internal mutex (the documented stdlib internals: the same
+        deque/condition ``queue.Queue.put`` uses, minus the per-item lock
+        round-trips). Returns the rows that did not fit — the caller
+        parks them on the bounded overload wait."""
+        q = self._queue
+        with q.mutex:
+            space = (
+                q.maxsize - len(q.queue) if q.maxsize > 0 else len(pendings)
+            )
+            take = pendings[: max(0, space)]
+            if take:
+                q.queue.extend(take)
+                q.unfinished_tasks += len(take)
+                # one consumer (the dispatch loop): a single notify wakes
+                # it and it drains greedily
+                q.not_empty.notify()
+        return pendings[len(take):]
 
     async def submit_async(
         self,
@@ -899,7 +1044,14 @@ class MicroBatcher:
     ) -> None:
         """Complete a future, tolerating a concurrent client-side cancel
         (the webhook caller timing out mid-batch must never take down the
-        dispatch thread)."""
+        dispatch thread). Sink rows (submit_many) accumulate into the
+        delivery batch instead — one sink call per batch."""
+        if p.sink is not None:
+            if delivery is not None:
+                delivery.add_sink(p, response, None)
+            else:
+                _deliver_sink(p.sink, [(p.token, response, None)])
+            return
         try:
             p.future.set_result(response)
         except Exception:  # cancelled/already-done race
@@ -912,6 +1064,12 @@ class MicroBatcher:
         exc: BaseException,
         delivery: _DeliveryBatch | None = None,
     ) -> None:
+        if p.sink is not None:
+            if delivery is not None:
+                delivery.add_sink(p, None, exc)
+            else:
+                _deliver_sink(p.sink, [(p.token, None, exc)])
+            return
         try:
             p.future.set_exception(exc)
         except Exception:
@@ -1064,39 +1222,83 @@ class MicroBatcher:
 
         # Phase 1 (host): pre-evaluation — id parse, namespace shortcut,
         # bounded pre-eval hooks. Items that short-circuit or fail resolve
-        # here and drop out of the device batch.
+        # here and drop out of the device batch. Round 12: the loop is
+        # vectorized over the burst — ONE perf_counter read for every
+        # deadline check, pre_evaluate memoized per policy id (its only
+        # per-row work is the id-format parse unless an always-accept
+        # namespace is configured), and the hook machinery skipped
+        # entirely for hookless targets (the common case). Early
+        # completions batch into one delivery flush instead of one
+        # wakeup per row.
+        aa_ns = getattr(self.env, "always_accept_namespace", None)
+        preparsed = self._preparsed_ok
+        hookless = self._hookless
+        delivery = _DeliveryBatch()
         runnable: list[_Pending] = []
+        # one clock read for the whole batch, refreshed after every
+        # hook-running row (hooks are the only phase-1 work that can
+        # block long enough to stale the snapshot) — rows that expired
+        # during formation still drop, without a per-row syscall
+        now = time.perf_counter()
         for p in batch:
-            if p.future.cancelled():
+            if p.future is not None and p.future.cancelled():
                 continue
             # no dead work: a row whose propagated deadline passed while
             # queued is dropped HERE, before any encode/dispatch spend
-            if p.deadline is not None and time.perf_counter() >= p.deadline:
-                self._reject_expired(p)
+            if p.deadline is not None and now >= p.deadline:
+                self._reject_expired(p, delivery)
                 continue
-            try:
-                short = service.pre_evaluate(
-                    self.env, p.policy_id, p.request, p.origin, p.enqueued_at
-                )
-            except Exception as e:  # EvaluationError → the HTTP error mapper
-                self._fail(p, e)
-                continue
-            if short is not None:
-                self._resolve(p, short)
-                continue
-            try:
-                if not self._run_hooks_with_deadline(p):
-                    continue  # deadline rejection already delivered
-            except Exception as e:  # noqa: BLE001 — per-item isolation: a
-                # payload that breaks its own hook setup must not fail the
-                # whole batch
-                self._fail(p, e)
-                continue
-            remaining = self._remaining(p)
-            if remaining is not None and remaining <= 0:
-                self._reject_deadline(p)
-                continue
+            pid = p.policy_id
+            no_hooks = hookless.get(pid)
+            known = no_hooks is not None
+            if not known:
+                no_hooks = self._target_hookless(pid)
+                if no_hooks is None:
+                    no_hooks = True  # unknown id: fails in validate_batch
+                else:
+                    # memos are bounded to REGISTRY-KNOWN ids only — a
+                    # stream of distinct unknown ids must not grow them
+                    hookless[pid] = no_hooks
+                    known = True
+            if aa_ns is not None or pid not in preparsed:
+                try:
+                    short = service.pre_evaluate(
+                        self.env, pid, p.request, p.origin, p.enqueued_at
+                    )
+                except Exception as e:  # EvaluationError → HTTP error mapper
+                    self._fail(p, e, delivery)
+                    continue
+                if short is not None:
+                    self._resolve(p, short, delivery)
+                    continue
+                if aa_ns is None and known:
+                    preparsed.add(pid)
+            if no_hooks:
+                if (
+                    self.policy_timeout is not None
+                    and now - p.enqueued_at >= self.policy_timeout
+                ):
+                    self._reject_deadline(p, delivery)
+                    continue
+            else:
+                try:
+                    if not self._run_hooks_with_deadline(p):
+                        continue  # deadline rejection already delivered
+                except Exception as e:  # noqa: BLE001 — per-item
+                    # isolation: a payload that breaks its own hook setup
+                    # must not fail the whole batch
+                    self._fail(p, e, delivery)
+                    continue
+                # hooks block: re-read the clock for this and later rows
+                now = time.perf_counter()
+                if (
+                    self.policy_timeout is not None
+                    and now - p.enqueued_at >= self.policy_timeout
+                ):
+                    self._reject_deadline(p, delivery)
+                    continue
             runnable.append(p)
+        delivery.flush()
         if not runnable:
             return
 
@@ -1275,10 +1477,14 @@ class MicroBatcher:
         # Phase 3 (host): service-layer constraints + metrics per item.
         # Items the watchdog already rejected are skipped — their verdicts
         # arrived too late to be observable and must not double-count
-        # metrics.
+        # metrics. Round 12: ONE clock read covers every latency sample,
+        # spans are emitted only when a trace context exists (the native
+        # bulk path has none), and completions fan out batch-granular —
+        # one sink call / one loop wakeup per batch.
         live_ids = {id(p) for p in live}
         delivery = _DeliveryBatch()
         metrics_sink: list = []
+        done_at = time.perf_counter()
         for p, result in zip(runnable, results):
             if id(p) not in live_ids:
                 continue
@@ -1299,21 +1505,23 @@ class MicroBatcher:
                 response = service.post_evaluate(
                     self.env, p.policy_id, p.request, p.origin,
                     result, p.enqueued_at, metrics_sink=metrics_sink,
+                    now=done_at,
                 )
                 self._resolve(p, response, delivery)
-                otlp.emit_span(
-                    "policy_evaluation",
-                    p.trace_ctx,
-                    dispatch_start_ns,
-                    {
-                        "policy_id": p.policy_id,
-                        "batch_size": len(runnable),
-                        "allowed": response.allowed,
-                    },
-                )
+                if p.trace_ctx is not None:
+                    otlp.emit_span(
+                        "policy_evaluation",
+                        p.trace_ctx,
+                        dispatch_start_ns,
+                        {
+                            "policy_id": p.policy_id,
+                            "batch_size": len(runnable),
+                            "allowed": response.allowed,
+                        },
+                    )
             except Exception as e:  # noqa: BLE001 — never kill the loop
                 self._fail(p, e, delivery)
-        # ONE wakeup per client loop for the whole batch
+        # ONE wakeup per client loop / ONE sink call for the whole batch
         delivery.flush()
         if metrics_sink:
             service._registry().record_evaluations_batch(metrics_sink)
@@ -1411,6 +1619,21 @@ class MicroBatcher:
                 "abandoned device batch completed after deadline; "
                 "verdicts discarded"
             )
+
+    def _target_hookless(self, policy_id: str) -> bool | None:
+        """True/False when the policy id resolves to a registry target
+        (memoizable: the registry is immutable post-boot and an epoch
+        flip builds a fresh batcher); None for ids the registry does not
+        know — those must NOT be memoized, or a client streaming
+        ever-distinct unknown ids would grow the caches without bound
+        (their real 404/500 surfaces in validate_batch)."""
+        try:
+            target = self.env._lookup_top_level(  # noqa: SLF001 — same package
+                PolicyID.parse(policy_id)
+            )
+        except Exception:  # noqa: BLE001 — resolved later with semantics
+            return None
+        return not self.env.pre_eval_hooks_of(target)
 
     def _run_hooks_with_deadline(self, p: _Pending) -> bool:
         """Run the target's pre-eval hooks (latency-fault fixtures) off the

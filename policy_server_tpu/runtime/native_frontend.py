@@ -153,6 +153,10 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int64, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
             ctypes.c_int64, ctypes.c_int,
         ]
+        pylib.httpfront_complete_verdict_bulk.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
         pylib.httpfront_outstanding.restype = ctypes.c_int64
         pylib.httpfront_outstanding.argtypes = [ctypes.c_void_p]
         pylib.httpfront_stats.argtypes = [
@@ -355,6 +359,37 @@ class NativeFrontend:
                 1 if raw_shape else 0,
             )
 
+    # one bulk verdict record: u64 req_id | u8 allowed | u8 raw_shape |
+    # i32 code(-1 absent) | i32 uid_len | i32 msg_len(-1 absent)
+    _BULK_REC = struct.Struct("<QBBiii")
+
+    def complete_verdict_bulk(self, records: list[tuple]) -> None:
+        """Batch-granular completion fill: ``records`` is
+        [(req_id, uid_bytes, allowed, code|None, msg_bytes|None,
+        raw_shape), ...] — ONE frontend-lock acquisition and ONE native
+        call push every verdict of a dispatched batch onto the MPSC
+        completion stack."""
+        pack = self._BULK_REC.pack
+        parts: list[bytes] = []
+        for req_id, uid_b, allowed, code, msg_b, raw_shape in records:
+            parts.append(
+                pack(
+                    req_id, 1 if allowed else 0, 1 if raw_shape else 0,
+                    -1 if code is None else int(code),
+                    len(uid_b), -1 if msg_b is None else len(msg_b),
+                )
+            )
+            parts.append(uid_b)
+            if msg_b is not None:
+                parts.append(msg_b)
+        buf = b"".join(parts)
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._pylib.httpfront_complete_verdict_bulk(
+                self._handle, buf, len(buf), len(records)
+            )
+
     # -- introspection ----------------------------------------------------
 
     def stats(self) -> dict[str, int]:
@@ -391,6 +426,7 @@ class NativeFrontend:
             # the full poll buffer (max_body-sized) per drain cycle
             data = ctypes.string_at(buf, n)
             off = 0
+            burst: list[tuple] = []
             while off < n:
                 (
                     total, req_id, kind, flags, plen, ulen, nslen, oplen,
@@ -409,6 +445,28 @@ class NativeFrontend:
                 p += glen
                 payload = data[p : p + paylen]
                 off += total
+                burst.append(
+                    (req_id, kind, policy, uid, ns, op, gvk, payload)
+                )
+            # array-at-a-time handoff (round 12): the whole poll burst
+            # crosses into the sink in ONE call — the BatcherSink turns
+            # it into one submit_many instead of a ring-pop →
+            # submit_nowait hop per request. Sinks without a burst
+            # surface (BridgeSink, embedders) get the per-record calls.
+            handle_burst = getattr(sink, "handle_burst", None)
+            if handle_burst is not None:
+                try:
+                    handle_burst(self, burst)
+                except Exception as e:  # noqa: BLE001 — a broken burst
+                    # must answer every request, not hang them
+                    logger.error("native frontend sink failed: %s", e)
+                    body = json.dumps(
+                        {"message": "Something went wrong", "status": 500}
+                    ).encode()
+                    for rec in burst:
+                        self.complete(rec[0], 500, body)
+                continue
+            for req_id, kind, policy, uid, ns, op, gvk, payload in burst:
                 try:
                     sink.handle(
                         self, req_id, kind, policy, uid, ns, op, gvk, payload
@@ -461,41 +519,88 @@ def _verdict_is_native(r: Any) -> bool:
 
 class BatcherSink:
     """Evaluation-process sink: parsed records feed the MicroBatcher
-    directly; responses complete from the batcher's delivery threads."""
+    array-at-a-time (``submit_many``, one call per poll burst); verdicts
+    come back batch-granular through :meth:`deliver_many` — one
+    frontend-lock acquisition and one native bulk completion call per
+    dispatched batch."""
 
     def __init__(self, state: Any):
         self.state = state  # ApiServerState: epoch flips rebind .batcher
+        # the sink's token → the completion route: (frontend, req_id,
+        # raw_shape). The frontend rides in the token (not on self) so an
+        # epoch flip or multi-frontend embedding can never cross wires.
 
-    def handle(
-        self,
-        frontend: NativeFrontend,
-        req_id: int,
-        kind: int,
-        policy_id: str,
-        uid: str,
-        ns: str | None,
-        op: str,
-        gvk: str,
-        payload: bytes,
+    def handle_burst(
+        self, frontend: NativeFrontend, burst: list[tuple]
+    ) -> None:
+        """One poll burst → at most one submit_many per origin; fallback
+        records (Python parse oracle, raw shapes) keep their per-record
+        path — they are the rare tail by construction."""
+        from policy_server_tpu.api.service import RequestOrigin
+        from policy_server_tpu.runtime.frontend import WireValidateRequest
+
+        items: list = []
+        tokens: list = []
+        audit_items: list = []
+        audit_tokens: list = []
+        for req_id, kind, policy_id, uid, ns, op, gvk, payload in burst:
+            if kind in (K_VALIDATE, K_AUDIT):
+                header = {
+                    "uid": uid,
+                    "namespace": ns,
+                    "operation": op,
+                    "kind": gvk or None,
+                }
+                request: Any = WireValidateRequest(header, payload)
+                if kind == K_AUDIT:
+                    audit_items.append((policy_id, request))
+                    audit_tokens.append((frontend, req_id, False))
+                else:
+                    items.append((policy_id, request))
+                    tokens.append((frontend, req_id, False))
+            else:
+                try:
+                    self._handle_fallback(
+                        frontend, req_id, kind, policy_id, payload
+                    )
+                except Exception as e:  # noqa: BLE001 — a broken record
+                    # must answer, not hang its HTTP request
+                    logger.error("native frontend record failed: %s", e)
+                    frontend.complete(
+                        req_id, 500,
+                        _api_error_body(500, "Something went wrong"),
+                    )
+        # per-submission containment: a failure admitting one group must
+        # answer only ITS records — the other group may already be
+        # submitted (double-completing admitted rows would race their
+        # real verdicts), and fallback records above already answered
+        batcher = self.state.batcher
+        for group, origin in (
+            (list(zip(items, tokens)), RequestOrigin.VALIDATE),
+            (list(zip(audit_items, audit_tokens)), RequestOrigin.AUDIT),
+        ):
+            if not group:
+                continue
+            g_items = [it for it, _ in group]
+            g_tokens = [tok for _, tok in group]
+            try:
+                batcher.submit_many(
+                    g_items, origin, sink=self, tokens=g_tokens
+                )
+            except Exception as e:  # noqa: BLE001 — answer, don't hang
+                logger.error("bulk submission failed: %s", e)
+                body = _api_error_body(500, "Something went wrong")
+                for _fe, req_id, _raw in g_tokens:
+                    frontend.complete(req_id, 500, body)
+
+    def _handle_fallback(
+        self, frontend, req_id, kind, policy_id, payload
     ) -> None:
         from policy_server_tpu.api.service import RequestOrigin
         from policy_server_tpu.models import ValidateRequest
-        from policy_server_tpu.runtime.frontend import WireValidateRequest
 
         raw_shape = False
-        if kind in (K_VALIDATE, K_AUDIT):
-            header = {
-                "uid": uid,
-                "namespace": ns,
-                "operation": op,
-                "kind": gvk or None,
-            }
-            request: Any = WireValidateRequest(header, payload)
-            origin = (
-                RequestOrigin.AUDIT if kind == K_AUDIT
-                else RequestOrigin.VALIDATE
-            )
-        elif kind in (K_VALIDATE_FB, K_AUDIT_FB):
+        if kind in (K_VALIDATE_FB, K_AUDIT_FB):
             # the native parser declined (float, dup key, bad syntax, …):
             # Python is the parse oracle, 422 bodies are bit-exact
             from policy_server_tpu.api.handlers import (
@@ -555,6 +660,96 @@ class BatcherSink:
         fut.add_done_callback(
             lambda f: _deliver(frontend, req_id, raw_shape, f)
         )
+
+    # -- batch-granular completion (runtime/batcher.py CompletionSink) ----
+
+    def deliver_many(self, completions: list[tuple]) -> None:
+        """One call per dispatched batch: the common verdict shape packs
+        into ONE native bulk fill; errors, sheds, and exotic shapes take
+        their per-record paths (the rare tail). Every record is
+        individually guarded — one broken response must answer 500, not
+        strand the rest of the batch's HTTP callers."""
+        bulk_by_frontend: dict = {}
+        for token, response, exc in completions:
+            frontend, req_id, raw_shape = token
+            try:
+                self._deliver_one(
+                    bulk_by_frontend, frontend, req_id, raw_shape,
+                    response, exc,
+                )
+            except Exception as e:  # noqa: BLE001 — answer, don't hang
+                logger.error("completion delivery failed: %s", e)
+                try:
+                    frontend.complete(
+                        req_id, 500,
+                        _api_error_body(500, "Something went wrong"),
+                    )
+                except Exception:  # noqa: BLE001 — frontend gone
+                    pass
+        for frontend, records in bulk_by_frontend.items():
+            try:
+                frontend.complete_verdict_bulk(records)
+            except Exception as e:  # noqa: BLE001 — last resort: the
+                # packed fill failed as a unit; answer each in-band
+                logger.error("bulk completion fill failed: %s", e)
+                for rec in records:
+                    try:
+                        frontend.complete(
+                            rec[0], 500,
+                            _api_error_body(500, "Something went wrong"),
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def _deliver_one(
+        self, bulk_by_frontend, frontend, req_id, raw_shape, response, exc
+    ) -> None:
+        if exc is not None:
+            self._deliver_exc(frontend, req_id, exc)
+            return
+        r = response
+        if _verdict_is_native(r):
+            try:
+                uid_b = r.uid.encode()
+                st = r.status
+                msg_b = (
+                    st.message.encode()
+                    if st is not None and st.message is not None
+                    else None
+                )
+                bulk_by_frontend.setdefault(frontend, []).append(
+                    (
+                        req_id, uid_b, r.allowed,
+                        st.code if st is not None else None,
+                        msg_b, raw_shape,
+                    )
+                )
+                return
+            except UnicodeEncodeError:
+                pass  # surrogates: Python json handles them below
+        from policy_server_tpu.models import (
+            AdmissionReviewResponse,
+            RawReviewResponse,
+        )
+
+        env = RawReviewResponse(r) if raw_shape else AdmissionReviewResponse(r)
+        frontend.complete(req_id, 200, json.dumps(env.to_dict()).encode())
+
+    @staticmethod
+    def _deliver_exc(frontend, req_id: int, exc: BaseException) -> None:
+        from policy_server_tpu.evaluation.errors import PolicyNotFoundError
+        from policy_server_tpu.runtime.batcher import ShedError
+
+        if isinstance(exc, ShedError):
+            retry = max(1, math.ceil(exc.retry_after_seconds))
+            frontend.complete(req_id, 429, _shed_body(retry), retry)
+        elif isinstance(exc, PolicyNotFoundError):
+            frontend.complete(req_id, 404, _api_error_body(404, str(exc)))
+        else:
+            logger.error("Evaluation error: %s", exc)
+            frontend.complete(
+                req_id, 500, _api_error_body(500, "Something went wrong")
+            )
 
 
 def _deliver(frontend: NativeFrontend, req_id: int, raw_shape: bool, fut) -> None:

@@ -226,6 +226,9 @@ class PolicyServer:
                 window_seconds=config.breaker_window_seconds,
                 cooldown_seconds=config.breaker_cooldown_seconds,
             ),
+            # columnar device transport + input-buffer donation (round 12)
+            columnar=config.columnar,
+            donate_buffers=config.donate_buffers,
         )
         environment = _build_environment(config, builder_kwargs)
 
@@ -708,6 +711,65 @@ class PolicyServer:
                 "Cumulative time requests spent queued between batcher "
                 "submission and batch formation",
                 bstats["queue_wait_ns"] / 1e9,
+            )
+            # Array-at-a-time serving path + columnar transport (round
+            # 12): bulk admission volume, wire bytes vs the row-packed
+            # equivalent, delta-column hit rate, donation, and the
+            # device-resident zero-constant footprint. All zero with
+            # --columnar off / the python submission paths (families
+            # still export so dashboard panels resolve everywhere).
+            yield (
+                metrics_names.BULK_SUBMITS, "counter",
+                "submit_many bursts admitted (one queue-lock "
+                "acquisition each)",
+                bstats["bulk_submits"],
+            )
+            yield (
+                metrics_names.BULK_SUBMITTED_ROWS, "counter",
+                "Rows admitted through submit_many bursts",
+                bstats["bulk_submitted_rows"],
+            )
+            yield (
+                metrics_names.WIRE_BYTES_SHIPPED, "counter",
+                "Bytes actually shipped to the device by the columnar "
+                "transport (delta planes + column indices)",
+                profile.get("wire_bytes_shipped", 0),
+            )
+            yield (
+                metrics_names.WIRE_BYTES_PACKED_EQUIV, "counter",
+                "Bytes the row-packed transport form would have shipped "
+                "for the same dispatches",
+                profile.get("wire_bytes_packed_equiv", 0),
+            )
+            yield (
+                metrics_names.WIRE_ROWS, "counter",
+                "Rows shipped by the columnar transport (bytes/row = "
+                "wire_bytes_shipped / this)",
+                profile.get("wire_rows", 0),
+            )
+            yield (
+                metrics_names.DELTA_COLS_SHIPPED, "counter",
+                "32-bit feature columns shipped (delta columns with any "
+                "nonzero value, after power-of-two padding)",
+                profile.get("delta_cols_shipped", 0),
+            )
+            yield (
+                metrics_names.DELTA_COLS_TOTAL, "counter",
+                "32-bit feature columns in the dispatched schemas (hit "
+                "rate = 1 - shipped/total)",
+                profile.get("delta_cols_total", 0),
+            )
+            yield (
+                metrics_names.DONATED_DISPATCHES, "counter",
+                "Columnar dispatches whose input buffers were donated "
+                "(jax donate_argnums)",
+                profile.get("donated_dispatches", 0),
+            )
+            yield (
+                metrics_names.RESIDENT_CONST_BYTES, "counter",
+                "Bytes of elided zero planes/columns materialized as "
+                "device-resident constants of compiled columnar programs",
+                profile.get("resident_const_bytes", 0),
             )
 
         from policy_server_tpu.telemetry import default_registry

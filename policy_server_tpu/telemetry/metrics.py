@@ -107,6 +107,19 @@ HOST_ENCODE_ROWS = "policy_server_host_encode_rows_total"
 HOST_BOOKKEEPING_SECONDS = "policy_server_host_bookkeeping_seconds_total"
 DISPATCH_WAIT_SECONDS = "policy_server_dispatch_wait_seconds_total"
 DISPATCHED_ROWS = "policy_server_dispatched_rows_total"
+# round 12 — array-at-a-time serving path + columnar device transport
+# (runtime/batcher.py submit_many, evaluation/environment.py planes):
+# bulk admission volume, wire bytes shipped vs the packed-transport
+# equivalent, delta-column hit rate, donation, resident constants
+BULK_SUBMITS = "policy_server_bulk_submits"
+BULK_SUBMITTED_ROWS = "policy_server_bulk_submitted_rows"
+WIRE_BYTES_SHIPPED = "policy_server_wire_bytes_shipped"
+WIRE_BYTES_PACKED_EQUIV = "policy_server_wire_bytes_packed_equivalent"
+WIRE_ROWS = "policy_server_wire_rows"
+DELTA_COLS_SHIPPED = "policy_server_delta_columns_shipped"
+DELTA_COLS_TOTAL = "policy_server_delta_columns_available"
+DONATED_DISPATCHES = "policy_server_donated_buffer_dispatches"
+RESIDENT_CONST_BYTES = "policy_server_device_resident_constant_bytes"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".

@@ -1,0 +1,17 @@
+"""Benchmark suite package (round 12: bench.py outgrew single-file shape,
+ROADMAP item 5) — one module per workload family:
+
+* :mod:`tools.bench.common`   — emit/spread helpers, request corpora
+* :mod:`tools.bench.configs`  — BASELINE configs 1/2/3/5 + the wasm line
+* :mod:`tools.bench.http`     — aiohttp serving-path lines (latency,
+  routing A/B, overload shedding)
+* :mod:`tools.bench.native`   — native-frontend line + raw-socket client
+* :mod:`tools.bench.audit`    — mixed live + audit-scanner line
+* :mod:`tools.bench.serving`  — batcher-only serving path (no HTTP)
+* :mod:`tools.bench.firehose` — config 4 headline (32-policy firehose)
+* :mod:`tools.bench.main`     — the driver entrypoint
+
+``python bench.py`` at the repo root is a thin shim over
+:func:`tools.bench.main.main`; every BENCH json key and the driver
+command are unchanged from the single-file suite.
+"""

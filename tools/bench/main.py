@@ -1,0 +1,142 @@
+"""Benchmark driver: one JSON line per benchmark, the HEADLINE line LAST
+(config 4, the 32-policy firehose — the driver's recorded metric):
+
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+
+``vs_baseline`` is value / 100_000 on throughput metrics — the north-star
+target from BASELINE.json (the reference publishes no numbers; ≥1.0 means
+the target is met on this hardware). Latency-only lines use the <10 ms
+p99 target instead (vs_baseline = 10 / p99, ≥1.0 means met)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from tools.bench.common import build_requests, emit, emit_summary
+
+
+def main() -> int:
+    if "--config5-child" in sys.argv:
+        from tools.bench.configs import bench_config5_child
+
+        bench_config5_child()
+        return 0
+    if "--native-client" in sys.argv:
+        from tools.bench.native import _native_client_main
+
+        i = sys.argv.index("--native-client")
+        return _native_client_main(sys.argv[i + 1 : i + 6])
+
+    from tools.bench.audit import bench_audit_mixed
+    from tools.bench.configs import (
+        bench_config1,
+        bench_config2,
+        bench_config3,
+        bench_config5,
+        bench_wasm,
+    )
+    from tools.bench.firehose import bench_config4
+    from tools.bench.http import (
+        bench_http,
+        bench_http_overload_shedding,
+        bench_http_routing_ab,
+    )
+    from tools.bench.native import bench_http_native
+    from tools.bench.serving import bench_batcher_serving
+
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    batch_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    quick = os.environ.get("BENCH_QUICK") == "1"
+    if quick:
+        n_requests = min(n_requests, 8192)
+
+    requests = build_requests(max(4096, min(n_requests, 8192)), seed=42)
+    # error lines reuse the SUCCESS metric names so consumers keyed on the
+    # documented names see value 0 + error, not a vanished line
+    config_metrics = {
+        bench_config1: "config1_namespace_validate_single",
+        bench_config2: "config2_psp_pair_1k_replay",
+        bench_config3: "config3_image_signatures_group",
+        bench_wasm: "wasm_interpreter_reviews_per_sec",
+    }
+    for fn, metric in config_metrics.items():
+        try:
+            fn(requests)
+        except Exception as e:  # noqa: BLE001 — one config must not kill the run
+            emit(metric, 0.0, "error", 0.0, error=repr(e)[:300])
+    try:
+        bench_config5()
+    except Exception as e:  # noqa: BLE001
+        emit("config5_multitenant_8shards_virtual", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    try:
+        # the batcher serving path with ZERO HTTP (round-12 acceptance:
+        # submit_many bursts + batch-granular delivery vs the legacy
+        # per-request path)
+        bench_batcher_serving(quick=quick)
+    except Exception as e:  # noqa: BLE001
+        emit("batcher_serving_path", 0.0, "error", 0.0, error=repr(e)[:300])
+    try:
+        # moderate concurrency: batches stay under the host-fastpath
+        # threshold, so this measures the LATENCY serving path
+        bench_http(
+            n_requests=512 if quick else 2000,
+            concurrency=64,
+            metric="http_validate_latency_p99_c64",
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("http_validate_latency_p99_c64", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    try:
+        # concurrency 256 ≈ the knee of this transport's throughput curve
+        # (890 rps @ p99 492 ms after the async-logging/metrics-cache
+        # work; 1024 concurrent only adds queue wait — the Python asyncio
+        # HTTP framing caps ~1.3k rps/loop, PROFILE.md)
+        bench_http(
+            n_requests=512 if quick else 4000,
+            concurrency=64 if quick else 256,
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("http_validate_latency_p99", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    try:
+        # native (GIL-free C++) frontend at c256, shedding off, vs the
+        # Python frontend under the same raw-socket client (round-11)
+        bench_http_native(quick=quick)
+    except Exception as e:  # noqa: BLE001
+        emit("http_validate_native", 0.0, "error", 0.0, error=repr(e)[:300])
+    try:
+        # latency-budget router A/B at c64 (VERDICT Weak #3 closure)
+        bench_http_routing_ab(n_requests=512 if quick else 1500)
+    except Exception as e:  # noqa: BLE001
+        emit("http_validate_latency_routing_ab_c64", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    try:
+        # c256 overload with load shedding on vs off (round-7 acceptance)
+        bench_http_overload_shedding(n_requests=512 if quick else 3000)
+    except Exception as e:  # noqa: BLE001
+        emit("http_overload_shedding_c256", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    try:
+        # mixed live+audit: scanner harvest on idle slots vs live p99
+        # (round-10 acceptance)
+        bench_audit_mixed(
+            n_resources=512 if quick else 2000,
+            duration_s=2.0 if quick else 4.0,
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("mixed_live_audit_scan", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    emit_summary()
+    # headline LAST: the driver records the final JSON line
+    try:
+        bench_config4(n_requests, batch_size)
+    except Exception as e:  # noqa: BLE001 — the headline line must exist
+        emit("admission_reviews_per_sec_32policies", 0.0, "error", 0.0,
+             error=repr(e)[:300])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
