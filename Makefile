@@ -4,10 +4,10 @@
 IMG ?= policy-server-tpu:latest
 
 .PHONY: all test unit-tests integration-tests bench chaos check docs \
-        docs-check fastenc httpfront natives image dev-stack \
-        dev-stack-down dryrun-multichip clean
+        docs-check fastenc httpfront natives soak-smoke soak image \
+        dev-stack dev-stack-down dryrun-multichip clean
 
-all: natives test check
+all: natives test check soak-smoke
 
 # full suite on the 8-virtual-device CPU backend (tests/conftest.py)
 test:
@@ -35,6 +35,19 @@ fuzz:
 # session on any lock-order inversion or cycle.
 chaos:
 	GRAFTCHECK_LOCKSAN=1 python -m pytest tests/test_resilience.py -q
+
+# seeded mini-soak through the FULL serving stack (tools/soak/): ~20 s
+# of trace replay against the native frontend with a mid-soak fault
+# storm (SIGHUP reload, breaker trip, watch/audit/frontend failpoints)
+# plus slowloris/malformed/disconnect abuse waves, SLO-gated (zero
+# unexplained non-2xx, p99 budget) and emitting BENCH_soak_r13_smoke.json
+soak-smoke:
+	JAX_PLATFORMS=cpu python -m tools.soak --preset smoke
+
+# the cluster-scale soak: 100k+ watched objects churning into the audit
+# feed, prefork workers in the kill rotation, a 5-minute storm
+soak:
+	JAX_PLATFORMS=cpu python -m tools.soak --preset full
 
 # the graftcheck CI gate (tools/graftcheck/): concurrency lint
 # (guarded-by + lock-order cycles), trace-purity lint, observability

@@ -862,6 +862,14 @@ struct Conn {
   size_t off = 0;  // parse cursor into `in`
   std::string out;
   size_t out_off = 0;
+  // connection-abuse hardening (round 13): last byte activity and the
+  // start of the oldest incomplete request (0 = none pending). The
+  // idle timeout reaps silent keep-alive connections; the read timeout
+  // bounds how long ONE request may take to arrive in full, which is
+  // what defeats slowloris drips (each drip refreshes last_activity
+  // but never completes the request).
+  int64_t last_activity_ns = 0;
+  int64_t request_start_ns = 0;
   bool want_write = false;
   bool closing = false;       // stop parsing further requests
   bool flush_queued = false;  // dedup marker within one process_comps pass
@@ -896,6 +904,7 @@ struct Loop {
   std::unordered_map<int, Conn*> conns;
   std::unordered_map<uint64_t, std::pair<Conn*, PendingResp*>> pending;
   uint64_t next_seq = 1;
+  int64_t last_sweep_ns = 0;  // timeout sweep cadence (~1 s)
   bool listen_registered = false;
   // cached Date header value, rebuilt once per second
   time_t date_sec = 0;
@@ -903,6 +912,8 @@ struct Loop {
 
   explicit Loop(size_t ring_bits) : ring(ring_bits) {}
 };
+
+constexpr int STAT_N = 24;
 
 struct Front {
   int listen_fd;
@@ -914,13 +925,18 @@ struct Front {
   int sub_efd = -1;  // wakes the Python drainer
   std::atomic<bool> stop{false};
   std::atomic<bool> stop_accepting{false};
-  std::atomic<int64_t> stats[16] = {};
+  // connection-abuse hardening knobs (httpfront_configure; 0 = off)
+  std::atomic<int64_t> idle_timeout_ns{0};
+  std::atomic<int64_t> read_timeout_ns{0};
+  std::atomic<int64_t> max_conns{0};
+  std::atomic<int64_t> live_conns{0};
+  std::atomic<int64_t> stats[STAT_N] = {};
 };
 
 enum {
   S_CONNS = 0, S_REQUESTS, S_PARSED, S_FALLBACKS, S_NATIVE_SER, S_PY_SER,
   S_RING_FULL, S_BAD_REQ, S_ROUTE_MISS, S_OVERSIZE, S_BYTES_IN, S_BYTES_OUT,
-  S_FRAMING_NS, S_OUTSTANDING, S_DISCONNECTS,
+  S_FRAMING_NS, S_OUTSTANDING, S_DISCONNECTS, S_IDLE_CLOSES, S_CONN_CAP,
 };
 
 void wake_fd(int fd) {
@@ -983,6 +999,7 @@ void conn_destroy(Loop* lp, Conn* c, bool midbody) {
   lp->conns.erase(c->fd);
   epoll_ctl(lp->ep, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
+  lp->front->live_conns.fetch_add(-1, std::memory_order_relaxed);
   if (midbody)
     lp->front->stats[S_DISCONNECTS].fetch_add(1, std::memory_order_relaxed);
   delete c;
@@ -1106,6 +1123,11 @@ void submit_request(Loop* lp, Conn* c, const std::string& body) {
 void finish_request(Loop* lp, Conn* c, const std::string& body) {
   Front* f = lp->front;
   f->stats[S_REQUESTS].fetch_add(1, std::memory_order_relaxed);
+  // a request ARRIVED in full: reset the read-timeout clock so a
+  // healthy client pipelining back-to-back requests (whose buffer
+  // never drains to a clean boundary) is not reaped mid-stream; the
+  // post-parse bookkeeping re-arms it from NOW for any partial tail
+  c->request_start_ns = 0;
   // route misses FIRST: aiohttp 404/405s without ever reading the body,
   // so an oversized body on an unknown route must still answer 404
   if (c->route == -1) {
@@ -1376,6 +1398,19 @@ bool conn_parse(Loop* lp, Conn* c) {
   next_iter:
     continue;
   }
+  // read-timeout bookkeeping: a request is "pending" while a body is
+  // incomplete (state != 0) or a partial head sits unconsumed — the
+  // clock starts at the first such observation, each completed request
+  // zeroes it (finish_request), and it clears when the buffer drains to
+  // a clean boundary; slowloris drips keep ONE request incomplete, so
+  // their clock is never reset
+  bool pending_req =
+      !c->closing && (c->state != 0 || c->off < c->in.size());
+  if (pending_req) {
+    if (c->request_start_ns == 0) c->request_start_ns = now_ns();
+  } else {
+    c->request_start_ns = 0;
+  }
   // compact the input buffer
   if (c->off == c->in.size()) {
     c->in.clear();
@@ -1390,16 +1425,43 @@ bool conn_parse(Loop* lp, Conn* c) {
 
 // --------------------------------------------------------- loop machinery --
 
+// best-effort in-band reject for connections over the cap: one
+// non-blocking send of a canned 503, then close — a silent close would
+// read as a network fault, not an explicit server decision
+void reject_over_cap(Front* f, int fd) {
+  static const char kBody[] =
+      "{\"message\": \"connection limit reached; retry later\", "
+      "\"status\": 503}";
+  char wire[256];
+  int n = snprintf(wire, sizeof(wire),
+                   "HTTP/1.1 503 Service Unavailable\r\n"
+                   "Content-Type: application/json; charset=utf-8\r\n"
+                   "Content-Length: %zu\r\nRetry-After: 1\r\n"
+                   "Connection: close\r\n\r\n%s",
+                   sizeof(kBody) - 1, kBody);
+  ssize_t r = send(fd, wire, (size_t)n, MSG_NOSIGNAL);
+  (void)r;
+  close(fd);
+  f->stats[S_CONN_CAP].fetch_add(1, std::memory_order_relaxed);
+}
+
 void do_accept(Loop* lp) {
   Front* f = lp->front;
   for (;;) {
     int fd = accept4(f->listen_fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) break;  // EAGAIN / another loop won the race
+    int64_t cap = f->max_conns.load(std::memory_order_relaxed);
+    if (cap > 0 &&
+        f->live_conns.load(std::memory_order_relaxed) >= cap) {
+      reject_over_cap(f, fd);
+      continue;
+    }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     Conn* c = new Conn();
     c->fd = fd;
+    c->last_activity_ns = now_ns();
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.fd = fd;
@@ -1409,12 +1471,14 @@ void do_accept(Loop* lp) {
       continue;
     }
     lp->conns[fd] = c;
+    f->live_conns.fetch_add(1, std::memory_order_relaxed);
     f->stats[S_CONNS].fetch_add(1, std::memory_order_relaxed);
   }
 }
 
 void conn_read(Loop* lp, Conn* c) {
   char buf[65536];
+  c->last_activity_ns = now_ns();
   for (;;) {
     ssize_t n = recv(c->fd, buf, sizeof(buf), 0);
     if (n > 0) {
@@ -1435,6 +1499,37 @@ void conn_read(Loop* lp, Conn* c) {
     return;
   }
   conn_parse(lp, c);  // may destroy the conn via conn_flush
+}
+
+// reap abusive/idle connections (round 13): idle keep-alive conns past
+// the idle timeout, and conns whose CURRENT request has been arriving
+// for longer than the read timeout (slowloris drips). Runs ~1/s per
+// loop — O(conns) at sweep cadence, not per tick.
+void sweep_timeouts(Loop* lp, int64_t now) {
+  Front* f = lp->front;
+  int64_t idle = f->idle_timeout_ns.load(std::memory_order_relaxed);
+  int64_t readt = f->read_timeout_ns.load(std::memory_order_relaxed);
+  if (idle <= 0 && readt <= 0) return;
+  std::vector<Conn*> victims;
+  for (auto& kv : lp->conns) {
+    Conn* c = kv.second;
+    if (readt > 0 && c->request_start_ns != 0 &&
+        now - c->request_start_ns > readt) {
+      victims.push_back(c);
+      continue;
+    }
+    // idle applies only BETWEEN requests: nothing half-read and no
+    // response outstanding (a conn waiting on a slow verdict is the
+    // batcher deadline machinery's problem, not an idle abuser)
+    if (idle > 0 && c->request_start_ns == 0 && c->pipeline.empty() &&
+        now - c->last_activity_ns > idle) {
+      victims.push_back(c);
+    }
+  }
+  for (Conn* c : victims) {
+    f->stats[S_IDLE_CLOSES].fetch_add(1, std::memory_order_relaxed);
+    conn_destroy(lp, c, false);
+  }
 }
 
 void process_comps(Loop* lp) {
@@ -1485,6 +1580,13 @@ void loop_main(Loop* lp) {
     // producers never pay a wake syscall (see push_comp)
     int n = epoll_wait(lp->ep, evs, 128, 1);
     process_comps(lp);
+    {
+      int64_t now = now_ns();
+      if (now - lp->last_sweep_ns >= 1000000000ll) {
+        lp->last_sweep_ns = now;
+        sweep_timeouts(lp, now);
+      }
+    }
     for (int i = 0; i < n; i++) {
       int fd = evs[i].data.fd;
       if (fd == f->listen_fd) {
@@ -1607,6 +1709,24 @@ void* httpfront_create(int listen_fd, int n_loops, int64_t max_body,
     f->loops.push_back(std::move(lp));
   }
   return f;
+}
+
+// Connection-abuse hardening knobs (0 disables each): idle keep-alive
+// timeout, per-request read (header+body arrival) timeout, and the max
+// concurrent connection cap (over-cap accepts answer an in-band 503 and
+// close, counted). Callable before start() or live — the loops read the
+// atomics on every sweep/accept.
+void httpfront_configure(void* h, int64_t idle_timeout_ms,
+                         int64_t read_timeout_ms, int64_t max_conns) {
+  Front* f = (Front*)h;
+  f->idle_timeout_ns.store(
+      idle_timeout_ms > 0 ? idle_timeout_ms * 1000000ll : 0,
+      std::memory_order_relaxed);
+  f->read_timeout_ns.store(
+      read_timeout_ms > 0 ? read_timeout_ms * 1000000ll : 0,
+      std::memory_order_relaxed);
+  f->max_conns.store(max_conns > 0 ? max_conns : 0,
+                     std::memory_order_relaxed);
 }
 
 void httpfront_set_static(void* h, int slot, int status,
@@ -1794,9 +1914,13 @@ int64_t httpfront_outstanding(void* h) {
   return ((Front*)h)->stats[S_OUTSTANDING].load(std::memory_order_relaxed);
 }
 
-void httpfront_stats(void* h, int64_t* out) {
+void httpfront_stats(void* h, int64_t* out, int cap) {
+  // cap is the caller's buffer size: the Python side allocates it from
+  // its own constant, so a future STAT_N bump here must never write
+  // past what the caller actually handed us
   Front* f = (Front*)h;
-  for (int i = 0; i < 16; i++)
+  int n = cap < STAT_N ? cap : STAT_N;
+  for (int i = 0; i < n; i++)
     out[i] = f->stats[i].load(std::memory_order_relaxed);
 }
 

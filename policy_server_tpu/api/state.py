@@ -35,6 +35,14 @@ class ApiServerState:
     # the background audit scanner (audit.AuditScanner); None when
     # --audit-mode off — the GET /audit/reports endpoints then 404
     audit: Any = None
+    # the live-cluster watch feed (audit.WatchFeed); None unless
+    # --audit-watch — /metrics reads it through the state
+    audit_watch: Any = None
+    # live soak-window SLO observer (tools/soak engine, in-process
+    # soaks): a dict of {rps, p99_ms, shed_rate} the engine refreshes
+    # per window so /metrics exposes the soak's live trend; None outside
+    # a soak (the gauge families export as zero)
+    soak: Any = None
     # the native HTTP front-end (runtime/native_frontend.NativeFrontend);
     # None under --frontend python or after native-load fallback — the
     # /metrics framing counters read it through the state so the scrape

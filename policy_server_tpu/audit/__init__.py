@@ -1,20 +1,32 @@
-"""In-process background audit scanner (round 10).
+"""In-process background audit scanner (round 10) + live watch feed
+(round 13).
 
 The reference relies on an external companion (Kubewarden's
 audit-scanner) to continuously replay existing cluster resources through
 the policy set; this package keeps that loop in-process, riding the
 micro-batcher's best-effort audit lane so live admission traffic
-strictly preempts it. See scanner.py for the full contract.
+strictly preempts it. See scanner.py for the full contract, and
+watch_feed.py for the list+watch feed that keeps the snapshot inventory
+tracking a LIVE cluster instead of only /validate traffic and a seed
+file.
 """
 
 from policy_server_tpu.audit.reports import PolicyReportStore
 from policy_server_tpu.audit.scanner import AUDIT_MODES, AuditScanner
-from policy_server_tpu.audit.snapshot import SnapshotStore, resource_key
+from policy_server_tpu.audit.snapshot import (
+    SnapshotStore,
+    resource_key,
+    synthesize_review,
+)
+from policy_server_tpu.audit.watch_feed import WatchFeed, parse_watch_resources
 
 __all__ = [
     "AUDIT_MODES",
     "AuditScanner",
     "PolicyReportStore",
     "SnapshotStore",
+    "WatchFeed",
+    "parse_watch_resources",
     "resource_key",
+    "synthesize_review",
 ]

@@ -41,6 +41,40 @@ from policy_server_tpu.models import (
 from policy_server_tpu.telemetry.tracing import logger
 
 
+def synthesize_review(
+    obj: Any, operation: str = "CREATE", uid: str | None = None
+) -> ValidateRequest | None:
+    """One Kubernetes object → a synthetic admission review the snapshot
+    store can record: the stand-in for the review the API server would
+    have sent had this object been admitted through the webhook. Used by
+    file seeding (CREATE rows) and by the live watch feed (ADDED →
+    CREATE, MODIFIED → UPDATE, DELETED → DELETE — the DELETE shape only
+    needs the identity fields; :meth:`SnapshotStore.observe` evicts on
+    it without storing the payload). Returns ``None`` for objects with
+    no usable kind."""
+    if not isinstance(obj, dict) or "kind" not in obj:
+        return None
+    api_version = obj.get("apiVersion", "v1") or "v1"
+    group, _, version = api_version.rpartition("/")
+    meta = obj.get("metadata") or {}
+    gvk = GroupVersionKind(
+        group=group, version=version, kind=obj.get("kind", "")
+    )
+    uid = uid or meta.get("uid") or f"audit-synth-{id(obj):x}"
+    name = meta.get("name") or uid
+    req = AdmissionRequest(
+        uid=uid,
+        kind=gvk,
+        name=name,
+        namespace=meta.get("namespace"),
+        operation=operation,
+        user_info={"username": "system:policy-server-audit"},
+        object=None if operation == "DELETE" else obj,
+        dry_run=True,
+    )
+    return ValidateRequest.from_admission(req)
+
+
 def resource_key(request: ValidateRequest) -> str | None:
     """GVK + namespace + name identity of the object an admission review
     targets; ``None`` for rows the store cannot track (raw requests,
@@ -152,7 +186,7 @@ class SnapshotStore:
         seeded = 0
         batch: list[ValidateRequest] = []
         for i, obj in enumerate(objects):
-            req = self._synthesize(obj, i)
+            req = synthesize_review(obj, "CREATE", uid=f"audit-seed-{i}")
             if req is not None:
                 batch.append(req)
                 seeded += 1
@@ -162,28 +196,6 @@ class SnapshotStore:
             extra={"span_fields": {"path": path, "resources": seeded}},
         )
         return seeded
-
-    @staticmethod
-    def _synthesize(obj: Any, index: int) -> ValidateRequest | None:
-        if not isinstance(obj, dict) or "kind" not in obj:
-            return None
-        api_version = obj.get("apiVersion", "v1") or "v1"
-        group, _, version = api_version.rpartition("/")
-        meta = obj.get("metadata") or {}
-        gvk = GroupVersionKind(
-            group=group, version=version, kind=obj.get("kind", "")
-        )
-        req = AdmissionRequest(
-            uid=f"audit-seed-{index}",
-            kind=gvk,
-            name=meta.get("name") or f"audit-seed-{index}",
-            namespace=meta.get("namespace"),
-            operation="CREATE",
-            user_info={"username": "system:policy-server-audit"},
-            object=obj,
-            dry_run=True,
-        )
-        return ValidateRequest.from_admission(req)
 
     # -- collection (the scanner's sweep feed) -----------------------------
 

@@ -269,6 +269,53 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "List document) seeding the audit snapshot store at "
                    "boot — the stand-in for the companion audit "
                    "scanner's cluster LIST")),
+        ("--audit-watch", "KUBEWARDEN_AUDIT_WATCH",
+         dict(action="store_true",
+              help="Feed the audit snapshot store from the Kubernetes "
+                   "list+watch stream (audit/watch_feed.py): "
+                   "ADDED/MODIFIED events supersede, DELETED evicts and "
+                   "prunes report rows, and the scanner then audits the "
+                   "LIVE cluster instead of only /validate traffic and "
+                   "the seed file. Streams resume from the last "
+                   "resourceVersion on clean close; faults and "
+                   "queue overflows force a counted full re-LIST "
+                   "resync. Requires --audit-mode interval|on-promote")),
+        ("--audit-watch-resources", "KUBEWARDEN_AUDIT_WATCH_RESOURCES",
+         dict(default="v1/Pod,v1/Namespace,apps/v1/Deployment,"
+                      "apps/v1/ReplicaSet,apps/v1/StatefulSet,"
+                      "apps/v1/DaemonSet",
+              metavar="KINDS",
+              help="Comma-separated apiVersion/Kind list the audit "
+                   "watch feed follows (e.g. 'v1/Pod,apps/v1/"
+                   "Deployment')")),
+        ("--audit-watch-max-queue-events",
+         "KUBEWARDEN_AUDIT_WATCH_MAX_QUEUE_EVENTS",
+         dict(type=int, default=65536, metavar="N",
+              help="Bound of the watch-event queue between the per-kind "
+                   "watcher threads and the snapshot applier; an "
+                   "overflow drops the event (counted loudly) and "
+                   "forces a full re-LIST resync of that kind, so a "
+                   "drop can delay freshness but never corrupt the "
+                   "inventory")),
+        ("--native-idle-timeout-seconds",
+         "KUBEWARDEN_NATIVE_IDLE_TIMEOUT_SECONDS",
+         dict(type=float, default=75.0, metavar="SECONDS",
+              help="Native frontend: close keep-alive connections idle "
+                   "longer than this between requests (aiohttp "
+                   "keepalive parity; 0 disables)")),
+        ("--native-read-timeout-seconds",
+         "KUBEWARDEN_NATIVE_READ_TIMEOUT_SECONDS",
+         dict(type=float, default=30.0, metavar="SECONDS",
+              help="Native frontend: a single request (header+body) "
+                   "must ARRIVE in full within this bound or the "
+                   "connection is closed — the slowloris defense "
+                   "(drips refresh byte activity but never complete "
+                   "the request; 0 disables)")),
+        ("--native-max-connections", "KUBEWARDEN_NATIVE_MAX_CONNECTIONS",
+         dict(type=int, default=0, metavar="N",
+              help="Native frontend: cap on concurrent connections; "
+                   "accepts over it answer an in-band 503 + "
+                   "Retry-After and close (counted; 0 = uncapped)")),
         ("--reload-admin-token", "KUBEWARDEN_RELOAD_ADMIN_TOKEN",
          dict(default=None, metavar="TOKEN",
               help="Bearer token authenticating the policy-lifecycle "

@@ -243,6 +243,27 @@ class Config:
     # optional YAML/JSON resources file seeding the snapshot store at
     # boot (the stand-in for the companion scanner's cluster LIST)
     audit_resources_file: str | None = None
+    # live-cluster watch feed (audit/watch_feed.py, round 13): list+watch
+    # events populate the audit snapshot store directly, so the scanner
+    # audits the LIVE cluster instead of only /validate traffic + a seed
+    # file; requires --audit-mode != off
+    audit_watch: bool = False
+    # apiVersion/Kind list the watch feed follows
+    audit_watch_resources: str = (
+        "v1/Pod,v1/Namespace,apps/v1/Deployment,apps/v1/ReplicaSet,"
+        "apps/v1/StatefulSet,apps/v1/DaemonSet"
+    )
+    # bounded watch-event queue between the per-kind watcher threads and
+    # the snapshot applier; overflow drops the event (counted) and
+    # forces a full re-LIST resync of that kind
+    audit_watch_max_queue_events: int = 65536
+    # native-frontend connection-abuse hardening (csrc/httpfront.cpp,
+    # round 13): idle keep-alive reap, per-request read (arrival)
+    # timeout bounding slowloris drips, and the concurrent-connection
+    # cap answering an in-band 503 over it (0 disables each)
+    native_idle_timeout_seconds: float = 75.0
+    native_read_timeout_seconds: float = 30.0
+    native_max_connections: int = 0
     mesh: MeshSpec = field(default_factory=MeshSpec)
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
@@ -337,6 +358,31 @@ class Config:
             raise ValueError("--audit-batch-size must be >= 1")
         if self.audit_max_snapshot_bytes < 0:
             raise ValueError("--audit-max-snapshot-bytes must be >= 0")
+        if self.audit_watch:
+            if self.audit_mode == "off":
+                raise ValueError(
+                    "--audit-watch requires the audit scanner "
+                    "(--audit-mode interval or on-promote)"
+                )
+            from policy_server_tpu.audit.watch_feed import (
+                parse_watch_resources,
+            )
+
+            if not parse_watch_resources(self.audit_watch_resources):
+                raise ValueError(
+                    "--audit-watch-resources must name at least one "
+                    "apiVersion/Kind"
+                )
+        if self.audit_watch_max_queue_events < 1:
+            raise ValueError(
+                "--audit-watch-max-queue-events must be >= 1"
+            )
+        if self.native_idle_timeout_seconds < 0:
+            raise ValueError("--native-idle-timeout-seconds must be >= 0")
+        if self.native_read_timeout_seconds < 0:
+            raise ValueError("--native-read-timeout-seconds must be >= 0")
+        if self.native_max_connections < 0:
+            raise ValueError("--native-max-connections must be >= 0")
         if not (0.0 <= self.reload_divergence_threshold <= 1.0):
             raise ValueError(
                 "--reload-divergence-threshold must be in [0, 1]"
@@ -453,6 +499,18 @@ class Config:
             audit_batch_size=int(args.audit_batch_size),
             audit_max_snapshot_bytes=parse_size(args.audit_max_snapshot_bytes),
             audit_resources_file=args.audit_resources_file or None,
+            audit_watch=args.audit_watch,
+            audit_watch_resources=args.audit_watch_resources,
+            audit_watch_max_queue_events=int(
+                args.audit_watch_max_queue_events
+            ),
+            native_idle_timeout_seconds=float(
+                args.native_idle_timeout_seconds
+            ),
+            native_read_timeout_seconds=float(
+                args.native_read_timeout_seconds
+            ),
+            native_max_connections=int(args.native_max_connections),
             mesh=MeshSpec.parse(args.mesh),
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,

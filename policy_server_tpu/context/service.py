@@ -227,6 +227,110 @@ def _object_key(obj: Mapping[str, Any]) -> tuple:
     return ("nn", meta.get("namespace"), meta.get("name"))
 
 
+def run_watch_loop(
+    fetcher: Any,
+    resource: ContextAwareResource,
+    *,
+    stop: threading.Event,
+    refresh_seconds: float,
+    replace_kind: Any,
+    apply_event: Any,
+    rv: str | None = None,
+    resync_multiplier: int = 10,
+    on_resync: Any = None,
+    on_stream: Any = None,
+) -> None:
+    """The ONE list+watch state machine (round 13: extracted so the audit
+    snapshot feed shares it with the context service instead of re-growing
+    the subtle parts independently). For a single kind:
+
+    * a cleanly closed stream (server-side ~5 min timeout) resumes the
+      watch from the last seen resourceVersion — bookmarks exist precisely
+      so this path never re-LISTs;
+    * a 410-Gone-style ERROR event or any exception (transport fault, an
+      injected ``on_stream`` failure, a consumer signalling overflow)
+      drops the rv and restarts from a fresh LIST after an exponentially
+      growing backoff capped at ``refresh_seconds``;
+    * a full re-LIST resync runs at the first stream close after
+      ``resync_multiplier x refresh_seconds`` since the last LIST — the
+      safety net bounding staleness from silently dropped events.
+
+    Callbacks: ``replace_kind(key, items)`` applies a full LIST,
+    ``apply_event(key, etype, obj)`` applies one event (it may RAISE to
+    force a resync — e.g. a bounded queue that overflowed), ``on_resync
+    (key, reason)`` counts every post-boot LIST (reason: "expired" |
+    "error" | "interval"), ``on_stream()`` runs before each watch connect
+    (the ``watch.stream`` chaos hook). The caller seeds ``rv`` from its
+    boot LIST; ``rv=None`` starts with a LIST."""
+    key = resource_key(resource)
+    base_backoff = min(1.0, refresh_seconds)
+    backoff = base_backoff
+    # rv seeded => the caller just LISTed; unseeded => first pass LISTs
+    last_list = time.monotonic()
+    resync_interval = refresh_seconds * resync_multiplier
+    pending_reason = None
+    boot_list_pending = rv is None  # the caller's first LIST: not a resync
+    while not stop.is_set():
+        delivered = False
+        try:
+            if rv is None or time.monotonic() - last_list > resync_interval:
+                reason = pending_reason or "interval"
+                items, rv = fetcher.list_with_version(resource)
+                replace_kind(key, items)
+                last_list = time.monotonic()
+                pending_reason = None
+                if on_resync is not None and not boot_list_pending:
+                    on_resync(key, reason)
+                boot_list_pending = False
+            if on_stream is not None:
+                on_stream()
+            for event in fetcher.watch(resource, rv):
+                if stop.is_set():
+                    return
+                etype = event.get("type")
+                obj = event.get("object") or {}
+                if etype == "ERROR":
+                    # e.g. 410 Gone: resourceVersion too old → re-list
+                    # (an ERROR does NOT count as healthy delivery — a
+                    # persistently erroring stream must back off, not
+                    # spin LISTs against the control plane)
+                    logger.info("watch %s expired, re-listing", key)
+                    rv = None
+                    pending_reason = "expired"
+                    break
+                if etype == "BOOKMARK":
+                    rv = str(
+                        (obj.get("metadata") or {}).get("resourceVersion")
+                        or rv
+                    )
+                    delivered = True
+                    backoff = base_backoff
+                    continue
+                apply_event(key, etype, obj)
+                rv = str(
+                    (obj.get("metadata") or {}).get("resourceVersion")
+                    or rv
+                )
+                # applied, not just received: a consumer fault (e.g. a
+                # queue overflow raised by apply_event) must take the
+                # backoff below, not spin full re-LISTs against the API
+                delivered = True
+                backoff = base_backoff
+            # clean close with rv intact → resume watch, no LIST
+        except Exception as e:  # noqa: BLE001 — keep last good state
+            if stop.is_set():
+                return
+            logger.error("watch %s failed: %s", key, e)
+            rv = None  # transport/consumer fault → full re-list on recovery
+            pending_reason = "error"
+        if not delivered and not stop.is_set():
+            # ERROR event, exception, or a stream that closed without
+            # delivering anything: wait before hitting the API again,
+            # growing exponentially up to the refresh period
+            stop.wait(backoff)
+            backoff = min(backoff * 2, max(refresh_seconds, base_backoff))
+
+
 class ContextSnapshotService:
     """Background refresher holding the current immutable snapshot.
 
@@ -364,71 +468,20 @@ class ContextSnapshotService:
     def _watch_loop(
         self, resource: ContextAwareResource, rv: str | None = None
     ) -> None:
-        """list+watch with resourceVersion resume for ONE kind. A cleanly
-        closed stream (server-side ~5 min timeout) resumes the watch from
-        the last seen resourceVersion — bookmarks exist precisely so this
-        path never re-LISTs. A 410-Gone-style ERROR event or a transport
-        error drops the rv and restarts from a fresh LIST after an
-        exponentially growing backoff (capped at ``refresh_seconds``); the
-        last good snapshot keeps serving meanwhile."""
-        key = resource_key(resource)
-        base_backoff = min(1.0, self.refresh_seconds)
-        backoff = base_backoff
-        last_list = time.monotonic()  # start() seeded us from a LIST
-        resync_interval = self.refresh_seconds * self.RESYNC_MULTIPLIER
-        while not self._stop.is_set():
-            delivered = False
-            try:
-                if (
-                    rv is None
-                    or time.monotonic() - last_list > resync_interval
-                ):
-                    items, rv = self.fetcher.list_with_version(resource)
-                    self._replace_kind(key, items)
-                    last_list = time.monotonic()
-                for event in self.fetcher.watch(resource, rv):
-                    if self._stop.is_set():
-                        return
-                    etype = event.get("type")
-                    obj = event.get("object") or {}
-                    if etype == "ERROR":
-                        # e.g. 410 Gone: resourceVersion too old → re-list
-                        # (an ERROR does NOT count as healthy delivery — a
-                        # persistently erroring stream must back off, not
-                        # spin LISTs against the control plane)
-                        logger.info(
-                            "context watch %s expired, re-listing", key
-                        )
-                        rv = None
-                        break
-                    # a real event delivered → connection is healthy
-                    delivered = True
-                    backoff = base_backoff
-                    if etype == "BOOKMARK":
-                        rv = str(
-                            (obj.get("metadata") or {}).get("resourceVersion")
-                            or rv
-                        )
-                        continue
-                    self._apply_event(key, etype, obj)
-                    rv = str(
-                        (obj.get("metadata") or {}).get("resourceVersion")
-                        or rv
-                    )
-                # clean close with rv intact → resume watch, no LIST
-            except Exception as e:  # noqa: BLE001 — keep last good snapshot
-                if self._stop.is_set():
-                    return
-                logger.error("context watch %s failed: %s", key, e)
-                rv = None  # transport fault → full re-list on recovery
-            if not delivered and not self._stop.is_set():
-                # ERROR event, exception, or a stream that closed without
-                # delivering anything: wait before hitting the API again,
-                # growing exponentially up to the refresh period
-                self._stop.wait(backoff)
-                backoff = min(
-                    backoff * 2, max(self.refresh_seconds, base_backoff)
-                )
+        """list+watch with resourceVersion resume for ONE kind — the
+        shared :func:`run_watch_loop` state machine applied to the
+        context snapshot's per-kind store (a transport error keeps the
+        last good snapshot serving while the loop backs off)."""
+        run_watch_loop(
+            self.fetcher,
+            resource,
+            stop=self._stop,
+            refresh_seconds=self.refresh_seconds,
+            replace_kind=self._replace_kind,
+            apply_event=self._apply_event,
+            rv=rv,
+            resync_multiplier=self.RESYNC_MULTIPLIER,
+        )
 
     def _replace_kind(self, key: str, items: Iterable[Any]) -> None:
         self._store[key] = {_object_key(o): o for o in items}

@@ -48,6 +48,16 @@ Sites instrumented (grep for ``failpoints.fire``):
                     aborts (un-judged keys re-marked dirty), the error
                     is counted, and the scanner retries on the next
                     trigger; live serving is untouched
+``watch.stream``    audit watch-feed stream connect (audit/
+                    watch_feed.py) — ``raise`` = watch transport fault;
+                    the kind's loop backs off and recovers through a
+                    counted full re-LIST resync, the snapshot keeps
+                    serving its last good inventory
+``frontend.accept`` native frontend burst intake (runtime/
+                    native_frontend.py drain loop) — ``raise`` = a
+                    fault between framing and admission; every request
+                    of the poll burst answers an in-band 500 instead of
+                    stranding, and the drainer keeps running
 ==================  =====================================================
 
 Every fire is counted (``fired_count(site)``) so chaos tests can assert

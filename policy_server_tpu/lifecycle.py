@@ -334,6 +334,16 @@ class PolicyLifecycleManager:
         ).start()
         return True
 
+    def reload_in_flight(self) -> bool:
+        """True while a background reload pipeline is running — a
+        point-in-time observation for callers that want to wait for a
+        triggered reload to settle (the soak engine drains one before
+        judging its SLO gate)."""
+        if self._reload_inflight.acquire(blocking=False):
+            self._reload_inflight.release()
+            return False
+        return True
+
     # -- the reload pipeline ----------------------------------------------
 
     def reload(
@@ -371,12 +381,18 @@ class PolicyLifecycleManager:
                 # stage 3 — shadow canary against the host oracle
                 stage = "canary"
                 self._run_canary(candidate_env, policies)
-            except ReloadRejected:
-                self._reject(stage, candidate_env, candidate_batcher, reason)
+            except ReloadRejected as e:
+                self._reject(
+                    stage, candidate_env, candidate_batcher, reason,
+                    detail=str(e),
+                )
                 raise
             except Exception as e:  # noqa: BLE001 — every stage failure
                 # takes the same last-good path
-                self._reject(stage, candidate_env, candidate_batcher, reason)
+                self._reject(
+                    stage, candidate_env, candidate_batcher, reason,
+                    detail=str(e),
+                )
                 raise ReloadRejected(stage, str(e)) from e
 
             if self._stop.is_set():
@@ -418,7 +434,8 @@ class PolicyLifecycleManager:
         return current.policies
 
     def _reject(
-        self, stage: str, env: Any, batcher: Any, reason: str
+        self, stage: str, env: Any, batcher: Any, reason: str,
+        detail: str = "",
     ) -> None:
         """Last-good containment: tear the candidate down, count the
         failure loudly, leave the current epoch serving untouched."""
@@ -437,9 +454,9 @@ class PolicyLifecycleManager:
             self._rollbacks += 1
             self._last_outcome = f"rejected:{stage}"
         logger.error(
-            "policy reload (%s) REJECTED at %s stage; last-good policy set "
-            "keeps serving (policy_server_policy_reload_rollbacks_total "
-            "incremented)", reason, stage,
+            "policy reload (%s) REJECTED at %s stage (%s); last-good policy "
+            "set keeps serving (policy_server_policy_reload_rollbacks_total "
+            "incremented)", reason, stage, detail or "no detail",
         )
 
     # -- shadow canary -----------------------------------------------------

@@ -43,6 +43,7 @@ import threading
 from pathlib import Path
 from typing import Any
 
+from policy_server_tpu import failpoints
 from policy_server_tpu.telemetry.tracing import logger
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
@@ -78,7 +79,14 @@ _STAT_NAMES = (
     "framing_ns",
     "inflight",
     "midbody_disconnects",
+    "idle_timeout_closes",
+    "conn_cap_rejections",
 )
+
+# buffer we hand httpfront_stats, passed as its cap argument (the C side
+# writes min(cap, STAT_N) slots, so the two constants may drift safely);
+# only the first len(_STAT_NAMES) slots are named, the rest are headroom
+_STAT_SLOTS = 24
 
 _lib_lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -131,6 +139,10 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
             ctypes.c_int,
         ]
+        lib.httpfront_configure.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
         lib.httpfront_set_static.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
@@ -160,7 +172,7 @@ def _load() -> ctypes.CDLL | None:
         pylib.httpfront_outstanding.restype = ctypes.c_int64
         pylib.httpfront_outstanding.argtypes = [ctypes.c_void_p]
         pylib.httpfront_stats.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ]
         _lib = lib
         _pylib = pylib
@@ -202,6 +214,14 @@ class NativeFrontend:
 
     _POLL_TIMEOUT_MS = 200
 
+    # connection-abuse hardening defaults (soak round 13): idle matches
+    # aiohttp's 75 s keep-alive; the read timeout bounds one request's
+    # ARRIVAL (header+body), which is what defeats slowloris drips; the
+    # connection cap answers an in-band 503 over it (0 = uncapped)
+    IDLE_TIMEOUT_MS = 75_000
+    READ_TIMEOUT_MS = 30_000
+    MAX_CONNECTIONS = 0
+
     def __init__(
         self,
         sock: socket.socket,
@@ -209,6 +229,10 @@ class NativeFrontend:
         *,
         loops: int = 1,
         max_body: int = MAX_BODY_BYTES,
+        ring_bits: int = 12,
+        idle_timeout_ms: int | None = None,
+        read_timeout_ms: int | None = None,
+        max_connections: int | None = None,
     ):
         lib = _load()
         if lib is None:
@@ -227,10 +251,19 @@ class NativeFrontend:
         self._lock = threading.Lock()
         handle = lib.httpfront_create(
             sock.fileno(), int(loops), int(max_body),
-            server_header().encode(), 12,
+            server_header().encode(), int(ring_bits),
         )
         if not handle:
             raise RuntimeError("httpfront_create failed")
+        lib.httpfront_configure(
+            handle,
+            self.IDLE_TIMEOUT_MS if idle_timeout_ms is None
+            else int(idle_timeout_ms),
+            self.READ_TIMEOUT_MS if read_timeout_ms is None
+            else int(read_timeout_ms),
+            self.MAX_CONNECTIONS if max_connections is None
+            else int(max_connections),
+        )
         self._handle = handle  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
         self._drainer: threading.Thread | None = None
@@ -393,12 +426,14 @@ class NativeFrontend:
     # -- introspection ----------------------------------------------------
 
     def stats(self) -> dict[str, int]:
-        out = (ctypes.c_int64 * 16)()
+        out = (ctypes.c_int64 * _STAT_SLOTS)()
         with self._lock:
             if self._closed or not self._handle:
                 return {name: 0 for name in _STAT_NAMES}
             self._pylib.httpfront_stats(
-                self._handle, ctypes.cast(out, ctypes.POINTER(ctypes.c_int64))
+                self._handle,
+                ctypes.cast(out, ctypes.POINTER(ctypes.c_int64)),
+                _STAT_SLOTS,
             )
         return {name: int(out[i]) for i, name in enumerate(_STAT_NAMES)}
 
@@ -448,6 +483,20 @@ class NativeFrontend:
                 burst.append(
                     (req_id, kind, policy, uid, ns, op, gvk, payload)
                 )
+            # chaos site: a fault at frontend intake (drainer dies mid-
+            # handoff / sink wiring broken) must answer every request of
+            # the burst in-band, never strand them — fired per BURST,
+            # not per record (hot-path discipline)
+            try:
+                failpoints.fire("frontend.accept")
+            except Exception as e:  # noqa: BLE001 — injected intake fault
+                logger.error("native frontend intake fault: %s", e)
+                body = json.dumps(
+                    {"message": "Something went wrong", "status": 500}
+                ).encode()
+                for rec in burst:
+                    self.complete(rec[0], 500, body)
+                continue
             # array-at-a-time handoff (round 12): the whole poll burst
             # crosses into the sink in ONE call — the BatcherSink turns
             # it into one submit_many instead of a ring-pop →

@@ -360,6 +360,15 @@ class PolicyServer:
                     on_promote=state.audit.on_promote,
                     on_rollback=state.audit.on_rollback,
                 )
+            if config.audit_watch:
+                # live-cluster feed: list+watch events populate the
+                # snapshot store the scanner sweeps, so the audited
+                # inventory tracks the cluster instead of only webhook
+                # traffic (audit/watch_feed.py)
+                state.audit_watch = _build_audit_watch_feed(
+                    config, snapshot_store
+                )
+                state.audit.watch_feed = state.audit_watch
             state.audit.start()
 
         def runtime_stats():
@@ -771,6 +780,58 @@ class PolicyServer:
                 "device-resident constants of compiled columnar programs",
                 profile.get("resident_const_bytes", 0),
             )
+            # Live watch feed + connection-abuse hardening + soak-window
+            # SLOs (round 13). All zero without --audit-watch / the
+            # native frontend / a running soak (families still export so
+            # dashboard panels resolve everywhere).
+            yield (
+                metrics_names.WATCH_EVENTS_APPLIED, "counter",
+                "Kubernetes watch events applied to the audit snapshot "
+                "store (ADDED/MODIFIED supersede, DELETED evicts)",
+                astats.get("watch_events_applied", 0),
+            )
+            yield (
+                metrics_names.WATCH_EVENTS_DROPPED, "counter",
+                "Watch events dropped by the bounded feed queue (each "
+                "forces a counted full re-LIST resync of its kind)",
+                astats.get("watch_events_dropped", 0),
+            )
+            yield (
+                metrics_names.WATCH_RESYNCS, "counter",
+                "Full re-LIST resyncs of the audit watch feed (410 "
+                "expiry, transport fault, queue overflow, or the "
+                "staleness-bounding interval)",
+                astats.get("watch_resyncs", 0),
+            )
+            yield (
+                metrics_names.NATIVE_IDLE_CLOSES, "counter",
+                "Native-frontend connections reaped by the idle or "
+                "read (slowloris) timeout",
+                nstats.get("idle_timeout_closes", 0),
+            )
+            yield (
+                metrics_names.NATIVE_CONN_CAP_REJECTS, "counter",
+                "Connections answered an in-band 503 because the "
+                "native frontend's connection cap was reached",
+                nstats.get("conn_cap_rejections", 0),
+            )
+            soak = getattr(state, "soak", None) or {}
+            yield (
+                metrics_names.SOAK_WINDOW_RPS, "gauge",
+                "Requests/s of the current soak window (tools/soak "
+                "in-process engine; 0 outside a soak)",
+                soak.get("rps", 0.0),
+            )
+            yield (
+                metrics_names.SOAK_WINDOW_P99_MS, "gauge",
+                "p99 latency (ms) of the current soak window",
+                soak.get("p99_ms", 0.0),
+            )
+            yield (
+                metrics_names.SOAK_WINDOW_SHED_RATE, "gauge",
+                "Shed (429) fraction of the current soak window",
+                soak.get("shed_rate", 0.0),
+            )
 
         from policy_server_tpu.telemetry import default_registry
 
@@ -884,7 +945,14 @@ class PolicyServer:
             assert nf.MAX_BODY_BYTES == MAX_BODY_BYTES
             sock = nf.make_listen_socket(self.config.addr, self.config.port)
             front = nf.NativeFrontend(
-                sock, nf.BatcherSink(self.state), max_body=MAX_BODY_BYTES
+                sock, nf.BatcherSink(self.state), max_body=MAX_BODY_BYTES,
+                idle_timeout_ms=int(
+                    self.config.native_idle_timeout_seconds * 1000
+                ),
+                read_timeout_ms=int(
+                    self.config.native_read_timeout_seconds * 1000
+                ),
+                max_connections=self.config.native_max_connections,
             )
             front.start()
         except Exception as e:  # noqa: BLE001 — fall back, never refuse boot
@@ -1088,6 +1156,11 @@ class PolicyServer:
         for runner in self._runners:
             await runner.cleanup()
         self._runners.clear()
+        if self.state.audit_watch is not None:
+            # stop the live feed BEFORE the scanner: a watcher applying
+            # events into a store nobody will sweep again is dead work
+            self.state.audit_watch.stop()
+            self.state.audit_watch = None
         if self.state.audit is not None:
             # stop sweeping BEFORE epochs tear down: a sweep racing the
             # batcher shutdown would only burn its retry budget
@@ -1224,6 +1297,42 @@ def _bound_port(runner: web.AppRunner) -> int | None:
         if server and server.sockets:
             return server.sockets[0].getsockname()[1]
     return None
+
+
+def _build_audit_watch_feed(config: Config, snapshot_store):
+    """--audit-watch bring-up: the in-cluster list+watch client feeding
+    the audit snapshot store (audit/watch_feed.py). Connection failure
+    follows the context-service contract: fatal unless
+    --ignore-kubernetes-connection-failure, which degrades to the
+    dirty-tracking + seed-file feeds with a loud error."""
+    from policy_server_tpu.audit import WatchFeed, parse_watch_resources
+    from policy_server_tpu.context import KubeApiFetcher, KubeConnectionError
+
+    resources = parse_watch_resources(config.audit_watch_resources)
+    try:
+        fetcher = KubeApiFetcher(
+            insecure_skip_tls_verify=config.kube_insecure_skip_tls_verify
+        )
+    except KubeConnectionError as e:
+        if not config.ignore_kubernetes_connection_failure:
+            raise RuntimeError(
+                f"--audit-watch cannot connect to the Kubernetes API: {e} "
+                "(use --ignore-kubernetes-connection-failure to boot "
+                "without the live feed)"
+            ) from e
+        logger.error(
+            "Kubernetes connection failed; the audit snapshot store "
+            "falls back to /validate dirty-tracking and the seed file: "
+            "%s", e,
+        )
+        return None
+    return WatchFeed(
+        fetcher,
+        resources,
+        snapshot_store,
+        refresh_seconds=config.context_refresh_seconds,
+        max_queue_events=config.audit_watch_max_queue_events,
+    ).start()
 
 
 def _build_context_service(config: Config):

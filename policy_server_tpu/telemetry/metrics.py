@@ -120,6 +120,19 @@ DELTA_COLS_SHIPPED = "policy_server_delta_columns_shipped"
 DELTA_COLS_TOTAL = "policy_server_delta_columns_available"
 DONATED_DISPATCHES = "policy_server_donated_buffer_dispatches"
 RESIDENT_CONST_BYTES = "policy_server_device_resident_constant_bytes"
+# round 13 — cluster-scale soak + live watch feed: the audit snapshot
+# store's list+watch event accounting (audit/watch_feed.py), the native
+# frontend's connection-abuse hardening counters (csrc/httpfront.cpp
+# idle/read timeouts + connection cap), and the live soak-window SLO
+# gauges an in-process soak (tools/soak) publishes through the state
+WATCH_EVENTS_APPLIED = "policy_server_audit_watch_events_applied"
+WATCH_EVENTS_DROPPED = "policy_server_audit_watch_events_dropped"
+WATCH_RESYNCS = "policy_server_audit_watch_resyncs"
+NATIVE_IDLE_CLOSES = "policy_server_native_idle_timeout_closes"
+NATIVE_CONN_CAP_REJECTS = "policy_server_native_connection_cap_rejections"
+SOAK_WINDOW_RPS = "policy_server_soak_window_rps"
+SOAK_WINDOW_P99_MS = "policy_server_soak_window_p99_ms"
+SOAK_WINDOW_SHED_RATE = "policy_server_soak_window_shed_rate"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".

@@ -10,3 +10,7 @@ def fetch():
 
 def encode():
     failpoints.fire("site.unarmed")  # FP02: no test arms this
+
+
+def stream():
+    failpoints.fire("site.chaosed")  # armed by test_resilience_arming

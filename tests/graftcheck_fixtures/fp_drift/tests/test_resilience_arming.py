@@ -1,0 +1,9 @@
+"""Fixture chaos suite: arming from a test_resilience* file satisfies
+FP04 for its site (site.chaosed stays clean)."""
+
+from policy_server_tpu import failpoints
+
+
+def test_chaosed():
+    with failpoints.active("site.chaosed", lambda: None):
+        pass

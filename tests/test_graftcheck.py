@@ -142,9 +142,12 @@ def test_failpoint_drift_fixture_flagged():
         tests_dir="tests",
         failpoints_rel="does/not/exist.py",
     )
-    assert rules_of(findings) == {"FP01", "FP02"}
+    assert rules_of(findings) == {"FP01", "FP02", "FP04"}
     assert symbols_of(findings, "FP01") == {"armed:site.phantom"}
     assert symbols_of(findings, "FP02") == {"fired:site.unarmed"}
+    # site.armed is armed ONLY by a plain unit-test file; site.chaosed
+    # is armed from a test_resilience* file and stays FP04-clean
+    assert symbols_of(findings, "FP04") == {"unchaosed:site.armed"}
 
 
 def test_failpoint_repo_sites_all_armed_and_documented():

@@ -564,3 +564,217 @@ def test_native_counters_reach_metrics_endpoint(pair):
     stats = nat.server._native_frontend.stats()
     assert stats["http_requests"] > 0
     assert stats["requests_parsed_native"] > 0
+
+
+# -- round 13: drainer backpressure + connection-abuse hardening -------------
+
+
+class _GatedSink:
+    """Burst sink that blocks until released, then answers 200s — the
+    deterministic way to wedge the drainer so the SPSC ring fills."""
+
+    def __init__(self):
+        import threading
+
+        self.gate = threading.Event()
+
+    def handle_burst(self, frontend, burst):
+        self.gate.wait(timeout=30)
+        for rec in burst:
+            frontend.complete(rec[0], 200, b'{"ok": true}')
+
+
+def _mini_frontend(sink, **kw):
+    sock = nf.make_listen_socket("127.0.0.1", 0)
+    port = sock.getsockname()[1]
+    front = nf.NativeFrontend(sock, sink, **kw).start()
+    return front, port
+
+
+def test_ring_full_answers_inband_503_not_stall():
+    """With the drainer wedged, a flood past the submission ring's
+    capacity must answer in-band 503s (counted) from the epoll loop —
+    never stall it — and the wedge's release must complete every
+    admitted request."""
+    sink = _GatedSink()
+    front, port = _mini_frontend(sink, ring_bits=8)  # 256-slot ring
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        one = post_bytes("/validate/p", b"{}", close=False)
+        s.sendall(one)  # latches the drainer into the blocked sink
+        time.sleep(0.3)
+        flood = b"".join(
+            post_bytes("/validate/p", b"{}", close=False)
+            for _ in range(600)
+        )
+        s.sendall(flood)
+        deadline = time.time() + 10
+        while (
+            time.time() < deadline
+            and front.stats()["ring_full_rejections"] == 0
+        ):
+            time.sleep(0.05)
+        rejected = front.stats()["ring_full_rejections"]
+        assert rejected > 0, "flood never overran the 256-slot ring"
+        sink.gate.set()
+        # every request answers: 200 (drained) or 503 (ring-full)
+        s.settimeout(20)
+        stream = b""
+        try:
+            while stream.count(b"HTTP/1.1 ") < 601:
+                chunk = s.recv(1 << 20)
+                if not chunk:
+                    break
+                stream += chunk
+        except socket.timeout:
+            pass
+        resps = parse_responses(stream)
+        assert len(resps) == 601, len(resps)
+        codes = [st.split(" ")[1] for st, _h, _b in resps]
+        assert codes.count("503") == rejected
+        assert codes.count("200") == 601 - rejected
+        s.close()
+    finally:
+        sink.gate.set()
+        front.shutdown(timeout=5)
+
+
+class _EchoSink:
+    def handle_burst(self, frontend, burst):
+        for rec in burst:
+            frontend.complete(rec[0], 200, b'{"ok": true}')
+
+
+def test_read_timeout_reaps_slowloris_and_idle_conns():
+    """A request dripping forever (slowloris) must be reaped by the
+    read timeout; a silent keep-alive conn by the idle timeout — both
+    counted, with served conns untouched in between."""
+    front, port = _mini_frontend(
+        _EchoSink(), read_timeout_ms=1000, idle_timeout_ms=2500
+    )
+    try:
+        # slowloris: header never completes
+        slow = socket.create_connection(("127.0.0.1", port))
+        slow.sendall(b"POST /validate/p HTTP/1.1\r\n")
+        # idle: one served request, then silence
+        idle = socket.create_connection(("127.0.0.1", port))
+        idle.sendall(post_bytes("/validate/p", b"{}", close=False))
+        idle.settimeout(10)
+        assert b" 200 " in idle.recv(65536)
+
+        def reaped(sock_, drip):
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                try:
+                    if drip:
+                        sock_.sendall(b"X")
+                    sock_.settimeout(0.3)
+                    try:
+                        if sock_.recv(4096) == b"":
+                            return True
+                    except socket.timeout:
+                        pass
+                except OSError:
+                    return True
+                time.sleep(0.2)
+            return False
+
+        assert reaped(slow, drip=True), "slowloris conn never reaped"
+        assert reaped(idle, drip=False), "idle conn never reaped"
+        assert front.stats()["idle_timeout_closes"] >= 2
+        # the port still serves
+        ok = socket.create_connection(("127.0.0.1", port))
+        ok.sendall(post_bytes("/validate/p", b"{}"))
+        ok.settimeout(10)
+        assert b" 200 " in ok.recv(65536)
+        ok.close()
+    finally:
+        front.shutdown(timeout=5)
+
+
+def test_continuous_pipelining_outlives_read_timeout():
+    """The read-timeout clock is per REQUEST arrival, not per buffer
+    drain: a healthy client pipelining back-to-back requests for longer
+    than the read timeout (its buffer often holding a partial tail)
+    must never be reaped mid-stream — each completed request resets the
+    clock (regression: the clock used to clear only when the input
+    buffer drained to a clean boundary)."""
+    front, port = _mini_frontend(
+        _EchoSink(), read_timeout_ms=700, idle_timeout_ms=60_000
+    )
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        s.settimeout(10)
+        one = post_bytes("/validate/p", b"{}", close=False)
+        head, tail = one[: len(one) // 2], one[len(one) // 2:]
+        # every burst ENDS with a partial request, so the server's input
+        # buffer never drains to a clean boundary for the whole run —
+        # the old clock (cleared only on a drained buffer) starts once
+        # and reaps this healthy conn at 700 ms
+        s.sendall(one + head)
+        sent = 1
+        stream = b""
+        deadline = time.time() + 2.5  # ~3.5x the read timeout
+        while time.time() < deadline:
+            while stream.count(b"HTTP/1.1 ") < sent:
+                chunk = s.recv(1 << 16)
+                assert chunk, (
+                    "server closed a continuously pipelining conn "
+                    f"after {stream.count(b'HTTP/1.1 ')} of {sent} "
+                    "responses"
+                )
+                stream += chunk
+            s.sendall(tail + one + head)  # completes 2, leaves 1 partial
+            sent += 2
+            time.sleep(0.05)
+        s.sendall(tail)  # finish the last partial
+        while stream.count(b"HTTP/1.1 ") < sent:
+            chunk = s.recv(1 << 16)
+            assert chunk, "server closed the conn on the final drain"
+            stream += chunk
+        resps = parse_responses(stream)
+        assert len(resps) == sent and sent >= 20
+        assert all(" 200 " in st for st, _h, _b in resps)
+        assert front.stats()["idle_timeout_closes"] == 0
+        s.close()
+    finally:
+        front.shutdown(timeout=5)
+
+
+def test_connection_cap_rejects_inband_503():
+    """Accepts over --native-max-connections answer an in-band 503 +
+    Retry-After and close (counted) instead of silently dropping."""
+    front, port = _mini_frontend(_EchoSink(), max_connections=2)
+    try:
+        held = [
+            socket.create_connection(("127.0.0.1", port))
+            for _ in range(2)
+        ]
+        time.sleep(0.3)  # both registered by the event loop
+        over = socket.create_connection(("127.0.0.1", port))
+        over.settimeout(10)
+        data = b""
+        while True:
+            try:
+                chunk = over.recv(65536)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            data += chunk
+        assert b" 503 " in data.split(b"\r\n", 1)[0], data[:120]
+        assert b"connection limit reached" in data
+        assert b"retry-after" in data.lower()
+        assert front.stats()["conn_cap_rejections"] == 1
+        over.close()
+        # capacity frees as held conns close
+        held[0].close()
+        time.sleep(1.2)
+        again = socket.create_connection(("127.0.0.1", port))
+        again.sendall(post_bytes("/validate/p", b"{}"))
+        again.settimeout(10)
+        assert b" 200 " in again.recv(65536)
+        again.close()
+        held[1].close()
+    finally:
+        front.shutdown(timeout=5)

@@ -1196,3 +1196,132 @@ def test_native_armed_failpoint_breaker_degrades_to_oracle():
         assert handle.server._native_frontend.stats()["http_requests"] >= 12
     finally:
         handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# Round 13 — soak-era chaos: frontend intake fault, watch-stream fault,
+# and the burst-level shed Retry-After contract
+# ---------------------------------------------------------------------------
+
+
+def test_submit_many_shed_retry_after_derives_from_ewma():
+    """Burst-level shedding (submit_many) must stamp Retry-After from
+    the measured EWMA queue wait — the SAME estimate the admission
+    check used — not a constant: a deeper/slower queue must advertise a
+    proportionally longer retry."""
+    env = make_env()
+    batcher = MicroBatcher(  # deliberately NOT started: queue holds
+        env, max_batch_size=4, batch_timeout_ms=1.0, policy_timeout=5.0,
+        request_timeout_ms=50.0,
+    )
+    try:
+        # one admitted row so the queue has depth (depth 0 never sheds)
+        batcher.submit("ns", review(), RequestOrigin.VALIDATE)
+
+        def shed_burst() -> float:
+            est = batcher.estimated_wait()
+            futures = batcher.submit_many(
+                [("ns", review()) for _ in range(3)],
+                RequestOrigin.VALIDATE,
+            )
+            retries = set()
+            for fut in futures:
+                with pytest.raises(ShedError) as exc:
+                    fut.result(timeout=1)
+                retries.add(exc.value.retry_after_seconds)
+            assert len(retries) == 1  # one estimate for the whole burst
+            retry = retries.pop()
+            # the stamp IS the estimate (modulo the clamp floor)
+            assert retry == pytest.approx(max(0.001, est), rel=0.25)
+            return retry
+
+        batcher._dev_rtt[bucket_size(4)] = 2.0
+        slow = shed_burst()
+        batcher._dev_rtt[bucket_size(4)] = 8.0
+        slower = shed_burst()
+        # 4x the device RTT → ~4x the advertised retry: EWMA-derived,
+        # provably not a constant
+        assert slower == pytest.approx(slow * 4.0, rel=0.25)
+        assert batcher.shed_requests == 6
+    finally:
+        batcher.shutdown()
+
+
+def test_native_frontend_accept_fault_answers_500_and_recovers():
+    """An armed frontend.accept fault: the poisoned poll burst answers
+    every request with an in-band 500 (never strands the HTTP caller),
+    the drainer survives, and the very next request serves normally."""
+    import requests as rq
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    _native_or_skip()
+    handle = ServerHandle(make_config(frontend="native"))
+    assert handle.server._native_frontend is not None
+    try:
+        failpoints.configure("frontend.accept=raise:intake-fault*1")
+        r = rq.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(False),
+            headers={"Connection": "close"}, timeout=30,
+        )
+        assert r.status_code == 500
+        assert r.json() == {
+            "message": "Something went wrong", "status": 500
+        }
+        assert failpoints.fired_count("frontend.accept") == 1
+        # next burst is clean: the drainer kept running
+        r = rq.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(True),
+            headers={"Connection": "close"}, timeout=30,
+        )
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is False
+    finally:
+        handle.stop()
+
+
+def test_watch_feed_stream_fault_resyncs_and_recovers():
+    """An armed watch.stream fault: the kind's stream connect raises,
+    the feed backs off and recovers through a counted full re-LIST
+    resync — the snapshot store still converges to cluster truth and
+    later churn applies through the repaired stream."""
+    from policy_server_tpu.audit import SnapshotStore, WatchFeed
+    from tools.soak.cluster import SyntheticCluster
+
+    cluster = SyntheticCluster(seed=3)
+    cluster.populate(120)
+    store = SnapshotStore()
+    feed = WatchFeed(cluster, cluster.kinds, store, refresh_seconds=0.5)
+    # one raise per kind: every stream's FIRST connect faults, the
+    # retry path must re-LIST and carry on
+    failpoints.configure(
+        f"watch.stream=raise:injected-watch-fault*{len(cluster.kinds)}"
+    )
+    try:
+        feed.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and (
+            len(store) < 120
+            or feed.stats()["resyncs"] < 1
+        ):
+            time.sleep(0.05)
+        assert len(store) == 120
+        stats = feed.stats()
+        assert failpoints.fired_count("watch.stream") >= 1
+        assert stats["resyncs"] >= 1
+        assert stats["resync_reasons"].get("error", 0) >= 1
+        # the repaired streams keep delivering
+        cluster.churn(80)
+        deadline = time.monotonic() + 20
+        while (
+            time.monotonic() < deadline
+            and cluster.object_count() != len(store)
+        ):
+            time.sleep(0.05)
+        assert cluster.object_count() == len(store)
+        assert feed.stats()["events_applied"] > 0
+    finally:
+        feed.stop()
+        cluster.stop()

@@ -1,0 +1,277 @@
+"""Soak-engine unit tests (tools/soak): scenario determinism, SLO
+classification + gate logic, fault-storm scheduling, artifact shape.
+The full stack soak itself runs as ``make soak-smoke`` (CI-gated) and a
+slow-marked mini-engine case here.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from tools.soak import scenarios
+from tools.soak.faults import FaultStorm
+from tools.soak.slo import SLORecorder, write_artifact
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_trace_is_seed_deterministic():
+    a = scenarios.build_trace(1234, 600)
+    b = scenarios.build_trace(1234, 600)
+    assert [(i.path, i.body, i.expect) for i in a.items] == [
+        (i.path, i.body, i.expect) for i in b.items
+    ]
+    assert [(w.kind, w.conns, w.param) for w in a.abuse] == [
+        (w.kind, w.conns, w.param) for w in b.abuse
+    ]
+    c = scenarios.build_trace(99, 600)
+    assert [i.body for i in a.items] != [i.body for i in c.items]
+
+
+def test_trace_covers_every_scenario_family():
+    trace = scenarios.build_trace(42, 2000)
+    families = {i.scenario for i in trace.items}
+    assert families >= {
+        "rollout_storm", "namespace_churn", "schema_diversity",
+        "mutating_chain", "adversarial_payloads", "unknown_policy",
+    }
+    kinds = {w.kind for w in trace.abuse}
+    assert kinds == {"slowloris", "malformed_flood", "midbody_disconnect"}
+    # expectation classes present: ok, 422 (malformed), 404 (unknown)
+    assert {i.expect for i in trace.items} == {"ok", "422", "404"}
+
+
+def test_trace_bodies_are_wire_ready():
+    trace = scenarios.build_trace(7, 400)
+    for item in trace.items:
+        assert item.path.startswith(("/validate/", "/validate_raw/"))
+        assert isinstance(item.body, bytes) and item.body
+        if item.expect == "ok" and item.scenario != "adversarial_payloads":
+            json.loads(item.body)  # well-formed unless adversarial
+
+
+# ---------------------------------------------------------------------------
+# SLO recorder + gate
+# ---------------------------------------------------------------------------
+
+
+def test_classification_matrix():
+    rec = SLORecorder(window_seconds=60.0)
+    assert rec.classify(200, "ok") == "ok"
+    assert rec.classify(422, "422") == "ok"
+    assert rec.classify(404, "404") == "ok"
+    assert rec.classify(429, "ok") == "shed"
+    assert rec.classify(504, "ok") == "expired"
+    assert rec.classify(422, "ok") == "unexplained"
+    assert rec.classify(500, "ok") == "unexplained"
+    # inside a declared fault window, 5xx become fault_injected — 4xx
+    # mismatches stay unexplained
+    rec.note_fault_window("frontend_fault", duration=60.0)
+    assert rec.classify(500, "ok") == "fault_injected"
+    assert rec.classify(599, "ok") == "fault_injected"  # conn-drop sentinel
+    assert rec.classify(422, "ok") == "unexplained"
+
+
+def test_gate_requires_storm_and_clean_traffic():
+    from tools.soak.faults import FaultEvent
+
+    rec = SLORecorder(window_seconds=0.05)
+    for _ in range(50):
+        rec.record(200, 5.0, "ok")
+    rec.record(429, 0.0, "ok")
+    rec.finish()
+    applied = [
+        FaultEvent(at=1.0, kind=k, applied_at=1.0)
+        for k in ("sighup", "device_fault", "watch_fault")
+    ]
+    rec.record_abuse({"kind": "malformed_flood", "passed": True})
+    gate = rec.gate(p99_budget_ms=100.0, fault_events=applied)
+    assert gate["passed"], gate["checks"]
+    assert gate["totals"]["shed"] == 1
+
+    # one unexplained response fails the gate
+    rec2 = SLORecorder(window_seconds=0.05)
+    rec2.record(200, 5.0, "ok")
+    rec2.record(500, 5.0, "ok")
+    rec2.finish()
+    rec2.record_abuse({"kind": "malformed_flood", "passed": True})
+    gate2 = rec2.gate(p99_budget_ms=100.0, fault_events=applied)
+    assert not gate2["passed"]
+    assert not gate2["checks"]["zero_unexplained_non_2xx"]
+    assert gate2["totals"]["unexplained_samples"]
+
+    # an un-applied storm fails the gate even with clean traffic
+    rec3 = SLORecorder(window_seconds=0.05)
+    rec3.record(200, 5.0, "ok")
+    rec3.finish()
+    rec3.record_abuse({"kind": "malformed_flood", "passed": True})
+    gate3 = rec3.gate(
+        p99_budget_ms=100.0,
+        fault_events=[FaultEvent(at=1.0, kind="sighup")],  # never applied
+    )
+    assert not gate3["passed"]
+    assert not gate3["checks"]["fault_storm_happened"]
+
+    # a soak where every reload rolled back fails the promoted-flip
+    # check; one promotion passes it; None (no lifecycle) omits it
+    gate4 = rec.gate(
+        p99_budget_ms=100.0, fault_events=applied, promoted_reloads=0
+    )
+    assert not gate4["passed"]
+    assert not gate4["checks"]["epoch_flip_promoted"]
+    gate5 = rec.gate(
+        p99_budget_ms=100.0, fault_events=applied, promoted_reloads=1
+    )
+    assert gate5["passed"], gate5["checks"]
+    assert "epoch_flip_promoted" not in gate["checks"]
+
+
+def test_windows_roll_and_publish_soak_state():
+    class FakeState:
+        soak = None
+
+    state = FakeState()
+    rec = SLORecorder(window_seconds=0.01, soak_state=state)
+    rec.record(200, 4.0, "ok")
+    import time
+
+    time.sleep(0.03)
+    rec.record(200, 6.0, "ok")
+    rec.finish()
+    assert len(rec.windows()) >= 1
+    assert state.soak is None  # finish() clears the live gauge source
+
+
+# ---------------------------------------------------------------------------
+# fault storm scheduling
+# ---------------------------------------------------------------------------
+
+
+def test_storm_schedule_is_seeded_and_bounded():
+    class FakeServer:
+        class config:
+            breaker_failure_threshold = 5
+
+    a = FaultStorm.schedule(random.Random(5), 60.0, FakeServer())
+    b = FaultStorm.schedule(random.Random(5), 60.0, FakeServer())
+    assert [(e.at, e.kind) for e in a.events] == [
+        (e.at, e.kind) for e in b.events
+    ]
+    kinds = [e.kind for e in a.events]
+    assert kinds.count("sighup") == 2  # mid-storm + late reload
+    for core in ("device_fault", "watch_fault", "audit_fault",
+                 "frontend_fault", "reload_poison", "stream_close"):
+        assert core in kinds
+    assert "worker_kill" not in kinds  # workers=False
+    for e in a.events:
+        assert 0.05 * 60 <= e.at <= 0.95 * 60
+    assert [e.at for e in a.events] == sorted(e.at for e in a.events)
+    # the device-fault window must CLOSE before the late reload so the
+    # promoted-flip gate check is deterministic (lingering device arms
+    # poisoned every reload in the first soak runs); the poisoned
+    # reload goes early so its reload.compile arm is consumed by its
+    # own reload, never the late flip
+    late = max(e.at for e in a.events if e.kind == "sighup")
+    device = next(e for e in a.events if e.kind == "device_fault")
+    poison = next(e for e in a.events if e.kind == "reload_poison")
+    assert device.at + a.window_seconds < late
+    assert poison.at <= 0.25 * 60
+    assert 2.0 <= a.window_seconds <= 5.0
+
+
+def test_device_fault_window_auto_disarms():
+    """An armed device fault the live path never consumed (cache hits,
+    host fast-path) must not outlive its window — lingering arms
+    poisoned later epochs' warmup dispatches in the first soak runs."""
+    import time
+
+    from policy_server_tpu import failpoints
+
+    class FakeServer:
+        class config:
+            breaker_failure_threshold = 1
+
+    storm = FaultStorm(server=FakeServer(), window_seconds=0.2)
+    try:
+        effect = storm._device_fault()
+        assert "auto-disarm" in effect
+        with pytest.raises(Exception, match="soak-device-fault"):
+            failpoints.fire("device.fetch")  # one arm consumed live
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                failpoints.fire("device.fetch")
+            except Exception:
+                time.sleep(0.05)  # window not closed yet
+            else:
+                break  # disarmed: fire is a no-op again
+        else:
+            raise AssertionError("device.fetch never auto-disarmed")
+    finally:
+        storm.stop()
+
+
+def test_storm_includes_worker_kill_only_with_workers():
+    class FakeServer:
+        class config:
+            breaker_failure_threshold = 5
+
+    storm = FaultStorm.schedule(
+        random.Random(1), 60.0, FakeServer(), workers=True
+    )
+    assert "worker_kill" in [e.kind for e in storm.events]
+
+
+# ---------------------------------------------------------------------------
+# artifact
+# ---------------------------------------------------------------------------
+
+
+def test_artifact_shape(tmp_path):
+    path = tmp_path / "BENCH_soak_test.json"
+    write_artifact(
+        str(path),
+        meta={"seed": 1, "preset": "unit"},
+        windows=[{"t": 0, "rps": 10.0}],
+        faults=[{"at": 1.0, "kind": "sighup", "applied_at": 1.1}],
+        gate={"passed": True, "checks": {}},
+        extra={"watch_feed": {"events_applied": 3}},
+    )
+    doc = json.loads(path.read_text())
+    assert doc["meta"]["preset"] == "unit"
+    assert doc["slo_gate"]["passed"] is True
+    assert doc["windows"] and doc["faults"]
+    assert doc["watch_feed"]["events_applied"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the engine end to end (slow: boots the real server)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mini_soak_engine_gates_green():
+    """A tiny full-stack soak: real server, real sockets, seeded storm.
+    The CI-sized version of this runs as `make soak-smoke`."""
+    from tools.soak.engine import SoakEngine, SoakSettings
+
+    import tempfile
+
+    artifact = tempfile.mktemp(suffix=".json")
+    settings = SoakSettings.smoke(
+        duration=12.0, objects=2000, clients=2, target_rps=120.0,
+        n_trace_items=1200, artifact=artifact,
+    )
+    rc = SoakEngine(settings).run()
+    doc = json.loads(open(artifact).read())
+    assert rc == 0, doc["slo_gate"]
+    assert doc["slo_gate"]["passed"] is True
+    assert doc["watch_feed"]["events_applied"] > 0
+    applied = [f for f in doc["faults"] if f["applied_at"] is not None]
+    assert len(applied) >= 3
+    assert any(f["kind"] == "sighup" for f in applied)

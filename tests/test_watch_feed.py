@@ -1,0 +1,264 @@
+"""Watch-feed correctness (audit/watch_feed.py + context/service.py's
+shared run_watch_loop).
+
+The feed's contract: the audit snapshot store converges to the live
+cluster's truth — ADDED/MODIFIED supersede, DELETED evicts and queues
+report pruning, a cleanly closed stream resumes from its
+resourceVersion without a LIST, compacted history (410) and bounded-
+queue overflows recover through counted full re-LIST resyncs that also
+synthesize DELETEs for objects that vanished while the stream was
+down. Driven by the tools/soak SyntheticCluster, which implements the
+same fetcher protocol the in-cluster KubeApiFetcher does.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from policy_server_tpu.audit import (
+    PolicyReportStore,
+    SnapshotStore,
+    WatchFeed,
+    parse_watch_resources,
+    synthesize_review,
+)
+from policy_server_tpu.audit.snapshot import resource_key
+from tools.soak.cluster import SyntheticCluster
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def converged(cluster, store) -> bool:
+    return cluster.object_count() == len(store)
+
+
+@pytest.fixture()
+def setup():
+    cluster = SyntheticCluster(seed=7)
+    store = SnapshotStore()
+    feeds = []
+
+    def make_feed(**kw):
+        kw.setdefault("refresh_seconds", 1.0)
+        feed = WatchFeed(cluster, cluster.kinds, store, **kw)
+        feeds.append(feed)
+        return feed
+
+    yield cluster, store, make_feed
+    for f in feeds:
+        f.stop()
+    cluster.stop()
+
+
+def test_boot_list_and_added_modified_supersede(setup):
+    cluster, store, make_feed = setup
+    cluster.populate(200)
+    feed = make_feed().start()
+    assert wait_until(lambda: len(store) == 200)
+    # ADDED beyond boot
+    pod = cluster.kinds[0]
+    name = cluster.add_object(pod, namespace="ns-x")
+    assert wait_until(lambda: converged(cluster, store))
+    recorded = store.stats()["recorded"]
+    # MODIFIED supersedes: same key, newer generation, no growth
+    before_len = len(store)
+    assert cluster.modify_object(pod, name)
+    assert wait_until(
+        lambda: store.stats()["superseded"] >= 1
+        and store.stats()["recorded"] > recorded
+    )
+    assert len(store) == before_len
+    # the stored row is the NEWEST generation
+    key = resource_key(
+        synthesize_review(
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": name, "namespace": "ns-x",
+                          "uid": f"uid-{name}"}},
+            "UPDATE",
+        )
+    )
+    rows = dict(store.collect(dirty_only=False))
+    assert key in rows
+    assert b'"generation":2' in rows[key].payload_json().replace(b" ", b"")
+
+
+def test_deleted_evicts_and_prunes_reports(setup):
+    cluster, store, make_feed = setup
+    cluster.populate(50)
+    feed = make_feed().start()
+    assert wait_until(lambda: len(store) == 50)
+    # stamp a report row for one resource, then DELETE it in the cluster
+    reports = PolicyReportStore()
+    key, request = store.collect(dirty_only=False)[0]
+    group, version, kind, ns, name = key.split("/", 4)
+    resource = next(
+        r for r in cluster.kinds
+        if r.kind == kind
+    )
+    row = reports.row_from_result(
+        key, "policy-a", request, RuntimeError("placeholder"), epoch=0
+    )
+    reports.put([row])
+    assert reports.stats()["resident"] == 1
+    assert cluster.delete_object(resource, name)
+    assert wait_until(lambda: converged(cluster, store))
+    assert len(store) == 49
+    # the scanner's prune contract: drained deletions drop report rows
+    deletions = store.take_deletions()
+    assert key in deletions
+    reports.drop_resources(deletions)
+    assert reports.stats()["resident"] == 0
+
+
+def test_stream_close_resumes_from_resource_version(setup):
+    cluster, store, make_feed = setup
+    cluster.populate(100)
+    feed = make_feed().start()
+    assert wait_until(lambda: len(store) == 100)
+    streams_before = feed.stats()["streams_opened"]
+    resyncs_before = feed.stats()["resyncs"]
+    cluster.close_streams()
+    assert wait_until(
+        lambda: feed.stats()["streams_opened"]
+        >= streams_before + len(cluster.kinds)
+    )
+    # events delivered AFTER the close still apply — and through the
+    # resumed watch, not a re-LIST
+    cluster.churn(60)
+    assert wait_until(lambda: converged(cluster, store))
+    assert feed.stats()["resyncs"] == resyncs_before
+    assert feed.stats()["events_applied"] > 0
+
+
+def test_compacted_history_forces_counted_resync_with_delete_repair(setup):
+    cluster, store, make_feed = setup
+    # tiny event log: any burst larger than it compacts history → the
+    # resumed watch sees 410 → counted full re-LIST resync
+    cluster.event_log_bound = 20
+    cluster.populate(60)
+    feed = make_feed().start()
+    assert wait_until(lambda: len(store) == 60)
+    cluster.close_streams()  # park the watchers on a fresh stream
+    # churn far past the log bound INCLUDING deletes, racing the resumed
+    # watch: whether a given event arrives live or via the 410 re-LIST,
+    # the store must converge and vanished objects must queue pruning
+    store.take_deletions()
+    cluster.churn(400)
+    assert wait_until(lambda: converged(cluster, store), timeout=20)
+    stats = feed.stats()
+    assert stats["resyncs"] >= 1
+    assert stats["resync_reasons"].get("expired", 0) >= 1
+    # deletes that happened during the gap queued report pruning,
+    # whether they arrived as live events or were synthesized by the
+    # re-LIST repair
+    assert store.take_deletions()
+
+
+def test_resync_repair_keeps_recreated_object_with_new_uid(setup):
+    """An object deleted AND re-created (same name, new uid) during a
+    stream outage must survive the re-LIST repair: the store is
+    name-keyed, so a uid-keyed synthetic DELETE would evict the live
+    row the repair's own CREATE just recorded (regression)."""
+    cluster, store, make_feed = setup
+    feed = make_feed()
+
+    def pod(uid, name="web-0"):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"uid": uid, "name": name, "namespace": "ns"},
+            "spec": {"containers": []},
+        }
+
+    key = "v1/Pod"
+    feed._apply_batch([
+        ("event", key, "ADDED", pod("uid-old")),
+        ("event", key, "ADDED", pod("uid-gone", name="web-1")),
+    ])
+    assert len(store) == 2
+    store.take_deletions()
+    # outage: web-0 deleted + re-created (new uid), web-1 truly vanished
+    feed._apply_batch([("replace", key, (pod("uid-new"),))])
+    assert len(store) == 1, "re-created object was evicted by the repair"
+    assert feed.stats()["deletes_synthesized"] == 1  # web-1 only
+    pruned = store.take_deletions()
+    assert all("web-1" in k for k in pruned), pruned
+
+
+def test_bounded_queue_overflow_drops_loudly_and_resyncs(setup):
+    cluster, store, make_feed = setup
+    cluster.populate(30)
+    feed = make_feed(max_queue_events=4).start()
+    assert wait_until(lambda: len(store) == 30)
+    # a burst far past the 4-slot queue must drop (counted) and repair
+    # through a full re-LIST — the store still converges
+    cluster.churn(500)
+    assert wait_until(lambda: converged(cluster, store), timeout=20)
+    stats = feed.stats()
+    if stats["events_dropped"]:  # drops depend on applier timing
+        assert stats["resyncs"] >= 1
+    assert stats["events_applied"] + stats["replaces"] > 0
+
+
+def test_interval_resync_bounds_staleness(setup):
+    cluster, store, make_feed = setup
+    cluster.populate(40)
+    # resync_multiplier 1 × refresh 0.5 s: the first stream close after
+    # 0.5 s re-LISTs even with a healthy resourceVersion
+    feed = make_feed(
+        refresh_seconds=0.5, resync_multiplier=1
+    ).start()
+    assert wait_until(lambda: len(store) == 40)
+    time.sleep(1.0)
+    cluster.close_streams()
+    assert wait_until(
+        lambda: feed.stats()["resync_reasons"].get("interval", 0) >= 1,
+        timeout=15,
+    )
+    assert converged(cluster, store)
+
+
+def test_parse_watch_resources_rejects_malformed():
+    assert len(parse_watch_resources("v1/Pod , apps/v1/Deployment")) == 2
+    with pytest.raises(ValueError):
+        parse_watch_resources("Pod")
+    with pytest.raises(ValueError):
+        parse_watch_resources("v1/")
+
+
+@pytest.mark.slow
+def test_100k_churning_cluster_bounded_bytes():
+    """The acceptance-scale proof: a 100k-object synthetic cluster feeds
+    the store through watch events; the snapshot stays byte-bounded,
+    churn (incl. deletes) converges, and DELETE pruning queues."""
+    cluster = SyntheticCluster(seed=13)
+    store = SnapshotStore(max_bytes=256 * 1024 * 1024)
+    feed = WatchFeed(
+        cluster, cluster.kinds, store, refresh_seconds=5.0
+    )
+    try:
+        cluster.populate(100_000)
+        feed.start()
+        assert wait_until(
+            lambda: len(store) == 100_000, timeout=120
+        ), (len(store), feed.stats())
+        stats = store.stats()
+        assert 0 < stats["bytes"] <= 256 * 1024 * 1024
+        store.take_deletions()
+        cluster.churn(2_000)
+        assert wait_until(
+            lambda: cluster.object_count() == len(store), timeout=60
+        ), (cluster.object_count(), len(store), feed.stats())
+        assert len(store.take_deletions()) > 0
+        assert feed.stats()["events_applied"] >= 1_000
+    finally:
+        feed.stop()
+        cluster.stop()

@@ -30,6 +30,15 @@ def pct(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def write_json_artifact(path: str, doc: dict) -> None:
+    """One writer for BENCH-style JSON artifacts (bench trend files,
+    tools/soak's BENCH_soak_* output): stable formatting so round-over-
+    round diffs stay readable."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
 def emit(metric: str, value: float, unit: str, vs: float, **details) -> None:
     _EMITTED.append((metric, round(value, 2), unit))
     print(

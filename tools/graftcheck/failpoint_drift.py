@@ -14,6 +14,12 @@ Rules:
 * **FP02** — a compiled-in ``failpoints.fire`` site that no test arms.
 * **FP03** — the failpoints.py docstring site table is missing a
   compiled site (or lists a stale one).
+* **FP04** — a compiled-in site that no CHAOS or SOAK surface arms
+  (``tests/test_resilience*`` / ``tests/test_soak*`` /
+  ``tools/soak/``): an injection exercised only by a unit test never
+  runs with the lock-order sanitizer armed or under the soak's
+  interaction load, which is where failpoint regressions actually
+  surface (round-13 rule).
 
 Armed sites are recognized through every arming surface:
 ``set_failpoint("site", ...)``, ``failpoints.active("site", ...)``,
@@ -63,14 +69,22 @@ def _parse_spec(spec: str) -> list[str]:
     return [m.group(1) for m in _SPEC_SITE_RE.finditer(spec)]
 
 
-def _armed_sites(root: Path, tests_dir: str) -> dict[str, tuple[str, int]]:
-    out: dict[str, tuple[str, int]] = {}
+def _armed_sites(
+    root: Path, tests_dir: str, extra_dirs: tuple[str, ...] = ()
+) -> dict[str, list[tuple[str, int]]]:
+    """site → EVERY (relpath, line) arming it, across the tests dir and
+    any extra arming surfaces (tools/soak arms sites programmatically)."""
+    out: dict[str, list[tuple[str, int]]] = {}
 
     def add(site: str, relpath: str, line: int) -> None:
         if site and "." in site:
-            out.setdefault(site, (relpath, line))
+            out.setdefault(site, []).append((relpath, line))
 
-    for path in iter_py_files(root, tests_dir):
+    paths: list[Path] = list(iter_py_files(root, tests_dir))
+    for extra in extra_dirs:
+        if (root / extra).exists():
+            paths.extend(iter_py_files(root, extra))
+    for path in paths:
         relpath = str(path.relative_to(root))
         tree = ast.parse(path.read_text())
         for node in ast.walk(tree):
@@ -124,10 +138,19 @@ def check(
     root = Path(root)
     findings: list[Finding] = []
     fired = _fired_sites(root, package)
-    armed = _armed_sites(root, tests_dir)
+    armed = _armed_sites(root, tests_dir, extra_dirs=("tools/soak",))
 
-    for site, (relpath, line) in sorted(armed.items()):
+    def _chaos_or_soak(relpath: str) -> bool:
+        name = relpath.replace("\\", "/")
+        return (
+            name.startswith(f"{tests_dir}/test_resilience")
+            or name.startswith(f"{tests_dir}/test_soak")
+            or name.startswith("tools/soak/")
+        )
+
+    for site, locs in sorted(armed.items()):
         if site not in fired:
+            relpath, line = locs[0]
             findings.append(
                 Finding(
                     "failpoints", "FP01", relpath, line,
@@ -145,6 +168,17 @@ def check(
                     f"fired:{site}",
                     f"compiled-in failpoint site '{site}' is never armed "
                     "by any test — dead instrumentation",
+                )
+            )
+        elif not any(_chaos_or_soak(rp) for rp, _ln in armed[site]):
+            findings.append(
+                Finding(
+                    "failpoints", "FP04", relpath, line,
+                    f"unchaosed:{site}",
+                    f"failpoint site '{site}' is armed only outside the "
+                    "chaos/soak surfaces (tests/test_resilience*, "
+                    "tests/test_soak*, tools/soak/) — it never runs "
+                    "under the lock-order sanitizer or soak load",
                 )
             )
 

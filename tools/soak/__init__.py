@@ -1,0 +1,26 @@
+"""Cluster-scale soak engine (round 13).
+
+Unit and chaos tests exercise subsystems in isolation; the failures
+that survive them are *interaction* failures — a SIGHUP epoch flip
+landing mid-rollout-storm while the audit lane sweeps and a breaker is
+half-open. This package replays realistic, seeded cluster traces
+against the FULL serving stack (native frontend by default, real
+sockets), schedules mid-soak fault storms, churns a synthetic cluster
+into the audit watch feed, and records windowed SLO trend lines as a
+``BENCH_soak_*.json`` artifact behind a pass/fail gate.
+
+Modules:
+
+* ``scenarios`` — composable seeded trace generators (rollout storms,
+  namespace churn, CRD/schema diversity, mutating chains, adversarial
+  payloads) plus connection-abuse wave specs (slowloris, malformed
+  floods, mid-body disconnects).
+* ``cluster``   — a seeded synthetic Kubernetes cluster implementing
+  the ``list_with_version``/``watch`` fetcher protocol, churned live
+  during the soak to drive the audit watch feed at 100k+ objects.
+* ``faults``    — the fault-storm scheduler: SIGHUP reloads, armed
+  failpoints, breaker trips, worker kills on a seeded timeline.
+* ``slo``       — windowed SLO recorder + gate + artifact writer.
+* ``engine``    — the harness wiring it all together
+  (``python -m tools.soak``; ``make soak-smoke``).
+"""

@@ -1,0 +1,248 @@
+"""Seeded synthetic Kubernetes cluster for the soak engine and the
+watch-feed tests.
+
+Implements the fetcher protocol the audit :class:`WatchFeed` (and the
+context service) consume — ``list_with_version(resource)`` and
+``watch(resource, rv)`` — over an in-memory object store that the soak
+churns live: ADD/MODIFY/DELETE ops bump a global resourceVersion and
+append to a BOUNDED per-kind event log. A watch from an rv older than
+the log's tail yields a 410-style ERROR event (the consumer must
+re-LIST), exactly like a real API server compacting etcd history; a
+stream also closes cleanly after ``max_events_per_stream`` deliveries,
+exercising the resourceVersion-resume path on a cadence a real server
+would (~5 min) make untestably slow.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+from typing import Any, Iterator
+
+from policy_server_tpu.models.policy import ContextAwareResource
+
+DEFAULT_KINDS = (
+    ContextAwareResource(api_version="v1", kind="Pod"),
+    ContextAwareResource(api_version="v1", kind="Namespace"),
+    ContextAwareResource(api_version="apps/v1", kind="Deployment"),
+)
+
+
+def _kind_key(resource: ContextAwareResource) -> str:
+    return f"{resource.api_version}/{resource.kind}"
+
+
+class SyntheticCluster:
+    """In-memory cluster: per-kind name→object maps + bounded event
+    logs. Thread-safe; watch streams block on a condition and wake on
+    churn, stop, or a forced close."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        kinds: tuple[ContextAwareResource, ...] = DEFAULT_KINDS,
+        *,
+        event_log_bound: int = 50_000,
+        max_events_per_stream: int = 10_000,
+    ) -> None:
+        self.kinds = kinds
+        self.event_log_bound = int(event_log_bound)
+        self.max_events_per_stream = int(max_events_per_stream)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._rv = 0  # guarded-by: _lock
+        self._objects: dict[str, dict[str, dict]] = {  # guarded-by: _lock
+            _kind_key(k): {} for k in kinds
+        }
+        # per kind: list of (rv, etype, obj-copy) + a parallel rv list
+        # (bisect: a watch wake must not linear-scan 50k events)
+        self._events: dict[str, list] = {  # guarded-by: _lock
+            _kind_key(k): [] for k in kinds
+        }
+        self._event_rvs: dict[str, list] = {  # guarded-by: _lock
+            _kind_key(k): [] for k in kinds
+        }
+        self._oldest_rv: dict[str, int] = {  # guarded-by: _lock
+            _kind_key(k): 0 for k in kinds
+        }
+        self._close_generation = 0  # guarded-by: _lock
+        self._stopped = False  # guarded-by: _lock
+        self.churn_ops = 0  # guarded-by: _lock
+
+    # -- population / churn ------------------------------------------------
+
+    def populate(self, n_objects: int, namespaces: int = 50) -> None:
+        """Seed ``n_objects`` across the kinds (Pod-heavy, like a real
+        cluster)."""
+        for i in range(n_objects):
+            kind = self.kinds[0] if i % 10 < 8 else (
+                self.kinds[min(1 + i % (len(self.kinds) - 1),
+                               len(self.kinds) - 1)]
+                if len(self.kinds) > 1 else self.kinds[0]
+            )
+            self.add_object(kind, namespace=f"ns-{i % namespaces}")
+
+    def _make_obj(
+        self, resource: ContextAwareResource, name: str,
+        namespace: str | None, rv: int, generation: int,
+    ) -> dict:
+        return {
+            "apiVersion": resource.api_version,
+            "kind": resource.kind,
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "uid": f"uid-{name}",
+                "resourceVersion": str(rv),
+                "generation": generation,
+            },
+            "spec": {"revision": generation},
+        }
+
+    def add_object(
+        self,
+        resource: ContextAwareResource,
+        name: str | None = None,
+        namespace: str | None = None,
+    ) -> str:
+        key = _kind_key(resource)
+        name = name or f"{resource.kind.lower()}-{self._rng.getrandbits(40):010x}"
+        with self._cond:
+            self._rv += 1
+            obj = self._make_obj(resource, name, namespace, self._rv, 1)
+            self._objects[key][name] = obj
+            self._append_event(key, "ADDED", obj)
+        return name
+
+    def modify_object(self, resource: ContextAwareResource, name: str) -> bool:
+        key = _kind_key(resource)
+        with self._cond:
+            obj = self._objects[key].get(name)
+            if obj is None:
+                return False
+            self._rv += 1
+            gen = obj["metadata"]["generation"] + 1
+            newobj = self._make_obj(
+                resource, name, obj["metadata"]["namespace"], self._rv, gen
+            )
+            self._objects[key][name] = newobj
+            self._append_event(key, "MODIFIED", newobj)
+        return True
+
+    def delete_object(self, resource: ContextAwareResource, name: str) -> bool:
+        key = _kind_key(resource)
+        with self._cond:
+            obj = self._objects[key].pop(name, None)
+            if obj is None:
+                return False
+            self._rv += 1
+            gone = dict(obj)
+            gone["metadata"] = dict(obj["metadata"])
+            gone["metadata"]["resourceVersion"] = str(self._rv)
+            self._append_event(key, "DELETED", gone)
+        return True
+
+    def churn(self, ops: int) -> None:
+        """Apply ``ops`` seeded random churn operations (add/modify/
+        delete, weighted toward modify like real clusters)."""
+        for _ in range(ops):
+            resource = self._rng.choice(self.kinds)
+            key = _kind_key(resource)
+            with self._lock:
+                names = list(self._objects[key])
+                self.churn_ops += 1
+            roll = self._rng.random()
+            if not names or roll < 0.25:
+                self.add_object(resource)
+            elif roll < 0.75:
+                self.modify_object(resource, self._rng.choice(names))
+            else:
+                self.delete_object(resource, self._rng.choice(names))
+
+    def _append_event(self, key: str, etype: str, obj: dict) -> None:
+        # holds: _lock
+        log = self._events[key]
+        rvs = self._event_rvs[key]
+        log.append((self._rv, etype, obj))
+        rvs.append(self._rv)
+        if len(log) > self.event_log_bound:
+            drop = len(log) - self.event_log_bound
+            del log[:drop]
+            del rvs[:drop]
+            self._oldest_rv[key] = log[0][0]
+        self._cond.notify_all()
+
+    def object_count(self) -> int:
+        with self._lock:
+            return sum(len(m) for m in self._objects.values())
+
+    def close_streams(self) -> None:
+        """Force every open watch stream to close cleanly (the server-
+        side ~5 min stream recycle): consumers must resume from their
+        last resourceVersion without a re-LIST."""
+        with self._cond:
+            self._close_generation += 1
+            self._cond.notify_all()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # -- fetcher protocol (context.service / audit.watch_feed) -------------
+
+    def list_with_version(
+        self, resource: ContextAwareResource
+    ) -> tuple[tuple[Any, ...], str]:
+        key = _kind_key(resource)
+        with self._lock:
+            return tuple(self._objects[key].values()), str(self._rv)
+
+    def watch(
+        self, resource: ContextAwareResource, resource_version: str
+    ) -> Iterator[dict]:
+        key = _kind_key(resource)
+        try:
+            rv = int(resource_version or "0")
+        except ValueError:
+            rv = 0
+        delivered = 0
+        with self._lock:
+            my_generation = self._close_generation
+            if rv and rv < self._oldest_rv[key]:
+                # compacted history: 410 Gone semantics
+                yield {"type": "ERROR", "object": {"code": 410}}
+                return
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                if self._close_generation != my_generation:
+                    return  # clean close → caller resumes from its rv
+                # history compacted PAST our position while we were
+                # yielding/waiting: delivering from the truncated log
+                # head would silently skip events (a compacted DELETED
+                # leaves a ghost row the consumer never prunes) —
+                # surface the same 410 as at entry so the caller
+                # re-LISTs
+                compacted = bool(rv) and rv < self._oldest_rv[key]
+                if not compacted:
+                    start = bisect.bisect_right(self._event_rvs[key], rv)
+                    pending = self._events[key][start:]
+                    if not pending:
+                        self._cond.wait(timeout=0.2)
+                        continue
+            if compacted:  # yield outside the lock
+                yield {"type": "ERROR", "object": {"code": 410}}
+                return
+            for erv, etype, obj in pending:
+                yield {"type": etype, "object": obj}
+                rv = erv
+                delivered += 1
+                if delivered >= self.max_events_per_stream:
+                    return  # clean close (stream recycle)
+            with self._lock:
+                if self._stopped or self._close_generation != my_generation:
+                    return

@@ -1,0 +1,651 @@
+"""The soak harness: full serving stack + trace replay + fault storm +
+live watch feed + SLO artifact.
+
+Runs the REAL server in-process (the same bootstrap `python -m
+policy_server_tpu` uses — native frontend by default over real sockets,
+prefork optional) inside a private event-loop thread, then drives it
+with:
+
+* paced client threads replaying the seeded scenario trace over
+  keep-alive, pipelined raw sockets (statuses + latencies recorded per
+  expectation class);
+* an abuse driver executing the trace's connection-abuse waves
+  (slowloris drips against the native read timeout, pipelined malformed
+  floods, mid-body disconnects);
+* a churn thread mutating the :class:`SyntheticCluster` that feeds the
+  audit snapshot store through the live :class:`WatchFeed`;
+* the :class:`FaultStorm` applying seeded mid-soak faults (SIGHUP
+  reload, poisoned reload, breaker trip, audit/watch/frontend
+  failpoints, stream closes, worker kills).
+
+When the engine owns the main thread (``python -m tools.soak``) the
+SIGHUP is a REAL signal through a registered handler. The run ends with
+the SLO gate and a ``BENCH_soak_<tag>.json`` artifact; exit code 1 on a
+gate failure (``make soak-smoke`` is CI-gating).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import signal
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.soak import scenarios
+from tools.soak.cluster import SyntheticCluster
+from tools.soak.faults import FaultStorm
+from tools.soak.slo import SLORecorder, write_artifact
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+_POLICIES_YAML = """\
+pod-privileged:
+  module: builtin://pod-privileged
+pod-privileged-monitor:
+  module: builtin://pod-privileged
+  policyMode: monitor
+raw-mutation:
+  module: builtin://raw-mutation
+  allowedToMutate: true
+soak-group:
+  expression: happy() && priv()
+  message: group rejected the request
+  policies:
+    happy:
+      module: builtin://always-happy
+    priv:
+      module: builtin://pod-privileged
+"""
+
+
+@dataclass
+class SoakSettings:
+    seed: int = 42
+    duration: float = 45.0
+    clients: int = 4
+    pipeline: int = 4
+    target_rps: float = 300.0
+    n_trace_items: int = 4000
+    objects: int = 20_000
+    churn_ops_per_second: float = 400.0
+    window_seconds: float = 5.0
+    p99_budget_ms: float = 750.0
+    frontend: str = "native"
+    http_workers: int = 1
+    read_timeout_seconds: float = 5.0  # native slowloris bound
+    audit_interval_seconds: float = 5.0
+    artifact: str | None = None
+    tag: str = "r13"
+    preset: str = "custom"
+
+    @classmethod
+    def smoke(cls, **over) -> "SoakSettings":
+        """The <=60 s CI mini-soak (make soak-smoke)."""
+        base = dict(
+            duration=20.0, clients=3, target_rps=220.0,
+            n_trace_items=2500, objects=20_000,
+            churn_ops_per_second=300.0, window_seconds=2.5,
+            preset="smoke", tag="r13_smoke",
+        )
+        base.update(over)
+        return cls(**base)
+
+    @classmethod
+    def full(cls, **over) -> "SoakSettings":
+        """The cluster-scale soak: 100k+ watched objects, prefork
+        workers in the kill rotation, a longer storm."""
+        base = dict(
+            duration=300.0, clients=6, target_rps=700.0,
+            n_trace_items=20_000, objects=120_000,
+            churn_ops_per_second=800.0, window_seconds=10.0,
+            http_workers=2, preset="full", tag="r13_full",
+        )
+        base.update(over)
+        return cls(**base)
+
+
+class _ServerThread:
+    """PolicyServer inside a private event loop (test_server.ServerHandle
+    shape, re-owned here so the soak tool has no tests/ dependency)."""
+
+    def __init__(self, config):
+        from policy_server_tpu.server import PolicyServer
+
+        self.server = PolicyServer.new_from_config(config)
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._boot_error: BaseException | None = None
+        self.thread = threading.Thread(
+            target=self._run, name="soak-server", daemon=True
+        )
+        self.thread.start()
+        if not self._started.wait(timeout=180):
+            raise RuntimeError("soak server failed to start (timeout)")
+        if self._boot_error is not None:
+            raise RuntimeError(
+                "soak server failed to start"
+            ) from self._boot_error
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        try:
+            self.loop.run_until_complete(self.server.start())
+        except BaseException as e:  # noqa: BLE001 — a boot failure must
+            # surface as the constructor's exception, not a daemon-thread
+            # stderr line followed by a causeless 3-minute timeout
+            self._boot_error = e
+            self._started.set()
+            return
+        self._started.set()
+        self.loop.run_forever()
+
+    def stop(self) -> None:
+        async def _shutdown():
+            await self.server.stop()
+            self.loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
+        self.thread.join(timeout=30)
+
+
+@dataclass
+class SoakEngine:
+    settings: SoakSettings
+    log: list[str] = field(default_factory=list)
+
+    def _say(self, msg: str) -> None:
+        line = f"[soak +{time.monotonic() - self._t0:6.1f}s] {msg}"
+        self.log.append(line)
+        print(line, flush=True)
+
+    # -- bring-up ----------------------------------------------------------
+
+    def _build_config(self, policies_path: Path):
+        from policy_server_tpu.config.config import (
+            Config,
+            TlsConfig,
+            read_policies_file,
+        )
+
+        s = self.settings
+        return Config(
+            addr="127.0.0.1",
+            port=0,
+            readiness_probe_port=0,
+            tls_config=TlsConfig(),
+            policies=read_policies_file(policies_path),
+            policies_path=str(policies_path),
+            policy_timeout_seconds=5.0,
+            max_batch_size=16,
+            batch_timeout_ms=2.0,
+            request_timeout_ms=2000.0,
+            frontend=s.frontend,
+            http_workers=s.http_workers,
+            policy_reload_mode="auto",
+            reload_canary_requests=16,
+            audit_mode="interval",
+            audit_interval_seconds=s.audit_interval_seconds,
+            audit_batch_size=256,
+            native_read_timeout_seconds=s.read_timeout_seconds,
+            native_idle_timeout_seconds=75.0,
+            native_max_connections=4096,
+            enable_pprof=False,
+        )
+
+    # -- traffic -----------------------------------------------------------
+
+    def _client_loop(
+        self, idx: int, items: list, stop: threading.Event
+    ) -> None:
+        s = self.settings
+        rec = self.recorder
+        rng = random.Random(s.seed * 1000 + idx)
+        order = list(range(len(items)))
+        rng.shuffle(order)
+        per_client = max(1.0, s.target_rps / s.clients)
+        burst_sleep = s.pipeline / per_client
+        pos = 0
+        sock_ = None
+        while not stop.is_set():
+            t_burst = time.perf_counter()
+            burst = [
+                items[order[(pos + i) % len(order)]]
+                for i in range(s.pipeline)
+            ]
+            pos = (pos + s.pipeline) % len(order)
+            try:
+                if sock_ is None:
+                    sock_ = _HttpConn(self.api_port)
+                payload = b"".join(
+                    self._wire(it.path, it.body) for it in burst
+                )
+                sock_.sendall(payload)
+                for it in burst:
+                    status, _hdrs, _body = sock_.read_response()
+                    rec.record(
+                        status,
+                        (time.perf_counter() - t_burst) * 1000.0,
+                        it.expect,
+                        detail=f"{it.scenario} {it.path}",
+                    )
+            except Exception as e:  # noqa: BLE001 — conn died: the
+                # responses we did not read are unobservable; a server
+                # that closed on us mid-burst outside an abuse wave
+                # shows up via the requests we re-issue, so just
+                # reconnect (drops counted by the artifact's totals gap)
+                if not stop.is_set():
+                    rec.record(599, 0.0, "ok", detail=f"conn: {e}")
+                if sock_ is not None:
+                    sock_.close()
+                sock_ = None
+                continue
+            elapsed = time.perf_counter() - t_burst
+            if elapsed < burst_sleep:
+                time.sleep(burst_sleep - elapsed)
+        if sock_ is not None:
+            sock_.close()
+
+    @staticmethod
+    def _wire(path: str, body: bytes) -> bytes:
+        return (
+            f"POST {path} HTTP/1.1\r\nHost: soak\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+
+    # -- abuse driver ------------------------------------------------------
+
+    def _abuse_loop(
+        self, waves: list, stop: threading.Event, t0: float
+    ) -> None:
+        s = self.settings
+        if not waves:
+            return
+        # spread waves over the middle of the soak
+        spacing = s.duration * 0.8 / (len(waves) + 1)
+        for i, wave in enumerate(waves):
+            due = t0 + s.duration * 0.1 + spacing * (i + 1)
+            while not stop.is_set() and time.monotonic() < due:
+                stop.wait(0.2)
+            if stop.is_set():
+                return
+            try:
+                result = self._run_wave(wave)
+            except Exception as e:  # noqa: BLE001 — an abuse wave must
+                # never kill the soak; record the failure
+                result = {"kind": wave.kind, "passed": False,
+                          "error": str(e)}
+            result["t"] = round(time.monotonic() - t0, 1)
+            self.recorder.record_abuse(result)
+            self._say(f"abuse wave {result}")
+
+    def _run_wave(self, wave) -> dict:
+        if wave.kind == "slowloris":
+            return self._wave_slowloris(wave)
+        if wave.kind == "malformed_flood":
+            return self._wave_malformed(wave)
+        return self._wave_midbody(wave)
+
+    def _wave_slowloris(self, wave) -> dict:
+        if not self.native_active:
+            return {
+                "kind": "slowloris", "passed": None,
+                "note": "skipped: python frontend has no read timeout",
+            }
+        budget = self.settings.read_timeout_seconds + 6.0
+        conns = []
+        for _ in range(wave.conns):
+            c = socket.create_connection(
+                ("127.0.0.1", self.api_port), timeout=budget
+            )
+            c.sendall(b"POST /validate/pod-privileged HTTP/1.1\r\n")
+            conns.append(c)
+        deadline = time.monotonic() + budget
+        open_conns = list(conns)
+        closed = 0
+        # drip ALL conns concurrently each interval (sequential drips
+        # would serialize N read-timeout waits past the soak window)
+        while open_conns and time.monotonic() < deadline:
+            time.sleep(max(0.1, wave.param))
+            still = []
+            for c in open_conns:
+                try:
+                    c.sendall(b"X")  # one more header byte: never done
+                    c.setblocking(False)
+                    try:
+                        if c.recv(4096) == b"":
+                            closed += 1
+                            continue
+                    except BlockingIOError:
+                        pass
+                    finally:
+                        c.setblocking(True)
+                    still.append(c)
+                except OSError:
+                    closed += 1
+            open_conns = still
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        return {
+            "kind": "slowloris", "conns": wave.conns, "closed": closed,
+            "passed": closed == wave.conns,
+        }
+
+    def _wave_malformed(self, wave) -> dict:
+        got_400 = 0
+        for _ in range(wave.conns):
+            c = socket.create_connection(
+                ("127.0.0.1", self.api_port), timeout=15
+            )
+            try:
+                flood = b"".join(
+                    b"BLARGH nonsense\r\nGarbage: yes\r\n\r\n"
+                    for _ in range(int(wave.param))
+                )
+                c.sendall(flood)
+                c.settimeout(10)
+                data = b""
+                try:
+                    while True:
+                        chunk = c.recv(65536)
+                        if not chunk:
+                            break
+                        data += chunk
+                except socket.timeout:
+                    pass
+                if b" 400 " in data.split(b"\r\n", 1)[0]:
+                    got_400 += 1
+            finally:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        return {
+            "kind": "malformed_flood", "conns": wave.conns,
+            "answered_400": got_400, "passed": got_400 == wave.conns,
+        }
+
+    def _wave_midbody(self, wave) -> dict:
+        for _ in range(wave.conns):
+            c = socket.create_connection(
+                ("127.0.0.1", self.api_port), timeout=15
+            )
+            c.sendall(
+                b"POST /validate/pod-privileged HTTP/1.1\r\nHost: s\r\n"
+                b"Content-Length: 50000\r\n\r\npartial-then-gone"
+            )
+            c.close()
+        # the server must still answer cleanly right after
+        probe = scenarios.build_trace(1, 4).items[0]
+        conn = _HttpConn(self.api_port)
+        try:
+            conn.sendall(self._wire(probe.path, probe.body))
+            status, _h, _b = conn.read_response()
+        finally:
+            conn.close()
+        ok = status in (200, 429, 504)
+        return {
+            "kind": "midbody_disconnect", "conns": wave.conns,
+            "probe_status": status, "passed": ok,
+        }
+
+    # -- churn -------------------------------------------------------------
+
+    def _churn_loop(self, stop: threading.Event) -> None:
+        s = self.settings
+        tick = 0.25
+        per_tick = max(1, int(s.churn_ops_per_second * tick))
+        while not stop.wait(tick):
+            self.cluster.churn(per_tick)
+
+    # -- the run -----------------------------------------------------------
+
+    def run(self) -> int:
+        import tempfile
+
+        from policy_server_tpu.audit import WatchFeed
+
+        s = self.settings
+        self._t0 = time.monotonic()
+        rng = random.Random(s.seed)
+        self._say(
+            f"soak preset={s.preset} seed={s.seed} duration={s.duration}s "
+            f"clients={s.clients} target_rps={s.target_rps} "
+            f"objects={s.objects}"
+        )
+        trace = scenarios.build_trace(s.seed, s.n_trace_items)
+        self._say(
+            f"trace built: {len(trace.items)} items, "
+            f"{len(trace.abuse)} abuse waves"
+        )
+        tmp = tempfile.mkdtemp(prefix="policy-server-soak-")
+        policies_path = Path(tmp) / "policies.yml"
+        policies_path.write_text(_POLICIES_YAML, encoding="utf-8")
+        config = self._build_config(policies_path)
+
+        handle = _ServerThread(config)
+        server = handle.server
+        self.api_port = server.api_port
+        self.native_active = server._native_frontend is not None
+        if s.frontend == "native" and not self.native_active:
+            self._say(
+                "NOTE: native frontend unavailable — soaking the python "
+                "frontend (recorded in the artifact)"
+            )
+        self._say(f"server up on :{self.api_port} native={self.native_active}")
+
+        # SIGHUP: a REAL signal when we own the main thread
+        sighup_registered = False
+        if (
+            hasattr(signal, "SIGHUP")
+            and threading.current_thread() is threading.main_thread()
+        ):
+            signal.signal(
+                signal.SIGHUP, lambda *_a: server.reload_signal()
+            )
+            sighup_registered = True
+
+        # synthetic cluster → live watch feed → audit snapshot store
+        self.cluster = SyntheticCluster(seed=s.seed)
+        self.cluster.populate(s.objects)
+        self._say(f"synthetic cluster populated: {self.cluster.object_count()} objects")
+        feed = WatchFeed(
+            self.cluster,
+            self.cluster.kinds,
+            server.state.audit.snapshot,
+            refresh_seconds=5.0,
+            max_queue_events=65536,
+        ).start()
+        server.state.audit_watch = feed
+        server.state.audit.watch_feed = feed
+
+        self.recorder = SLORecorder(
+            window_seconds=s.window_seconds, soak_state=server.state
+        )
+
+        storm = FaultStorm.schedule(
+            rng, s.duration, server, self.cluster,
+            sighup_registered=sighup_registered,
+            workers=s.http_workers > 1,
+        )
+        storm.recorder = self.recorder
+
+        stop = threading.Event()
+        threads = [
+            threading.Thread(
+                target=self._client_loop, args=(i, trace.items, stop),
+                name=f"soak-client-{i}", daemon=True,
+            )
+            for i in range(s.clients)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        churner = threading.Thread(
+            target=self._churn_loop, args=(stop,), name="soak-churn",
+            daemon=True,
+        )
+        churner.start()
+        abuser = threading.Thread(
+            target=self._abuse_loop, args=(trace.abuse, stop, t0),
+            name="soak-abuse", daemon=True,
+        )
+        abuser.start()
+        storm.start(t0)
+        self._say("traffic + churn + storm running")
+
+        end = t0 + s.duration
+        while time.monotonic() < end:
+            time.sleep(min(2.0, max(0.1, end - time.monotonic())))
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        churner.join(timeout=5)
+        abuser.join(timeout=10)
+        storm.stop()
+        self.recorder.finish()
+        self._say("soak traffic done; collecting")
+
+        # the storm's late reload may still be compiling its candidate:
+        # give it a bounded drain so the promoted-flip gate check judges
+        # a settled lifecycle, not a race with the collection point
+        if server.lifecycle is not None:
+            drain_end = time.monotonic() + 60.0
+            while (server.lifecycle.reload_in_flight()
+                   and time.monotonic() < drain_end):
+                time.sleep(0.25)
+
+        lifecycle_stats = (
+            server.lifecycle.stats() if server.lifecycle else {}
+        )
+        gate = self.recorder.gate(
+            p99_budget_ms=s.p99_budget_ms,
+            fault_events=storm.events,
+            promoted_reloads=(
+                lifecycle_stats.get("reloads")
+                if server.lifecycle is not None else None
+            ),
+        )
+        feed_stats = feed.stats()
+        scanner_stats = server.state.audit.stats()
+        batcher_stats = server.batcher.stats_snapshot()
+        native_stats = (
+            server.state.native_frontend.stats()
+            if server.state.native_frontend is not None else {}
+        )
+        snapshot_stats = server.state.audit.snapshot.stats()
+
+        artifact_path = s.artifact or str(
+            _REPO_ROOT / f"BENCH_soak_{s.tag}.json"
+        )
+        write_artifact(
+            artifact_path,
+            meta={
+                "preset": s.preset,
+                "seed": s.seed,
+                "duration_seconds": s.duration,
+                "clients": s.clients,
+                "target_rps": s.target_rps,
+                "trace_items": len(trace.items),
+                "cluster_objects": self.cluster.object_count(),
+                "churn_ops": self.cluster.churn_ops,
+                "frontend": "native" if self.native_active else "python",
+                "sighup_real_signal": sighup_registered,
+            },
+            windows=self.recorder.windows(),
+            faults=[
+                {
+                    "at": round(e.at, 1), "kind": e.kind,
+                    "applied_at": (
+                        round(e.applied_at, 1)
+                        if e.applied_at is not None else None
+                    ),
+                    "effect": e.effect,
+                }
+                for e in storm.events
+            ],
+            gate=gate,
+            extra={
+                "watch_feed": feed_stats,
+                "scanner": scanner_stats,
+                "snapshot": snapshot_stats,
+                "batcher": {
+                    k: batcher_stats[k]
+                    for k in (
+                        "requests_dispatched", "shed_requests",
+                        "expired_dropped", "audit_batches_dispatched",
+                        "audit_preemptions", "bulk_submits",
+                    )
+                },
+                "lifecycle": lifecycle_stats,
+                "native_frontend": native_stats,
+            },
+        )
+        self._say(
+            f"gate={'PASS' if gate['passed'] else 'FAIL'} "
+            f"{json.dumps(gate['checks'])}"
+        )
+        self._say(
+            f"totals={json.dumps({k: v for k, v in gate['totals'].items() if k not in ('unexplained_samples', 'abuse_waves')})}"
+        )
+        self._say(f"artifact: {artifact_path}")
+
+        feed.stop()
+        self.cluster.stop()
+        handle.stop()
+        if sighup_registered:
+            signal.signal(signal.SIGHUP, signal.SIG_DFL)
+        return 0 if gate["passed"] else 1
+
+
+class _HttpConn:
+    """One keep-alive client connection + its pipelined read-ahead
+    buffer (socket objects do not accept ad-hoc attributes)."""
+
+    def __init__(self, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+        self.pending = b""
+
+    def sendall(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def read_response(self) -> tuple[int, dict, bytes]:
+        """Read exactly one HTTP response (Content-Length framing — both
+        frontends always send it); over-reads stay buffered for the next
+        call."""
+        buf = self.pending
+        self.pending = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed mid-response")
+            buf += chunk
+        head, rest = buf.split(b"\r\n\r\n", 1)
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", "0"))
+        while len(rest) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed mid-body")
+            rest += chunk
+        body, self.pending = rest[:n], rest[n:]
+        return status, headers, body

@@ -1,0 +1,282 @@
+"""Fault-storm scheduler: mid-soak faults on a seeded timeline.
+
+The point of the soak is INTERACTION coverage, so faults land while the
+trace is flowing: a SIGHUP epoch flip mid-rollout-storm, a device fault
+tripping the breaker while the audit lane sweeps, a watch-stream fault
+forcing a resync while the cluster churns, a poisoned reload that must
+roll back. Every event is applied at a seeded offset and recorded
+(what, when, effect) for the artifact — the SLO gate requires the storm
+actually happened (>= 3 events incl. one SIGHUP reload).
+
+Event kinds:
+
+* ``sighup``          — deliver SIGHUP to this process (real signal →
+  the registered handler drives cert + policy reload); falls back to
+  calling ``server.reload_signal()`` directly when the engine could not
+  register a handler (non-main thread), recorded as ``sighup(direct)``.
+* ``reload_poison``   — arm ``reload.compile=raise*1`` then SIGHUP: the
+  candidate must be rejected and last-good keep serving (rollback
+  counters move, traffic must not notice).
+* ``device_fault``    — arm ``device.fetch`` to raise enough times to
+  trip a shard breaker; the oracle fallback serves until the half-open
+  probe recovers. The fault is a bounded WINDOW, not a loaded gun: a
+  timer disarms any unfired raises at window end, because arms the live
+  path did not consume (verdict-cache hits and the host fast-path can
+  absorb whole bursts without a device fetch) otherwise linger and
+  poison the next epoch's warmup dispatches minutes later — exactly the
+  interaction the first soak runs caught: every mid-soak reload was
+  REJECTED at compile by a device fault armed 10 s earlier.
+* ``audit_fault``     — arm ``audit.sweep=raise*1``: the next sweep
+  aborts, re-marks dirty, retries.
+* ``watch_fault``     — arm ``watch.stream=raise*1``: the feed's next
+  stream connect fails → backoff → counted full re-LIST resync.
+* ``frontend_fault``  — arm ``frontend.accept=raise*1``: one poll burst
+  answers in-band 500s (counted as explained by the recorder via the
+  fault window) and the drainer survives.
+* ``stream_close``    — force the synthetic cluster to close every
+  watch stream (resourceVersion resume path, no re-LIST).
+* ``worker_kill``     — SIGKILL one prefork HTTP worker (only when the
+  engine runs ``http_workers > 1``); the supervisor must respawn it.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from policy_server_tpu import failpoints
+
+
+@dataclass
+class FaultEvent:
+    at: float  # offset seconds into the soak
+    kind: str
+    note: str = ""
+    applied_at: float | None = None
+    effect: str = ""
+
+
+@dataclass
+class FaultStorm:
+    """Seeded schedule + the applier thread."""
+
+    server: Any
+    cluster: Any = None
+    sighup_registered: bool = False
+    # optional slo.SLORecorder: faults whose blast radius can legally
+    # surface as 5xx/conn drops (frontend burst fault, worker kill,
+    # device fault) declare a short window so the recorder counts them
+    # as fault_injected — loudly, but not as unexplained
+    recorder: Any = None
+    events: list[FaultEvent] = field(default_factory=list)
+    # blast-radius window: recorder fault windows AND the device-fault
+    # auto-disarm share it, so an armed fault can never outlive the
+    # period the recorder counts its 5xx as explained
+    window_seconds: float = 5.0
+    _thread: threading.Thread | None = None
+    _stop: threading.Event = field(default_factory=threading.Event)
+    _timers: list[threading.Timer] = field(default_factory=list)
+
+    _WINDOWED_KINDS = ("frontend_fault", "worker_kill", "device_fault")
+
+    @classmethod
+    def schedule(
+        cls,
+        rng: random.Random,
+        duration: float,
+        server: Any,
+        cluster: Any = None,
+        *,
+        sighup_registered: bool = False,
+        workers: bool = False,
+    ) -> "FaultStorm":
+        """The seeded timeline: one of each core fault inside the middle
+        80% of the soak (faults at the very edges test nothing), plus a
+        poisoned reload and a stream close when time allows."""
+        kinds = [
+            "sighup", "device_fault", "watch_fault", "audit_fault",
+            "frontend_fault",
+        ]
+        if duration >= 30:
+            kinds += ["reload_poison", "stream_close"]
+        if workers:
+            kinds.append("worker_kill")
+        lo, hi = 0.1 * duration, 0.9 * duration
+        window = min(5.0, max(2.0, 0.15 * duration))
+        events = sorted(
+            (
+                FaultEvent(at=rng.uniform(lo, hi), kind=k)
+                for k in kinds
+            ),
+            key=lambda e: e.at,
+        )
+        for e in events:
+            # the device window (arm → auto-disarm) must CLOSE before
+            # the late reload below, so the promoted-flip gate check is
+            # deterministic; a mid-storm collision stays possible (and
+            # welcome) via the pinned mid sighup
+            if e.kind == "device_fault":
+                e.at = min(e.at, 0.6 * duration)
+            # the poisoned reload goes early: its reload.compile*1 arm
+            # must be consumed by ITS OWN reload, not coalesced into a
+            # concurrent one and left lingering for the late flip
+            if e.kind == "reload_poison":
+                e.at = min(e.at, 0.25 * duration)
+        # a SIGHUP mid-storm is the acceptance-critical interaction:
+        # pin one reload into the middle half regardless of the draw
+        if not any(lo + 0.15 * duration <= e.at <= hi - 0.15 * duration
+                   and e.kind == "sighup" for e in events):
+            for e in events:
+                if e.kind == "sighup":
+                    e.at = rng.uniform(0.3 * duration, 0.6 * duration)
+        # a second, late reload: the mid-storm one may legitimately be
+        # REJECTED by a concurrently armed fault (device raise during
+        # candidate warmup — last-good keeps serving); the late one
+        # runs after every fault window has closed and must prove a
+        # PROMOTED epoch flip under load in the same run (gate check)
+        events.append(
+            FaultEvent(
+                at=rng.uniform(0.78 * duration, 0.88 * duration),
+                kind="sighup",
+                note="late reload (fault windows closed)",
+            )
+        )
+        events.sort(key=lambda e: e.at)
+        return cls(
+            server=server, cluster=cluster,
+            sighup_registered=sighup_registered, events=events,
+            window_seconds=window,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, t0: float) -> "FaultStorm":
+        self._thread = threading.Thread(
+            target=self._run, args=(t0,), name="soak-faults", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for t in self._timers:
+            t.cancel()
+        failpoints.clear()
+
+    def applied(self) -> list[FaultEvent]:
+        return [e for e in self.events if e.applied_at is not None]
+
+    # -- the applier -------------------------------------------------------
+
+    def _run(self, t0: float) -> None:
+        for event in self.events:
+            while not self._stop.is_set():
+                delay = t0 + event.at - time.monotonic()
+                if delay <= 0:
+                    break
+                self._stop.wait(min(delay, 0.2))
+            if self._stop.is_set():
+                return
+            try:
+                self._apply(event)
+                event.applied_at = time.monotonic() - t0
+            except Exception as e:  # noqa: BLE001 — a storm that dies
+                # mid-soak invalidates the artifact; record and continue
+                event.effect = f"APPLY FAILED: {e}"
+                event.applied_at = time.monotonic() - t0
+
+    def _apply(self, event: FaultEvent) -> None:
+        if self.recorder is not None and event.kind in self._WINDOWED_KINDS:
+            self.recorder.note_fault_window(
+                event.kind, duration=self.window_seconds
+            )
+        apply_fn: Callable[[], str] = {
+            "sighup": self._sighup,
+            "reload_poison": self._reload_poison,
+            "device_fault": self._device_fault,
+            "audit_fault": self._audit_fault,
+            "watch_fault": self._watch_fault,
+            "frontend_fault": self._frontend_fault,
+            "stream_close": self._stream_close,
+            "worker_kill": self._worker_kill,
+        }[event.kind]
+        event.effect = apply_fn()
+
+    def _sighup(self) -> str:
+        if self.sighup_registered and hasattr(signal, "SIGHUP"):
+            os.kill(os.getpid(), signal.SIGHUP)
+            return "SIGHUP delivered (real signal)"
+        self.server.reload_signal()
+        return "sighup(direct): reload_signal() called"
+
+    def _reload_poison(self) -> str:
+        failpoints.configure("reload.compile=raise:soak-poisoned*1")
+        note = self._sighup()
+        return f"reload.compile armed then {note} — candidate must reject"
+
+    def _device_fault(self) -> str:
+        # enough raises to cross the breaker threshold of one shard
+        threshold = getattr(
+            self.server.config, "breaker_failure_threshold", 5
+        )
+        failpoints.configure(
+            f"device.fetch=raise:soak-device-fault*{threshold + 1}"
+        )
+        # bounded window: disarm whatever the live path did not consume
+        # (see the module docstring — lingering arms poison the next
+        # epoch's warmup long after the "fault" supposedly ended)
+        timer = threading.Timer(
+            self.window_seconds,
+            lambda: failpoints.configure("device.fetch=off"),
+        )
+        timer.daemon = True
+        timer.start()
+        self._timers.append(timer)
+        return (
+            f"device.fetch armed x{threshold + 1} (breaker trip), "
+            f"auto-disarm in {self.window_seconds:g}s"
+        )
+
+    def _audit_fault(self) -> str:
+        failpoints.configure("audit.sweep=raise:soak-audit-fault*1")
+        return "audit.sweep armed x1 (sweep aborts, retries)"
+
+    def _watch_fault(self) -> str:
+        failpoints.configure("watch.stream=raise:soak-watch-fault*1")
+        # the site fires on stream CONNECT: close the streams so the
+        # reconnect hits the armed fault now, not at the next natural
+        # stream recycle
+        if self.cluster is not None:
+            self.cluster.close_streams()
+            return (
+                "watch.stream armed x1 + streams closed (reconnect "
+                "faults -> counted re-LIST resync)"
+            )
+        return "watch.stream armed x1 (feed resyncs via re-LIST)"
+
+    def _frontend_fault(self) -> str:
+        failpoints.configure("frontend.accept=raise:soak-frontend-fault*1")
+        return "frontend.accept armed x1 (one burst answers 500)"
+
+    def _stream_close(self) -> str:
+        if self.cluster is None:
+            return "skipped (no synthetic cluster)"
+        self.cluster.close_streams()
+        return "all watch streams closed (rv-resume path)"
+
+    def _worker_kill(self) -> str:
+        procs = [
+            p for p in getattr(self.server, "_worker_procs", [])
+            if p is not None and hasattr(p, "kill") and p.poll() is None
+        ]
+        if not procs:
+            return "skipped (no live prefork workers)"
+        procs[0].kill()
+        return f"worker pid {procs[0].pid} killed (supervisor respawns)"

@@ -1,0 +1,395 @@
+"""Seeded, composable scenario generators for the soak engine.
+
+Every generator takes a ``random.Random`` (the ONE source of
+nondeterminism — the same seed replays the same trace byte-for-byte)
+and yields :class:`ReviewItem`s: the wire path, the exact body bytes,
+and the EXPECTED outcome class. The expectation is what makes the SLO
+gate honest: a malformed-payload item answering 422 is the scenario
+working, the same 422 on a rollout item is a bug. Shed 429s and
+deadline 504s are legal for any admission item under load and are
+counted separately by the recorder.
+
+Generators deliberately go BEYOND the 25-family schema catalog: the
+``schema_diversity`` stream invents CRD-ish GVKs and field shapes the
+bucketed encoder has never seen (exercising schema-overflow and oracle
+fallback), and ``adversarial_payloads`` covers the canonicalizer's
+decline list (floats, duplicate keys, NaN, depth, astral unicode) so
+the native→Python fallback path soaks under load too.
+
+Connection-level abuse is a separate stream of :class:`AbuseWave`
+specs executed by the engine's abuse driver against raw sockets —
+slowloris drips, pipelined malformed floods, and mid-body disconnects
+never produce admission verdicts, so they carry their own expectation
+("server closes within the read timeout", "400s then close", "no
+response, server unharmed").
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+
+# expectation classes (slo.py groups observed statuses against these)
+EXPECT_OK = "ok"            # must answer 2xx (or a legal 429/504)
+EXPECT_REJECTED = "ok"      # policy rejection is still HTTP 200
+EXPECT_422 = "422"          # parse/deserialize error, bit-exact body
+EXPECT_404 = "404"          # unknown policy id
+
+NAMESPACES = tuple(f"ns-{i}" for i in range(24)) + (
+    "kube-system", "default", "prod-payments", "späce-ü",
+)
+
+
+@dataclass(frozen=True)
+class ReviewItem:
+    """One HTTP request of the trace."""
+
+    path: str
+    body: bytes
+    expect: str = EXPECT_OK
+    scenario: str = ""
+
+
+@dataclass(frozen=True)
+class AbuseWave:
+    """One connection-abuse wave (engine's abuse driver).
+
+    kind: 'slowloris' | 'malformed_flood' | 'midbody_disconnect'
+    """
+
+    kind: str
+    conns: int = 4
+    # slowloris: seconds between dripped bytes; flood: requests/conn
+    param: float = 1.0
+
+
+@dataclass
+class Trace:
+    items: list[ReviewItem] = field(default_factory=list)
+    abuse: list[AbuseWave] = field(default_factory=list)
+
+
+def _review(
+    rng: random.Random,
+    obj: dict,
+    *,
+    operation: str = "CREATE",
+    namespace: str | None = None,
+    kind: dict | None = None,
+) -> dict:
+    uid = f"soak-{rng.getrandbits(63):016x}"
+    meta = obj.setdefault("metadata", {})
+    req = {
+        "uid": uid,
+        "kind": kind or {
+            "group": "", "version": obj.get("apiVersion", "v1"),
+            "kind": obj.get("kind", "Pod"),
+        },
+        "requestKind": kind or {
+            "group": "", "version": obj.get("apiVersion", "v1"),
+            "kind": obj.get("kind", "Pod"),
+        },
+        "name": meta.get("name", uid),
+        "operation": operation,
+        "userInfo": {"username": f"user-{rng.randrange(64)}"},
+        "object": obj,
+    }
+    if namespace is not None:
+        req["namespace"] = namespace
+        meta.setdefault("namespace", namespace)
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": req,
+    }
+
+
+def _pod(rng: random.Random, name: str, privileged: bool) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "labels": {"app": name.rsplit("-", 2)[0]},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "c0",
+                    "image": f"registry.local/app:{rng.randrange(40)}",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+
+
+# -- admission streams -------------------------------------------------------
+
+
+def rollout_storm(
+    rng: random.Random, n_templates: int, replicas: int, policy: str
+) -> list[ReviewItem]:
+    """A Deployment rollout admits its replica pods back-to-back:
+    ``n_templates`` unique specs, each admitted ``replicas`` times with
+    fresh names/uids — the dedup/supersede stress shape."""
+    out: list[ReviewItem] = []
+    for t in range(n_templates):
+        ns = rng.choice(NAMESPACES)
+        privileged = rng.random() < 0.25
+        base = f"app-{rng.getrandbits(24):06x}"
+        for r in range(replicas):
+            pod = _pod(rng, f"{base}-{t}-{r}", privileged)
+            doc = _review(rng, pod, namespace=ns)
+            out.append(
+                ReviewItem(
+                    f"/validate/{policy}",
+                    json.dumps(doc).encode(),
+                    EXPECT_OK,
+                    "rollout_storm",
+                )
+            )
+    return out
+
+
+def namespace_churn(
+    rng: random.Random, n: int, policy: str
+) -> list[ReviewItem]:
+    """Namespaces created/deleted with objects inside them: CREATE and
+    DELETE admissions interleave, so the audit store's supersede/evict
+    paths churn under load."""
+    out: list[ReviewItem] = []
+    live: list[tuple[str, str]] = []  # (namespace, pod name)
+    for _ in range(n):
+        if live and rng.random() < 0.4:
+            ns, name = live.pop(rng.randrange(len(live)))
+            doc = _review(
+                rng, _pod(rng, name, False), operation="DELETE",
+                namespace=ns,
+            )
+            out.append(
+                ReviewItem(
+                    f"/validate/{policy}",
+                    json.dumps(doc).encode(),
+                    EXPECT_OK,
+                    "namespace_churn",
+                )
+            )
+        else:
+            ns = f"churn-{rng.getrandbits(16):04x}"
+            name = f"pod-{rng.getrandbits(24):06x}"
+            live.append((ns, name))
+            doc = _review(rng, _pod(rng, name, False), namespace=ns)
+            out.append(
+                ReviewItem(
+                    f"/validate/{policy}",
+                    json.dumps(doc).encode(),
+                    EXPECT_OK,
+                    "namespace_churn",
+                )
+            )
+    return out
+
+
+_CRD_GROUPS = (
+    "soak.example.io", "storm.dev", "widgets.acme.corp", "mesh.internal",
+)
+_CRD_KINDS = (
+    "Widget", "TrafficSplit", "BackupPlan", "Rollout", "FeatureGate",
+    "QuotaClaim", "EdgeFunction", "Vault", "ShardMap", "Lease",
+)
+
+
+def _random_value(rng: random.Random, depth: int):
+    roll = rng.random()
+    if depth > 3 or roll < 0.35:
+        return rng.choice(
+            ["alpha", "beta", rng.randrange(10_000), True, None,
+             "x" * rng.randrange(1, 40)]
+        )
+    if roll < 0.6:
+        return {
+            f"f{rng.randrange(8)}": _random_value(rng, depth + 1)
+            for _ in range(rng.randrange(1, 4))
+        }
+    return [_random_value(rng, depth + 1) for _ in range(rng.randrange(1, 4))]
+
+
+def schema_diversity(
+    rng: random.Random, n: int, policy: str
+) -> list[ReviewItem]:
+    """CRD-ish objects with invented GVKs and field shapes beyond the
+    25-family catalog: every item is a schema the bucketed encoder has
+    never seen, soaking the overflow/oracle-fallback path."""
+    out: list[ReviewItem] = []
+    for _ in range(n):
+        group = rng.choice(_CRD_GROUPS)
+        kind = rng.choice(_CRD_KINDS)
+        obj = {
+            "apiVersion": f"{group}/v1",
+            "kind": kind,
+            "metadata": {"name": f"{kind.lower()}-{rng.getrandbits(24):06x}"},
+            "spec": {
+                f"field{rng.randrange(12)}": _random_value(rng, 0)
+                for _ in range(rng.randrange(1, 6))
+            },
+        }
+        doc = _review(
+            rng, obj, namespace=rng.choice(NAMESPACES),
+            kind={"group": group, "version": "v1", "kind": kind},
+        )
+        out.append(
+            ReviewItem(
+                f"/validate/{policy}",
+                json.dumps(doc).encode(),
+                EXPECT_OK,
+                "schema_diversity",
+            )
+        )
+    return out
+
+
+def mutating_chain(rng: random.Random, n: int, policy: str) -> list[ReviewItem]:
+    """Raw reviews through a mutating policy: the patch/serialization
+    path (Python-rendered responses) soaks next to the native-serialized
+    verdict path."""
+    out: list[ReviewItem] = []
+    for _ in range(n):
+        doc = {
+            "request": {
+                "uid": f"raw-{rng.getrandbits(48):012x}",
+                "user": rng.choice(["alice", "bob", "mallory"]),
+                "action": rng.choice(["create", "update", "scale"]),
+                "resource": {"replicas": rng.randrange(32)},
+            }
+        }
+        out.append(
+            ReviewItem(
+                f"/validate_raw/{policy}",
+                json.dumps(doc).encode(),
+                EXPECT_OK,
+                "mutating_chain",
+            )
+        )
+    return out
+
+
+def adversarial_payloads(
+    rng: random.Random, n: int, policy: str
+) -> list[ReviewItem]:
+    """The canonicalizer's decline list under load, valid AND invalid:
+    deep nesting (in and beyond the depth cap), astral unicode, floats,
+    NaN, duplicate keys, raw control garbage — each tagged with the
+    outcome the Python parse oracle gives it."""
+    out: list[ReviewItem] = []
+    for _ in range(n):
+        case = rng.randrange(7)
+        if case == 0:  # deep-but-legal nesting → 200 via Python fallback
+            obj: dict = {"leaf": rng.randrange(100)}
+            for _i in range(rng.randrange(90, 130)):
+                obj = {"n": obj}
+            doc = _review(rng, {"kind": "Pod", "apiVersion": "v1",
+                                "metadata": {"name": "deep"},
+                                "spec": obj})
+            item = (json.dumps(doc).encode(), EXPECT_OK)
+        elif case == 1:  # astral/ugly unicode → 200, native-escaped
+            s = "😀ü\t\x01" * rng.randrange(1, 12)
+            doc = _review(rng, {"kind": "Pod", "apiVersion": "v1",
+                                "metadata": {"name": "uni",
+                                             "labels": {"weird": s}},
+                                "spec": {}},
+                          namespace="späce-ü")
+            item = (json.dumps(doc).encode(), EXPECT_OK)
+        elif case == 2:  # floats → 200 via fallback
+            doc = _review(rng, {"kind": "Pod", "apiVersion": "v1",
+                                "metadata": {"name": "flt"},
+                                "spec": {"w": rng.random() * 1e30}})
+            item = (json.dumps(doc).encode(), EXPECT_OK)
+        elif case == 3:  # NaN → Python json parses it → 200
+            item = (
+                b'{"request": {"uid": "nan-'
+                + f"{rng.getrandbits(32):08x}".encode()
+                + b'", "object": {"v": NaN}}}',
+                EXPECT_OK,
+            )
+        elif case == 4:  # duplicate keys → 200, Python last-wins
+            item = (
+                b'{"request": {"uid": "dup-'
+                + f"{rng.getrandbits(32):08x}".encode()
+                + b'", "object": {"a": 1, "a": 2}, '
+                  b'"operation": "CREATE"}}',
+                EXPECT_OK,
+            )
+        elif case == 5:  # broken JSON → 422 bit-exact from the oracle
+            item = (b'{"request": {"uid": ', EXPECT_422)
+        else:  # missing/empty uid → 422
+            item = (b'{"request": {"operation": "CREATE"}}', EXPECT_422)
+        out.append(
+            ReviewItem(
+                f"/validate/{policy}", item[0], item[1],
+                "adversarial_payloads",
+            )
+        )
+    return out
+
+
+def unknown_policy_noise(
+    rng: random.Random, n: int
+) -> list[ReviewItem]:
+    """Requests at policies that do not exist: the 404 path must stay
+    cheap and correct under the storm."""
+    out = []
+    for _ in range(n):
+        doc = _review(rng, _pod(rng, f"x-{rng.getrandbits(16):04x}", False))
+        out.append(
+            ReviewItem(
+                f"/validate/no-such-policy-{rng.randrange(8)}",
+                json.dumps(doc).encode(),
+                EXPECT_404,
+                "unknown_policy",
+            )
+        )
+    return out
+
+
+# -- composition -------------------------------------------------------------
+
+
+def build_trace(
+    seed: int,
+    n_items: int,
+    *,
+    validate_policy: str = "pod-privileged",
+    raw_policy: str = "raw-mutation",
+    abuse_waves: int = 3,
+) -> Trace:
+    """The composed soak trace: every stream generated from ONE seeded
+    rng, shuffled into a single interleaving (the interactions are the
+    point), plus the abuse-wave schedule."""
+    rng = random.Random(seed)
+    items: list[ReviewItem] = []
+    items += rollout_storm(
+        rng, max(1, n_items // 20), 8, validate_policy
+    )
+    items += namespace_churn(rng, n_items // 5, validate_policy)
+    items += schema_diversity(rng, n_items // 6, validate_policy)
+    items += mutating_chain(rng, n_items // 8, raw_policy)
+    items += adversarial_payloads(rng, n_items // 8, validate_policy)
+    items += unknown_policy_noise(rng, n_items // 40)
+    rng.shuffle(items)
+    abuse = []
+    kinds = ("slowloris", "malformed_flood", "midbody_disconnect")
+    for i in range(abuse_waves):
+        kind = kinds[i % len(kinds)]
+        abuse.append(
+            AbuseWave(
+                kind=kind,
+                conns=rng.randrange(2, 6),
+                param=(
+                    0.3 if kind == "slowloris"
+                    else float(rng.randrange(8, 32))
+                ),
+            )
+        )
+    return Trace(items=items, abuse=abuse)

@@ -1,0 +1,268 @@
+"""Windowed SLO recorder + gate + BENCH_soak artifact writer.
+
+Client threads record every (status, latency, expectation) observation;
+the recorder folds them into fixed-width windows — rps, p99, shed rate,
+expired rate, unexplained non-2xx — and publishes the CURRENT window to
+``state.soak`` so a live soak is visible on /metrics (the round-13
+soak-window gauges). At the end, :meth:`gate` applies the SLO:
+
+* **zero unexplained non-2xx** — every response must match its item's
+  expectation class; shed 429s and deadline 504s are legal under load
+  and counted separately; 5xx inside a declared fault window (e.g. the
+  ``frontend.accept`` injection) count as ``fault_injected``, loudly,
+  not as unexplained.
+* **p99 within the budget** — over accepted (expectation-matching)
+  responses across the whole soak.
+* **the storm happened** — >= ``min_fault_events`` applied events
+  including one SIGHUP reload, and >= 1 abuse wave executed.
+* **an epoch flip was PROMOTED** (when the engine passes the lifecycle
+  count) — a mid-storm reload may legitimately be rejected by a
+  concurrent fault, but a soak where EVERY reload rolled back proves
+  containment only, not the flip-under-load interaction; the storm's
+  late reload runs after the fault windows close so at least one
+  promotion is deterministic.
+
+The artifact (``BENCH_soak_<tag>.json``) carries the full window trend,
+the fault timeline, totals, and the gate verdict — a regression in ANY
+subsystem interaction shows up as a trend-line break a reviewer can
+diff across rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tools.bench.common import pct, write_json_artifact
+
+# observation classes
+OK = "ok"                # matched the expectation (2xx/422/404 as tagged)
+SHED = "shed"            # 429 + Retry-After: legal under load
+EXPIRED = "expired"      # 504 deadline: legal under load
+FAULTED = "fault_injected"  # 5xx inside a declared fault window
+UNEXPLAINED = "unexplained"
+
+
+@dataclass
+class Window:
+    start: float
+    requests: int = 0
+    ok: int = 0
+    shed: int = 0
+    expired: int = 0
+    faulted: int = 0
+    unexplained: int = 0
+    latencies_ms: list[float] = field(default_factory=list)
+
+    def summary(self, width: float) -> dict[str, Any]:
+        lat = sorted(self.latencies_ms)
+        n = max(1, self.requests)
+        return {
+            "t": round(self.start, 1),
+            "rps": round(self.requests / width, 1),
+            "p50_ms": round(pct(lat, 0.50), 2),
+            "p99_ms": round(pct(lat, 0.99), 2),
+            "ok": self.ok,
+            "shed": self.shed,
+            "expired": self.expired,
+            "fault_injected": self.faulted,
+            "unexplained": self.unexplained,
+            "shed_rate": round(self.shed / n, 4),
+        }
+
+
+class SLORecorder:
+    """Thread-safe observation sink (see module docstring)."""
+
+    def __init__(
+        self, window_seconds: float = 5.0, soak_state: Any = None
+    ) -> None:
+        self.window_seconds = float(window_seconds)
+        # optional ApiServerState: the current window is published to
+        # state.soak for the /metrics soak gauges
+        self.soak_state = soak_state
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._windows: list[Window] = []  # guarded-by: _lock
+        self._current = Window(start=0.0)  # guarded-by: _lock
+        self._fault_windows: list[tuple[str, float, float]] = []  # guarded-by: _lock
+        self._unexplained_samples: list[dict] = []  # guarded-by: _lock
+        self._abuse_results: list[dict] = []  # guarded-by: _lock
+
+    # -- fault windows (the storm declares its observable side effects) ---
+
+    def note_fault_window(self, kind: str, duration: float = 3.0) -> None:
+        now = time.monotonic() - self._t0
+        with self._lock:
+            self._fault_windows.append((kind, now, now + duration))
+
+    # -- recording ---------------------------------------------------------
+
+    def classify(self, status: int, expect: str) -> str:
+        if status == 429:
+            return SHED
+        if status == 504:
+            return EXPIRED
+        matched = (
+            (expect == "ok" and 200 <= status < 300)
+            or (expect == "422" and status == 422)
+            or (expect == "404" and status == 404)
+        )
+        if matched:
+            return OK
+        if status >= 500:
+            now = time.monotonic() - self._t0
+            with self._lock:
+                for _kind, a, b in self._fault_windows:
+                    if a <= now <= b:
+                        return FAULTED
+        return UNEXPLAINED
+
+    def record(
+        self, status: int, latency_ms: float, expect: str,
+        detail: str = "",
+    ) -> None:
+        cls = self.classify(status, expect)
+        now = time.monotonic() - self._t0
+        with self._lock:
+            self._roll_locked(now)
+            w = self._current
+            w.requests += 1
+            if cls == OK:
+                w.ok += 1
+                w.latencies_ms.append(latency_ms)
+            elif cls == SHED:
+                w.shed += 1
+            elif cls == EXPIRED:
+                w.expired += 1
+            elif cls == FAULTED:
+                w.faulted += 1
+            else:
+                w.unexplained += 1
+                if len(self._unexplained_samples) < 32:
+                    self._unexplained_samples.append(
+                        {"t": round(now, 2), "status": status,
+                         "expect": expect, "detail": detail[:200]}
+                    )
+
+    def record_abuse(self, result: dict) -> None:
+        with self._lock:
+            self._abuse_results.append(result)
+
+    def _roll_locked(self, now: float) -> None:
+        # holds: _lock
+        while now - self._current.start >= self.window_seconds:
+            self._windows.append(self._current)
+            done = self._current
+            self._current = Window(
+                start=self._current.start + self.window_seconds
+            )
+            if self.soak_state is not None:
+                s = done.summary(self.window_seconds)
+                # dict assignment is atomic; /metrics reads whole dict
+                self.soak_state.soak = {
+                    "rps": s["rps"],
+                    "p99_ms": s["p99_ms"],
+                    "shed_rate": s["shed_rate"],
+                }
+
+    # -- gate + artifact ---------------------------------------------------
+
+    def finish(self) -> None:
+        with self._lock:
+            now = time.monotonic() - self._t0
+            if self._current.requests:
+                self._windows.append(self._current)
+                self._current = Window(start=now)
+            if self.soak_state is not None:
+                self.soak_state.soak = None
+
+    def totals(self) -> dict[str, Any]:
+        with self._lock:
+            ws = list(self._windows) + (
+                [self._current] if self._current.requests else []
+            )
+            lat = sorted(
+                v for w in ws for v in w.latencies_ms
+            )
+            return {
+                "requests": sum(w.requests for w in ws),
+                "ok": sum(w.ok for w in ws),
+                "shed": sum(w.shed for w in ws),
+                "expired": sum(w.expired for w in ws),
+                "fault_injected": sum(w.faulted for w in ws),
+                "unexplained": sum(w.unexplained for w in ws),
+                "p50_ms": round(pct(lat, 0.50), 2),
+                "p99_ms": round(pct(lat, 0.99), 2),
+                "unexplained_samples": list(self._unexplained_samples),
+                "abuse_waves": list(self._abuse_results),
+            }
+
+    def gate(
+        self,
+        *,
+        p99_budget_ms: float,
+        fault_events: list,
+        min_fault_events: int = 3,
+        promoted_reloads: int | None = None,
+    ) -> dict[str, Any]:
+        t = self.totals()
+        sighups = [
+            e for e in fault_events
+            if e.kind in ("sighup", "reload_poison")
+            and e.applied_at is not None
+        ]
+        abuse_ok = [
+            a for a in t["abuse_waves"] if a.get("passed") is True
+        ]
+        abuse_failed = [
+            a for a in t["abuse_waves"] if a.get("passed") is False
+        ]
+        checks = {
+            "zero_unexplained_non_2xx": t["unexplained"] == 0,
+            "p99_within_budget": t["p99_ms"] <= p99_budget_ms,
+            "fault_storm_happened": (
+                sum(1 for e in fault_events if e.applied_at is not None)
+                >= min_fault_events
+            ),
+            "sighup_reload_happened": len(sighups) >= 1,
+            "abuse_wave_happened": len(abuse_ok) >= 1,
+            "abuse_waves_all_passed": not abuse_failed,
+            "traffic_flowed": t["ok"] > 0,
+        }
+        if promoted_reloads is not None:
+            checks["epoch_flip_promoted"] = promoted_reloads >= 1
+        return {
+            "passed": all(checks.values()),
+            "checks": checks,
+            "p99_budget_ms": p99_budget_ms,
+            "totals": t,
+        }
+
+    def windows(self) -> list[dict]:
+        with self._lock:
+            return [
+                w.summary(self.window_seconds) for w in self._windows
+            ]
+
+
+def write_artifact(
+    path: str,
+    *,
+    meta: dict,
+    windows: list[dict],
+    faults: list[dict],
+    gate: dict,
+    extra: dict | None = None,
+) -> None:
+    doc = {
+        "meta": meta,
+        "slo_gate": gate,
+        "windows": windows,
+        "faults": faults,
+    }
+    if extra:
+        doc.update(extra)
+    write_json_artifact(path, doc)
