@@ -103,6 +103,11 @@ class AuditScanner:
         self._sweep_errors = 0  # guarded-by: _lock
         self._paused_sweeps = 0  # guarded-by: _lock
         self._rows_scanned = 0  # guarded-by: _lock
+        # whole-run accounting, segmented by the policy epoch whose set
+        # judged the rows (PROFILE r13 caveat 3: one total alone reads
+        # ambiguously after an epoch flip — the soak artifact needs the
+        # run's full audit volume AND the per-epoch decomposition)
+        self._rows_by_epoch: dict[int, int] = {}  # guarded-by: _lock
         self._last_full_sweep: float | None = None  # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
@@ -272,6 +277,9 @@ class AuditScanner:
                 scanned += len(chunk)
                 with self._lock:
                     self._rows_scanned += len(chunk)
+                    self._rows_by_epoch[epoch] = (
+                        self._rows_by_epoch.get(epoch, 0) + len(chunk)
+                    )
         except BaseException:
             # abort: un-judged resources go back on the dirty set so the
             # next sweep (e.g. the post-promote full sweep after a
@@ -327,15 +335,24 @@ class AuditScanner:
             body["scanner"]["watch_feed"] = self.watch_feed.stats()
         return body
 
-    def stats(self) -> dict[str, float]:
-        """One locked snapshot for runtime_stats (/metrics + OTLP)."""
+    def stats(self) -> dict[str, Any]:
+        """One locked snapshot for runtime_stats (/metrics + OTLP).
+        ``rows_scanned`` is the WHOLE-RUN total across every policy
+        epoch; ``rows_scanned_by_epoch`` decomposes it (string epoch
+        keys, JSON-artifact friendly) so a soak whose last event was an
+        epoch flip still reports the run's full audit volume next to the
+        post-promote sweep's share."""
         with self._lock:
-            out = {
+            out: dict[str, Any] = {
                 "full_sweeps": self._full_sweeps,
                 "dirty_sweeps": self._dirty_sweeps,
                 "sweep_errors": self._sweep_errors,
                 "paused_sweeps": self._paused_sweeps,
                 "rows_scanned": self._rows_scanned,
+                "rows_scanned_by_epoch": {
+                    str(e): n
+                    for e, n in sorted(self._rows_by_epoch.items())
+                },
             }
         out["freshness_seconds"] = self.freshness_seconds()
         if self.watch_feed is not None:

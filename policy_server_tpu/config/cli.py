@@ -325,6 +325,17 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
         ("--mesh", "KUBEWARDEN_MESH",
          dict(default="auto", metavar="MESH_SPEC",
               help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),
+        ("--mesh-dispatch", "KUBEWARDEN_MESH_DISPATCH",
+         dict(default="fused", metavar="MODE", choices=["fused", "threaded"],
+              help="How a >1 policy axis executes (round 14): 'fused' "
+                   "lowers the whole policy set as ONE SPMD program over "
+                   "the (data x policy) mesh — each policy shard is a "
+                   "lax.switch branch selected by its mesh position, "
+                   "verdict blocks meet in an all-gather collective, and "
+                   "XLA overlaps the cross-shard work (one device program "
+                   "per batch); 'threaded' keeps the legacy "
+                   "thread-per-shard MPMD dispatcher (one program per "
+                   "policy shard, host-side thread joins) as a fallback")),
         ("--no-warmup", "KUBEWARDEN_NO_WARMUP",
          dict(action="store_true",
               help="Skip AOT compilation of the policy program at boot")),

@@ -265,6 +265,12 @@ class Config:
     native_read_timeout_seconds: float = 30.0
     native_max_connections: int = 0
     mesh: MeshSpec = field(default_factory=MeshSpec)
+    # how a >1 policy axis executes (round 14): 'fused' lowers the whole
+    # policy set as ONE SPMD program over the (data x policy) mesh —
+    # per-shard lax.switch branches + an all-gather collective replace
+    # the thread pool's N host-side joins; 'threaded' keeps the legacy
+    # thread-per-shard MPMD dispatcher (parallel/policy_sharded.py)
+    mesh_dispatch: str = "fused"
     warmup_at_boot: bool = True
     compilation_cache_dir: str | None = None
     # prefork HTTP frontend (runtime/frontend.py): worker processes
@@ -386,6 +392,11 @@ class Config:
         if not (0.0 <= self.reload_divergence_threshold <= 1.0):
             raise ValueError(
                 "--reload-divergence-threshold must be in [0, 1]"
+            )
+        if self.mesh_dispatch not in ("fused", "threaded"):
+            raise ValueError(
+                f"invalid mesh dispatch {self.mesh_dispatch!r} "
+                "(expected 'fused' or 'threaded')"
             )
         if self.distributed_coordinator is None:
             if (
@@ -512,6 +523,7 @@ class Config:
             ),
             native_max_connections=int(args.native_max_connections),
             mesh=MeshSpec.parse(args.mesh),
+            mesh_dispatch=args.mesh_dispatch,
             warmup_at_boot=not args.no_warmup,
             compilation_cache_dir=args.compilation_cache_dir,
             http_workers=int(args.http_workers),

@@ -518,6 +518,14 @@ class EvaluationEnvironment:
         # resident zero-constant accounting (first dispatch of a new
         # combo materializes its skipped planes as device constants)
         self._plane_combos: set = set()  # guarded-by: _profile_lock
+        # monotonic count of plane-structure combos traced so far: a
+        # dispatch that advances it paid a serve-time XLA compile, and
+        # the batcher's RTT estimator must not ingest that sample (the
+        # same rule its warmup documents — a compile-inclusive reading
+        # would misroute traffic host-side; on a multi-device mesh the
+        # compile is seconds, so one sample poisons the router for the
+        # rest of the run)
+        self._plane_compiles = 0  # guarded-by: _profile_lock
         self._oracle_fallbacks = 0  # guarded-by: _fallback_lock
         # Device circuit breaker (resilience.py): repeated dispatch faults
         # or watchdog trips (reported by the batcher via
@@ -616,6 +624,14 @@ class EvaluationEnvironment:
             self._group_mat[name] = (f"g:{name}:allowed", members, risky)
         self._fallback_lock = threading.Lock()
         self._mesh = None  # set by attach_mesh
+        # fused-SPMD policy sharding (round 14, attach_mesh with a >1
+        # policy axis): the shard_map'd per-policy block, its lax.switch
+        # branch closures, and the policy → gathered-column map. None on
+        # single-device / pure data-parallel programs.
+        self._mesh_block = None
+        self._mesh_branches: list = []
+        self._mesh_block_width = 0
+        self._mesh_policy_col: dict[str, int] = {}
         self._min_bucket = 1
         self._closed = False
         # Drain pool: fetching results pays the transport's full sync
@@ -651,15 +667,68 @@ class EvaluationEnvironment:
     # -- mesh attachment (parallel/mesh.py) --------------------------------
 
     def attach_mesh(self, mesh: Any) -> None:
-        """Switch the fused program to data-parallel dispatch over a device
-        mesh: batch-sharded inputs/outputs, XLA-partitioned predicate
-        program (SURVEY.md §2.3 last row). Batch buckets are forced to
-        multiples of the data-axis size."""
+        """Switch the fused program to SPMD dispatch over a device mesh:
+        batch-sharded inputs/outputs, XLA-partitioned predicate program
+        (SURVEY.md §2.3 last row). Batch buckets are forced to multiples
+        of the data-axis size.
+
+        A mesh with a ``policy`` axis > 1 additionally shards the POLICY
+        dimension inside the same single program (round 14): policies
+        bucket round-robin into per-shard ``lax.switch`` branches selected
+        by ``lax.axis_index("policy")`` under a ``shard_map``, and the
+        per-shard verdict blocks meet in an ``all_gather`` collective
+        before the group/expression combine — one device program per
+        batch where the threaded MPMD dispatcher paid one per policy
+        shard plus N host-side thread joins."""
+        import functools
+
         from policy_server_tpu.parallel import mesh as mesh_mod
+        from jax.sharding import PartitionSpec
 
         self._mesh = mesh
         self._min_bucket = mesh.shape[mesh_mod.DATA_AXIS]
+        n_policy = mesh.shape.get(mesh_mod.POLICY_AXIS, 1)
+        self._mesh_block = None
+        if n_policy > 1 and self._compiled:
+            buckets, width, column_of = mesh_mod.plan_policy_buckets(
+                list(self._compiled), n_policy
+            )
+            self._mesh_block_width = width
+            self._mesh_policy_col = column_of
+            self._mesh_branches = [
+                functools.partial(self._mesh_bucket_block, bucket=b)
+                for b in buckets
+            ]
+            data_spec = PartitionSpec(mesh_mod.DATA_AXIS)
+            # check_rep off: the all-gather makes the outputs replicated
+            # over the policy axis, but shard_map cannot infer that
+            # through lax.switch
+            self._mesh_block = mesh_mod.shard_map(
+                self._mesh_block_local,
+                mesh=mesh,
+                in_specs=data_spec,
+                out_specs=(data_spec, data_spec),
+                check_rep=False,
+            )
         self._fused = mesh_mod.jit_data_parallel(self._forward, mesh)
+        # rebuild the columnar root: its traces must capture the mesh
+        # (plane reconstruction places resident zero constants with the
+        # mesh's NamedSharding)
+        self._fused_planes = jax.jit(
+            self._forward_planes,
+            static_argnums=(0,),
+            donate_argnums=(1,) if self.donate_buffers else (),
+        )
+
+    def _columnar_mesh_ok(self) -> bool:
+        """Columnar dispatch is safe on this topology: the delta-plane
+        STRUCTURE is derived from host-local batch content, so every
+        process of a multi-host mesh could trace a different program and
+        deadlock the SPMD step — multi-process meshes keep the packed
+        transport (structure depends only on schema width there)."""
+        if self._mesh is None:
+            return True
+        return jax.process_count() == 1
 
     def bucket_for(self, n: int) -> int:
         """Power-of-two bucket, rounded up to a multiple of the mesh data
@@ -942,13 +1011,23 @@ class EvaluationEnvironment:
             return dict(self._host_profile)
 
     @property
+    def plane_program_compiles(self) -> int:
+        """Monotonic count of columnar plane structures traced (each is
+        one serve- or warmup-time XLA compile). The batcher snapshots it
+        around a dispatch and discards RTT samples whose window saw a
+        compile — the warmup rule ("the second, compile-free run is the
+        routing baseline") applied to serve time."""
+        with self._profile_lock:
+            return self._plane_compiles
+
+    @property
     def warmup_dispatches(self) -> int:
         """Device dispatches ONE ``warmup((b,))`` call issues — warmup
         runs every shape schema (twice per schema on the columnar path:
         the all-elided and the dense structures), a serving batch
         dispatches exactly one, so RTT seeds divide by this
         (runtime/batcher.py; ADVICE r5 #4)."""
-        per_schema = 2 if (self.columnar and self._mesh is None) else 1
+        per_schema = 2 if (self.columnar and self._columnar_mesh_ok()) else 1
         return max(1, len(self.schemas) * per_schema)
 
     @property
@@ -1132,6 +1211,22 @@ class EvaluationEnvironment:
         features = self._features_from_planes(spec, delta)
         return self._eval_features(features)
 
+    def _resident_zeros(self, shape: tuple, dtype: Any) -> Any:
+        """A zero constant reconstructed ON DEVICE for an elided plane —
+        resident across dispatches (XLA materializes it once per
+        compiled program). Mesh programs place it with the mesh's
+        NamedSharding (leading batch dim split on ``data``, replicated
+        on ``policy``) so the reconstruction never gathers: each shard
+        materializes only its local zero rows."""
+        z = jnp.zeros(shape, dtype)
+        if self._mesh is not None:
+            from policy_server_tpu.parallel import mesh as mesh_mod
+
+            z = jax.lax.with_sharding_constraint(
+                z, mesh_mod.batch_sharding(self._mesh)
+            )
+        return z
+
     def _features_from_planes(
         self, spec: tuple, delta: Mapping[str, Any]
     ) -> dict[str, Any]:
@@ -1147,14 +1242,15 @@ class EvaluationEnvironment:
         schema_idx, batch, narrow = spec
         schema = self.schemas[schema_idx]
         layout = schema.packed_layout()
-        out: dict[str, Any] = {BATCH_KEY: jnp.zeros((batch,), jnp.bool_)}
+        zeros = self._resident_zeros
+        out: dict[str, Any] = {BATCH_KEY: zeros((batch,), jnp.bool_)}
 
         def plane(name: str, n_cols: int, zero_dtype):
             full = delta.get(name + "_full")
             if full is not None:
                 return jnp.asarray(full)
             vals = delta.get(name)
-            base = jnp.zeros((batch, n_cols), zero_dtype)
+            base = zeros((batch, n_cols), zero_dtype)
             if vals is None:
                 return base
             cols = jnp.asarray(delta[name + "_cols"])
@@ -1177,7 +1273,7 @@ class EvaluationEnvironment:
             expanded = (bits[:, :, None] >> shifts) & jnp.uint8(1)
             shipped_lanes = expanded.reshape(batch, -1)[:, :k]
             lanes = (
-                jnp.zeros((batch, layout.total8), jnp.uint8)
+                zeros((batch, layout.total8), jnp.uint8)
                 .at[:, cols]
                 .set(shipped_lanes)
             )
@@ -1185,7 +1281,7 @@ class EvaluationEnvironment:
             if e.key == BATCH_KEY:
                 continue
             if lanes is None:
-                out[e.key] = jnp.zeros((batch, *e.caps), jnp.bool_)
+                out[e.key] = zeros((batch, *e.caps), jnp.bool_)
             else:
                 block = jax.lax.slice_in_dim(
                     lanes, e.offset, e.offset + e.elems, axis=1
@@ -1218,18 +1314,75 @@ class EvaluationEnvironment:
         if self._wasm_member_order:
             wb = delta.get(WASM_BITS_KEY)
             out[WASM_BITS_KEY] = (
-                jnp.zeros((batch, len(self._wasm_member_order)), jnp.bool_)
+                zeros((batch, len(self._wasm_member_order)), jnp.bool_)
                 if wb is None
                 else jnp.asarray(wb)
             )
         return out
 
+    def _mesh_bucket_block(self, features: Mapping[str, Any], bucket: tuple):
+        """One ``lax.switch`` branch of the fused SPMD program: this
+        policy shard's compiled predicates over the LOCAL batch rows,
+        stacked and zero-padded to the common block width so every
+        branch agrees on shape."""
+        batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))[0]
+        outs = [self._compiled[pid](features) for pid in bucket]
+        allowed_cols = [jnp.asarray(a, jnp.bool_) for a, _r in outs]
+        rule_cols = [jnp.asarray(r, jnp.int32) for _a, r in outs]
+        pad = self._mesh_block_width - len(allowed_cols)
+        allowed_cols.extend([jnp.zeros((batch,), jnp.bool_)] * pad)
+        rule_cols.extend([jnp.zeros((batch,), jnp.int32)] * pad)
+        return (
+            jnp.stack(allowed_cols, axis=-1),
+            jnp.stack(rule_cols, axis=-1),
+        )
+
+    def _mesh_block_local(self, features: Mapping[str, Any]):
+        """The fused-SPMD per-policy body (shard_map root; runs once per
+        device on its local batch rows): select this device's
+        policy-shard branch by its position on the policy axis, compute
+        that shard's verdict block, and all-gather the blocks over the
+        policy axis — the XLA collective that replaces the threaded
+        dispatcher's N host-side thread joins. Returns shard-major
+        ``(batch_local, n_shards * width)`` allowed/rule matrices."""
+        from policy_server_tpu.parallel import mesh as mesh_mod
+
+        idx = jax.lax.axis_index(mesh_mod.POLICY_AXIS)
+        allowed_blk, rule_blk = jax.lax.switch(
+            idx, self._mesh_branches, features
+        )
+        a_all = jax.lax.all_gather(allowed_blk, mesh_mod.POLICY_AXIS)
+        r_all = jax.lax.all_gather(rule_blk, mesh_mod.POLICY_AXIS)
+        batch = allowed_blk.shape[0]
+        a_mat = jnp.transpose(a_all, (1, 0, 2)).reshape(batch, -1)
+        r_mat = jnp.transpose(r_all, (1, 0, 2)).reshape(batch, -1)
+        return a_mat, r_mat
+
+    def _per_policy_verdicts(
+        self, features: Mapping[str, Any]
+    ) -> dict[str, tuple[Any, Any]]:
+        """pid → (allowed, rule) columns for every compiled policy — the
+        per-policy half of the fused body. Policy-sharded meshes compute
+        them through the shard_map collective block (each device runs
+        only its own shard's predicates); everything else inlines each
+        compiled program directly."""
+        per_policy: dict[str, tuple[Any, Any]] = {}
+        if self._mesh_block is not None:
+            a_mat, r_mat = self._mesh_block(features)
+            col = self._mesh_policy_col
+            for pid in self._compiled:
+                c = col[pid]
+                per_policy[pid] = (a_mat[:, c], r_mat[:, c])
+        else:
+            for pid, fn in self._compiled.items():
+                per_policy[pid] = fn(features)
+        return per_policy
+
     def _eval_features(self, features: Mapping[str, Any]):
         """The fused predicate + group-reduction body shared by the packed
-        (_forward) and columnar (_forward_planes) roots."""
-        per_policy: dict[str, tuple[Any, Any]] = {}
-        for pid, fn in self._compiled.items():
-            per_policy[pid] = fn(features)
+        (_forward) and columnar (_forward_planes) roots — and, through
+        _per_policy_verdicts, by the single-device and mesh-SPMD forms."""
+        per_policy = self._per_policy_verdicts(features)
         # Host-executed group members: their compiled programs are inert
         # placeholders — the real verdicts arrive as input bits, computed
         # by the host wasm engine at encode time, and join the fused group
@@ -1280,7 +1433,7 @@ class EvaluationEnvironment:
         # (B, P + P + G + G*Mmax) — uint8 when every rule index fits a
         # byte (compact outputs: 4x less fetch on the ~7 MB/s tunnel)
         out_dtype = jnp.uint8 if self._compact_outputs else jnp.int32
-        return jnp.concatenate(
+        out = jnp.concatenate(
             [
                 p_allowed.astype(out_dtype),
                 p_rule.astype(out_dtype),
@@ -1289,6 +1442,16 @@ class EvaluationEnvironment:
             ],
             axis=1,
         )
+        if self._mesh is not None:
+            # the verdict reduction stays batch-sharded: per-host
+            # frontends fetch only their local rows, and XLA keeps the
+            # group combine partitioned on data instead of gathering
+            from policy_server_tpu.parallel import mesh as mesh_mod
+
+            out = jax.lax.with_sharding_constraint(
+                out, mesh_mod.batch_sharding(self._mesh)
+            )
+        return out
 
     def _unpack(self, packed: np.ndarray) -> dict[str, np.ndarray]:
         """Packed device output → the per-key dict the materializers use."""
@@ -1484,6 +1647,7 @@ class EvaluationEnvironment:
                 hp["donated_dispatches"] += 1
             if combo not in self._plane_combos:
                 self._plane_combos.add(combo)
+                self._plane_compiles += 1
                 # planes reconstructed on device are resident zero
                 # constants of this compiled program: the elided
                 # byte-columns plus every unshipped 32-bit column
@@ -1503,15 +1667,26 @@ class EvaluationEnvironment:
                     0, layout.total32 - cols_shipped
                 )
                 hp["resident_const_bytes"] += resident
+        if self._mesh is not None:
+            # mesh dispatch: batch-carrying planes shard over the data
+            # axis up front (one device_put of the tree), column-index
+            # vectors replicate — wire bytes per data shard are
+            # shipped / data-axis-size (batches are bucketed to divide
+            # the axis, so the split is exact)
+            from policy_server_tpu.parallel import mesh as mesh_mod
+
+            delta = mesh_mod.shard_delta_planes(delta, self._mesh)
         return self._device_call(self._fused_planes, spec, delta)
 
     def _dispatch_features(self, features: Mapping[str, Any]) -> Any:
         """The one device-dispatch funnel for full batches: columnar when
-        enabled and the features are a wide packed buffer on a
-        single-device program; otherwise the packed (row-major,
-        bit-packed transport) path. Mesh-sharded programs keep the packed
-        path — plane sharding constraints are not implemented."""
-        if self.columnar and self._mesh is None:
+        enabled and the features are a wide packed buffer — including
+        mesh-sharded programs (round 14: delta planes ship batch-sharded,
+        elided planes come back as NamedSharding-placed resident zero
+        constants); otherwise the packed (row-major, bit-packed
+        transport) path. Multi-process meshes keep the packed path (see
+        _columnar_mesh_ok)."""
+        if self.columnar and self._columnar_mesh_ok():
             schema_idx = self._schema_index_for(features)
             if schema_idx is not None:
                 return self._plane_dispatch(schema_idx, features)
@@ -1546,7 +1721,18 @@ class EvaluationEnvironment:
         breaker = self.breaker
         try:
             failpoints.fire("device.fetch")
-            out = jax.device_get(dev_out)
+            if (
+                getattr(dev_out, "is_fully_addressable", True)
+                or not isinstance(dev_out, jax.Array)
+            ):
+                out = jax.device_get(dev_out)
+            else:
+                # multi-host mesh: the verdict tensor is batch-sharded
+                # across processes — this host fetches ONLY its local
+                # rows (its own frontend's requests; the make_mesh
+                # data-outermost layout makes them contiguous), never a
+                # cross-DCN gather of rows another host will answer
+                out = self._local_rows(dev_out)
         except Exception:
             if breaker is not None:
                 breaker.record_failure()
@@ -1554,6 +1740,22 @@ class EvaluationEnvironment:
         if breaker is not None:
             breaker.record_success()
         return out
+
+    @staticmethod
+    def _local_rows(dev_out: Any) -> np.ndarray:
+        # holds: nothing — pure shard assembly for _device_fetch (the
+        # TP03 choke point); policy-axis replicas dedup by global row
+        # range, rows concatenate in global order == this host's
+        # submission order
+        by_start: dict[int, Any] = {}
+        for shard in dev_out.addressable_shards:
+            row_slice = shard.index[0] if shard.index else slice(None)
+            start = row_slice.start or 0
+            if start not in by_start:
+                by_start[start] = np.asarray(shard.data)
+        return np.concatenate(
+            [by_start[s] for s in sorted(by_start)], axis=0
+        )
 
     def record_dispatch_failure(self, policy_ids: Any = None) -> None:
         """Report a device-path failure the environment cannot observe
@@ -1607,7 +1809,7 @@ class EvaluationEnvironment:
                 batch = schema.empty_batch_packed(b)
                 self._add_wasm_bits(batch, b)
                 self.run_batch(batch)
-                if self.columnar and self._mesh is None:
+                if self.columnar and self._columnar_mesh_ok():
                     # also compile the DENSE columnar structure (every
                     # plane shipped full): the all-zero batch above only
                     # compiles the all-elided program, and the first real

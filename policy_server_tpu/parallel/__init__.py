@@ -1,6 +1,7 @@
 """Device-mesh parallelism (SURVEY.md §2.3): data-parallel batch sharding,
-policy sharding across submeshes, ICI collectives for metric reductions,
-multi-host init."""
+fused-SPMD policy sharding over the (data × policy) mesh, ICI collectives
+for metric reductions, multi-host init. The thread-per-shard MPMD
+dispatcher survives as the ``--mesh-dispatch threaded`` fallback."""
 
 from policy_server_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -9,7 +10,9 @@ from policy_server_tpu.parallel.mesh import (
     initialize_distributed,
     jit_data_parallel,
     make_mesh,
+    plan_policy_buckets,
     plan_policy_shards,
+    shard_delta_planes,
     shard_features,
 )
 from policy_server_tpu.parallel.policy_sharded import PolicyShardedEvaluator
@@ -22,6 +25,8 @@ __all__ = [
     "initialize_distributed",
     "jit_data_parallel",
     "make_mesh",
+    "plan_policy_buckets",
     "plan_policy_shards",
+    "shard_delta_planes",
     "shard_features",
 ]

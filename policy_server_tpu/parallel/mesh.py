@@ -8,19 +8,25 @@ TPU-native design replaces that with sharding over a ``jax.sharding.Mesh``:
 * ``data`` axis — requests (the batch dimension) shard across chips; XLA
   partitions the fused predicate program, elementwise work scales linearly
   and no collective is needed for the verdicts themselves.
-* ``policy`` axis — very large policy sets split into shards (BASELINE.md
-  config 5); each shard is its OWN fused XLA program (policies are
-  heterogeneous code, so this is MPMD across submeshes: every policy shard
-  owns a data-parallel submesh, dispatches concurrently, and the host
-  concatenates verdict blocks — the TPU analog of the reference's
-  replicas-behind-a-Service, but with deterministic placement).
+* ``policy`` axis — large policy sets split into shards. Round 14: the
+  serving form is ONE jit-compiled SPMD program over the full 2-D mesh —
+  each policy shard's predicate block is a ``lax.switch`` branch selected
+  by ``lax.axis_index("policy")`` inside a ``shard_map``, verdict blocks
+  meet in an ``all_gather`` collective over the policy axis, and the
+  group/expression combine runs on data-sharded rows with a
+  ``with_sharding_constraint``. XLA overlaps the cross-shard collectives
+  the old host-side thread pool serialized (one device program per batch
+  instead of one per policy shard). The legacy thread-per-shard MPMD
+  dispatcher (``policy_sharded.py``) remains as the
+  ``--mesh-dispatch threaded`` fallback.
 * metrics reduction — per-policy acceptance counts are a ``psum`` over the
   data axis (``shard_map`` + ``lax.psum``), the collective the driver's
   multi-chip dry-run exercises end to end.
 
 Multi-host: ``jax.distributed.initialize`` + the same mesh spanning all
 processes' devices (ICI within a slice, DCN across slices) — see
-``initialize_distributed``.
+``initialize_distributed``; on the CPU backend the cross-process
+collectives need the gloo implementation, selected there before init.
 """
 
 from __future__ import annotations
@@ -51,14 +57,69 @@ def initialize_distributed(
     process_id: int | None = None,
 ) -> None:
     """Multi-host bring-up (jax.distributed over DCN). No-op when
-    single-process args are absent."""
+    single-process args are absent.
+
+    On the CPU backend XLA's default collectives cannot cross process
+    boundaries ("Multiprocess computations aren't implemented on the CPU
+    backend"); the gloo implementation can — select it before init so
+    the 2-process localhost smoke (and any CPU-backed multi-host
+    deployment) forms a working global mesh. TPU/GPU backends ignore the
+    option, and jax versions without it simply keep their default."""
     if coordinator_address is None:
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    prev_collectives = None
+    set_collectives = False
+    if _is_cpu_platform():
+        try:
+            prev_collectives = jax.config._read(
+                "jax_cpu_collectives_implementation"
+            )
+        except Exception:  # pragma: no cover - jax-version dependent
+            prev_collectives = "none"
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            set_collectives = True
+        except Exception:  # pragma: no cover - jax-version dependent
+            pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except BaseException:
+        # the gloo selection is only valid with a live distributed
+        # client — leaking it after a failed bring-up would break the
+        # NEXT (single-process) CPU backend initialization in this
+        # process with "make_gloo_tcp_collectives(... NoneType)"
+        if set_collectives:
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", prev_collectives
+                )
+            except Exception:  # pragma: no cover
+                pass
+        raise
+
+
+def _is_cpu_platform() -> bool:
+    """True unless a non-CPU platform is EXPLICITLY configured — read
+    from config/env without forcing backend initialization. An empty
+    configuration counts as CPU: jax defaults to the CPU backend when no
+    accelerator plugin resolves, and that default-CPU multi-host
+    deployment is exactly the one that needs gloo collectives (the
+    option is harmless on accelerator platforms — it only shapes the
+    CPU client, which has a live distributed client by then)."""
+    import os
+
+    configured = None
+    try:
+        configured = jax.config.jax_platforms
+    except Exception:  # pragma: no cover - jax-version dependent
+        configured = None
+    configured = configured or os.environ.get("JAX_PLATFORMS", "")
+    s = str(configured).lower().strip()
+    return not s or "cpu" in s
 
 
 def resolve_axes(spec: MeshSpec, devices: Sequence[Any] | None = None) -> dict[str, int]:
@@ -83,10 +144,36 @@ def resolve_axes(spec: MeshSpec, devices: Sequence[Any] | None = None) -> dict[s
 def make_mesh(
     spec: MeshSpec | None = None, devices: Sequence[Any] | None = None
 ) -> Mesh:
-    """Build the (data, policy) mesh. Axis order puts ``data`` innermost on
-    the device list so batch shards ride the fastest ICI links."""
+    """Build the (data, policy) mesh.
+
+    Single-process: axis order puts ``data`` innermost on the device
+    list so batch shards ride the fastest ICI links. Multi-process
+    (``jax.distributed``): ``data`` goes OUTERMOST instead — the global
+    device list orders each process's devices contiguously, so an outer
+    data axis splits the batch dimension ACROSS hosts (each host's
+    frontend feeds host-local rows and fetches only its local verdicts)
+    while the policy axis — and its all-gather collective — stays on
+    each host's local links instead of crossing DCN per batch."""
     devs = np.array(list(devices if devices is not None else jax.devices()))
     axes = resolve_axes(spec or MeshSpec(), devs.tolist())
+    if jax.process_count() > 1:
+        # The host-local-rows contract requires every data row (one
+        # batch shard = policy_axis consecutive global devices) to live
+        # WITHIN one host: a row spanning hosts would make two processes
+        # supply different local content for the same global batch
+        # region (make_array_from_process_local_data then builds
+        # silently divergent arrays). Fail fast instead.
+        local = jax.local_device_count()
+        policy = axes[POLICY_AXIS]
+        if policy > local or local % policy != 0:
+            raise ValueError(
+                f"multi-process mesh: policy axis {policy} must divide "
+                f"the per-host device count {local} (a data shard must "
+                "be host-local; shrink the policy axis or use more "
+                "devices per host)"
+            )
+        grid = devs.reshape(axes[DATA_AXIS], axes[POLICY_AXIS])
+        return Mesh(grid, (DATA_AXIS, POLICY_AXIS))
     grid = devs.reshape(axes[POLICY_AXIS], axes[DATA_AXIS])
     return Mesh(grid, (POLICY_AXIS, DATA_AXIS))
 
@@ -119,6 +206,36 @@ def plan_policy_shards(
 
 
 # ---------------------------------------------------------------------------
+# Fused SPMD planning (round 14): one program over the (data × policy) mesh
+# ---------------------------------------------------------------------------
+
+
+def plan_policy_buckets(
+    policy_ids: Sequence[str], n_shards: int
+) -> tuple[list[tuple[str, ...]], int, dict[str, int]]:
+    """Partition policy ids round-robin (sorted, the same placement rule
+    ``plan_policy_shards`` uses) into the ``lax.switch`` branch buckets of
+    the fused SPMD program.
+
+    Returns ``(buckets, width, column_of)``: every branch pads its
+    verdict block to ``width`` columns so all switch branches agree on
+    shape, and ``column_of[pid]`` is the policy's column in the
+    all-gathered ``(batch, n_shards * width)`` verdict matrix
+    (shard-major: shard ``s`` slot ``k`` lands at ``s * width + k``)."""
+    ordered = sorted(policy_ids)
+    buckets: list[list[str]] = [[] for _ in range(n_shards)]
+    for i, pid in enumerate(ordered):
+        buckets[i % n_shards].append(pid)
+    width = max(1, max((len(b) for b in buckets), default=1))
+    column_of = {
+        pid: s * width + k
+        for s, bucket in enumerate(buckets)
+        for k, pid in enumerate(bucket)
+    }
+    return [tuple(b) for b in buckets], width, column_of
+
+
+# ---------------------------------------------------------------------------
 # Data-parallel dispatch of a fused forward
 # ---------------------------------------------------------------------------
 
@@ -129,14 +246,48 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated placement (delta column-index vectors: every
+    shard scatters with the same static column set)."""
+    return NamedSharding(mesh, P())
+
+
 def shard_features(
     features: Mapping[str, np.ndarray], mesh: Mesh
 ) -> dict[str, jax.Array]:
     """Host → device transfer with the batch axis pre-sharded (one
     device_put of the whole tree; transfers are the serving bottleneck on
-    remote transports)."""
+    remote transports). Multi-host meshes assemble the global array from
+    each process's LOCAL rows — every host ships only its own shard over
+    its own PCIe/DCN link (the per-host frontends feed host-local
+    batches)."""
     sharding = batch_sharding(mesh)
+    if jax.process_count() > 1:
+        return {
+            k: jax.make_array_from_process_local_data(
+                sharding, np.asarray(v)
+            )
+            for k, v in features.items()
+        }
     return jax.device_put(dict(features), sharding)
+
+
+def shard_delta_planes(
+    delta: Mapping[str, np.ndarray], mesh: Mesh
+) -> dict[str, jax.Array]:
+    """Columnar delta planes → device, mesh-placed: batch-carrying planes
+    (2-D+, leading batch dim) shard over the data axis; 1-D column-index
+    vectors replicate (every shard scatters the same static columns).
+    One device_put of the whole tree, mirroring shard_features."""
+    shardings = {
+        k: (
+            batch_sharding(mesh)
+            if getattr(v, "ndim", 0) >= 2
+            else replicated_sharding(mesh)
+        )
+        for k, v in delta.items()
+    }
+    return jax.device_put(dict(delta), shardings)
 
 
 def jit_data_parallel(

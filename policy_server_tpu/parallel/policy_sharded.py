@@ -317,6 +317,14 @@ class PolicyShardedEvaluator:
         )
 
     @property
+    def plane_program_compiles(self) -> int:
+        """Columnar plane structures traced, summed across shards — the
+        batcher's compile-window guard for its RTT estimator."""
+        return sum(
+            env.plane_program_compiles for env in self._routing.shards
+        )
+
+    @property
     def batch_dedup_hits(self) -> int:
         return sum(env.batch_dedup_hits for env in self._routing.shards)
 

@@ -1365,6 +1365,14 @@ class MicroBatcher:
         if use_host:
             with self._stats_lock:
                 self.host_fastpath_batches += 1
+        # RTT samples whose dispatch window traced a NEW columnar plane
+        # structure paid a one-time XLA compile (seconds on a multi-device
+        # mesh) — snapshot the environment's compile counter so
+        # _observe_dispatch can discard them, the warmup rule ("the
+        # second, compile-free run is the routing baseline") applied at
+        # serve time. One poisoned EWMA sample would otherwise route the
+        # firehose host-side for the rest of the run.
+        compiles_before = getattr(self.env, "plane_program_compiles", 0)
         dispatch_start_ns = time.time_ns()
         dispatch_start = time.perf_counter()
         if self.policy_timeout is None:
@@ -1431,6 +1439,7 @@ class MicroBatcher:
                         use_host, bucket, n,
                         time.perf_counter() - dispatch_start,
                         lower_bound=True,
+                        compiles_before=compiles_before,
                     )
                     return
             if handle is not None:
@@ -1468,10 +1477,12 @@ class MicroBatcher:
                 self._observe_dispatch(
                     use_host, bucket, n,
                     time.perf_counter() - dispatch_start, lower_bound=True,
+                    compiles_before=compiles_before,
                 )
                 return  # every item deadline-rejected; device work abandoned
         self._observe_dispatch(
-            use_host, bucket, n, time.perf_counter() - dispatch_start
+            use_host, bucket, n, time.perf_counter() - dispatch_start,
+            compiles_before=compiles_before,
         )
 
         # Phase 3 (host): service-layer constraints + metrics per item.
@@ -1533,6 +1544,7 @@ class MicroBatcher:
         n: int,
         dur: float,
         lower_bound: bool = False,
+        compiles_before: int | None = None,
     ) -> None:
         """Feed the routing estimators with a measured dispatch. Racy
         float writes from concurrent batch workers are benign (last EWMA
@@ -1552,6 +1564,15 @@ class MicroBatcher:
             self._host_cost_per_row = (
                 0.7 * self._host_cost_per_row + 0.3 * dur / n
             )
+            return
+        if compiles_before is not None and (
+            getattr(self.env, "plane_program_compiles", 0) > compiles_before
+        ):
+            # the dispatch window traced a new columnar plane structure:
+            # dur includes a one-time XLA compile, not the steady-state
+            # device cost — discard the sample (a concurrent worker's
+            # compile landing in our window skips a valid sample instead,
+            # which is benign: the next compile-free dispatch feeds in)
             return
         est = self._dev_rtt.get(bucket)
         if lower_bound:

@@ -1383,15 +1383,22 @@ def _build_context_service(config: Config):
 
 
 def _build_environment(config: Config, builder_kwargs: dict):
-    """Build the evaluation environment, honoring ``config.mesh``.
+    """Build the evaluation environment, honoring ``config.mesh`` and
+    ``config.mesh_dispatch``.
 
     TPU-first serving topology (SURVEY.md §2.3 last row; the reference's
     scale-out is replicas behind a Service, README.md:21-26):
 
-    * ``policy`` axis > 1 → :class:`PolicyShardedEvaluator` — MPMD over
-      submeshes, each policy shard data-parallel within its row.
-    * otherwise, with >1 device on the mesh → one fused program with
-      batch-sharded (data-parallel) dispatch via ``attach_mesh``.
+    * >1 device on the mesh → ONE fused SPMD program over the whole
+      (data × policy) mesh via ``attach_mesh`` (round 14): batch planes
+      shard on ``data``, a >1 ``policy`` axis additionally buckets the
+      policy set into per-shard ``lax.switch`` branches whose verdict
+      blocks meet in an all-gather collective — one device program per
+      batch.
+    * ``policy`` axis > 1 with ``--mesh-dispatch threaded`` →
+      :class:`PolicyShardedEvaluator`, the legacy MPMD fallback: one
+      fused program per policy shard on its own submesh row, dispatched
+      from a host thread pool.
     * single device (the default ``auto`` spec on a 1-chip host) → plain
       single-device environment, unchanged.
     """
@@ -1400,7 +1407,10 @@ def _build_environment(config: Config, builder_kwargs: dict):
         from policy_server_tpu.parallel import make_mesh
 
         mesh = make_mesh(config.mesh)
-        if config.mesh.policy_size() > 1:
+        if (
+            config.mesh.policy_size() > 1
+            and config.mesh_dispatch == "threaded"
+        ):
             from policy_server_tpu.parallel import PolicyShardedEvaluator
 
             sharded = PolicyShardedEvaluator(
@@ -1411,7 +1421,7 @@ def _build_environment(config: Config, builder_kwargs: dict):
                 builder_kwargs=builder_kwargs,
             )
             logger.info(
-                "policy-sharded mesh attached",
+                "policy-sharded mesh attached (threaded MPMD fallback)",
                 extra={"span_fields": {
                     "mesh": dict(config.mesh.axes),
                     "shards": len(sharded.shards),
@@ -1428,9 +1438,11 @@ def _build_environment(config: Config, builder_kwargs: dict):
     if mesh is not None and mesh.devices.size > 1:
         environment.attach_mesh(mesh)
         logger.info(
-            "data-parallel mesh attached",
+            "fused SPMD mesh attached",
             extra={"span_fields": {"mesh": dict(config.mesh.axes),
-                                   "devices": int(mesh.devices.size)}},
+                                   "devices": int(mesh.devices.size),
+                                   "policy_sharded":
+                                       environment._mesh_block is not None}},
         )
     return environment
 

@@ -428,6 +428,36 @@ def test_scanner_full_and_dirty_sweeps(env):
         batcher.shutdown()
 
 
+def test_scanner_rows_scanned_accounts_whole_run_across_epochs(env):
+    """PROFILE r13 caveat 3 (soak-artifact accounting): ``rows_scanned``
+    is the WHOLE-RUN total across policy epochs, and
+    ``rows_scanned_by_epoch`` decomposes it — a run whose last event is
+    an epoch flip reports every epoch's audit volume, not only the
+    post-promote sweep's."""
+    lifecycle = SimpleNamespace(current_epoch=0)
+    batcher = MicroBatcher(env, max_batch_size=8, policy_timeout=10.0).start()
+    scanner = make_scanner(env, batcher, lifecycle=lifecycle, batch_size=4)
+    try:
+        scanner.snapshot.observe([
+            pod_review("a", privileged=True), pod_review("b"),
+        ])
+        assert scanner.sweep(full=True) == 4  # epoch 0
+        # promote: the post-promote full sweep re-judges everything
+        # under the new epoch's set
+        lifecycle.current_epoch = 1
+        scanner.on_promote(1)
+        assert scanner.sweep(full=True) == 4  # epoch 1
+        stats = scanner.stats()
+        assert stats["rows_scanned"] == 8  # whole run, both epochs
+        assert stats["rows_scanned_by_epoch"] == {"0": 4, "1": 4}
+        assert (
+            sum(stats["rows_scanned_by_epoch"].values())
+            == stats["rows_scanned"]
+        )
+    finally:
+        batcher.shutdown()
+
+
 def test_scanner_pauses_while_breaker_open(env):
     class BreakerOpen:
         breaker_all_open = True

@@ -477,3 +477,60 @@ def test_budget_routing_reprobes_after_decay(env):
     # each bypass decayed the estimate toward an eventual device re-probe
     assert batcher._dev_rtt[bucket] == pytest.approx(10.0 * 0.98**5)
     batcher.shutdown()
+
+
+def test_rtt_estimator_discards_compile_bearing_samples():
+    """Round-14 regression: a dispatch whose window traced a NEW columnar
+    plane structure paid a one-time XLA compile — seconds for a mesh
+    program — and feeding that one sample into the device-RTT EWMA made
+    the budget router send every later batch host-side for the rest of
+    the run. _observe_dispatch must discard samples whose window
+    advanced the environment's plane_program_compiles counter."""
+
+    class CompilingEnv:
+        supports_host_fastpath = True
+        plane_program_compiles = 0
+
+    cenv = CompilingEnv()
+    batcher = MicroBatcher(
+        cenv, max_batch_size=8, latency_budget_ms=100.0, policy_timeout=2.0
+    )
+    bucket = bucket_size(4)
+    batcher._dev_rtt[bucket] = 0.005  # compile-free warmup seed
+    # window saw a compile: the 3 s reading is a trace+compile stall,
+    # not the steady-state device cost — discarded
+    snapshot = cenv.plane_program_compiles
+    cenv.plane_program_compiles += 1
+    batcher._observe_dispatch(False, bucket, 4, 3.0, compiles_before=snapshot)
+    assert batcher._dev_rtt[bucket] == pytest.approx(0.005)
+    # compile-free window: the sample feeds the EWMA normally
+    batcher._observe_dispatch(
+        False, bucket, 4, 0.009,
+        compiles_before=cenv.plane_program_compiles,
+    )
+    assert batcher._dev_rtt[bucket] == pytest.approx(
+        0.7 * 0.005 + 0.3 * 0.009
+    )
+    # a watchdog-abandoned (lower-bound) sample is discarded too when
+    # its window compiled — the program exists now; the stall won't recur
+    snapshot = cenv.plane_program_compiles
+    cenv.plane_program_compiles += 1
+    batcher._observe_dispatch(
+        False, bucket, 4, 60.0, lower_bound=True, compiles_before=snapshot
+    )
+    assert batcher._dev_rtt[bucket] < 1.0
+    # environments WITHOUT the counter (host oracle, older shims) keep
+    # the pre-round-14 behavior: getattr defaults to 0 == compiles_before
+    # and every sample feeds in
+    class CounterlessEnv:
+        supports_host_fastpath = True
+
+    legacy = MicroBatcher(
+        CounterlessEnv(), max_batch_size=8, latency_budget_ms=100.0,
+        policy_timeout=2.0,
+    )
+    legacy._dev_rtt[bucket] = 0.005
+    legacy._observe_dispatch(False, bucket, 4, 0.02, compiles_before=0)
+    assert legacy._dev_rtt[bucket] == pytest.approx(
+        0.7 * 0.005 + 0.3 * 0.02
+    )

@@ -1,7 +1,10 @@
 """Multi-host plumbing tests (SURVEY.md §7.2 step 10): the distributed
-flags flow CLI → Config → bootstrap → ``jax.distributed.initialize``. A
-real multi-process bring-up cannot run here; these tests prove the wiring
-so a v5e multi-host deployment only needs the three flags set per process."""
+flags flow CLI → Config → bootstrap → ``jax.distributed.initialize`` —
+plus the round-14 REAL bring-up smoke: 2 localhost processes form one
+global mesh over ``jax.distributed`` (CPU gloo collectives) and serve
+host-local rows through the fused SPMD program. Where the platform
+cannot form a multi-process mesh the smoke SKIPS LOUDLY (pytest.skip
+with the worker tail), never silently."""
 
 from __future__ import annotations
 
@@ -77,12 +80,37 @@ def test_initialize_distributed_calls_jax(monkeypatch):
     import jax
 
     monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
-    mesh_mod.initialize_distributed("coord:8476", 8, 3)
+    try:
+        mesh_mod.initialize_distributed("coord:8476", 8, 3)
+    finally:
+        # the faked success left the gloo collectives selection set with
+        # NO live distributed client — restore it or the next test to
+        # initialize the real CPU backend in this process dies with
+        # "make_gloo_tcp_collectives(... NoneType)"
+        jax.config.update("jax_cpu_collectives_implementation", "none")
     assert calls == {
         "coordinator_address": "coord:8476",
         "num_processes": 8,
         "process_id": 3,
     }
+
+
+def test_initialize_distributed_failure_restores_collectives(monkeypatch):
+    """A failed bring-up must not leak the gloo collectives selection:
+    with no live distributed client, a leaked 'gloo' breaks every later
+    CPU backend initialization in the process (found as an order-
+    dependent failure of test_server_mesh after test_distributed)."""
+    import jax
+
+    def boom(coordinator_address, num_processes, process_id):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="coordinator unreachable"):
+        mesh_mod.initialize_distributed("coord:8476", 2, 0)
+    assert (
+        jax.config._read("jax_cpu_collectives_implementation") == "none"
+    )
 
 
 def test_initialize_distributed_noop_without_coordinator(monkeypatch):
@@ -126,3 +154,26 @@ def test_bootstrap_invokes_initialize_distributed(tmp_path, monkeypatch):
     finally:
         server.batcher.shutdown()
         server.environment.close()
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke():
+    """The real multi-host bring-up (round 14, `make multichip`): two
+    localhost processes join a gloo process group, build ONE global
+    (data:4, policy:2) mesh over 2x4 virtual devices, and each serves
+    host-local rows through the fused SPMD program — one device program
+    per batch, verdicts bit-exact vs the host oracle on every rank. A
+    platform that cannot form a multi-process mesh skips LOUDLY."""
+    import __graft_entry__ as graft_entry
+
+    stats = graft_entry.dryrun_distributed(2)
+    if stats.get("distributed_smoke") == "SKIPPED":
+        pytest.skip(
+            "platform cannot form a multi-process jax mesh: "
+            + str(stats)
+        )
+    assert stats["distributed_smoke"] == "PASSED"
+    assert stats["processes"] == 2
+    assert stats["mesh"] == {"data": 4, "policy": 2}
+    assert stats["dispatches_per_batch"] == 1
+    assert stats["bit_exact_vs_oracle"] is True

@@ -614,8 +614,9 @@ def test_ring_full_answers_inband_503_not_stall():
             and front.stats()["ring_full_rejections"] == 0
         ):
             time.sleep(0.05)
-        rejected = front.stats()["ring_full_rejections"]
-        assert rejected > 0, "flood never overran the 256-slot ring"
+        assert front.stats()["ring_full_rejections"] > 0, (
+            "flood never overran the 256-slot ring"
+        )
         sink.gate.set()
         # every request answers: 200 (drained) or 503 (ring-full)
         s.settimeout(20)
@@ -631,6 +632,11 @@ def test_ring_full_answers_inband_503_not_stall():
         resps = parse_responses(stream)
         assert len(resps) == 601, len(resps)
         codes = [st.split(" ")[1] for st, _h, _b in resps]
+        # compare against the counter AFTER every response is in: a
+        # snapshot taken while the flood is still hitting the full ring
+        # undercounts the rejections that land between snapshot and
+        # gate-release (observed 212 counted vs 344 final in CI)
+        rejected = front.stats()["ring_full_rejections"]
         assert codes.count("503") == rejected
         assert codes.count("200") == 601 - rejected
         s.close()

@@ -1325,3 +1325,158 @@ def test_watch_feed_stream_fault_resyncs_and_recovers():
     finally:
         feed.stop()
         cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Round 14: chaos under the fused-SPMD (data × policy) mesh program
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_breaker_trips_to_oracle_and_recovers():
+    """The round-7 breaker contract holds under the fused mesh program:
+    injected dispatch faults on the ONE (data × policy) SPMD program trip
+    its breaker, tripped traffic serves bit-exact verdicts from the host
+    oracle (the still-armed failpoint proves the mesh program is never
+    touched while open), and a half-open probe recovers it — through the
+    lax.switch + all-gather path, not the single-device program."""
+    from policy_server_tpu.config.config import MeshSpec
+    from policy_server_tpu.parallel import make_mesh
+
+    env = EvaluationEnvironmentBuilder(
+        backend="jax",
+        breaker_config=dict(
+            failure_threshold=2, window_seconds=10.0, cooldown_seconds=0.3
+        ),
+        # cache off: a hit would answer the half-open probe without
+        # touching the device (same rationale as make_env above)
+        verdict_cache_size=0,
+    ).build(
+        {
+            "ns": parse_policy_entry(
+                "ns",
+                {
+                    "module": "builtin://namespace-validate",
+                    "settings": {"denied_namespaces": ["blocked"]},
+                },
+            ),
+            "priv": parse_policy_entry(
+                "priv", {"module": "builtin://pod-privileged"}
+            ),
+        }
+    )
+    env.attach_mesh(make_mesh(MeshSpec.parse("data:4,policy:2")))
+    assert env._mesh_block is not None  # policy axis really sharded
+    try:
+        env.warmup((4,))
+        allowed = [("ns", review())]
+        denied = [("ns", review(namespace="blocked"))]
+
+        failpoints.configure("device.fetch=raise:injected-mesh-fault")
+        for _ in range(2):
+            with pytest.raises(failpoints.FailpointError):
+                env.validate_batch(allowed)
+        stats = env.breaker_stats
+        assert stats["trips"] == 1 and stats["open_shards"] == 1
+
+        out = env.validate_batch(allowed + denied)
+        assert out[0].allowed is True
+        assert out[1].allowed is False
+        assert env.breaker_stats["short_circuited_requests"] >= 2
+
+        failpoints.clear()
+        time.sleep(0.35)
+        out = env.validate_batch(allowed)
+        assert out[0].allowed is True
+        stats = env.breaker_stats
+        assert stats["recoveries"] == 1 and stats["open_shards"] == 0
+    finally:
+        env.close()
+
+
+def test_mesh_sighup_reload_under_load_zero_non_2xx():
+    """SIGHUP epoch flip while the serving program is the fused SPMD
+    mesh program: sustained traffic across the promoted flip sees ZERO
+    non-2xx and bit-exact verdicts, and the newly promoted epoch serves
+    through a freshly attached fused mesh program (the program swap is
+    mesh → mesh, never a fallback to single-device or threaded MPMD)."""
+    import requests as rq
+
+    from policy_server_tpu.config.config import MeshSpec
+    from policy_server_tpu.models.policy import parse_policy_entry as ppe
+    from policy_server_tpu.parallel import PolicyShardedEvaluator
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    policies = {
+        "pod-privileged": ppe(
+            "pod-privileged", {"module": "builtin://pod-privileged"}
+        ),
+        "latest": ppe("latest", {"module": "builtin://disallow-latest-tag"}),
+    }
+    config = make_config(
+        policies=policies,
+        policy_timeout_seconds=5.0,
+        max_batch_size=4,
+        reload_admin_token="chaos-token",
+        mesh=MeshSpec.parse("data:4,policy:2"),
+    )
+    handle = ServerHandle(config)
+    lifecycle = handle.server.lifecycle
+    boot_env = handle.server.environment
+    assert not isinstance(boot_env, PolicyShardedEvaluator)
+    assert boot_env._mesh_block is not None
+    stop = threading.Event()
+    results: list[tuple[int, bool | None, bool]] = []
+    errors: list[Exception] = []
+
+    def traffic(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            privileged = (i + worker) % 2 == 0
+            i += 1
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(privileged), timeout=30,
+                )
+                allowed = (
+                    r.json()["response"]["allowed"]
+                    if r.status_code == 200 else None
+                )
+                results.append((r.status_code, allowed, privileged))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=traffic, args=(w,), daemon=True)
+        for w in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        before = lifecycle.stats()["reloads"]
+        handle.server.reload_signal()
+        deadline = time.monotonic() + 120
+        while (
+            lifecycle.stats()["reloads"] == before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert lifecycle.stats()["reloads"] > before, "reload never promoted"
+        time.sleep(0.3)  # traffic THROUGH the promoted epoch
+        promoted_env = handle.server.environment
+        assert promoted_env is not boot_env
+        assert not isinstance(promoted_env, PolicyShardedEvaluator)
+        assert promoted_env._mesh_block is not None  # mesh → mesh swap
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        handle.stop()
+    assert not errors, errors
+    assert len(results) > 10
+    non_2xx = [r for r in results if r[0] != 200]
+    assert not non_2xx, f"non-2xx during mesh SIGHUP reload: {non_2xx[:5]}"
+    for _code, allowed, privileged in results:
+        assert allowed is (not privileged)  # bit-exact through the flip

@@ -41,11 +41,55 @@ def test_data_mesh_attached_and_serving():
         handle.stop()
 
 
-def test_policy_sharded_mesh_serving():
-    """--mesh data:4,policy:2 → MPMD PolicyShardedEvaluator in the server."""
+def test_policy_mesh_fused_spmd_serving():
+    """--mesh data:4,policy:2 (default --mesh-dispatch fused) → ONE
+    EvaluationEnvironment whose fused SPMD program spans the whole 2-D
+    mesh: the policy axis is lax.switch branches + an all-gather inside
+    one program, not threaded submesh dispatches."""
     metrics_mod.reset_metrics_for_tests()
     handle = ServerHandle(
         make_config(mesh=MeshSpec.parse("data:4,policy:2"))
+    )
+    try:
+        env = handle.server.environment
+        assert not isinstance(env, PolicyShardedEvaluator)
+        assert env._mesh is not None
+        assert env._mesh.devices.size == 8
+        assert env._mesh_block is not None  # policy-sharded SPMD block
+        assert env._min_bucket == 4  # batches pad to the DATA axis only
+
+        before = env.host_profile["dispatched_chunks"]
+        # verdicts through the real HTTP path, one device program each
+        for pid, priv, expect in [
+            ("pod-privileged", True, False),
+            ("pod-privileged", False, True),
+            ("group", False, True),
+        ]:
+            r = requests.post(
+                handle.url(f"/validate/{pid}"),
+                json=pod_review_body(priv), timeout=60,
+            )
+            assert r.status_code == 200, (pid, r.text)
+            assert r.json()["response"]["allowed"] is expect, pid
+        # unknown policy still 404s
+        r = requests.post(
+            handle.url("/validate/nope"), json=pod_review_body(False),
+            timeout=60,
+        )
+        assert r.status_code == 404
+    finally:
+        handle.stop()
+
+
+def test_policy_sharded_mesh_serving_threaded_fallback():
+    """--mesh-dispatch threaded → the legacy MPMD PolicyShardedEvaluator
+    (one fused program per policy shard, host thread-pool joins)."""
+    metrics_mod.reset_metrics_for_tests()
+    handle = ServerHandle(
+        make_config(
+            mesh=MeshSpec.parse("data:4,policy:2"),
+            mesh_dispatch="threaded",
+        )
     )
     try:
         env = handle.server.environment

@@ -22,6 +22,12 @@ def main() -> int:
 
         bench_config5_child()
         return 0
+    if "--mesh-child" in sys.argv:
+        from tools.bench.mesh import bench_mesh_child
+
+        i = sys.argv.index("--mesh-child")
+        bench_mesh_child(sys.argv[i + 1])
+        return 0
     if "--native-client" in sys.argv:
         from tools.bench.native import _native_client_main
 
@@ -42,6 +48,7 @@ def main() -> int:
         bench_http_overload_shedding,
         bench_http_routing_ab,
     )
+    from tools.bench.mesh import bench_mesh_dispatch
     from tools.bench.native import bench_http_native
     from tools.bench.serving import bench_batcher_serving
 
@@ -70,6 +77,13 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         emit("config5_multitenant_8shards_virtual", 0.0, "error", 0.0,
              error=repr(e)[:300])
+    try:
+        # round-14 tentpole: ONE fused SPMD program over the
+        # (data x policy) mesh vs the legacy thread-per-shard MPMD
+        # dispatcher on the same 32-policy / 8-virtual-device work
+        bench_mesh_dispatch()
+    except Exception as e:  # noqa: BLE001
+        emit("mesh_fused_spmd", 0.0, "error", 0.0, error=repr(e)[:300])
     try:
         # the batcher serving path with ZERO HTTP (round-12 acceptance:
         # submit_many bursts + batch-granular delivery vs the legacy
