@@ -300,7 +300,9 @@ bool emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
         break;
       case KIND_VALUE: {
         uint8_t* buf = st.buffers[t.array_id];
-        uint8_t* mask = st.buffers[t.mask_id];
+        // mask_id == -1: the optimizer proved this column's validity
+        // mask redundant (zero-fill folding) — no mask buffer exists
+        uint8_t* mask = t.mask_id >= 0 ? st.buffers[t.mask_id] : nullptr;
         switch (t.dtype) {
           case DT_ID:
             if (leaf.type == LEAF_STR) {
@@ -308,7 +310,7 @@ bool emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
                                     (int32_t)st.arena.size(),
                                     (int32_t)leaf.s->size()});
               st.arena.append(*leaf.s);
-              mask[off] = 1;
+              if (mask) mask[off] = 1;
             }
             break;
           case DT_F32:
@@ -321,7 +323,7 @@ bool emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
                 return false;
               }
               ((float*)buf)[off] = (float)v;
-              mask[off] = 1;
+              if (mask) mask[off] = 1;
             }
             break;
           case DT_I32:
@@ -332,13 +334,13 @@ bool emit_terminals(EncodeState& st, const Node& node, const Leaf& leaf,
                 return false;
               }
               ((int32_t*)buf)[off] = (int32_t)leaf.inum;
-              mask[off] = 1;
+              if (mask) mask[off] = 1;
             }
             break;
           case DT_BOOL:
             if (leaf.type == LEAF_BOOL) {
               buf[off] = leaf.b ? 1 : 0;
-              mask[off] = 1;
+              if (mask) mask[off] = 1;
             }
             break;
         }

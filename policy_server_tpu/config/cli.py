@@ -171,6 +171,34 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "(jax donate_argnums) so the device transport does not "
                    "round-trip dead input buffers; 'off' disables "
                    "donation (diagnostic)")),
+        ("--predicate-opt", "KUBEWARDEN_PREDICATE_OPT",
+         dict(default="on", metavar="MODE", choices=["on", "off"],
+              help="Predicate-program optimizer (round 15): before "
+                   "lowering, run cross-policy common-subexpression "
+                   "elimination (identical field-gather + comparison "
+                   "subtrees compute once via a shared let-binding "
+                   "table), constant folding (whole policies folding to "
+                   "a constant verdict drop out of the device program), "
+                   "and dead-field pruning (fields no surviving "
+                   "predicate reads lose their gather columns; validity "
+                   "masks provably redundant at the zero-fill lose "
+                   "their mask lanes). Purely structural — bit-exact vs "
+                   "the unoptimized program and the host oracle. 'off' "
+                   "restores the naive per-policy lowering")),
+        ("--kernel", "KUBEWARDEN_KERNEL",
+         dict(default="xla", metavar="KERNEL", choices=["xla", "pallas"],
+              help="Device kernel form for the fused predicate program: "
+                   "'xla' (default) lowers through XLA; 'pallas' streams "
+                   "packed rows through a fused gather→predicate→reduce "
+                   "Pallas kernel in VMEM-resident (row × policy) tiles "
+                   "for schema buckets that turn hot (per-bucket opt-in "
+                   "by dispatch count). The real Mosaic lowering is "
+                   "gated behind a LOUD capability probe; where it "
+                   "cannot compile (CPU dev boxes) the kernel runs in "
+                   "interpret mode — bit-exact, slow, warned once. "
+                   "Armed buckets use the packed transport (the "
+                   "kernel fuses the unpack; columnar delta planes "
+                   "keep the XLA path)")),
         ("--breaker-failure-threshold", "KUBEWARDEN_BREAKER_FAILURE_THRESHOLD",
          dict(type=int, default=5, metavar="N",
               help="Device circuit breaker: dispatch faults / watchdog "

@@ -211,6 +211,15 @@ class Config:
     columnar: bool = True
     # donate columnar input buffers on dispatch (jax donate_argnums)
     donate_buffers: bool = True
+    # predicate-program optimizer (round 15, ops/optimizer.py):
+    # cross-policy CSE + constant folding + dead-field/mask pruning
+    # before lowering; False restores the naive per-policy lowering
+    predicate_opt: bool = True
+    # device kernel form: 'xla' (fused jit program) or 'pallas' (fused
+    # gather→predicate→reduce Pallas kernel for hot schema buckets;
+    # real Mosaic lowering behind a loud capability probe, interpret
+    # mode elsewhere)
+    kernel: str = "xla"
     # zero-downtime policy lifecycle (lifecycle.py): 'auto' promotes a
     # canaried candidate epoch automatically, 'manual' stages it for an
     # explicit POST /policies/promote, 'off' restores the frozen-at-boot
@@ -497,6 +506,8 @@ class Config:
             breaker_cooldown_seconds=float(args.breaker_cooldown_seconds),
             columnar=args.columnar == "on",
             donate_buffers=args.donate_buffers == "on",
+            predicate_opt=args.predicate_opt == "on",
+            kernel=args.kernel,
             degraded_mode=args.degraded_mode,
             policy_reload_mode=args.policy_reload_mode,
             reload_canary_requests=int(args.reload_canary_requests),

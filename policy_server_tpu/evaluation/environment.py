@@ -231,6 +231,8 @@ class EvaluationEnvironmentBuilder:
         breaker_config: Mapping[str, Any] | None = None,
         columnar: bool = True,
         donate_buffers: bool = True,
+        predicate_opt: bool = True,
+        kernel: str = "xla",
     ) -> None:
         self.backend = backend
         self.continue_on_errors = continue_on_errors
@@ -264,6 +266,14 @@ class EvaluationEnvironmentBuilder:
         # (jax.jit donate_argnums) so the transport stops round-tripping
         # dead buffers
         self.donate_buffers = donate_buffers
+        # predicate-program optimizer (round 15, ops/optimizer.py):
+        # cross-policy CSE + constant folding + dead-field/mask pruning
+        # before lowering; False restores the naive per-policy lowering
+        self.predicate_opt = predicate_opt
+        # device kernel form: 'xla' (the fused jit program) or 'pallas'
+        # (the fused gather→predicate→reduce kernel for hot schema
+        # buckets, ops/pallas_kernels.py)
+        self.kernel = kernel
 
     def build(self, policies: Mapping[str, PolicyOrPolicyGroup]) -> "EvaluationEnvironment":
         cache = ProgramCache()
@@ -384,7 +394,28 @@ class EvaluationEnvironmentBuilder:
             breaker_config=self.breaker_config,
             columnar=self.columnar,
             donate_buffers=self.donate_buffers,
+            predicate_opt=self.predicate_opt,
+            kernel=self.kernel,
         )
+
+
+# Stats-dict key schemas of the round-15 optimizer/kernel surfaces.
+# graftcheck's OB07 cross-checks each key against a metrics.py constant
+# (policy_server_predicate_<key> / policy_server_pallas_<key>) exported
+# through runtime_stats with a dashboard panel — the stats dict cannot
+# grow a key the observability funnel does not carry.
+OPTIMIZER_STAT_KEYS = (
+    "subtrees_shared",
+    "policies_folded",
+    "rules_folded",
+    "fields_pruned",
+    "row_bytes_saved",
+)
+PALLAS_STAT_KEYS = (
+    "dispatches",
+    "buckets_armed",
+    "interpret_mode",
+)
 
 
 class EvaluationEnvironment:
@@ -410,6 +441,8 @@ class EvaluationEnvironment:
         breaker_config: Mapping[str, Any] | None = None,
         columnar: bool = True,
         donate_buffers: bool = True,
+        predicate_opt: bool = True,
+        kernel: str = "xla",
     ) -> None:
         self.backend = backend
         self.always_accept_namespace = always_accept_namespace
@@ -432,11 +465,45 @@ class EvaluationEnvironment:
         if small_axis_cap and small_axis_cap < axis_cap:
             cap_buckets.append((small_axis_cap, small_nested_axis_cap))
         cap_buckets.append((axis_cap, nested_axis_cap))
+        # Predicate-program optimizer (round 15, ops/optimizer.py):
+        # cross-policy CSE + constant folding + dead-field pruning run
+        # BEFORE schema build and lowering, so pruned fields never get
+        # feature columns and elided validity masks never get ':m:'
+        # lanes. jax backend only — the oracle backend interprets the
+        # ORIGINAL IR over raw JSON and stays the independent
+        # differential reference.
+        self.predicate_opt = bool(predicate_opt) and backend == "jax"
+        self.kernel = kernel if backend == "jax" else "xla"
+        self.optimization = None
+        schema_exprs = exprs
+        unmasked: frozenset = frozenset()
+        if self.predicate_opt:
+            from policy_server_tpu.ops.optimizer import optimize_policy_set
+
+            self.optimization = optimize_policy_set(
+                {
+                    pid: bp.precompiled.program
+                    for pid, bp in bound.items()
+                }
+            )
+            schema_exprs = self.optimization.surviving_exprs
+            unmasked = self.optimization.unmasked_value_keys
         self.schemas = [
-            FeatureSchema.build(exprs, axis_cap=a, nested_axis_cap=n)
+            FeatureSchema.build(
+                schema_exprs, axis_cap=a, nested_axis_cap=n,
+                unmasked=unmasked,
+            )
             for a, n in cap_buckets
         ]
         self.schema = self.schemas[-1]  # the widest (legacy name)
+        # pruning accounting vs the unoptimized schema (optimizer_stats):
+        # LAZY — rebuilding the naive schema per cap bucket is pure
+        # gauge math, and the reload path (one candidate build per
+        # policy-churn rewrite, plus the canary) must not pay it; the
+        # first stats read (metrics scrape, bench line) computes once
+        self._opt_accounting: "tuple[int, int, list[dict]] | None" = None
+        self._opt_base_exprs = exprs if self.optimization is not None else None
+        self._opt_cap_buckets = list(cap_buckets)
         for schema in self.schemas:
             schema.register_preds(self.table)
         # The packed device unpack selects its layout by row width
@@ -455,10 +522,30 @@ class EvaluationEnvironment:
                 )
             except Exception:  # pragma: no cover - build env dependent
                 self.native_encoding = False
-        self._compiled = {
-            pid: compile_program(bp.precompiled.program, self.schema, self.table)
-            for pid, bp in bound.items()
-        }
+        if self.optimization is not None:
+            from policy_server_tpu.ops.compiler import compile_constant
+
+            self._compiled = {}
+            for pid, bp in bound.items():
+                po = self.optimization.policies[pid]
+                if po.constant is not None:
+                    # whole-policy constant verdict: drops out of the
+                    # device program (two broadcasts XLA const-folds);
+                    # output columns — and therefore responses, metrics,
+                    # and audit report rows — are unchanged
+                    self._compiled[pid] = compile_constant(*po.constant)
+                else:
+                    self._compiled[pid] = compile_program(
+                        bp.precompiled.program, self.schema, self.table,
+                        conditions=po.conditions,
+                    )
+        else:
+            self._compiled = {
+                pid: compile_program(
+                    bp.precompiled.program, self.schema, self.table
+                )
+                for pid, bp in bound.items()
+            }
         # Stable orders for the packed device outputs (host↔device traffic
         # must be O(1) transfers per batch, not O(#policies): over a remote
         # device transport each transfer is a full roundtrip).
@@ -495,6 +582,16 @@ class EvaluationEnvironment:
             )
         }
         self._fused = jax.jit(self._forward)
+        # Pallas fused kernel path (round 15, ops/pallas_kernels.py):
+        # '--kernel pallas' arms it; each schema bucket opts in once its
+        # dispatch count crosses PALLAS_HOT_DISPATCHES (per-bucket
+        # hotness — cold buckets keep the XLA program). interpret-vs-
+        # mosaic is decided by ONE loud capability probe at first use.
+        self._fused_pallas = jax.jit(self._forward_pallas)
+        self._pallas_armed: set = set()  # guarded-by: _profile_lock
+        self._bucket_dispatches: dict = {}  # guarded-by: _profile_lock
+        self._pallas_dispatches = 0  # guarded-by: _profile_lock
+        self._pallas_interpret: bool | None = None
         # Columnar serving transport (round 12, ROADMAP item 3): the wide
         # packed batch splits into bit-packed / uint16 / int32 PLANES and
         # only all-nonzero ("delta") columns ship — all-zero planes and
@@ -629,7 +726,9 @@ class EvaluationEnvironment:
         # branch closures, and the policy → gathered-column map. None on
         # single-device / pure data-parallel programs.
         self._mesh_block = None
+        self._mesh_block_pallas = None
         self._mesh_branches: list = []
+        self._mesh_buckets: list = []
         self._mesh_block_width = 0
         self._mesh_policy_col: dict[str, int] = {}
         self._min_bucket = 1
@@ -689,10 +788,12 @@ class EvaluationEnvironment:
         self._min_bucket = mesh.shape[mesh_mod.DATA_AXIS]
         n_policy = mesh.shape.get(mesh_mod.POLICY_AXIS, 1)
         self._mesh_block = None
+        self._mesh_block_pallas = None
         if n_policy > 1 and self._compiled:
             buckets, width, column_of = mesh_mod.plan_policy_buckets(
                 list(self._compiled), n_policy
             )
+            self._mesh_buckets = buckets
             self._mesh_block_width = width
             self._mesh_policy_col = column_of
             self._mesh_branches = [
@@ -710,7 +811,22 @@ class EvaluationEnvironment:
                 out_specs=(data_spec, data_spec),
                 check_rep=False,
             )
+            if self.kernel == "pallas":
+                # round 15: the Pallas kernel runs PER POLICY SHARD
+                # inside the same shard_map switch — each shard's branch
+                # is a single-bucket kernel over its local packed rows,
+                # blocks meet in the identical all_gather collective
+                self._mesh_block_pallas = mesh_mod.shard_map(
+                    self._mesh_block_local_pallas,
+                    mesh=mesh,
+                    in_specs=data_spec,
+                    out_specs=(data_spec, data_spec),
+                    check_rep=False,
+                )
         self._fused = mesh_mod.jit_data_parallel(self._forward, mesh)
+        self._fused_pallas = mesh_mod.jit_data_parallel(
+            self._forward_pallas, mesh
+        )
         # rebuild the columnar root: its traces must capture the mesh
         # (plane reconstruction places resident zero constants with the
         # mesh's NamedSharding)
@@ -1028,7 +1144,84 @@ class EvaluationEnvironment:
         dispatches exactly one, so RTT seeds divide by this
         (runtime/batcher.py; ADVICE r5 #4)."""
         per_schema = 2 if (self.columnar and self._columnar_mesh_ok()) else 1
+        if self.kernel == "pallas":
+            # the Pallas leg dispatches the transport form until the
+            # hotness gate arms (the kernel compile lands in warmup)
+            per_schema += self.PALLAS_HOT_DISPATCHES
         return max(1, len(self.schemas) * per_schema)
+
+    @property
+    def optimizer_stats(self) -> dict[str, int]:
+        """Predicate-optimizer work accounting (ops/optimizer.py):
+        static per-environment facts, re-derived for every reload
+        candidate epoch. Keys are OPTIMIZER_STAT_KEYS (graftcheck OB07
+        ties each to an exported metrics family). All zeros with
+        --predicate-opt off."""
+        if self.optimization is None:
+            return {k: 0 for k in OPTIMIZER_STAT_KEYS}
+        fields_pruned, row_bytes_saved, _rows = self._opt_accounting_get()
+        return {
+            "subtrees_shared": self.optimization.subtrees_shared,
+            "policies_folded": self.optimization.policies_folded,
+            "rules_folded": self.optimization.rules_folded,
+            "fields_pruned": fields_pruned,
+            "row_bytes_saved": row_bytes_saved,
+        }
+
+    def _opt_accounting_get(self) -> "tuple[int, int, list[dict]]":
+        """Lazy pruning accounting vs the unoptimized schema: rebuilds
+        the naive FeatureSchema per cap bucket ONCE on first read (a
+        benign race — the computation is pure and idempotent)."""
+        if self._opt_accounting is not None:
+            return self._opt_accounting
+        if self._opt_base_exprs is None:
+            self._opt_accounting = (0, 0, [])
+            return self._opt_accounting
+
+        from policy_server_tpu.ops.codec import mask_key_for
+
+        def keyset(schema: FeatureSchema) -> set:
+            keys = set(schema.specs)
+            keys.update(
+                mask_key_for(s.key)
+                for s in schema.specs.values()
+                if s.has_mask
+            )
+            return keys
+
+        fields_pruned = 0
+        row_bytes_saved = 0
+        bucket_rows: list[dict] = []
+        for i, (a, n) in enumerate(self._opt_cap_buckets):
+            base = FeatureSchema.build(
+                self._opt_base_exprs, axis_cap=a, nested_axis_cap=n
+            )
+            bw = base.packed_layout().width
+            ow = self.schemas[i].packed_layout().width
+            row_bytes_saved += max(0, bw - ow)
+            bucket_rows.append(
+                {"bucket": i, "row_bytes": ow, "row_bytes_unopt": bw}
+            )
+            if i == len(self._opt_cap_buckets) - 1:
+                fields_pruned = len(keyset(base) - keyset(self.schemas[i]))
+        self._opt_accounting = (fields_pruned, row_bytes_saved, bucket_rows)
+        return self._opt_accounting
+
+    @property
+    def optimizer_bucket_stats(self) -> list[dict]:
+        """Per-schema-bucket packed-row widths, optimized vs naive
+        (bench detail lines)."""
+        return [dict(d) for d in self._opt_accounting_get()[2]]
+
+    @property
+    def pallas_stats(self) -> dict[str, int]:
+        """Pallas kernel-path accounting (keys: PALLAS_STAT_KEYS)."""
+        with self._profile_lock:
+            return {
+                "dispatches": self._pallas_dispatches,
+                "buckets_armed": len(self._pallas_armed),
+                "interpret_mode": 1 if self._pallas_interpret else 0,
+            }
 
     @property
     def dedup_stats(self) -> dict[str, int]:
@@ -1073,122 +1266,46 @@ class EvaluationEnvironment:
 
     # -- the fused device program -----------------------------------------
 
+    def _layout_for_buffer(
+        self, width: int
+    ) -> tuple[int, Any, bool, bool]:
+        """→ (schema index, layout, is_transport, is_narrow) for a packed
+        buffer width. Total by construction: ensure_unique_packed_widths
+        keeps every wide/transport/narrow width distinct across schemas."""
+        for i, s in enumerate(self.schemas):
+            lo = s.packed_layout()
+            if lo.transport16_width == width:
+                return i, lo, True, True
+            if lo.transport_width == width:
+                return i, lo, True, False
+            if lo.width == width:
+                return i, lo, False, False
+        raise AssertionError("no schema matches packed buffer width")
+
     def _unpack_features(
         self, features: Mapping[str, Any]
     ) -> Mapping[str, Any]:
-        """Packed two-buffer input → the per-key feature dict the compiled
+        """Packed buffer input → the per-key feature dict the compiled
         predicates consume. Slices/offsets are static per batch bucket, so
         XLA fuses the unpack into the predicate program — the packing
-        exists purely to make host→device traffic O(1) transfers."""
+        exists purely to make host→device traffic O(1) transfers. The
+        slice math itself lives in ``ops.codec.unpack_rows`` — ONE copy
+        shared with the Pallas kernel bodies, which run it per
+        VMEM-resident row tile."""
         if PACKED_KEY not in features:
             return features  # already per-key (tests, entry())
         buf = jnp.asarray(features[PACKED_KEY])
-        layout = None
-        transport = False
-        narrow = False
-        for s in self.schemas:
-            lo = s.packed_layout()
-            if lo.transport16_width == buf.shape[1]:
-                layout, transport, narrow = lo, True, True
-                break
-            if lo.transport_width == buf.shape[1]:
-                layout, transport = lo, True
-                break
-            if lo.width == buf.shape[1]:
-                layout = lo
-                break
-        assert layout is not None, "no schema matches packed buffer width"
-        batch = buf.shape[0]
+        _idx, layout, transport, narrow = self._layout_for_buffer(
+            buf.shape[1]
+        )
         # side-channel inputs riding alongside the packed buffer (wasm
         # member verdict bits) pass through untouched
         out: dict[str, Any] = {
             k: v for k, v in features.items() if k != PACKED_KEY
         }
-        if narrow:
-            # NARROW form: id lanes ride as uint16, the rest as int32 —
-            # two regions with their own sequential offsets (entry order)
-            n_id = layout.u16_count
-            if n_id:
-                u16_bytes = jax.lax.slice_in_dim(
-                    buf,
-                    layout.t16_off_u16_bytes,
-                    layout.t16_off_u16_bytes + n_id * 2,
-                    axis=1,
-                )
-                ids32 = jax.lax.bitcast_convert_type(
-                    u16_bytes.reshape(batch, n_id, 2), jnp.uint16
-                ).astype(jnp.int32)
-            n_other = layout.total32 - n_id
-            if n_other:
-                tail = jax.lax.slice_in_dim(
-                    buf,
-                    layout.t16_off32_bytes,
-                    layout.t16_off32_bytes + n_other * 4,
-                    axis=1,
-                )
-                o32 = jax.lax.bitcast_convert_type(
-                    tail.reshape(batch, n_other, 4), jnp.int32
-                )
-            id_off = other_off = 0
-            for e in layout.entries32:
-                if e.is_id:
-                    block = jax.lax.slice_in_dim(
-                        ids32, id_off, id_off + e.elems, axis=1
-                    )
-                    id_off += e.elems
-                else:
-                    block = jax.lax.slice_in_dim(
-                        o32, other_off, other_off + e.elems, axis=1
-                    )
-                    other_off += e.elems
-                block = block.reshape((batch, *e.caps))
-                if e.is_f32:
-                    block = jax.lax.bitcast_convert_type(block, jnp.float32)
-                out[e.key] = block
-        else:
-            off32_bytes = (
-                layout.t_off32_bytes if transport else layout.off32_bytes
-            )
-            if layout.total32:
-                # int32 tail region: groups of 4 bytes bitcast to int32
-                # (slice the exact region — widened layouts carry trailing
-                # pad bytes)
-                tail = jax.lax.slice_in_dim(
-                    buf,
-                    off32_bytes,
-                    off32_bytes + layout.total32 * 4,
-                    axis=1,
-                )
-                p32 = jax.lax.bitcast_convert_type(
-                    tail.reshape(batch, layout.total32, 4), jnp.int32
-                )
-            for e in layout.entries32:
-                block = jax.lax.slice_in_dim(
-                    p32, e.offset, e.offset + e.elems, axis=1
-                )
-                block = block.reshape((batch, *e.caps))
-                if e.is_f32:
-                    block = jax.lax.bitcast_convert_type(block, jnp.float32)
-                out[e.key] = block
-        if transport:
-            # bit-packed byte region (to_transport, little bit order):
-            # expand once to a (batch, bits_bytes*8) 0/1 matrix — static
-            # shapes, pure elementwise; XLA fuses it into the predicates
-            bits = jax.lax.slice_in_dim(buf, 0, layout.bits_bytes, axis=1)
-            shifts = jnp.arange(8, dtype=jnp.uint8)
-            expanded = (bits[:, :, None] >> shifts) & jnp.uint8(1)
-            lanes = expanded.reshape(batch, layout.bits_bytes * 8)
-            for e in layout.entries8:
-                block = jax.lax.slice_in_dim(
-                    lanes, e.offset, e.offset + e.elems, axis=1
-                )
-                out[e.key] = block.reshape((batch, *e.caps)) != 0
-        else:
-            for e in layout.entries8:
-                block = jax.lax.slice_in_dim(
-                    buf, e.offset, e.offset + e.elems, axis=1
-                )
-                out[e.key] = block.reshape((batch, *e.caps)) != 0
+        from policy_server_tpu.ops.codec import unpack_rows
+
+        out.update(unpack_rows(buf, layout, transport, narrow))
         return out
 
     def _forward(self, features: Mapping[str, Any]) -> tuple[Any, ...]:
@@ -1326,7 +1443,10 @@ class EvaluationEnvironment:
         stacked and zero-padded to the common block width so every
         branch agrees on shape."""
         batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))[0]
-        outs = [self._compiled[pid](features) for pid in bucket]
+        # one shared CSE table per switch branch: identical scoped
+        # subtrees within this policy shard lower once (ops/optimizer)
+        cse: dict | None = {} if self.optimization is not None else None
+        outs = [self._compiled[pid](features, cse) for pid in bucket]
         allowed_cols = [jnp.asarray(a, jnp.bool_) for a, _r in outs]
         rule_cols = [jnp.asarray(r, jnp.int32) for _a, r in outs]
         pad = self._mesh_block_width - len(allowed_cols)
@@ -1358,6 +1478,49 @@ class EvaluationEnvironment:
         r_mat = jnp.transpose(r_all, (1, 0, 2)).reshape(batch, -1)
         return a_mat, r_mat
 
+    def _pallas_bucket_block(self, buf: Any, bucket: tuple):
+        """One policy shard's Pallas branch: the fused kernel over this
+        shard's policies on its LOCAL packed rows, padded to the common
+        block width (same contract as _mesh_bucket_block)."""
+        from policy_server_tpu.ops import pallas_kernels
+
+        _idx, layout, transport, narrow = self._layout_for_buffer(
+            buf.shape[1]
+        )
+        run, _col = pallas_kernels.policy_matrix_program(
+            layout, transport, narrow,
+            {pid: self._compiled[pid] for pid in bucket},
+            use_cse=self.optimization is not None,
+            interpret=bool(self._pallas_interpret),
+            buckets=[tuple(bucket)],
+            width=self._mesh_block_width,
+        )
+        a_blk, r_blk = run(buf)
+        return a_blk, r_blk.astype(jnp.int32)
+
+    def _mesh_block_local_pallas(self, buf: Any):
+        """Pallas twin of _mesh_block_local (shard_map root): select this
+        device's policy-shard branch, run that shard's fused kernel on
+        the local packed rows, all-gather the verdict blocks over the
+        policy axis. Returns shard-major (batch_local, n_shards * width)
+        allowed/rule matrices."""
+        import functools
+
+        from policy_server_tpu.parallel import mesh as mesh_mod
+
+        idx = jax.lax.axis_index(mesh_mod.POLICY_AXIS)
+        branches = [
+            functools.partial(self._pallas_bucket_block, bucket=b)
+            for b in self._mesh_buckets
+        ]
+        allowed_blk, rule_blk = jax.lax.switch(idx, branches, buf)
+        a_all = jax.lax.all_gather(allowed_blk, mesh_mod.POLICY_AXIS)
+        r_all = jax.lax.all_gather(rule_blk, mesh_mod.POLICY_AXIS)
+        batch = allowed_blk.shape[0]
+        a_mat = jnp.transpose(a_all, (1, 0, 2)).reshape(batch, -1)
+        r_mat = jnp.transpose(r_all, (1, 0, 2)).reshape(batch, -1)
+        return a_mat, r_mat
+
     def _per_policy_verdicts(
         self, features: Mapping[str, Any]
     ) -> dict[str, tuple[Any, Any]]:
@@ -1374,8 +1537,14 @@ class EvaluationEnvironment:
                 c = col[pid]
                 per_policy[pid] = (a_mat[:, c], r_mat[:, c])
         else:
+            # the optimizer's shared let-binding table: ONE dict per
+            # trace — identical scoped subtrees across the whole policy
+            # set lower to the same traced value (ops/optimizer.py CSE)
+            cse: dict | None = (
+                {} if self.optimization is not None else None
+            )
             for pid, fn in self._compiled.items():
-                per_policy[pid] = fn(features)
+                per_policy[pid] = fn(features, cse)
         return per_policy
 
     def _eval_features(self, features: Mapping[str, Any]):
@@ -1383,6 +1552,54 @@ class EvaluationEnvironment:
         (_forward) and columnar (_forward_planes) roots — and, through
         _per_policy_verdicts, by the single-device and mesh-SPMD forms."""
         per_policy = self._per_policy_verdicts(features)
+        batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))[0]
+        return self._combine_outputs(per_policy, features, batch)
+
+    def _forward_pallas(self, features: Mapping[str, Any]):
+        """Pallas jit root (--kernel pallas, hot buckets): the per-policy
+        verdict matrix comes from the fused gather→predicate→reduce
+        kernel over the packed TRANSPORT buffer (ops/pallas_kernels.py);
+        the group combine + output packing reuse the shared epilogue.
+        Branch-free body (TP02); structure branching lives in the
+        helper."""
+        return self._pallas_eval(features)
+
+    def _pallas_eval(self, features: Mapping[str, Any]):
+        from policy_server_tpu.ops import pallas_kernels
+
+        buf = jnp.asarray(features[PACKED_KEY])
+        _idx, layout, transport, narrow = self._layout_for_buffer(
+            buf.shape[1]
+        )
+        interpret = bool(self._pallas_interpret)
+        if self._mesh_block_pallas is not None:
+            # policy-sharded mesh: the kernel runs per policy shard
+            # inside the existing shard_map switch branches; blocks meet
+            # in the same all_gather collective as the XLA form
+            a_mat, r_mat = self._mesh_block_pallas(buf)
+            col = self._mesh_policy_col
+        else:
+            run, col = pallas_kernels.policy_matrix_program(
+                layout, transport, narrow, self._compiled,
+                use_cse=self.optimization is not None,
+                interpret=interpret,
+            )
+            a_mat, r_mat = run(buf)
+        per_policy = {
+            pid: (a_mat[:, col[pid]] != 0, r_mat[:, col[pid]])
+            for pid in self._compiled
+        }
+        return self._combine_outputs(per_policy, features, buf.shape[0])
+
+    def _combine_outputs(
+        self,
+        per_policy: dict[str, tuple[Any, Any]],
+        features: Mapping[str, Any],
+        batch: Any,
+    ):
+        """The group-reduction + output-packing epilogue shared by the
+        XLA (_eval_features) and Pallas (_pallas_eval) forms. ``features``
+        supplies only the side channels here (wasm member bits)."""
         # Host-executed group members: their compiled programs are inert
         # placeholders — the real verdicts arrive as input bits, computed
         # by the host wasm engine at encode time, and join the fused group
@@ -1416,7 +1633,6 @@ class EvaluationEnvironment:
             pad = self._max_group_members - len(masks)
             masks.extend([jnp.zeros_like(verdict)] * pad)
             g_eval_cols.append(jnp.stack(masks, axis=-1))  # (B, Mmax)
-        batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))[0]
         g_allowed = (
             jnp.stack(g_allowed_cols, axis=-1)
             if g_allowed_cols
@@ -1686,8 +1902,27 @@ class EvaluationEnvironment:
         constants); otherwise the packed (row-major, bit-packed
         transport) path. Multi-process meshes keep the packed path (see
         _columnar_mesh_ok)."""
+        schema_idx = self._schema_index_for(features)
+        if schema_idx is not None and self._pallas_route(schema_idx):
+            # hot-bucket Pallas kernel (round 15): packed transport form
+            # (the kernel fuses the unpack; delta-plane scatter is the
+            # XLA path's gather). First dispatch of a new buffer shape
+            # is an XLA compile — count it so the batcher's RTT
+            # estimator discards the sample (plane_program_compiles).
+            features = self._transport(features)
+            buf = np.asarray(features[PACKED_KEY])
+            combo = ("pallas", schema_idx, buf.shape)
+            with self._profile_lock:
+                self._pallas_dispatches += 1
+                if combo not in self._plane_combos:
+                    self._plane_combos.add(combo)
+                    self._plane_compiles += 1
+            if self._mesh is not None:
+                from policy_server_tpu.parallel import mesh as mesh_mod
+
+                features = mesh_mod.shard_features(features, self._mesh)
+            return self._device_call(self._fused_pallas, features)
         if self.columnar and self._columnar_mesh_ok():
-            schema_idx = self._schema_index_for(features)
             if schema_idx is not None:
                 return self._plane_dispatch(schema_idx, features)
         features = self._transport(features)
@@ -1696,6 +1931,36 @@ class EvaluationEnvironment:
 
             features = mesh_mod.shard_features(features, self._mesh)
         return self._device_call(self._fused, features)
+
+    # A schema bucket opts into the Pallas kernel once this many batches
+    # have dispatched into it ('--kernel pallas' per-bucket hotness; cold
+    # buckets keep the XLA program and never pay a kernel compile).
+    # Warmup dispatches count — arming during warmup moves the kernel
+    # compile out of the serving path, which is exactly where it belongs.
+    PALLAS_HOT_DISPATCHES = 8
+
+    def _pallas_route(self, schema_idx: int) -> bool:
+        """True when this dispatch should use the fused Pallas kernel:
+        '--kernel pallas' armed AND the bucket is hot (dispatch count
+        crossed the threshold). Decides interpret-vs-mosaic via the loud
+        capability probe on first arm."""
+        if self.kernel != "pallas":
+            return False
+        from policy_server_tpu.ops import pallas_kernels
+
+        if not pallas_kernels.available():
+            return False
+        with self._profile_lock:
+            n = self._bucket_dispatches.get(schema_idx, 0) + 1
+            self._bucket_dispatches[schema_idx] = n
+            armed = schema_idx in self._pallas_armed
+            if not armed and n >= self.PALLAS_HOT_DISPATCHES:
+                self._pallas_armed.add(schema_idx)
+                armed = True
+        if armed and self._pallas_interpret is None:
+            ok, _detail = pallas_kernels.probe_mosaic_support()
+            self._pallas_interpret = not ok
+        return armed
 
     def _device_call(self, fn: Callable, *args: Any) -> Any:
         """Run a synchronous device-path call (the jit dispatch itself),
@@ -1804,7 +2069,7 @@ class EvaluationEnvironment:
         bucket) so the first request isn't a compile stall (reference
         precompiles at boot via rayon, lib.rs:287-307; SURVEY.md §7.2
         step 6)."""
-        for schema in self.schemas:
+        for idx, schema in enumerate(self.schemas):
             for b in sorted({self.bucket_for(b) for b in batch_sizes}):
                 batch = schema.empty_batch_packed(b)
                 self._add_wasm_bits(batch, b)
@@ -1821,6 +2086,27 @@ class EvaluationEnvironment:
                     }
                     self._add_wasm_bits(full, b)
                     self.run_batch(full)
+                if self.kernel == "pallas":
+                    from policy_server_tpu.ops import pallas_kernels
+
+                    if not pallas_kernels.available():
+                        continue
+                    # dispatch the packed transport form through the
+                    # normal funnel until the per-bucket hotness gate
+                    # arms ORGANICALLY: the kernel compile lands in
+                    # warmup, not the serving path — while buckets
+                    # warmup never visits stay cold on the XLA program
+                    # (the gate is real, not decorative). Once armed,
+                    # ONE dispatch per further batch size compiles that
+                    # shape (interpret-mode repeats are slow).
+                    for _ in range(self.PALLAS_HOT_DISPATCHES):
+                        pbatch = schema.empty_batch_packed(b)
+                        self._add_wasm_bits(pbatch, b)
+                        self.run_batch(pbatch)
+                        with self._profile_lock:
+                            armed = idx in self._pallas_armed
+                        if armed:
+                            break
 
     def encode_bucketed(
         self, payload: Any

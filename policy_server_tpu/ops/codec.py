@@ -85,6 +85,15 @@ class FeatureSpec:
     pred_kind: str | None
     pred_pattern: str | None
     caps: tuple[int, ...]
+    # validity-mask elision (ops/optimizer.py round 15): False when every
+    # use of this value column is provably False at the zero-fill, so the
+    # ':m:' mask column is redundant and never materializes — not in the
+    # encoder output, not in the packed layout, not on the wire
+    masked: bool = True
+
+    @property
+    def has_mask(self) -> bool:
+        return self.kind == "value" and self.masked
 
     @property
     def n_axes(self) -> int:
@@ -129,8 +138,13 @@ class FeatureSchema:
         exprs: Iterable[Expr],
         axis_cap: int = DEFAULT_AXIS_CAP,
         nested_axis_cap: int = DEFAULT_NESTED_AXIS_CAP,
+        unmasked: "frozenset[str] | set[str] | None" = None,
     ) -> "FeatureSchema":
+        """``unmasked``: value-spec keys whose validity mask is provably
+        redundant (ops/optimizer.py zero-fill analysis) — their ':m:'
+        columns are never created."""
         specs: dict[str, FeatureSpec] = {}
+        unmasked = unmasked or frozenset()
 
         def caps_for(segs: tuple[str, ...]) -> tuple[int, ...]:
             n = sum(1 for s in segs if s == STAR)
@@ -149,8 +163,9 @@ class FeatureSchema:
         def add_value(p: Path) -> None:
             base = p.key()
             caps = caps_for(p.segments)
-            add(FeatureSpec(f"{base}:v:{p.dtype.value}", p.segments, "value",
-                            p.dtype, None, None, caps))
+            key = f"{base}:v:{p.dtype.value}"
+            add(FeatureSpec(key, p.segments, "value", p.dtype, None, None,
+                            caps, masked=key not in unmasked))
 
         def add_present(segments: tuple[str, ...]) -> None:
             key = ir.render_key(segments) + ":p"
@@ -220,7 +235,7 @@ class FeatureSchema:
         out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros((), dtype=np.bool_)}
         for spec in self.specs.values():
             out[spec.key] = np.zeros(spec.caps, dtype=spec.np_dtype())
-            if spec.kind == "value":
+            if spec.has_mask:
                 out[_mask_key(spec.key)] = np.zeros(spec.caps, dtype=np.bool_)
         _walk_trie(self._trie(), payload, (), out, table)
         return out
@@ -232,7 +247,11 @@ class FeatureSchema:
         assert encoded and len(encoded) <= batch_size
         out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros(batch_size, dtype=np.bool_)}
         for spec in self.specs.values():
-            keys = [spec.key] if spec.kind != "value" else [spec.key, _mask_key(spec.key)]
+            keys = (
+                [spec.key, _mask_key(spec.key)]
+                if spec.has_mask
+                else [spec.key]
+            )
             for key in keys:
                 first = encoded[0][key]
                 arr = np.zeros((batch_size, *first.shape), dtype=first.dtype)
@@ -247,7 +266,7 @@ class FeatureSchema:
         out: dict[str, np.ndarray] = {BATCH_KEY: np.zeros(batch_size, dtype=np.bool_)}
         for spec in self.specs.values():
             out[spec.key] = np.zeros(spec.shape(batch_size), dtype=spec.np_dtype())
-            if spec.kind == "value":
+            if spec.has_mask:
                 out[_mask_key(spec.key)] = np.zeros(
                     spec.shape(batch_size), dtype=np.bool_
                 )
@@ -490,7 +509,7 @@ class PackedLayout:
                 e8.append(PackedEntry(spec.key, off8, elems, spec.caps))
                 off8 += elems
         for spec in specs:  # masks after all primaries (fastenc order)
-            if spec.kind != "value":
+            if not spec.has_mask:
                 continue
             elems = int(np.prod(spec.caps, dtype=np.int64)) if spec.caps else 1
             e8.append(PackedEntry(_mask_key(spec.key), off8, elems, spec.caps))
@@ -538,6 +557,118 @@ class PackedLayout:
         import dataclasses
 
         return dataclasses.replace(self, transport16_width=width)
+
+
+def unpack_rows(
+    buf: Any,
+    layout: "PackedLayout",
+    transport: bool,
+    narrow: bool,
+) -> dict[str, Any]:
+    """Packed (row-major) buffer → the per-key feature dict the compiled
+    predicates consume, as traced jnp ops. Slices/offsets are static for
+    a given layout, so XLA fuses the unpack into the predicate program.
+
+    ONE copy of the unpack math for every consumer: the environment's
+    packed jit root (``_forward``) and the Pallas kernel bodies
+    (``ops/pallas_kernels.py``) — which run it per VMEM-resident row
+    tile, so the expanded feature matrix never round-trips through HBM.
+
+    ``transport``: the buffer is in a wire form (bit-packed byte region);
+    ``narrow``: the uint16-narrowed id variant of the wire form.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    buf = jnp.asarray(buf)
+    batch = buf.shape[0]
+    out: dict[str, Any] = {}
+    if narrow:
+        # NARROW form: id lanes ride as uint16, the rest as int32 —
+        # two regions with their own sequential offsets (entry order)
+        n_id = layout.u16_count
+        if n_id:
+            u16_bytes = jax.lax.slice_in_dim(
+                buf,
+                layout.t16_off_u16_bytes,
+                layout.t16_off_u16_bytes + n_id * 2,
+                axis=1,
+            )
+            ids32 = jax.lax.bitcast_convert_type(
+                u16_bytes.reshape(batch, n_id, 2), jnp.uint16
+            ).astype(jnp.int32)
+        n_other = layout.total32 - n_id
+        if n_other:
+            tail = jax.lax.slice_in_dim(
+                buf,
+                layout.t16_off32_bytes,
+                layout.t16_off32_bytes + n_other * 4,
+                axis=1,
+            )
+            o32 = jax.lax.bitcast_convert_type(
+                tail.reshape(batch, n_other, 4), jnp.int32
+            )
+        id_off = other_off = 0
+        for e in layout.entries32:
+            if e.is_id:
+                block = jax.lax.slice_in_dim(
+                    ids32, id_off, id_off + e.elems, axis=1
+                )
+                id_off += e.elems
+            else:
+                block = jax.lax.slice_in_dim(
+                    o32, other_off, other_off + e.elems, axis=1
+                )
+                other_off += e.elems
+            block = block.reshape((batch, *e.caps))
+            if e.is_f32:
+                block = jax.lax.bitcast_convert_type(block, jnp.float32)
+            out[e.key] = block
+    else:
+        off32_bytes = (
+            layout.t_off32_bytes if transport else layout.off32_bytes
+        )
+        if layout.total32:
+            # int32 tail region: groups of 4 bytes bitcast to int32
+            # (slice the exact region — widened layouts carry trailing
+            # pad bytes)
+            tail = jax.lax.slice_in_dim(
+                buf,
+                off32_bytes,
+                off32_bytes + layout.total32 * 4,
+                axis=1,
+            )
+            p32 = jax.lax.bitcast_convert_type(
+                tail.reshape(batch, layout.total32, 4), jnp.int32
+            )
+        for e in layout.entries32:
+            block = jax.lax.slice_in_dim(
+                p32, e.offset, e.offset + e.elems, axis=1
+            )
+            block = block.reshape((batch, *e.caps))
+            if e.is_f32:
+                block = jax.lax.bitcast_convert_type(block, jnp.float32)
+            out[e.key] = block
+    if transport:
+        # bit-packed byte region (to_transport, little bit order):
+        # expand once to a (batch, bits_bytes*8) 0/1 matrix — static
+        # shapes, pure elementwise; XLA fuses it into the predicates
+        bits = jax.lax.slice_in_dim(buf, 0, layout.bits_bytes, axis=1)
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        expanded = (bits[:, :, None] >> shifts) & jnp.uint8(1)
+        lanes = expanded.reshape(batch, layout.bits_bytes * 8)
+        for e in layout.entries8:
+            block = jax.lax.slice_in_dim(
+                lanes, e.offset, e.offset + e.elems, axis=1
+            )
+            out[e.key] = block.reshape((batch, *e.caps)) != 0
+    else:
+        for e in layout.entries8:
+            block = jax.lax.slice_in_dim(
+                buf, e.offset, e.offset + e.elems, axis=1
+            )
+            out[e.key] = block.reshape((batch, *e.caps)) != 0
+    return out
 
 
 class _TrieNode:
@@ -618,7 +749,8 @@ def _walk_trie(
                 raise SchemaOverflow(spec.key, -1, 0, 0) from None
             if ok:
                 out[spec.key][coords] = converted
-                out[_mask_key(spec.key)][coords] = True
+                if spec.masked:
+                    out[_mask_key(spec.key)][coords] = True
         elif spec.kind == "present":
             if value is not None:
                 out[spec.key][coords] = True

@@ -70,16 +70,46 @@ def lower_expr(
     expr: Expr,
     features: Features,
     table: InternTable,
+    cse: dict | None = None,
+    scalar_inset: bool = False,
 ) -> Any:
     """Lower a typechecked boolean IR expression to a ``(B,)`` bool array.
 
     ``stack`` is the enclosing-quantifier domain stack (ir.DomainStack),
     threaded through the traversal — the same IR node may be reused under
     different quantifiers, so scope is contextual, never keyed on node
-    identity."""
+    identity.
+
+    ``cse`` is the optimizer's shared let-binding table (round 15): a
+    per-trace dict keyed by ``optimizer.scoped_key`` — identical scoped
+    subtrees anywhere in the fused program lower to the SAME traced
+    value, so a 32-policy set carrying pod-privileged three times
+    computes it once. None disables sharing (``--predicate-opt off``).
+
+    A leaf whose validity mask the schema elided (``FeatureSpec.masked``
+    False — the optimizer proved every use False at the zero-fill) lowers
+    mask-free: the mask key is simply absent from ``features``.
+
+    ``scalar_inset`` lowers ``InSet`` membership as an OR chain of
+    SCALAR equality compares instead of the vectorized any-equals
+    against an array constant table — Pallas kernel bodies cannot
+    capture array constants, and scalars inline as literals. Identical
+    semantics; the default XLA lowering keeps the vectorized form (one
+    op instead of O(N) for large settings-driven sets)."""
 
     def value_of(e: Expr, stack: ir.DomainStack) -> tuple[Lowered, Lowered | None]:
         """→ (values, validity-mask or None-if-always-valid)."""
+        if cse is not None and not isinstance(e, ir.Const):
+            from policy_server_tpu.ops.optimizer import scoped_key
+
+            memo_key = ("v", scoped_key(e, stack))
+            hit = cse.get(memo_key)
+            if hit is None:
+                hit = cse[memo_key] = _value_of(e, stack)
+            return hit
+        return _value_of(e, stack)
+
+    def _value_of(e: Expr, stack: ir.DomainStack) -> tuple[Lowered, Lowered | None]:
         if isinstance(e, ir.Const):
             if e.dtype is DType.ID:
                 v = jnp.int32(table.intern(e.value))
@@ -94,8 +124,15 @@ def lower_expr(
             p = ir.absolute_path(e, stack)
             key = f"{p.key()}:v:{p.dtype.value}"
             vals = jnp.asarray(features[key])
-            mask = jnp.asarray(features[mask_key_for(key)])
-            return Lowered(vals, p.n_stars), Lowered(mask, p.n_stars)
+            mask_arr = features.get(mask_key_for(key))
+            if mask_arr is None:
+                # mask elided by the optimizer: every use of this column
+                # is provably False at the zero-fill (see ops/optimizer)
+                return Lowered(vals, p.n_stars), None
+            return (
+                Lowered(vals, p.n_stars),
+                Lowered(jnp.asarray(mask_arr), p.n_stars),
+            )
         # boolean/integer-valued nodes used as values
         return Lowered(bool_of(e, stack), _naxes_of(e, stack)), None
 
@@ -134,6 +171,17 @@ def lower_expr(
         return pv, m
 
     def bool_of(e: Expr, stack: ir.DomainStack) -> Any:
+        if cse is not None and not isinstance(e, ir.Const):
+            from policy_server_tpu.ops.optimizer import scoped_key
+
+            memo_key = ("b", scoped_key(e, stack))
+            hit = cse.get(memo_key)
+            if hit is None:
+                hit = cse[memo_key] = _bool_of(e, stack)
+            return hit
+        return _bool_of(e, stack)
+
+    def _bool_of(e: Expr, stack: ir.DomainStack) -> Any:
         if isinstance(e, ir.Const):
             return jnp.bool_(e.value)
         if isinstance(e, ir.Exists):
@@ -169,16 +217,27 @@ def lower_expr(
                 return jnp.bool_(False)
             ov, om = value_of(e.operand, stack)
             if e.dtype is DType.ID:
-                consts = np.array(
-                    sorted(table.intern(v) for v in e.values), dtype=np.int32
-                )
+                vals = sorted(table.intern(v) for v in e.values)
+                np_dtype = np.int32
             elif e.dtype is DType.F32:
-                consts = np.array(sorted(e.values), dtype=np.float32)
+                vals, np_dtype = sorted(e.values), np.float32
             elif e.dtype is DType.I32:
-                consts = np.array(sorted(e.values), dtype=np.int32)
+                vals, np_dtype = sorted(e.values), np.int32
             else:
-                consts = np.array(sorted(e.values), dtype=np.bool_)
-            hits = jnp.any(ov.values[..., None] == jnp.asarray(consts), axis=-1)
+                vals, np_dtype = sorted(e.values), np.bool_
+            if scalar_inset:
+                # Pallas kernel body: an array constant table would be
+                # a captured const, which pallas_call rejects — lower
+                # membership as an OR chain of scalar compares instead
+                # (identical semantics; scalars inline as literals)
+                hits = ov.values == jnp.asarray(np_dtype(vals[0]))
+                for v in vals[1:]:
+                    hits = hits | (ov.values == jnp.asarray(np_dtype(v)))
+            else:
+                consts = np.asarray(vals, dtype=np_dtype)
+                hits = jnp.any(
+                    ov.values[..., None] == jnp.asarray(consts), axis=-1
+                )
             out = Lowered(hits, ov.naxes)
             if om is not None:
                 mv, hv, n = _align(om, out)
@@ -262,18 +321,49 @@ def compile_program(
     program: PolicyProgram,
     schema: FeatureSchema,
     table: InternTable,
-) -> Callable[[Features], tuple[Any, Any]]:
-    """→ fn(features) -> (allowed (B,), rule_idx (B,) int32, -1 if allowed).
+    conditions: "tuple[Any, ...] | None" = None,
+) -> Callable[..., tuple[Any, Any]]:
+    """→ fn(features, cse=None) -> (allowed (B,), rule_idx (B,) int32,
+    -1 if allowed).
 
-    The returned fn is pure and trace-safe; the evaluation environment fuses
-    all policies' fns into one jitted program per batch bucket."""
+    The returned fn is pure and trace-safe; the evaluation environment
+    fuses all policies' fns into one jitted program per batch bucket,
+    threading one shared ``cse`` table through every policy so identical
+    scoped subtrees lower once (ops/optimizer.py).
 
-    def fn(features: Features) -> tuple[Any, Any]:
+    ``conditions``: optimizer-folded per-rule conditions aligned with
+    ``program.rules`` (indices never shift — the materializer maps
+    ``rule_idx`` into the ORIGINAL rule tuple). Constant-False
+    conditions skip the lowered stack entirely; a constant-True
+    condition lowers as a broadcast (rules after it were already folded
+    to False by the optimizer)."""
+    conds = (
+        conditions
+        if conditions is not None
+        else tuple(r.condition for r in program.rules)
+    )
+    assert len(conds) == len(program.rules)
+
+    def fn(
+        features: Features,
+        cse: dict | None = None,
+        scalar_inset: bool = False,
+    ) -> tuple[Any, Any]:
         batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))
+        # the stack keeps FULL rule length: folded-constant conditions
+        # lower as scalar broadcasts (free after XLA constant folding),
+        # so rule indices never shift and no index-map array constant is
+        # needed (array consts cannot be captured by Pallas kernels)
         violated = jnp.stack(
             [
-                jnp.broadcast_to(lower_expr(r.condition, features, table), batch)
-                for r in program.rules
+                jnp.broadcast_to(
+                    lower_expr(
+                        c, features, table, cse=cse,
+                        scalar_inset=scalar_inset,
+                    ),
+                    batch,
+                )
+                for c in conds
             ],
             axis=-1,
         )  # (B, R)
@@ -281,5 +371,27 @@ def compile_program(
         first = jnp.argmax(violated, axis=-1).astype(jnp.int32)
         rule_idx = jnp.where(any_violated, first, jnp.int32(-1))
         return ~any_violated, rule_idx
+
+    return fn
+
+
+def compile_constant(
+    allowed: bool, rule_idx: int
+) -> Callable[..., tuple[Any, Any]]:
+    """A policy whose verdict the optimizer folded to a constant: no
+    predicate work on device, just two broadcasts XLA constant-folds.
+    Output columns (and therefore materialized responses, metrics, and
+    audit report rows) are identical to the unoptimized program's."""
+
+    def fn(
+        features: Features,
+        cse: dict | None = None,
+        scalar_inset: bool = False,
+    ) -> tuple[Any, Any]:
+        batch = jnp.shape(jnp.asarray(features[BATCH_KEY]))
+        return (
+            jnp.broadcast_to(jnp.bool_(allowed), batch),
+            jnp.broadcast_to(jnp.int32(rule_idx), batch),
+        )
 
     return fn

@@ -120,7 +120,7 @@ def _describe_schema(schema: FeatureSchema) -> tuple[str, list[FeatureSpec], lis
     mask_id: dict[str, int] = {}
     next_id = len(specs)
     for spec in specs:
-        if spec.kind == "value":
+        if spec.has_mask:
             mask_id[spec.key] = next_id
             next_id += 1
     pred_keys: list[str] = []
@@ -146,7 +146,7 @@ def _describe_schema(schema: FeatureSchema) -> tuple[str, list[FeatureSpec], lis
     ]
     arrays += [
         {"caps": list(s.caps), "elsize": 1, "row_stride": layout.width}
-        for s in specs if s.kind == "value"
+        for s in specs if s.has_mask
     ]
 
     # Serialize the SAME trie the Python encoder walks (codec._build_trie):
@@ -194,7 +194,9 @@ class NativeEncoder:
         self._handle = lib.fastenc_create(raw, len(raw))
         if not self._handle:
             raise RuntimeError("fastenc_create failed (bad schema description)")
-        self._value_specs = [s for s in self._specs if s.kind == "value"]
+        # specs carrying a validity-mask buffer (value specs minus the
+        # optimizer's mask-elided columns)
+        self._value_specs = [s for s in self._specs if s.has_mask]
         self._schema = schema
         self._scratch = threading.local()
 

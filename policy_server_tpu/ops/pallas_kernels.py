@@ -1,0 +1,239 @@
+"""Pallas fused gather→predicate→reduce kernels for the hot schema
+buckets (ROADMAP item 3 stretch goal, round 15).
+
+The XLA lowering of the fused predicate program materializes the
+unpacked feature matrix between the packed-row gather and the predicate
+evaluation — on a real TPU that is an HBM round-trip of the widest
+tensor in the serving path (the packed row expands ~9× through bit
+unpack + mask broadcast). The Pallas form streams packed TRANSPORT rows
+through one ``pallas_call``: each grid step holds one (row-tile ×
+policy-tile) block in VMEM, unpacks it with the SAME shared slice math
+the XLA root uses (``ops.codec.unpack_rows`` — one copy of the layout
+contract), evaluates that policy tile's optimized predicates, and
+reduces to the per-policy verdict block in place. The expanded feature
+matrix never exists outside VMEM.
+
+Selection: ``--kernel pallas`` arms the path; each schema bucket opts in
+individually once its dispatch count crosses the hotness threshold
+(``EvaluationEnvironment.PALLAS_HOT_DISPATCHES``), so cold buckets keep
+the XLA program and never pay a kernel compile. The real Mosaic
+lowering is gated behind a LOUD capability probe (like the mesh path's
+distributed smoke): where Mosaic cannot compile (CPU dev boxes, old
+jaxlib), the kernel runs in ``interpret=True`` mode — bit-exact, slow,
+and warned about exactly once — so the tri-way differential
+(pallas-interpret vs optimized-XLA vs host oracle) runs in-container.
+
+Group expressions combine OUTSIDE the kernel, on the (batch, P) verdict
+matrix the kernel emits: that reduction is O(policies) booleans per row
+and XLA fuses it into the same jit program — the HBM tensor the kernel
+exists to kill is the feature matrix, not the verdict matrix.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from policy_server_tpu.ops.codec import BATCH_KEY, unpack_rows
+
+try:  # pallas ships with jax; keep the import soft for exotic builds
+    from jax.experimental import pallas as pl
+except ImportError:  # pragma: no cover - build env dependent
+    pl = None
+
+logger = logging.getLogger("kubewarden-policy-server")
+
+# row-tile height: one grid step's VMEM-resident row block. 128 rows ×
+# a ~1-2 KB packed row stays well inside the ~16 MB VMEM budget even
+# with the unpacked tile alive; smaller batches collapse to one tile.
+ROW_TILE = 128
+
+# policies per policy-tile (grid dim 1): bounds the per-step program so
+# a very large policy set tiles instead of inlining everything into one
+# kernel body
+POLICY_TILE = 32
+
+_mosaic_probe: "tuple[bool, str] | None" = None
+
+
+def available() -> bool:
+    return pl is not None
+
+
+def probe_mosaic_support() -> tuple[bool, str]:
+    """ONE probe per process: can this backend compile a trivial Pallas
+    kernel with the real Mosaic lowering? Failure is LOUD (mirrors the
+    multi-host smoke's MULTICHIP_DISTRIBUTED_SKIP contract) and demotes
+    the kernel to interpret mode — bit-exact, slow, never silent."""
+    global _mosaic_probe
+    if _mosaic_probe is not None:
+        return _mosaic_probe
+    if pl is None:
+        _mosaic_probe = (False, "jax.experimental.pallas unavailable")
+        logger.warning(
+            "PALLAS_MOSAIC_UNAVAILABLE: %s — --kernel pallas will run in "
+            "interpret mode (bit-exact, slow)", _mosaic_probe[1],
+        )
+        return _mosaic_probe
+
+    def _probe_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + jnp.float32(1.0)
+
+    try:
+        x = jnp.zeros((8, 128), jnp.float32)
+        out = pl.pallas_call(
+            _probe_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )(x)
+        jax.block_until_ready(out)
+        _mosaic_probe = (True, "")
+    except Exception as e:  # noqa: BLE001 — any compile/runtime failure
+        # means "no mosaic here", whatever the backend's spelling
+        _mosaic_probe = (False, f"{type(e).__name__}: {e}")
+        logger.warning(
+            "PALLAS_MOSAIC_UNAVAILABLE: mosaic probe failed (%s) — "
+            "--kernel pallas will run in interpret mode (bit-exact, "
+            "slow; expected on CPU dev boxes)",
+            _mosaic_probe[1][:300],
+        )
+    return _mosaic_probe
+
+
+def plan_policy_tiles(
+    policy_ids: Sequence[str], tile: int = POLICY_TILE
+) -> tuple[list[tuple[str, ...]], int, dict[str, int]]:
+    """Split the policy list into kernel policy-tiles of at most ``tile``
+    policies: ``(buckets, width, column_of)`` with every tile padded to
+    the common ``width`` so all ``lax.switch`` branches agree on shape
+    (same scheme as ``parallel.mesh.plan_policy_buckets``)."""
+    ordered = list(policy_ids)
+    n_tiles = max(1, (len(ordered) + tile - 1) // tile)
+    buckets = [
+        tuple(ordered[t * tile : (t + 1) * tile]) for t in range(n_tiles)
+    ]
+    width = max(1, max(len(b) for b in buckets))
+    column_of = {
+        pid: t * width + k
+        for t, bucket in enumerate(buckets)
+        for k, pid in enumerate(bucket)
+    }
+    return buckets, width, column_of
+
+
+def _row_tile_for(batch: int) -> int:
+    if batch <= ROW_TILE:
+        return batch
+    if batch % ROW_TILE == 0:
+        return ROW_TILE
+    return batch  # non-tileable batch (non-pow2 mesh remainder): one tile
+
+
+def _bucket_body(
+    bucket: Sequence[str],
+    compiled: Mapping[str, Callable],
+    width: int,
+    use_cse: bool,
+) -> Callable:
+    """One policy-tile's kernel body half: features → padded
+    (rows, width) allowed/rule blocks. The per-policy rule reduction
+    (first-violated argmax) runs here, inside the kernel, on the
+    VMEM-resident tile."""
+
+    def run(feats: Mapping[str, Any]) -> tuple[Any, Any]:
+        cse: dict | None = {} if use_cse else None
+        rows = jnp.shape(jnp.asarray(feats[BATCH_KEY]))[0]
+        a_cols, r_cols = [], []
+        for pid in bucket:
+            # scalar_inset: kernel bodies cannot capture the vectorized
+            # form's array constant tables (ops/compiler.py)
+            allowed, rule = compiled[pid](feats, cse, True)
+            a_cols.append(jnp.asarray(allowed, jnp.bool_))
+            r_cols.append(jnp.asarray(rule, jnp.int32))
+        pad = width - len(a_cols)
+        a_cols.extend([jnp.zeros((rows,), jnp.bool_)] * pad)
+        r_cols.extend([jnp.zeros((rows,), jnp.int32)] * pad)
+        return jnp.stack(a_cols, axis=-1), jnp.stack(r_cols, axis=-1)
+
+    return run
+
+
+def policy_matrix_program(
+    layout: Any,
+    transport: bool,
+    narrow: bool,
+    compiled: Mapping[str, Callable],
+    *,
+    use_cse: bool = True,
+    interpret: bool = True,
+    buckets: "list[tuple[str, ...]] | None" = None,
+    width: "int | None" = None,
+) -> tuple[Callable[[Any], tuple[Any, Any]], dict[str, int]]:
+    """Build the fused kernel program for one schema bucket.
+
+    Returns ``(run, column_of)``: ``run(buf)`` maps a packed buffer
+    ``(B, layout_width) uint8`` to ``(allowed, rule)`` matrices of shape
+    ``(B, n_tiles * width)``, grid over (row-tile × policy-tile);
+    ``column_of[pid]`` is each policy's column. ``buckets``/``width``
+    override the tile plan (the mesh path passes ONE bucket per policy
+    shard padded to the shard-block width, so the kernel runs per-shard
+    inside the existing ``shard_map`` switch branches)."""
+    if pl is None:
+        raise RuntimeError("pallas unavailable")
+    if buckets is None:
+        buckets, width, column_of = plan_policy_tiles(list(compiled))
+    else:
+        assert width is not None
+        column_of = {
+            pid: t * width + k
+            for t, bucket in enumerate(buckets)
+            for k, pid in enumerate(bucket)
+        }
+    bodies = [
+        _bucket_body(b, compiled, width, use_cse) for b in buckets
+    ]
+    buf_width = (
+        layout.transport16_width
+        if narrow
+        else layout.transport_width if transport else layout.width
+    )
+
+    def kernel(buf_ref, allowed_ref, rule_ref):
+        # gather: the packed tile is already VMEM-resident; the unpack
+        # is the same static slice math as the XLA root (codec.unpack_rows)
+        feats = unpack_rows(buf_ref[...], layout, transport, narrow)
+        if len(bodies) == 1:
+            a_blk, r_blk = bodies[0](feats)
+        else:
+            a_blk, r_blk = jax.lax.switch(
+                pl.program_id(1), bodies, feats
+            )
+        allowed_ref[...] = a_blk
+        rule_ref[...] = r_blk
+
+    def run(buf: Any) -> tuple[Any, Any]:
+        batch = buf.shape[0]
+        tile = _row_tile_for(batch)
+        grid = (batch // tile, len(bodies))
+        out_cols = len(bodies) * width
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tile, buf_width), lambda i, j: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((tile, width), lambda i, j: (i, j)),
+                pl.BlockSpec((tile, width), lambda i, j: (i, j)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((batch, out_cols), jnp.bool_),
+                jax.ShapeDtypeStruct((batch, out_cols), jnp.int32),
+            ],
+            interpret=interpret,
+        )(buf)
+
+    return run, column_of

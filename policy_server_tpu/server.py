@@ -229,6 +229,9 @@ class PolicyServer:
             # columnar device transport + input-buffer donation (round 12)
             columnar=config.columnar,
             donate_buffers=config.donate_buffers,
+            # predicate-program optimizer + device kernel form (round 15)
+            predicate_opt=config.predicate_opt,
+            kernel=config.kernel,
         )
         environment = _build_environment(config, builder_kwargs)
 
@@ -814,6 +817,65 @@ class PolicyServer:
                 "Connections answered an in-band 503 because the "
                 "native frontend's connection cap was reached",
                 nstats.get("conn_cap_rejections", 0),
+            )
+            # Predicate-program optimizer + Pallas kernel path (round
+            # 15). Optimizer facts are static per serving epoch (the
+            # pass re-runs for every reload candidate); gauges follow
+            # the epoch pointer. All zero with --predicate-opt off /
+            # --kernel xla (families still export so dashboard panels
+            # resolve everywhere).
+            ostats = getattr(environment, "optimizer_stats", None) or {}
+            pstats = getattr(environment, "pallas_stats", None) or {}
+            yield (
+                metrics_names.PREDICATE_SUBTREES_SHARED, "gauge",
+                "Distinct predicate subtrees shared across policies by "
+                "the optimizer's CSE table (computed once per program "
+                "instead of once per policy)",
+                ostats.get("subtrees_shared", 0),
+            )
+            yield (
+                metrics_names.PREDICATE_POLICIES_FOLDED, "gauge",
+                "Policies whose verdict folded to a constant and "
+                "dropped out of the device program",
+                ostats.get("policies_folded", 0),
+            )
+            yield (
+                metrics_names.PREDICATE_RULES_FOLDED, "gauge",
+                "Rule conditions folded to constants (unreachable or "
+                "constant rules; indices preserved)",
+                ostats.get("rules_folded", 0),
+            )
+            yield (
+                metrics_names.PREDICATE_FIELDS_PRUNED, "gauge",
+                "Feature-schema fields pruned by the optimizer (dead "
+                "gather columns + zero-fill-redundant validity masks)",
+                ostats.get("fields_pruned", 0),
+            )
+            yield (
+                metrics_names.PREDICATE_ROW_BYTES_SAVED, "gauge",
+                "Packed-row bytes saved per row, summed over schema "
+                "buckets, vs the unoptimized layout",
+                ostats.get("row_bytes_saved", 0),
+            )
+            yield (
+                metrics_names.PALLAS_DISPATCHES, "counter",
+                "Device dispatches served by the fused Pallas "
+                "gather→predicate→reduce kernel (--kernel pallas, hot "
+                "buckets)",
+                pstats.get("dispatches", 0),
+            )
+            yield (
+                metrics_names.PALLAS_BUCKETS_ARMED, "gauge",
+                "Schema buckets currently armed for the Pallas kernel "
+                "(per-bucket opt-in by dispatch count)",
+                pstats.get("buckets_armed", 0),
+            )
+            yield (
+                metrics_names.PALLAS_INTERPRET_MODE, "gauge",
+                "1 when the Pallas kernel runs in interpret mode (the "
+                "Mosaic capability probe failed — bit-exact, slow, "
+                "loudly warned)",
+                pstats.get("interpret_mode", 0),
             )
             soak = getattr(state, "soak", None) or {}
             yield (

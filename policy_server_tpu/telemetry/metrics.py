@@ -133,6 +133,19 @@ NATIVE_CONN_CAP_REJECTS = "policy_server_native_connection_cap_rejections"
 SOAK_WINDOW_RPS = "policy_server_soak_window_rps"
 SOAK_WINDOW_P99_MS = "policy_server_soak_window_p99_ms"
 SOAK_WINDOW_SHED_RATE = "policy_server_soak_window_shed_rate"
+# round 15 — predicate-program optimizer (ops/optimizer.py) + Pallas
+# fused kernel path (ops/pallas_kernels.py). Names follow
+# policy_server_predicate_<OPTIMIZER_STAT_KEY> /
+# policy_server_pallas_<PALLAS_STAT_KEY> — graftcheck's OB07 enforces
+# the stats-dict ↔ constant ↔ dashboard mapping stays total.
+PREDICATE_SUBTREES_SHARED = "policy_server_predicate_subtrees_shared"
+PREDICATE_POLICIES_FOLDED = "policy_server_predicate_policies_folded"
+PREDICATE_RULES_FOLDED = "policy_server_predicate_rules_folded"
+PREDICATE_FIELDS_PRUNED = "policy_server_predicate_fields_pruned"
+PREDICATE_ROW_BYTES_SAVED = "policy_server_predicate_row_bytes_saved"
+PALLAS_DISPATCHES = "policy_server_pallas_dispatches"
+PALLAS_BUCKETS_ARMED = "policy_server_pallas_buckets_armed"
+PALLAS_INTERPRET_MODE = "policy_server_pallas_interpret_mode"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".

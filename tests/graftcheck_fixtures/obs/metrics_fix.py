@@ -4,6 +4,9 @@ constant (OB03), and the label schema tuples."""
 GOOD_COUNTER = "policy_server_fixture_good"
 GOOD_GAUGE = "policy_server_fixture_depth"
 DEAD_METRIC = "policy_server_fixture_dead"  # OB03: never registered
+# OB07 coverage: env_fix.py's 'covered_stat' maps here; 'phantom_stat'
+# and 'ghost_kernel_stat' have no constants (seeded OB07 drift)
+COVERED_STAT = "policy_server_predicate_covered_stat"
 
 _EVAL_LABELS = ("policy_name", "accepted")
 _INIT_LABELS = ("policy_name", "initialization_error")

@@ -100,9 +100,10 @@ def test_observability_fixture_flags_every_seeded_drift():
         metrics_path="metrics_fix.py",
         server_path="server_fix.py",
         dashboard_path="dash.json",
+        environment_path="env_fix.py",
     )
     rules = rules_of(findings)
-    assert {"OB01", "OB02", "OB03", "OB04", "OB05", "OB06"} <= rules
+    assert {"OB01", "OB02", "OB03", "OB04", "OB05", "OB06", "OB07"} <= rules
     # both OB01 shapes: a literal name AND a computed-name expression
     assert any(
         f.rule == "OB01" and "fixture_literal" in f.symbol for f in findings
@@ -122,6 +123,11 @@ def test_observability_fixture_flags_every_seeded_drift():
     assert any(
         f.rule == "OB06" and "policy_mode" in f.symbol for f in findings
     )
+    # OB07: uncovered stats keys flagged, the covered one not
+    ob07 = [f for f in findings if f.rule == "OB07"]
+    assert any("phantom_stat" in f.symbol for f in ob07)
+    assert any("ghost_kernel_stat" in f.symbol for f in ob07)
+    assert not any("covered_stat" in f.symbol for f in ob07)
 
 
 def test_observability_repo_mapping_is_total():

@@ -77,6 +77,86 @@ def test_classification_matrix():
     assert rec.classify(422, "ok") == "unexplained"
 
 
+def test_policy_churn_storm_is_seeded_and_keeps_base_policies():
+    """Round 15: every rewrite preserves the base policy ids (the
+    flowing trace must not start 404ing) and varies the churn-tenant
+    block; the schedule is deterministic per seed and respects the
+    >=3 s spacing the 1 s digest poll needs."""
+    base = "pod-privileged:\n  module: builtin://pod-privileged\n"
+    a = scenarios.policy_churn_storm(
+        random.Random(7), 60.0, base, rewrites=4
+    )
+    b = scenarios.policy_churn_storm(
+        random.Random(7), 60.0, base, rewrites=4
+    )
+    assert [(r.at, r.yaml_text) for r in a] == [
+        (r.at, r.yaml_text) for r in b
+    ]
+    c = scenarios.policy_churn_storm(
+        random.Random(8), 60.0, base, rewrites=4
+    )
+    assert [r.yaml_text for r in a] != [r.yaml_text for r in c]
+    assert len(a) == 4
+    for i, rw in enumerate(a):
+        assert "pod-privileged:" in rw.yaml_text  # base survives
+        assert rw.marker == f"churn-r{i}-t0-fence"
+        assert f"{rw.marker}:" in rw.yaml_text
+        assert 0.1 * 60 <= rw.at <= 0.95 * 60
+    # markers are unique per rewrite: a landed marker identifies WHICH
+    # rewrite's reload is serving
+    assert len({rw.marker for rw in a}) == 4
+    for prev, nxt in zip(a, a[1:]):
+        assert nxt.at - prev.at >= 2.0
+    # the rewritten sets PARSE into real policies (a rewrite that the
+    # candidate compile rejects every time tests only the rollback path)
+    import yaml
+
+    from policy_server_tpu.models.policy import parse_policy_entry
+
+    for rw in a:
+        doc = yaml.safe_load(rw.yaml_text)
+        parsed = {k: parse_policy_entry(k, v) for k, v in doc.items()}
+        assert "pod-privileged" in parsed and len(parsed) > 1
+    assert scenarios.policy_churn_storm(
+        random.Random(7), 60.0, base, rewrites=0
+    ) == []
+
+
+def test_gate_policy_churn_check():
+    """policy_rewrites dict: all-applied AND landed passes; a missed
+    rewrite fails; writes without a landed reload fail (a storm whose
+    every reload rolled back proves nothing); None omits the check."""
+    from tools.soak.faults import FaultEvent
+
+    rec = SLORecorder(window_seconds=0.05)
+    rec.record(200, 5.0, "ok")
+    rec.finish()
+    rec.record_abuse({"kind": "malformed_flood", "passed": True})
+    applied = [
+        FaultEvent(at=1.0, kind=k, applied_at=1.0)
+        for k in ("sighup", "device_fault", "watch_fault")
+    ]
+    gate = rec.gate(
+        p99_budget_ms=100.0, fault_events=applied,
+        policy_rewrites={"applied": 2, "planned": 2, "landed": True},
+    )
+    assert gate["passed"], gate["checks"]
+    assert gate["checks"]["policy_churn_happened"]
+    gate2 = rec.gate(
+        p99_budget_ms=100.0, fault_events=applied,
+        policy_rewrites={"applied": 1, "planned": 2, "landed": True},
+    )
+    assert not gate2["passed"]
+    assert not gate2["checks"]["policy_churn_happened"]
+    gate3 = rec.gate(
+        p99_budget_ms=100.0, fault_events=applied,
+        policy_rewrites={"applied": 2, "planned": 2, "landed": False},
+    )
+    assert not gate3["checks"]["policy_churn_happened"]
+    gate4 = rec.gate(p99_budget_ms=100.0, fault_events=applied)
+    assert "policy_churn_happened" not in gate4["checks"]
+
+
 def test_gate_requires_storm_and_clean_traffic():
     from tools.soak.faults import FaultEvent
 

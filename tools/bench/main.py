@@ -50,6 +50,7 @@ def main() -> int:
     )
     from tools.bench.mesh import bench_mesh_dispatch
     from tools.bench.native import bench_http_native
+    from tools.bench.predicate import bench_predicate_opt_ab
     from tools.bench.serving import bench_batcher_serving
 
     n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
@@ -84,6 +85,13 @@ def main() -> int:
         bench_mesh_dispatch()
     except Exception as e:  # noqa: BLE001
         emit("mesh_fused_spmd", 0.0, "error", 0.0, error=repr(e)[:300])
+    try:
+        # round-15 tentpole: predicate-program optimizer on vs off on the
+        # flagship set (cache off, trimmed median) + the optimizer's work
+        # accounting — the headline A/B for the CSE/fold/prune pass
+        bench_predicate_opt_ab(quick=quick)
+    except Exception as e:  # noqa: BLE001
+        emit("predicate_opt_ab", 0.0, "error", 0.0, error=repr(e)[:300])
     try:
         # the batcher serving path with ZERO HTTP (round-12 acceptance:
         # submit_many bursts + batch-granular delivery vs the legacy

@@ -140,6 +140,12 @@ def bench_mesh_child(mode: str) -> None:
         hp = evaluator.host_profile
         doc["wire_rows"] = hp["wire_rows"]
         doc["wire_bytes_shipped"] = hp["wire_bytes_shipped"]
+        # round 15: the predicate optimizer ran before this program
+        # lowered — its per-bucket work accounting belongs next to the
+        # rows/s it bought (subtrees shared / policies folded / fields
+        # pruned / packed-row shrink per schema bucket)
+        doc["optimizer"] = evaluator.optimizer_stats
+        doc["optimizer_buckets"] = evaluator.optimizer_bucket_stats
     print(json.dumps(doc), flush=True)
 
 
@@ -196,6 +202,8 @@ def bench_mesh_dispatch() -> None:
         rps_max=fused["rps_max"],
         wire_rows=fused.get("wire_rows"),
         wire_bytes_shipped=fused.get("wire_bytes_shipped"),
+        optimizer=fused.get("optimizer"),
+        optimizer_buckets=fused.get("optimizer_buckets"),
         threaded_rps=threaded["rps"],
         threaded_rps_min=threaded["rps_min"],
         threaded_rps_max=threaded["rps_max"],

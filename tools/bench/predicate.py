@@ -1,0 +1,150 @@
+"""Round-15 headline A/B: the predicate-program optimizer on vs off on
+the flagship 32-policy set (`predicate_opt_ab`).
+
+The recorded value is the fused DEVICE PROGRAM's rows/s — pre-encoded
+packed batches through ``run_batch`` (one dispatch + one verdict fetch
+per call), encode outside the timed region, verdict cache off. That is
+the surface the pass optimizes: CSE/folding/pruning reduce per-row
+FLOPs in the lowered program, and on this dev box the end-to-end path
+is host-bound (materialize + payload Python ~100 µs/row), which would
+dilute a real 20% compute win into measurement noise. The end-to-end
+``validate_batch`` A/B rides in the details for exactly that honesty:
+both numbers are printed, the device one is the claim.
+
+Opt-on and opt-off passes INTERLEAVE so ambient drift (the tunneled
+transport moves ±40% between identical runs) hits both sides equally,
+and the reported value is the trimmed median (drop best + worst pass).
+The optimizer's work accounting (subtrees shared / policies folded /
+fields pruned / row bytes saved) rides in the details — the acceptance
+gate requires a NON-vacuous pass (>0 shared subtrees AND >0 pruned
+fields on this workload), not just a throughput delta."""
+
+from __future__ import annotations
+
+import time
+
+from tools.bench.common import (
+    NORTH_STAR_RPS,
+    build_requests,
+    emit,
+    trimmed_spread,
+)
+
+_PASSES = 9          # per side, interleaved; trimmed_spread drops best+worst
+_DISPATCHES = 6      # run_batch calls per timed pass
+_BATCH = 2048        # rows per dispatch: big enough that per-row compute
+                     # dominates the fixed dispatch+fetch overhead
+_E2E_ROWS = 4096     # end-to-end detail A/B (validate_batch, cache off)
+
+
+def _device_batch(env, requests):
+    """Encode the request corpus into ONE packed device batch (outside
+    the timed region) and compile its shape."""
+    target = env._fast_target("pod-security-group")
+    encoded = []
+    for r in requests:
+        payload = env.payload_for(target, r)
+        bucket_idx, enc = env.encode_bucketed(payload)
+        if bucket_idx == 0:
+            encoded.append(enc)
+        if len(encoded) == _BATCH:
+            break
+    schema = env.schemas[0]
+    batch = schema.pack(schema.stack(encoded, batch_size=_BATCH))
+    env._add_wasm_bits(batch, _BATCH)
+    env.run_batch(dict(batch))  # compile this shape outside timing
+    return batch
+
+
+def bench_predicate_opt_ab(quick: bool = False) -> None:
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironmentBuilder,
+    )
+    from policy_server_tpu.policies.flagship import flagship_policies
+
+    requests = build_requests(max(_BATCH * 2, _E2E_ROWS), seed=15)
+    passes = 3 if quick else _PASSES
+
+    envs = {}
+    batches = {}
+    for mode in ("on", "off"):
+        env = EvaluationEnvironmentBuilder(
+            backend="jax", predicate_opt=(mode == "on")
+        ).build(flagship_policies())
+        env.warmup((_BATCH,))
+        envs[mode] = env
+        batches[mode] = _device_batch(env, requests)
+
+    # device-program A/B (the claim): one packed batch, repeated
+    # dispatch+fetch; interleaved so drift is shared. One untimed warm
+    # dispatch per side first — the box's first post-compile dispatch
+    # runs cold (allocator + thread-pool spin-up) and would land in the
+    # opt-on column only.
+    for mode, env in envs.items():
+        env.run_batch(dict(batches[mode]))
+    dev_runs: dict[str, list[float]] = {"on": [], "off": []}
+    for _ in range(passes):
+        for mode, env in envs.items():
+            batch = batches[mode]
+            t0 = time.perf_counter()
+            for _ in range(_DISPATCHES):
+                env.run_batch(dict(batch))
+            dev_runs[mode].append(
+                _DISPATCHES * _BATCH / (time.perf_counter() - t0)
+            )
+
+    # end-to-end serving A/B (the honesty detail): full validate_batch,
+    # cache off — host-bound on this box, so the compute win shrinks
+    items = [
+        ("pod-security-group", r) for r in requests[:_E2E_ROWS]
+    ]
+    e2e_runs: dict[str, list[float]] = {"on": [], "off": []}
+    for env in envs.values():
+        env.reset_verdict_cache()
+        env.validate_batch(items)  # prime shapes outside timing
+    for _ in range(3 if quick else 5):
+        for mode, env in envs.items():
+            env.reset_verdict_cache()
+            t0 = time.perf_counter()
+            env.validate_batch(items)
+            e2e_runs[mode].append(
+                len(items) / (time.perf_counter() - t0)
+            )
+
+    dev_on = trimmed_spread(dev_runs["on"])
+    dev_off = trimmed_spread(dev_runs["off"])
+    e2e_on = trimmed_spread(e2e_runs["on"])
+    e2e_off = trimmed_spread(e2e_runs["off"])
+    stats = envs["on"].optimizer_stats
+
+    def _ratio(a: dict, b: dict):
+        return round(a["median"] / b["median"], 3) if b["median"] else None
+
+    emit(
+        "predicate_opt_ab",
+        dev_on["median"],
+        "reviews/s",
+        dev_on["median"] / NORTH_STAR_RPS,
+        surface="device program (run_batch, encode outside timing)",
+        batch=_BATCH,
+        policies=len(envs["on"]._compiled),
+        device_on_rps=round(dev_on["median"], 1),
+        device_on_min=round(dev_on["min"], 1),
+        device_on_max=round(dev_on["max"], 1),
+        device_on_runs=dev_on["runs"],
+        device_off_rps=round(dev_off["median"], 1),
+        device_off_min=round(dev_off["min"], 1),
+        device_off_max=round(dev_off["max"], 1),
+        device_off_runs=dev_off["runs"],
+        device_speedup=_ratio(dev_on, dev_off),
+        e2e_rows=len(items),
+        e2e_on_rps=round(e2e_on["median"], 1),
+        e2e_off_rps=round(e2e_off["median"], 1),
+        e2e_speedup=_ratio(e2e_on, e2e_off),
+        subtrees_shared=stats["subtrees_shared"],
+        policies_folded=stats["policies_folded"],
+        rules_folded=stats["rules_folded"],
+        fields_pruned=stats["fields_pruned"],
+        row_bytes_saved=stats["row_bytes_saved"],
+        bucket_rows=envs["on"].optimizer_bucket_stats,
+    )

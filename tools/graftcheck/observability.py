@@ -27,6 +27,13 @@ Rules:
   sample suffix).
 * **OB06** — dashboard uses a label absent from the instrument's label
   schema (``_EVAL_LABELS``/``_INIT_LABELS``).
+* **OB07** — optimizer/kernel stats-dict drift (round 15): every key of
+  ``EvaluationEnvironment``'s ``OPTIMIZER_STAT_KEYS`` /
+  ``PALLAS_STAT_KEYS`` tuples must map to a metrics.py constant named
+  ``policy_server_predicate_<key>`` / ``policy_server_pallas_<key>``
+  that the server exports — a stats key the observability funnel does
+  not carry is invisible work (and OB03/OB04 then anchor the constant
+  to a registration and a dashboard panel).
 """
 
 from __future__ import annotations
@@ -222,11 +229,39 @@ def _dashboard_exprs(dashboard: dict) -> list[str]:
     return out
 
 
+def _stat_key_tuples(environment_path: Path) -> dict[str, tuple[str, ...]]:
+    """OPTIMIZER_STAT_KEYS / PALLAS_STAT_KEYS tuples from
+    evaluation/environment.py (module-level string-tuple assignments).
+    Fixture trees without an environment module simply have no stats
+    contract to enforce."""
+    if not environment_path.exists():
+        return {}
+    tree = ast.parse(environment_path.read_text())
+    out: dict[str, tuple[str, ...]] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id in (
+                "OPTIMIZER_STAT_KEYS", "PALLAS_STAT_KEYS"
+            )
+            and isinstance(node.value, ast.Tuple)
+        ):
+            out[node.targets[0].id] = tuple(
+                e.value
+                for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return out
+
+
 def check(
     root: str | Path,
     metrics_path: str = "policy_server_tpu/telemetry/metrics.py",
     server_path: str = "policy_server_tpu/server.py",
     dashboard_path: str = "kubewarden-dashboard.json",
+    environment_path: str = "policy_server_tpu/evaluation/environment.py",
 ) -> list[Finding]:
     root = Path(root)
     findings: list[Finding] = []
@@ -239,6 +274,35 @@ def check(
     instruments = _prom_instruments(mpath, consts)  # family -> kind
     yields, yfindings = _runtime_yields(spath, consts, server_path)
     findings.extend(yfindings)
+
+    # OB07: every optimizer/kernel stats-dict key maps to a metrics.py
+    # constant (policy_server_predicate_<key> / policy_server_pallas_
+    # <key>) — OB03/OB04 then anchor that constant to a registration and
+    # a dashboard panel, so the whole funnel is transitively total
+    _STAT_PREFIX = {
+        "OPTIMIZER_STAT_KEYS": "policy_server_predicate_",
+        "PALLAS_STAT_KEYS": "policy_server_pallas_",
+    }
+    const_values = set(consts.values())
+    for tuple_name, keys in sorted(
+        _stat_key_tuples(root / environment_path).items()
+    ):
+        prefix = _STAT_PREFIX.get(tuple_name)
+        if prefix is None:
+            continue
+        for key in keys:
+            family = f"{prefix}{key}"
+            if family not in const_values:
+                findings.append(
+                    Finding(
+                        "observability", "OB07", environment_path, 0,
+                        f"stat:{tuple_name}:{key}",
+                        f"stats key '{key}' of {tuple_name} has no "
+                        f"metrics.py constant '{family}' — the "
+                        "observability funnel does not carry this "
+                        "optimizer/kernel stat",
+                    )
+                )
 
     # exported families: family name -> kind
     exported: dict[str, str] = dict(instruments)

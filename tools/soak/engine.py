@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import random
 import signal
 import socket
@@ -82,6 +83,10 @@ class SoakSettings:
     artifact: str | None = None
     tag: str = "r13"
     preset: str = "custom"
+    # policy-churn storm (round 15): scheduled policies.yml rewrites
+    # under load — the reload digest watch detects each one and the
+    # predicate optimizer re-runs for every candidate epoch. 0 disables.
+    policy_rewrites: int = 0
 
     @classmethod
     def smoke(cls, **over) -> "SoakSettings":
@@ -90,7 +95,7 @@ class SoakSettings:
             duration=20.0, clients=3, target_rps=220.0,
             n_trace_items=2500, objects=20_000,
             churn_ops_per_second=300.0, window_seconds=2.5,
-            preset="smoke", tag="r13_smoke",
+            preset="smoke", tag="r13_smoke", policy_rewrites=2,
         )
         base.update(over)
         return cls(**base)
@@ -104,6 +109,7 @@ class SoakSettings:
             n_trace_items=20_000, objects=120_000,
             churn_ops_per_second=800.0, window_seconds=10.0,
             http_workers=2, preset="full", tag="r13_full",
+            policy_rewrites=5,
         )
         base.update(over)
         return cls(**base)
@@ -406,6 +412,36 @@ class SoakEngine:
         while not stop.wait(tick):
             self.cluster.churn(per_tick)
 
+    def _policy_churn_loop(
+        self,
+        rewrites: list,
+        policies_path: Path,
+        stop: threading.Event,
+        t0: float,
+    ) -> None:
+        """Write each scheduled policies.yml rewrite at its offset; the
+        lifecycle digest watcher (1 s poll) picks it up and kicks a
+        background reload while the trace keeps flowing."""
+        for rw in rewrites:
+            while not stop.is_set():
+                delay = t0 + rw.at - time.monotonic()
+                if delay <= 0:
+                    break
+                stop.wait(min(delay, 0.2))
+            if stop.is_set():
+                return
+            # atomic replace: the lifecycle's digest poll must never
+            # read a truncated half-written file (a garbage candidate
+            # would reject and the rewrite's reload silently vanish)
+            tmp_path = policies_path.with_suffix(".yml.tmp")
+            tmp_path.write_text(rw.yaml_text, encoding="utf-8")
+            os.replace(tmp_path, policies_path)
+            self._policy_rewrites_applied.append(
+                {"at": round(time.monotonic() - t0, 1), "note": rw.note,
+                 "marker": rw.marker}
+            )
+            self._say(f"policies.yml rewritten ({rw.note})")
+
     # -- the run -----------------------------------------------------------
 
     def run(self) -> int:
@@ -478,6 +514,14 @@ class SoakEngine:
         )
         storm.recorder = self.recorder
 
+        # policy-churn storm (round 15): seeded policies.yml rewrites
+        # under load — the digest watch reloads each one, and the
+        # predicate optimizer re-runs for every candidate epoch
+        policy_rewrites = scenarios.policy_churn_storm(
+            rng, s.duration, _POLICIES_YAML, rewrites=s.policy_rewrites
+        )
+        self._policy_rewrites_applied: list[dict] = []
+
         stop = threading.Event()
         threads = [
             threading.Thread(
@@ -494,6 +538,12 @@ class SoakEngine:
             daemon=True,
         )
         churner.start()
+        policy_churner = threading.Thread(
+            target=self._policy_churn_loop,
+            args=(policy_rewrites, policies_path, stop, t0),
+            name="soak-policy-churn", daemon=True,
+        )
+        policy_churner.start()
         abuser = threading.Thread(
             target=self._abuse_loop, args=(trace.abuse, stop, t0),
             name="soak-abuse", daemon=True,
@@ -509,6 +559,7 @@ class SoakEngine:
         for t in threads:
             t.join(timeout=30)
         churner.join(timeout=5)
+        policy_churner.join(timeout=5)
         abuser.join(timeout=10)
         storm.stop()
         self.recorder.finish()
@@ -516,12 +567,29 @@ class SoakEngine:
 
         # the storm's late reload may still be compiling its candidate:
         # give it a bounded drain so the promoted-flip gate check judges
-        # a settled lifecycle, not a race with the collection point
+        # a settled lifecycle, not a race with the collection point. The
+        # policy-churn gate needs more than "no reload in flight": the
+        # LAST rewrite's marker policy must actually be serving (its
+        # digest-watch trigger may still be pending a poll tick when
+        # the drain starts, and coalesced triggers re-detect next tick)
+        churn_marker = (
+            self._policy_rewrites_applied[-1]["marker"]
+            if self._policy_rewrites_applied else None
+        )
+        churn_landed = False
         if server.lifecycle is not None:
             drain_end = time.monotonic() + 60.0
-            while (server.lifecycle.reload_in_flight()
-                   and time.monotonic() < drain_end):
-                time.sleep(0.25)
+            while time.monotonic() < drain_end:
+                if server.lifecycle.reload_in_flight():
+                    time.sleep(0.25)
+                    continue
+                if churn_marker is None:
+                    break
+                env_now = server.state.evaluation_environment
+                if churn_marker in env_now.policy_ids():
+                    churn_landed = True
+                    break
+                time.sleep(0.3)  # watcher poll is 1 s; wait a tick
 
         lifecycle_stats = (
             server.lifecycle.stats() if server.lifecycle else {}
@@ -532,6 +600,14 @@ class SoakEngine:
             promoted_reloads=(
                 lifecycle_stats.get("reloads")
                 if server.lifecycle is not None else None
+            ),
+            policy_rewrites=(
+                {
+                    "applied": len(self._policy_rewrites_applied),
+                    "planned": s.policy_rewrites,
+                    "landed": churn_landed,
+                }
+                if s.policy_rewrites else None
             ),
         )
         feed_stats = feed.stats()
@@ -587,6 +663,21 @@ class SoakEngine:
                 },
                 "lifecycle": lifecycle_stats,
                 "native_frontend": native_stats,
+                # the churn storm's receipts: rewrites written, and the
+                # serving epoch's optimizer accounting at collection
+                # (re-derived per candidate epoch — nonzero here proves
+                # the pass survived the flips)
+                "policy_churn": {
+                    "planned": s.policy_rewrites,
+                    "applied": self._policy_rewrites_applied,
+                    "last_rewrite_landed": churn_landed,
+                    "optimizer_stats": dict(
+                        getattr(
+                            server.state.evaluation_environment,
+                            "optimizer_stats", None,
+                        ) or {}
+                    ),
+                },
             },
         )
         self._say(

@@ -353,6 +353,88 @@ def unknown_policy_noise(
     return out
 
 
+# -- policy churn (round 15) -------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PolicyRewrite:
+    """One scheduled policies.yml rewrite (engine's policy-churn driver
+    writes ``yaml_text`` over the served file at offset ``at``; the
+    lifecycle's digest watcher detects it within its 1 s poll and kicks
+    a background reload). ``marker`` is a policy id unique to THIS
+    rewrite — when it appears in the serving policy set, this rewrite's
+    reload provably landed (intermediate rewrites may legitimately
+    coalesce; the last one must not)."""
+
+    at: float
+    yaml_text: str
+    note: str = ""
+    marker: str = ""
+
+
+def policy_churn_storm(
+    rng: random.Random,
+    duration: float,
+    base_yaml: str,
+    rewrites: int = 3,
+) -> list[PolicyRewrite]:
+    """Repeated policies.yml rewrites under load: every rewrite keeps
+    the base policy ids (the flowing trace must keep answering 200, not
+    404) and swaps a seeded churn-tenant block around them — tenant
+    count, fence constants, and duplicated builtin entries all vary, so
+    each candidate epoch compiles a genuinely different program and the
+    predicate optimizer re-runs from scratch (its CSE/fold/prune pass is
+    per-environment; this storm is its lifecycle coverage). Duplicated
+    pod-privileged/latest-tag entries across tenants are deliberate CSE
+    food; per-tenant namespace fences carry distinct constants so they
+    never fold away entirely.
+
+    Rewrites land in the middle 75% of the soak, >=3 s apart (digest
+    poll is 1 s and a reload in flight coalesces followers — back-to-
+    back rewrites would just test the coalescer)."""
+    lo, hi = 0.15 * duration, 0.9 * duration
+    gap = max(3.0, (hi - lo) / max(1, rewrites + 1))
+    out: list[PolicyRewrite] = []
+    for i in range(rewrites):
+        at = lo + gap * (i + 1) + rng.uniform(-0.2, 0.2) * min(gap, 3.0)
+        # the 3 s gap floor can push late rewrites past the soak on
+        # pathological settings (short duration × many rewrites) — an
+        # unwritten rewrite would fail the policy_churn_happened gate
+        # even though the engine behaved; clamp into the soak window
+        at = min(at, hi)
+        n_tenants = rng.randrange(1, 5)
+        blocks: list[str] = [base_yaml.rstrip(), ""]
+        # rewrite index in the ids: each rewrite's policy set is
+        # distinguishable from every other's, so its marker appearing
+        # in the serving set proves THIS rewrite's reload landed
+        marker = f"churn-r{i}-t0-fence"
+        for t in range(n_tenants):
+            fence = f"churn-{rng.getrandbits(16):04x}"
+            blocks.append(
+                f"churn-r{i}-t{t}-fence:\n"
+                f"  module: builtin://namespace-validate\n"
+                f"  settings:\n"
+                f"    denied_namespaces: [\"{fence}\", \"{fence}-b\"]\n"
+                f"churn-r{i}-t{t}-priv:\n"
+                f"  module: builtin://pod-privileged\n"
+            )
+            if rng.random() < 0.5:
+                blocks.append(
+                    f"churn-r{i}-t{t}-latest:\n"
+                    f"  module: builtin://disallow-latest-tag\n"
+                )
+        out.append(
+            PolicyRewrite(
+                at=at,
+                yaml_text="\n".join(blocks) + "\n",
+                note=f"rewrite {i + 1}/{rewrites}: {n_tenants} churn "
+                     "tenant(s)",
+                marker=marker,
+            )
+        )
+    return out
+
+
 # -- composition -------------------------------------------------------------
 
 

@@ -207,6 +207,7 @@ class SLORecorder:
         fault_events: list,
         min_fault_events: int = 3,
         promoted_reloads: int | None = None,
+        policy_rewrites: "dict | None" = None,
     ) -> dict[str, Any]:
         t = self.totals()
         sighups = [
@@ -234,6 +235,18 @@ class SLORecorder:
         }
         if promoted_reloads is not None:
             checks["epoch_flip_promoted"] = promoted_reloads >= 1
+        if policy_rewrites is not None:
+            # policy-churn storm (round 15): every scheduled policies.yml
+            # rewrite was written while traffic flowed AND the last
+            # rewrite's reload provably LANDED (its marker policy is
+            # serving) — a storm whose every reload was rejected or
+            # rolled back exercised nothing but the rollback path
+            checks["policy_churn_happened"] = (
+                policy_rewrites.get("planned", 0) > 0
+                and policy_rewrites.get("applied", 0)
+                >= policy_rewrites["planned"]
+                and bool(policy_rewrites.get("landed"))
+            )
         return {
             "passed": all(checks.values()),
             "checks": checks,
