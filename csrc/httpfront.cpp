@@ -1258,7 +1258,10 @@ bool conn_parse(Loop* lp, Conn* c) {
         continue;
       }
       (void)keep_alive_hdr;  // HTTP/1.0 closes either way (finish_request)
-      // routing (query strings stripped; policy id must be one segment)
+      // routing (query strings stripped): one segment is a policy id,
+      // two non-empty segments are "tenant/policy" (round-16 tenant
+      // routing — the Python sink resolves the tenant and answers the
+      // same 404 body as the aiohttp router for unknown names)
       size_t q = path.find('?');
       if (q != std::string::npos) path.resize(q);
       c->route = -1;
@@ -1266,11 +1269,16 @@ bool conn_parse(Loop* lp, Conn* c) {
           {"/validate_raw/", 1}, {"/validate/", 0}, {"/audit/", 2}};
       for (const auto& r : routes) {
         size_t pl = strlen(r.prefix);
-        if (path.compare(0, pl, r.prefix) == 0 && path.size() > pl &&
-            path.find('/', pl) == std::string::npos) {
-          c->route = r.route;
-          c->policy_id = path.substr(pl);
-          break;
+        if (path.compare(0, pl, r.prefix) == 0 && path.size() > pl) {
+          size_t slash = path.find('/', pl);
+          bool one_seg = slash == std::string::npos;
+          bool two_seg = !one_seg && slash > pl && slash + 1 < path.size() &&
+                         path.find('/', slash + 1) == std::string::npos;
+          if (one_seg || two_seg) {
+            c->route = r.route;
+            c->policy_id = path.substr(pl);
+            break;
+          }
         }
       }
       if (c->route >= 0 && method != "POST") c->route = -2;

@@ -14,6 +14,13 @@ def api_error(status: int, message: str) -> web.Response:
     )
 
 
+def api_error_body(status: int, message: str) -> bytes:
+    """The serialized :func:`api_error` body — the ONE spelling of the
+    byte-parity-critical error shape for surfaces that frame their own
+    HTTP (the native frontend's C++ loops, the prefork bridge)."""
+    return json.dumps({"message": message, "status": status}).encode()
+
+
 def json_body_error(message: str) -> web.Response:
     """Malformed/undeserializable JSON body → 422 (the axum JsonRejection
     path, src/api/handlers.rs:30-39; integration_test.rs:155-172 expects

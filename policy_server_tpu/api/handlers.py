@@ -110,8 +110,35 @@ def _record_response(fields: dict, response: AdmissionResponse) -> None:
             fields["response_message"] = response.status.message
 
 
+def _tenant_span_field(request: web.Request) -> dict:
+    """``{"tenant": name}`` for tenant-routed requests; empty for the
+    default routes so their span/log lines stay byte-identical."""
+    name = request.match_info.get("tenant")
+    return {} if name is None else {"tenant": name}
+
+
+def _tenant_state(state: ApiServerState, request: web.Request):
+    """Resolve the serving tenant from the request path (round 16,
+    tenancy.py): un-prefixed routes keep the default epoch pointer (the
+    state itself — every existing URL unchanged); ``{tenant}`` routes
+    resolve through the tenant registry. Returns ``(state_like, None)``
+    or ``(None, 404 response)`` for an unknown tenant."""
+    name = request.match_info.get("tenant")
+    if name is None:
+        return state, None
+    from policy_server_tpu.tenancy import (
+        lookup_tenant,
+        unknown_tenant_message,
+    )
+
+    tenant = lookup_tenant(state, name)
+    if tenant is None:
+        return None, api_error(404, unknown_tenant_message(name))
+    return tenant.state, None
+
+
 async def _evaluate(
-    state: ApiServerState,
+    batcher,
     policy_id: str,
     request: ValidateRequest,
     origin: RequestOrigin,
@@ -121,7 +148,7 @@ async def _evaluate(
     try:
         # submit_async returns a loop-bound asyncio future; whole batches
         # deliver with one loop wakeup (runtime/batcher.py _DeliveryBatch)
-        future = await state.batcher.submit_async(policy_id, request, origin)
+        future = await batcher.submit_async(policy_id, request, origin)
         return await future
     except ShedError as e:
         # admission-time load shed: the queue cannot meet this request's
@@ -160,15 +187,19 @@ async def _read_admission_review(
 async def validate_handler(request: web.Request) -> web.Response:
     state = request.app[STATE_KEY]
     policy_id = request.match_info["policy_id"]
+    tstate, denied = _tenant_state(state, request)
+    if denied is not None:
+        return denied
     review = await _read_admission_review(request)
     if isinstance(review, web.Response):
         return review
     with span(
         "validation", host=state.hostname, policy_id=policy_id,
+        **_tenant_span_field(request),
         **_span_fields_from_admission(review),
     ) as fields:
         result = await _evaluate(
-            state, policy_id,
+            tstate.batcher, policy_id,
             ValidateRequest.from_admission(review.request),
             RequestOrigin.VALIDATE,
         )
@@ -181,15 +212,19 @@ async def validate_handler(request: web.Request) -> web.Response:
 async def audit_handler(request: web.Request) -> web.Response:
     state = request.app[STATE_KEY]
     policy_id = request.match_info["policy_id"]
+    tstate, denied = _tenant_state(state, request)
+    if denied is not None:
+        return denied
     review = await _read_admission_review(request)
     if isinstance(review, web.Response):
         return review
     with span(
         "audit", host=state.hostname, policy_id=policy_id,
+        **_tenant_span_field(request),
         **_span_fields_from_admission(review),
     ) as fields:
         result = await _evaluate(
-            state, policy_id,
+            tstate.batcher, policy_id,
             ValidateRequest.from_admission(review.request),
             RequestOrigin.AUDIT,
         )
@@ -214,6 +249,9 @@ async def audit_reports_handler(request: web.Request) -> web.Response:
 async def validate_raw_handler(request: web.Request) -> web.Response:
     state = request.app[STATE_KEY]
     policy_id = request.match_info["policy_id"]
+    tstate, denied = _tenant_state(state, request)
+    if denied is not None:
+        return denied
     try:
         body = json.loads(await request.read())
         raw_review = RawReviewRequest.from_dict(body)
@@ -223,9 +261,10 @@ async def validate_raw_handler(request: web.Request) -> web.Response:
         return json_body_error(f"Failed to deserialize the JSON body: {e}")
     with span(
         "validation_raw", host=state.hostname, policy_id=policy_id,
+        **_tenant_span_field(request),
     ) as fields:
         result = await _evaluate(
-            state, policy_id,
+            tstate.batcher, policy_id,
             ValidateRequest.from_raw(raw_review.request),
             RequestOrigin.VALIDATE,
         )
@@ -239,8 +278,29 @@ async def readiness_handler(request: web.Request) -> web.Response:
     """Honest readiness (round 9): 503 until the first policy epoch is
     compiled+warmed, 200 on last-good during a background reload, 503
     when every shard's breaker is open under --degraded-mode reject
-    (ApiServerState.readiness holds the verdict logic)."""
+    (ApiServerState.readiness holds the verdict logic; multi-tenant
+    deployments aggregate — 503 only when EVERY tenant is degraded)."""
     status, text = request.app[STATE_KEY].readiness()
+    return web.Response(status=status, text=text)
+
+
+async def readiness_tenant_handler(request: web.Request) -> web.Response:
+    """GET /readiness/{tenant} (round 16): ONE tenant's honest verdict —
+    503 until that tenant's first epoch is compiled+warmed, or while its
+    breakers are all open under a per-tenant --degraded-mode reject.
+    404 for unknown tenants (and for every name when no tenants
+    manifest is configured)."""
+    state = request.app[STATE_KEY]
+    name = request.match_info["tenant"]
+    from policy_server_tpu.tenancy import (
+        lookup_tenant,
+        unknown_tenant_message,
+    )
+
+    tenant = lookup_tenant(state, name)
+    if tenant is None:
+        return api_error(404, unknown_tenant_message(name))
+    status, text = tenant.readiness()
     return web.Response(status=status, text=text)
 
 
@@ -382,6 +442,17 @@ def build_router(state: ApiServerState) -> web.Application:
     app.router.add_get("/audit/reports", audit_reports_handler)
     app.router.add_get("/audit/reports/{namespace}", audit_reports_handler)
     app.router.add_post("/audit/{policy_id}", audit_handler)
+    # tenant-routed evaluation surface (round 16, tenancy.py): the
+    # tenant rides the path; the un-prefixed routes above stay the
+    # reserved default tenant. 'reports' is a reserved tenant name, so
+    # the literal audit routes can never be shadowed.
+    app.router.add_post(
+        "/validate/{tenant}/{policy_id}", validate_handler
+    )
+    app.router.add_post(
+        "/validate_raw/{tenant}/{policy_id}", validate_raw_handler
+    )
+    app.router.add_post("/audit/{tenant}/{policy_id}", audit_handler)
     if state.enable_pprof:
         app.router.add_get("/debug/pprof/cpu", pprof_cpu_handler)
         app.router.add_get("/debug/pprof/heap", pprof_heap_handler)
@@ -394,6 +465,9 @@ def build_readiness_router(state: ApiServerState) -> web.Application:
     app = web.Application()
     app[STATE_KEY] = state
     app.router.add_get("/readiness", readiness_handler)
+    # per-tenant honest readiness (round 16): 503 until THAT tenant's
+    # first epoch is warmed / while it is degraded-rejecting
+    app.router.add_get("/readiness/{tenant}", readiness_tenant_handler)
     app.router.add_get("/metrics", metrics_handler)
     # policy-lifecycle admin surface (bearer-token gated; 404 when the
     # lifecycle manager is absent, 403 when no token is configured)

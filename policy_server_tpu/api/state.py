@@ -17,6 +17,31 @@ from policy_server_tpu.evaluation.environment import EvaluationEnvironment
 from policy_server_tpu.runtime.batcher import MicroBatcher
 
 
+def readiness_verdict(
+    ready: bool, batcher: Any, environment: Any
+) -> tuple[int, str]:
+    """One tenant's honest readiness verdict (status code, body text):
+    503 until its first epoch is compiled+warmed, 200 on last-good
+    during a background reload (the epoch flip never un-readies), and
+    503 when every device shard's breaker is open under
+    ``--degraded-mode reject`` — a tenant that would answer every
+    review with an in-band 503 must not advertise ready. Shared by the
+    process-wide probe and the per-tenant probes (tenancy.py)."""
+    if not ready:
+        return 503, "first policy epoch not yet compiled and warmed"
+    if (
+        batcher is not None
+        and getattr(batcher, "degraded_mode", None) == "reject"
+        and getattr(environment, "breaker_all_open", False)
+    ):
+        return (
+            503,
+            "every device shard breaker is open and --degraded-mode "
+            "reject refuses traffic",
+        )
+    return 200, "ok"
+
+
 @dataclass
 class ApiServerState:
     evaluation_environment: EvaluationEnvironment
@@ -48,27 +73,31 @@ class ApiServerState:
     # /metrics framing counters read it through the state so the scrape
     # follows whatever is actually serving
     native_frontend: Any = None
+    # the tenant registry (tenancy.TenantManager); None on single-tenant
+    # deployments (no --tenants manifest) — every existing URL then maps
+    # to this state's own epoch pointer, unchanged
+    tenants: Any = None
 
     def readiness(self) -> tuple[int, str]:
-        """The /readiness verdict (status code, body text). Honest on
-        three axes: 503 until the first epoch is compiled+warmed, 200 on
-        last-good while a background reload runs (the flip above never
-        un-readies), and 503 when EVERY device shard's breaker is open
-        under ``--degraded-mode reject`` — a server that would answer
-        every review with an in-band 503 must not advertise ready."""
-        if not self.ready:
-            return 503, "first policy epoch not yet compiled and warmed"
-        batcher = self.batcher
-        if (
-            batcher is not None
-            and getattr(batcher, "degraded_mode", None) == "reject"
-            and getattr(
-                self.evaluation_environment, "breaker_all_open", False
+        """The process-wide /readiness verdict. Single-tenant: this
+        state's own honest verdict (readiness_verdict). Multi-tenant
+        (round 16): 503 only when EVERY tenant is degraded — a partial
+        outage keeps the pod in rotation (the healthy tenants' traffic
+        must keep landing here), with the degraded tenant names in the
+        200 body; per-tenant probes live at /readiness/{tenant}."""
+        if self.tenants is None:
+            return readiness_verdict(
+                self.ready, self.batcher, self.evaluation_environment
             )
-        ):
+        # the registry holds EVERY tenant incl. the default (whose
+        # per-tenant verdict comes from the same readiness_verdict over
+        # this state's raw fields — never this aggregate, no recursion)
+        degraded = self.tenants.degraded_names()
+        if not self.tenants.any_ready():
             return (
                 503,
-                "every device shard breaker is open and --degraded-mode "
-                "reject refuses traffic",
+                "every tenant is degraded: " + ", ".join(degraded),
             )
+        if degraded:
+            return 200, "ok (degraded tenants: " + ", ".join(degraded) + ")"
         return 200, "ok"

@@ -344,6 +344,29 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
               help="Native frontend: cap on concurrent connections; "
                    "accepts over it answer an in-band 503 + "
                    "Retry-After and close (counted; 0 = uncapped)")),
+        ("--tenants", "KUBEWARDEN_TENANTS",
+         dict(default=None, metavar="TENANTS_FILE",
+              help="Multi-tenant serving (round 16, tenancy.py): a YAML "
+                   "manifest mapping tenant names to their own policies "
+                   "files plus per-tenant knobs — weight (weighted-fair "
+                   "dispatch share), quota-rows-per-second + quota-burst "
+                   "(token-bucket admission; overflow answers 429 + "
+                   "Retry-After), max-inflight (admitted-unresolved row "
+                   "cap), request-timeout-ms (per-tenant deadline "
+                   "class), and degraded-mode (per-tenant breaker "
+                   "fallback). Each named tenant owns an independent "
+                   "epoch lifecycle (reload/canary/rollback/digest "
+                   "watch) over its policies file and is served at "
+                   "POST /validate/{tenant}/{policy_id} (plus the "
+                   "audit/raw variants and GET /readiness/{tenant}); "
+                   "every un-prefixed URL stays the reserved 'default' "
+                   "tenant, configured by --policies as before. A "
+                   "top-level 'default:' entry applies quota/weight "
+                   "knobs to the default tenant; "
+                   "'max-concurrent-dispatches' caps the shared "
+                   "weighted-fair dispatch scheduler. Unset = "
+                   "single-tenant, bit-identical to the pre-tenancy "
+                   "serving path")),
         ("--reload-admin-token", "KUBEWARDEN_RELOAD_ADMIN_TOKEN",
          dict(default=None, metavar="TOKEN",
               help="Bearer token authenticating the policy-lifecycle "

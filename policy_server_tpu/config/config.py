@@ -238,6 +238,13 @@ class Config:
     # the on-disk policies file backing hot reload (None when the config
     # was built programmatically — reloads then reuse the in-memory set)
     policies_path: str | None = None
+    # multi-tenant serving (round 16, tenancy.py): the tenants manifest
+    # path and its parsed form (tenancy.TenantManifest) — each named
+    # tenant gets its own policies file, epoch lifecycle, admission
+    # quota, deadline class, and breaker/degraded-mode; None keeps the
+    # single-tenant topology bit-identical to round 15
+    tenants_path: str | None = None
+    tenants: Any = None
     # background audit scanner (audit/scanner.py): 'interval' sweeps the
     # dirty set on a cadence AND fully on every epoch promotion,
     # 'on-promote' sweeps fully on epoch flips only, 'off' disables the
@@ -402,6 +409,14 @@ class Config:
             raise ValueError(
                 "--reload-divergence-threshold must be in [0, 1]"
             )
+        if self.tenants is not None:
+            from policy_server_tpu.tenancy import TenantManifest
+
+            if not isinstance(self.tenants, TenantManifest):
+                raise ValueError(
+                    "config.tenants must be a tenancy.TenantManifest "
+                    "(use read_tenants_file)"
+                )
         if self.mesh_dispatch not in ("fused", "threaded"):
             raise ValueError(
                 f"invalid mesh dispatch {self.mesh_dispatch!r} "
@@ -516,6 +531,8 @@ class Config:
             ),
             reload_admin_token=args.reload_admin_token or None,
             policies_path=str(policies_path) if policies_path.exists() else None,
+            tenants_path=args.tenants or None,
+            tenants=_read_tenants(args.tenants),
             audit_mode=args.audit_mode,
             audit_interval_seconds=float(args.audit_interval_seconds),
             audit_batch_size=int(args.audit_batch_size),
@@ -547,6 +564,15 @@ class Config:
         )
         cfg.validate()
         return cfg
+
+
+def _read_tenants(path: str | None):
+    """Parse the --tenants manifest (None passthrough)."""
+    if not path:
+        return None
+    from policy_server_tpu.tenancy import read_tenants_file
+
+    return read_tenants_file(path)
 
 
 def read_policies_file(path: str | Path) -> dict[str, PolicyOrPolicyGroup]:

@@ -1974,6 +1974,13 @@ class EvaluationEnvironment:
                 self.breaker.record_failure()
             raise
 
+    def _scoped_device_fetch(self, scope_name: str | None, dev_out: Any):
+        """_device_fetch on a drain-pool thread, re-applying the
+        submitter's ambient failpoint scope — tenant-scoped chaos
+        (failpoints.scope) must cross the pool boundary with the work."""
+        with failpoints.scope(scope_name):
+            return self._device_fetch(dev_out)
+
     def _device_fetch(self, dev_out: Any) -> Any:
         """The choke point every device RESULT FETCH goes through (plain
         run_batch and the native pipeline's drain futures): fires the
@@ -3103,7 +3110,10 @@ class EvaluationEnvironment:
                 dispatched_rows=n_dispatched, dispatched_chunks=1
             )
             entry = (
-                self._drain_pool.submit(self._device_fetch, dev_out),
+                self._drain_pool.submit(
+                    self._scoped_device_fetch,
+                    failpoints.current_scope(), dev_out,
+                ),
                 slot_rows,
                 stash,
                 lru_inserts,

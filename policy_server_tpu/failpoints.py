@@ -23,6 +23,15 @@ Activation, either:
   installs any callable — an Event-gated hang, a custom exception —
   or use the :func:`active` context manager for scoped injection.
 
+**Tenant scoping** (round 16): multi-tenant chaos needs a fault that
+hits ONE tenant's serving path while the others share the same process
+and the same ``fire`` sites. ``set_failpoint(..., scope="tenant-a")``
+arms the action only for threads whose ambient failpoint scope (a
+thread-local the batcher/lifecycle set around tenant-owned work via
+:func:`scope`) matches; unscoped failpoints fire everywhere, preserving
+every existing arming. Scope propagation is explicit — the code that
+hands tenant work to another thread wraps it in ``with scope(name):``.
+
 Sites instrumented (grep for ``failpoints.fire``):
 
 ==================  =====================================================
@@ -58,6 +67,16 @@ Sites instrumented (grep for ``failpoints.fire``):
                     fault between framing and admission; every request
                     of the poll burst answers an in-band 500 instead of
                     stranding, and the drainer keeps running
+``tenant.reload``   per-tenant policies.yml re-read at the head of a
+                    tenant's reload pipeline (tenancy.py read_policies
+                    closure) — ``raise`` = one tenant's manifest became
+                    unreadable; THAT tenant rejects at the fetch stage
+                    and keeps serving last-good, every other tenant's
+                    reload (e.g. the same SIGHUP) proceeds untouched
+``tenant.admission`` per-tenant admission quota check (tenancy.py
+                    TenantAdmission.admit) — ``raise`` = an admission-
+                    layer fault for one tenant; its requests answer
+                    in-band errors while other tenants admit normally
 ==================  =====================================================
 
 Every fire is counted (``fired_count(site)``) so chaos tests can assert
@@ -79,11 +98,17 @@ class FailpointError(Exception):
 
 
 class _Point:
-    __slots__ = ("fn", "remaining")
+    __slots__ = ("fn", "remaining", "scope")
 
-    def __init__(self, fn: Callable[[], None], remaining: int | None):
+    def __init__(
+        self,
+        fn: Callable[[], None],
+        remaining: int | None,
+        scope: str | None = None,
+    ):
         self.fn = fn
         self.remaining = remaining  # None = unlimited
+        self.scope = scope  # None = fire for every thread
 
 
 _lock = threading.Lock()
@@ -108,6 +133,8 @@ def _fire_slow(site: str) -> None:
         point = _points.get(site)
         if point is None:
             return
+        if point.scope is not None and point.scope != current_scope():
+            return  # scoped to another tenant's threads: no-op
         if point.remaining is not None:
             if point.remaining <= 0:
                 return
@@ -123,14 +150,56 @@ def _fire_slow(site: str) -> None:
 
 
 def set_failpoint(
-    site: str, fn: Callable[[], None], count: int | None = None
+    site: str,
+    fn: Callable[[], None],
+    count: int | None = None,
+    scope: str | None = None,
 ) -> None:
     """Install a callable to run on every ``fire(site)`` (at most
-    ``count`` times when given)."""
+    ``count`` times when given; only for threads whose ambient
+    failpoint scope matches when ``scope`` is given — the multi-tenant
+    chaos knob)."""
     global _armed
     with _lock:
-        _points[site] = _Point(fn, count)
+        _points[site] = _Point(fn, count, scope)
         _armed = True
+
+
+# -- tenant scoping (thread-local ambient scope) ----------------------------
+
+_tls = threading.local()
+
+
+def current_scope() -> str | None:
+    """The calling thread's ambient failpoint scope (None outside any
+    ``with scope(...)`` block)."""
+    return getattr(_tls, "scope", None)
+
+
+class scope:
+    """Set the ambient failpoint scope for the calling thread::
+
+        with failpoints.scope("tenant-a"):
+            ...  # scoped failpoints for tenant-a fire here
+
+    Nests (the previous scope is restored on exit); a ``None`` name is a
+    no-op passthrough so call sites need no conditional."""
+
+    __slots__ = ("name", "_prev")
+
+    def __init__(self, name: str | None):
+        self.name = name
+        self._prev: str | None = None
+
+    def __enter__(self) -> "scope":
+        self._prev = getattr(_tls, "scope", None)
+        if self.name is not None:
+            _tls.scope = self.name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.name is not None:
+            _tls.scope = self._prev
 
 
 def clear(site: str | None = None) -> None:
@@ -164,14 +233,19 @@ class active:
     """
 
     def __init__(
-        self, site: str, fn: Callable[[], None], count: int | None = None
+        self,
+        site: str,
+        fn: Callable[[], None],
+        count: int | None = None,
+        scope: str | None = None,
     ):
         self.site = site
         self.fn = fn
         self.count = count
+        self.scope = scope
 
     def __enter__(self) -> "active":
-        set_failpoint(self.site, self.fn, self.count)
+        set_failpoint(self.site, self.fn, self.count, scope=self.scope)
         return self
 
     def __exit__(self, *exc) -> None:

@@ -189,8 +189,13 @@ class PolicyLifecycleManager:
         canary_requests: int = 64,
         divergence_threshold: float = 0.0,
         warmup: bool = True,
+        tenant: str = "default",
     ) -> None:
         self.state = state
+        # the tenant this lifecycle serves (round 16, tenancy.py): names
+        # the ambient failpoint scope its reload/canary threads carry so
+        # chaos can fault ONE tenant's pipeline, and labels log lines
+        self.tenant = tenant
         self._build_environment = build_environment
         self._build_oracle_environment = build_oracle_environment
         self._build_batcher = build_batcher
@@ -355,6 +360,16 @@ class PolicyLifecycleManager:
         ``"promoted"`` or ``"staged"`` (manual mode); raises
         :class:`ReloadRejected` when the candidate is rejected — the
         current epoch is untouched in every failure mode."""
+        # the whole pipeline runs under this tenant's failpoint scope so
+        # a tenant-scoped reload fault hits only THIS tenant's pipeline
+        with failpoints.scope(self.tenant):
+            return self._reload_scoped(policies, reason)
+
+    def _reload_scoped(
+        self,
+        policies: Mapping[str, Any] | None,
+        reason: str,
+    ) -> str:
         with self._reload_lock:
             if self._stop.is_set():
                 raise ReloadRejected("shutdown", "lifecycle shutting down")
@@ -516,7 +531,10 @@ class PolicyLifecycleManager:
                 if not future.set_running_or_notify_cancel():
                     return
                 try:
-                    future.set_result(replay())
+                    # the canary replays on a FRESH thread: the tenant
+                    # failpoint scope must travel with it
+                    with failpoints.scope(self.tenant):
+                        future.set_result(replay())
                 except BaseException as e:  # noqa: BLE001 — future carries
                     future.set_exception(e)
 

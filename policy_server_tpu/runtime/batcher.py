@@ -41,6 +41,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any
 
+from policy_server_tpu import failpoints
 from policy_server_tpu.api import service
 from policy_server_tpu.evaluation.environment import (
     EvaluationEnvironment,
@@ -104,6 +105,11 @@ class _Pending:
     # one future resolution per row
     sink: Any = None
     token: Any = None
+    # tenant admission accounting (round 16): the TenantAdmission this
+    # row was counted against, cleared by the FIRST resolution so the
+    # in-flight cap releases exactly once per row; None when no quota
+    # applies (every single-tenant deployment)
+    quota_token: Any = None
 
 
 def _set_many(items: list) -> None:
@@ -207,8 +213,23 @@ class MicroBatcher:
         degraded_mode: str = "oracle",
         shadow_recorder: Any = None,
         audit_tracker: Any = None,
+        admission: Any = None,
+        scheduler: Any = None,
+        tenant: str = "default",
     ) -> None:
         self.env = env
+        # -- multi-tenant serving (round 16, tenancy.py) ------------------
+        # admission: the tenant's TenantAdmission quota (token-bucket
+        # rows/s + in-flight cap), consulted once per submit burst;
+        # scheduler: the process-wide FairDispatchScheduler every tenant
+        # batcher acquires a dispatch slot from (live > weighted shares >
+        # audit); tenant: this batcher's tenant name — also the ambient
+        # failpoint scope its evaluation threads carry so chaos can fault
+        # ONE tenant. All None/"default" on single-tenant deployments:
+        # the dispatch path is then bit-identical to round 15.
+        self.admission = admission
+        self.scheduler = scheduler
+        self.tenant = tenant
         # policy-lifecycle shadow recorder (lifecycle.ShadowRecorder):
         # every formed batch's (policy_id, request) pairs feed the
         # hot-reload canary's replay ring. None = disabled (no reload
@@ -489,6 +510,41 @@ class MicroBatcher:
                 self.shed_requests += 1
             raise ShedError(est)
 
+    def _admit_quota(self, pendings: list["_Pending"]) -> None:
+        """Tenant admission (round 16): count the burst against the
+        tenant's token bucket + in-flight cap; a denial raises ShedError
+        (HTTP 429 + Retry-After) and counts into BOTH the tenant-
+        labelled admission counters and this batcher's shed counter.
+        No-op without an admission quota (single-tenant deployments)."""
+        adm = self.admission
+        if adm is None:
+            return
+        try:
+            adm.admit(len(pendings))
+        except ShedError:
+            with self._stats_lock:
+                self.shed_requests += len(pendings)
+            raise
+        for p in pendings:
+            p.quota_token = adm
+
+    @staticmethod
+    def _release_quota(p: "_Pending") -> None:
+        """Release one admitted row's in-flight claim exactly once (the
+        first resolution clears the token; TenantAdmission floors at
+        zero so the rare shutdown double-resolve stays harmless)."""
+        tok = p.quota_token
+        if tok is not None:
+            p.quota_token = None
+            tok.release(1)
+
+    def _scoped(self, fn, *args, **kwargs):
+        """Run ``fn`` under this batcher's tenant failpoint scope —
+        evaluation work crosses to pool threads, and tenant-scoped chaos
+        (failpoints.scope) must travel with it."""
+        with failpoints.scope(self.tenant):
+            return fn(*args, **kwargs)
+
     def warmup(self) -> None:
         """Compile every batch bucket at boot (reference precompiles all
         policies via rayon at boot, src/lib.rs:287-307) and seed the
@@ -542,6 +598,7 @@ class MicroBatcher:
             self._reject_stopping(pending)
             return pending.future
         self._shed_check(pending)
+        self._admit_quota([pending])
         self._put_waiting(pending)
         return pending.future
 
@@ -625,6 +682,7 @@ class MicroBatcher:
             self._reject_stopping(pending)
             return pending.future
         self._shed_check(pending)
+        self._admit_quota([pending])
         try:
             self._queue.put_nowait(pending)
             # same stranding window as _put_waiting: shutdown may have
@@ -705,6 +763,15 @@ class MicroBatcher:
                 for p in pendings:
                     self._fail(p, err)
                 return futures
+        if self.admission is not None:
+            try:
+                self._admit_quota(pendings)
+            except ShedError as err:
+                # a bulk call cannot raise per row: resolve the whole
+                # burst with the same 429 the per-row path raises
+                for p in pendings:
+                    self._fail(p, err)
+                return futures
         overflow = self._put_burst(pendings)
         # same stranding window as submit_nowait: shutdown may have
         # finished both drains between the check above and the burst put
@@ -766,6 +833,7 @@ class MicroBatcher:
             self._reject_stopping(pending)
             return pending.aio_future
         self._shed_check(pending)
+        self._admit_quota([pending])
         try:
             self._queue.put_nowait(pending)
             # same stranding window as the sync path (_put_waiting):
@@ -936,18 +1004,51 @@ class MicroBatcher:
                     RuntimeError("batcher shutting down; audit lane closed")
                 )
                 return
+            sched = self.scheduler
+            granted = False
+            if sched is not None:
+                # multi-tenant (round 16): audit also yields CROSS-tenant
+                # — the AUDIT priority class is granted only behind every
+                # live waiter; a bounded wait re-queues at the lane head
+                # (counted as a preemption) instead of camping on a slot
+                from policy_server_tpu.runtime import scheduler as _fair
+
+                granted = sched.acquire(
+                    self.tenant, _fair.AUDIT, timeout=0.5,
+                    should_abort=lambda: self._stopping,
+                )
+                if not granted:
+                    if self._stopping:
+                        job.future.set_exception(
+                            RuntimeError(
+                                "batcher shutting down; audit lane closed"
+                            )
+                        )
+                        return
+                    with self._stats_lock:
+                        self.audit_preemptions += 1
+                    with self._audit_lock:
+                        self._audit_jobs.appendleft(job)
+                    return
             try:
-                # raw verdicts (audit-origin semantics: constraints never
-                # applied); run_hooks=False — the scan judges policy
-                # logic, not hook latency, exactly like the reload canary
-                results = self.env.validate_batch(job.pairs, run_hooks=False)
-            except Exception as e:  # noqa: BLE001 — the job carries it
-                job.future.set_exception(e)
-                return
-            with self._stats_lock:
-                self.audit_batches_dispatched += 1
-                self.audit_rows_dispatched += len(job.pairs)
-            job.future.set_result(results)
+                try:
+                    # raw verdicts (audit-origin semantics: constraints
+                    # never applied); run_hooks=False — the scan judges
+                    # policy logic, not hook latency, exactly like the
+                    # reload canary
+                    results = self._scoped(
+                        self.env.validate_batch, job.pairs, run_hooks=False
+                    )
+                except Exception as e:  # noqa: BLE001 — the job carries it
+                    job.future.set_exception(e)
+                    return
+                with self._stats_lock:
+                    self.audit_batches_dispatched += 1
+                    self.audit_rows_dispatched += len(job.pairs)
+                job.future.set_result(results)
+            finally:
+                if granted:
+                    sched.release(self.tenant)
         finally:
             with self._audit_lock:
                 self._audit_inflight = False
@@ -1022,7 +1123,9 @@ class MicroBatcher:
 
     def _process_batch(self, batch: list[_Pending]) -> None:
         try:
-            self._dispatch(batch)
+            # the tenant failpoint scope rides the batch worker thread
+            # (tenant-scoped chaos, failpoints.scope)
+            self._scoped(self._dispatch, batch)
         except Exception as e:  # noqa: BLE001 — last-resort guard
             for p in batch:
                 self._fail(p, e)
@@ -1046,6 +1149,7 @@ class MicroBatcher:
         (the webhook caller timing out mid-batch must never take down the
         dispatch thread). Sink rows (submit_many) accumulate into the
         delivery batch instead — one sink call per batch."""
+        self._release_quota(p)
         if p.sink is not None:
             if delivery is not None:
                 delivery.add_sink(p, response, None)
@@ -1064,6 +1168,7 @@ class MicroBatcher:
         exc: BaseException,
         delivery: _DeliveryBatch | None = None,
     ) -> None:
+        self._release_quota(p)
         if p.sink is not None:
             if delivery is not None:
                 delivery.add_sink(p, None, exc)
@@ -1301,7 +1406,34 @@ class MicroBatcher:
         delivery.flush()
         if not runnable:
             return
+        sched = self.scheduler
+        if sched is None:
+            # single-tenant: no slot gate — the round-15 path, unchanged
+            self._evaluate_runnable(runnable)
+            return
+        from policy_server_tpu.runtime import scheduler as _fair
 
+        # Weighted-fair dispatch slot (live class, round 16): a tenant
+        # past its share waits HERE, burning its own requests' deadline
+        # budget while other tenants' batches keep flowing — the
+        # noisy-neighbor containment point for shared device/CPU time.
+        if not sched.acquire(
+            self.tenant, _fair.LIVE,
+            should_abort=lambda: self._stopping,
+        ):
+            for p in runnable:
+                self._reject_stopping(p)
+            return
+        try:
+            self._evaluate_runnable(runnable)
+        finally:
+            sched.release(self.tenant)
+
+    def _evaluate_runnable(self, runnable: list[_Pending]) -> None:
+        """Phases 2-3 for a formed batch's runnable rows: degraded-mode
+        gate, host/device dispatch under the watchdog, service-layer
+        post-processing. Split from :meth:`_dispatch` so the round-16
+        fair scheduler brackets exactly the shared evaluation work."""
         # Degraded-mode gate: with every shard's breaker open and a
         # non-default policy, answer per --degraded-mode instead of
         # evaluating (the default 'oracle' keeps evaluating — the
@@ -1418,7 +1550,7 @@ class MicroBatcher:
             live = runnable
             if begin_fn is not None:
                 enc_future = self._encode_pool.submit(
-                    begin_fn, pairs, run_hooks=False
+                    self._scoped, begin_fn, pairs, run_hooks=False
                 )
                 try:
                     handle, live = self._watchdog_wait(enc_future, runnable)
@@ -1444,10 +1576,11 @@ class MicroBatcher:
                     return
             if handle is not None:
                 dev_future = self._device_pool.submit(
-                    self.env.validate_batch_finish, handle
+                    self._scoped, self.env.validate_batch_finish, handle
                 )
             elif use_host:
                 dev_future = self._device_pool.submit(
+                    self._scoped,
                     self.env.validate_batch,
                     pairs,
                     run_hooks=False,
@@ -1457,7 +1590,8 @@ class MicroBatcher:
                 # non-native environment (begin unavailable or returned
                 # None): the single-call path, still watchdog-bounded
                 dev_future = self._device_pool.submit(
-                    self.env.validate_batch, pairs, run_hooks=False
+                    self._scoped, self.env.validate_batch, pairs,
+                    run_hooks=False,
                 )
             try:
                 results, live = self._watchdog_wait(dev_future, live)

@@ -245,6 +245,28 @@ class EvaluationBridge:
             )
             await writer.drain()
 
+    def _route_tenant(self, policy_id: str):
+        """Tenant routing over the bridge (round 16, tenancy.py): the
+        worker forwards tenant-routed paths as ``"tenant/policy"`` in
+        the policy-id field; the shared registry helper resolves to
+        THAT tenant's batcher. Returns ``(batcher, bare_policy_id,
+        None)`` or ``(None, _, 404 body)`` with the same body the
+        in-process aiohttp router answers."""
+        from policy_server_tpu.api.api_error import api_error_body
+        from policy_server_tpu.tenancy import (
+            resolve_tenant_batcher,
+            unknown_tenant_message,
+        )
+
+        batcher, pid, unknown = resolve_tenant_batcher(
+            self.state, policy_id
+        )
+        if batcher is None:
+            return None, pid, api_error_body(
+                404, unknown_tenant_message(unknown)
+            )
+        return batcher, pid, None
+
     async def _evaluate_parsed(
         self,
         origin_code: int,
@@ -256,6 +278,9 @@ class EvaluationBridge:
         from policy_server_tpu.api.service import RequestOrigin
         from policy_server_tpu.models import AdmissionReviewResponse
 
+        batcher, policy_id, not_found = self._route_tenant(policy_id)
+        if batcher is None:
+            return 404, not_found
         request = WireValidateRequest(header, payload)
         origin = (
             RequestOrigin.AUDIT
@@ -263,7 +288,7 @@ class EvaluationBridge:
             else RequestOrigin.VALIDATE
         )
         result = await handlers._evaluate(  # noqa: SLF001 — same package
-            self.state, policy_id, request, origin
+            batcher, policy_id, request, origin
         )
         if hasattr(result, "status") and hasattr(result, "body"):
             return result.status, result.body or b""  # mapped error
@@ -317,8 +342,11 @@ class EvaluationBridge:
             if origin_code == ORIGIN_AUDIT
             else RequestOrigin.VALIDATE
         )
+        batcher, policy_id, not_found = self._route_tenant(policy_id)
+        if batcher is None:
+            return 404, not_found
         result = await handlers._evaluate(  # noqa: SLF001 — same package
-            self.state, policy_id, request, origin
+            batcher, policy_id, request, origin
         )
         if hasattr(result, "status") and hasattr(result, "body"):
             return result.status, result.body or b""  # mapped error
@@ -454,7 +482,7 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
         )
 
         async def handler(request: web.Request) -> web.Response:
-            policy_id = request.match_info["policy_id"]
+            policy_id = _wire_policy_id(request)
             body = await request.read()
             try:
                 review = parse_admission_review_bytes(body)
@@ -501,7 +529,7 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
         return handler
 
     async def raw_handler(request: web.Request) -> web.Response:
-        policy_id = request.match_info["policy_id"]
+        policy_id = _wire_policy_id(request)
         body = await request.read()
         with span(
             "validation_raw", host=hostname, policy_id=policy_id
@@ -532,7 +560,25 @@ def build_worker_app(bridge: BridgeClient, hostname: str):
         "/audit/{policy_id}",
         make_admission_handler(ORIGIN_AUDIT_PARSED, "audit"),
     )
+    # tenant-routed surface (round 16): the tenant travels to the
+    # evaluation process inside the policy-id field ("tenant/policy");
+    # the bridge resolves it to that tenant's batcher and answers
+    # unknown tenants with the in-process 404 body
+    validate_h = make_admission_handler(ORIGIN_VALIDATE_PARSED, "validation")
+    audit_h = make_admission_handler(ORIGIN_AUDIT_PARSED, "audit")
+    app.router.add_post("/validate/{tenant}/{policy_id}", validate_h)
+    app.router.add_post("/validate_raw/{tenant}/{policy_id}", raw_handler)
+    app.router.add_post("/audit/{tenant}/{policy_id}", audit_h)
     return app
+
+
+def _wire_policy_id(request: web.Request) -> str:
+    """The policy-id field as it crosses the bridge: tenant-routed
+    paths encode as ``"tenant/policy"`` (split again on the evaluation
+    side), un-prefixed paths stay the bare id."""
+    policy_id = request.match_info["policy_id"]
+    tenant = request.match_info.get("tenant")
+    return policy_id if tenant is None else f"{tenant}/{policy_id}"
 
 
 async def worker_main(

@@ -542,8 +542,10 @@ def _shed_body(retry_after: int) -> bytes:
 
 
 def _api_error_body(status: int, message: str) -> bytes:
-    # byte parity with api/api_error.api_error
-    return json.dumps({"message": message, "status": status}).encode()
+    # byte parity with api/api_error.api_error — one shared builder
+    from policy_server_tpu.api.api_error import api_error_body
+
+    return api_error_body(status, message)
 
 
 def _verdict_is_native(r: Any) -> bool:
@@ -579,21 +581,52 @@ class BatcherSink:
         # raw_shape). The frontend rides in the token (not on self) so an
         # epoch flip or multi-frontend embedding can never cross wires.
 
+    def _route(self, policy_id: str):
+        """Tenant routing (round 16, tenancy.py): a two-segment id
+        ("tenant/policy" — the C++ router passes it through verbatim)
+        resolves through the shared registry helper to THAT tenant's
+        batcher; bare ids keep the default epoch pointer. Returns
+        ``(batcher, bare_policy_id, None)`` or ``(None, _, 404 body)``
+        — the 404 text is shared with the aiohttp router so both
+        frontends answer unknown tenants byte-identically. Hot-path
+        discipline: this runs per RECORD of every poll burst, so the
+        single-tenant common case is one substring test."""
+        if "/" not in policy_id:
+            return self.state.batcher, policy_id, None
+        from policy_server_tpu.tenancy import (
+            resolve_tenant_batcher,
+            unknown_tenant_message,
+        )
+
+        batcher, pid, unknown = resolve_tenant_batcher(
+            self.state, policy_id
+        )
+        if batcher is None:
+            return None, pid, _api_error_body(
+                404, unknown_tenant_message(unknown)
+            )
+        return batcher, pid, None
+
     def handle_burst(
         self, frontend: NativeFrontend, burst: list[tuple]
     ) -> None:
-        """One poll burst → at most one submit_many per origin; fallback
-        records (Python parse oracle, raw shapes) keep their per-record
-        path — they are the rare tail by construction."""
+        """One poll burst → at most one submit_many per (tenant batcher,
+        origin) group; fallback records (Python parse oracle, raw
+        shapes) keep their per-record path — they are the rare tail by
+        construction."""
         from policy_server_tpu.api.service import RequestOrigin
         from policy_server_tpu.runtime.frontend import WireValidateRequest
 
-        items: list = []
-        tokens: list = []
-        audit_items: list = []
-        audit_tokens: list = []
+        # (id(batcher), origin) → [batcher, origin, items, tokens] — one
+        # bulk admission per serving batcher per burst; the single-tenant
+        # common case degenerates to the historical one-group-per-origin
+        groups: dict = {}
         for req_id, kind, policy_id, uid, ns, op, gvk, payload in burst:
             if kind in (K_VALIDATE, K_AUDIT):
+                batcher, pid, not_found = self._route(policy_id)
+                if batcher is None:
+                    frontend.complete(req_id, 404, not_found)
+                    continue
                 header = {
                     "uid": uid,
                     "namespace": ns,
@@ -601,12 +634,15 @@ class BatcherSink:
                     "kind": gvk or None,
                 }
                 request: Any = WireValidateRequest(header, payload)
-                if kind == K_AUDIT:
-                    audit_items.append((policy_id, request))
-                    audit_tokens.append((frontend, req_id, False))
-                else:
-                    items.append((policy_id, request))
-                    tokens.append((frontend, req_id, False))
+                origin = (
+                    RequestOrigin.AUDIT if kind == K_AUDIT
+                    else RequestOrigin.VALIDATE
+                )
+                g = groups.setdefault(
+                    (id(batcher), origin), [batcher, origin, [], []]
+                )
+                g[2].append((pid, request))
+                g[3].append((frontend, req_id, False))
             else:
                 try:
                     self._handle_fallback(
@@ -620,18 +656,10 @@ class BatcherSink:
                         _api_error_body(500, "Something went wrong"),
                     )
         # per-submission containment: a failure admitting one group must
-        # answer only ITS records — the other group may already be
+        # answer only ITS records — another group may already be
         # submitted (double-completing admitted rows would race their
         # real verdicts), and fallback records above already answered
-        batcher = self.state.batcher
-        for group, origin in (
-            (list(zip(items, tokens)), RequestOrigin.VALIDATE),
-            (list(zip(audit_items, audit_tokens)), RequestOrigin.AUDIT),
-        ):
-            if not group:
-                continue
-            g_items = [it for it, _ in group]
-            g_tokens = [tok for _, tok in group]
+        for batcher, origin, g_items, g_tokens in groups.values():
             try:
                 batcher.submit_many(
                     g_items, origin, sink=self, tokens=g_tokens
@@ -700,8 +728,12 @@ class BatcherSink:
     ) -> None:
         from policy_server_tpu.runtime.batcher import ShedError
 
+        batcher, policy_id, not_found = self._route(policy_id)
+        if batcher is None:
+            frontend.complete(req_id, 404, not_found)
+            return
         try:
-            fut = self.state.batcher.submit_nowait(policy_id, request, origin)
+            fut = batcher.submit_nowait(policy_id, request, origin)
         except ShedError as e:
             retry = max(1, math.ceil(e.retry_after_seconds))
             frontend.complete(req_id, 429, _shed_body(retry), retry)

@@ -1,0 +1,201 @@
+"""Weighted-fair dispatch scheduler — the multi-tenant generalization of
+the micro-batcher's best-effort audit lane (round 16).
+
+One process now serves N tenants, each with its own
+environment/batcher stack (tenancy.py), but the device and the host
+CPU are SHARED. This scheduler is the one arbitration point: every
+tenant batcher acquires a dispatch slot before running a batch's
+evaluation phases, and the audit lane acquires at a strictly lower
+priority class. The grant order is:
+
+* **live before audit** — any waiting live batch is granted before any
+  audit batch, always (the round-10 contract, now cross-tenant);
+* **weighted shares among tenants** — live waiters are granted by
+  virtual-time stride scheduling: each grant advances the tenant's
+  virtual clock by ``1/weight``, and the waiter with the LOWEST virtual
+  time wins, so over any contention window tenant grant counts converge
+  to their weight ratio. A tenant going idle does not bank credit: on
+  grant its clock is floored to the minimum active clock, so a
+  returning tenant gets its fair share going FORWARD, never a burst of
+  accumulated arrears that would starve everyone else.
+
+With no scheduler attached (every single-tenant deployment) the
+batcher's dispatch path is bit-identical to round 15 — the field is
+``None`` and never consulted beyond one attribute test per batch.
+
+Accounting (the per-tenant queue accounting of the round-16 tentpole):
+grants, cumulative slot-wait seconds, and instantaneous waiter depth
+per tenant, exported tenant-labelled on /metrics.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+# priority classes (grant order: lower value first)
+LIVE = 0
+AUDIT = 1
+
+# bounded condition-wait slice: every waiter re-checks cancellation
+# within one slice, so shutdown can never strand a blocked acquire
+_WAIT_SLICE_SECONDS = 0.05
+
+
+class _Waiter:
+    __slots__ = ("tenant", "priority", "seq", "granted")
+
+    def __init__(self, tenant: str, priority: int, seq: int):
+        self.tenant = tenant
+        self.priority = priority
+        self.seq = seq
+        self.granted = False
+
+
+class FairDispatchScheduler:
+    """Weighted-fair slot gate shared by every tenant's batcher (see
+    module docstring). ``max_concurrent`` bounds process-wide in-flight
+    batch evaluations — the shared-hardware analog of one batcher's
+    ``_inflight`` semaphore."""
+
+    def __init__(
+        self,
+        max_concurrent: int = 4,
+        weights: dict[str, float] | None = None,
+        default_weight: float = 1.0,
+    ) -> None:
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.default_weight = max(1e-6, float(default_weight))
+        self._weights = {
+            k: max(1e-6, float(v)) for k, v in (weights or {}).items()
+        }
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight = 0  # guarded-by: _lock
+        self._seq = itertools.count()  # guarded-by: _lock
+        self._waiters: list[_Waiter] = []  # guarded-by: _lock
+        self._vtime: dict[str, float] = {}  # guarded-by: _lock
+        # -- accounting (tenant-labelled /metrics families) ---------------
+        self._grants: dict[str, int] = {}  # guarded-by: _lock
+        self._wait_ns: dict[str, int] = {}  # guarded-by: _lock
+
+    def weight_of(self, tenant: str) -> float:
+        return self._weights.get(tenant, self.default_weight)
+
+    # -- the slot gate -----------------------------------------------------
+
+    def acquire(
+        self,
+        tenant: str,
+        priority: int = LIVE,
+        timeout: float | None = None,
+        should_abort=None,
+    ) -> bool:
+        """Block until a dispatch slot is granted; returns False on
+        timeout or when ``should_abort()`` turns true (shutdown). The
+        wait is sliced so cancellation is observed promptly."""
+        t0 = time.perf_counter()
+        deadline = None if timeout is None else t0 + timeout
+        # _cond shares _lock, so this block HOLDS _lock (cond.wait
+        # releases it only for the sleep itself)
+        with self._lock:
+            w = _Waiter(tenant, priority, next(self._seq))
+            self._waiters.append(w)
+            self._grant_locked()
+            while not w.granted:
+                if should_abort is not None and should_abort():
+                    self._abandon_locked(w)
+                    return False
+                wait = _WAIT_SLICE_SECONDS
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        self._abandon_locked(w)
+                        return False
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+            self._wait_ns[tenant] = self._wait_ns.get(tenant, 0) + int(
+                (time.perf_counter() - t0) * 1e9
+            )
+            return True
+
+    def release(self, tenant: str) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._grant_locked()
+            self._cond.notify_all()
+
+    def _abandon_locked(self, w: _Waiter) -> None:
+        # holds: _lock — the caller observed granted=False under this
+        # same lock, so the waiter is still queued (the defensive except
+        # guards nothing today but keeps removal shutdown-proof)
+        try:
+            self._waiters.remove(w)
+        except ValueError:
+            pass
+
+    def _grant_locked(self) -> None:
+        # holds: _lock — grant slots to the best waiters until the cap
+        # is reached or nobody waits
+        granted_any = False
+        while self._inflight < self.max_concurrent and self._waiters:
+            best = min(
+                self._waiters,
+                key=lambda w: (
+                    w.priority,
+                    self._vtime.get(w.tenant, 0.0),
+                    w.seq,
+                ),
+            )
+            self._waiters.remove(best)
+            best.granted = True
+            self._inflight += 1
+            granted_any = True
+            if best.priority == LIVE:
+                # stride scheduling: advance the winner's virtual clock
+                # by 1/weight, floored to the minimum ACTIVE clock so an
+                # idle tenant returns at parity instead of with banked
+                # arrears. AUDIT grants deliberately do NOT charge this
+                # clock: audit only ever wins an otherwise-idle slot,
+                # and billing it against the tenant's LIVE share would
+                # let a quiet-window audit sweep starve that tenant's
+                # next live burst.
+                floor = min(
+                    (
+                        self._vtime.get(w.tenant, 0.0)
+                        for w in self._waiters
+                        if w.priority == LIVE
+                    ),
+                    default=self._vtime.get(best.tenant, 0.0),
+                )
+                self._vtime[best.tenant] = (
+                    max(self._vtime.get(best.tenant, 0.0), floor)
+                    + 1.0 / self.weight_of(best.tenant)
+                )
+            self._grants[best.tenant] = (
+                self._grants.get(best.tenant, 0) + 1
+            )
+        if granted_any:
+            self._cond.notify_all()
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict[str, dict]:
+        """One locked snapshot: per-tenant grants / cumulative wait /
+        instantaneous waiter depth (the /metrics scrape's view)."""
+        with self._lock:
+            depth: dict[str, int] = {}
+            for w in self._waiters:
+                depth[w.tenant] = depth.get(w.tenant, 0) + 1
+            tenants = (
+                set(self._grants) | set(self._wait_ns) | set(depth)
+            )
+            return {
+                t: {
+                    "grants": self._grants.get(t, 0),
+                    "wait_ns": self._wait_ns.get(t, 0),
+                    "waiting": depth.get(t, 0),
+                }
+                for t in tenants
+            }

@@ -259,9 +259,60 @@ class PolicyServer:
             if config.audit_resources_file:
                 snapshot_store.seed_from_file(config.audit_resources_file)
 
-        def build_batcher(env) -> MicroBatcher:
-            """One batcher construction path for boot AND every reload
-            epoch — the knobs must not drift between generations."""
+        # multi-tenant scaffolding (round 16, tenancy.py): the shared
+        # weighted-fair dispatch scheduler and the default tenant's
+        # admission quota exist BEFORE the default batcher is built so
+        # the default tenant rides the same machinery as named tenants.
+        # Without a manifest both stay None and every batcher below is
+        # bit-identical to the single-tenant build.
+        tenants_manifest = config.tenants
+        fair_scheduler = None
+        default_admission = None
+        default_spec = None
+        if tenants_manifest is not None:
+            from policy_server_tpu.runtime.scheduler import (
+                FairDispatchScheduler,
+            )
+            from policy_server_tpu.tenancy import (
+                DEFAULT_TENANT,
+                TenantAdmission,
+            )
+
+            default_spec = tenants_manifest.default
+            weights = {
+                name: spec.weight
+                for name, spec in tenants_manifest.tenants.items()
+            }
+            weights[DEFAULT_TENANT] = default_spec.weight
+            fair_scheduler = FairDispatchScheduler(
+                max_concurrent=tenants_manifest.max_concurrent_dispatches,
+                weights=weights,
+            )
+            if (
+                default_spec.quota_rows_per_second > 0
+                or default_spec.max_inflight > 0
+            ):
+                default_admission = TenantAdmission(
+                    DEFAULT_TENANT,
+                    rows_per_second=default_spec.quota_rows_per_second,
+                    burst=default_spec.quota_burst,
+                    max_inflight=default_spec.max_inflight,
+                )
+
+        def make_batcher(
+            env, tenant_name, admission, spec, tenant_recorder, tracker
+        ) -> MicroBatcher:
+            """ONE batcher construction path for boot, every reload
+            epoch, and every tenant — the knobs must not drift between
+            generations. Per-tenant deadline class / degraded mode
+            override the process defaults when the spec carries them."""
+            request_timeout = config.request_timeout_ms
+            degraded = config.degraded_mode
+            if spec is not None:
+                if spec.request_timeout_ms is not None:
+                    request_timeout = spec.request_timeout_ms
+                if spec.degraded_mode is not None:
+                    degraded = spec.degraded_mode
             return MicroBatcher(
                 env,
                 max_batch_size=config.max_batch_size,
@@ -270,10 +321,21 @@ class PolicyServer:
                 queue_capacity=config.pool_size * config.max_batch_size,
                 host_fastpath_threshold=config.host_fastpath_threshold,
                 latency_budget_ms=config.latency_budget_ms,
-                request_timeout_ms=config.request_timeout_ms,
-                degraded_mode=config.degraded_mode,
-                shadow_recorder=recorder,
-                audit_tracker=snapshot_store,
+                request_timeout_ms=request_timeout,
+                degraded_mode=degraded,
+                shadow_recorder=tenant_recorder,
+                audit_tracker=tracker,
+                admission=admission,
+                scheduler=fair_scheduler,
+                tenant=tenant_name,
+            )
+
+        def build_batcher(env) -> MicroBatcher:
+            """The default tenant's batcher (also every reload epoch's,
+            via the lifecycle manager)."""
+            return make_batcher(
+                env, "default", default_admission, default_spec,
+                recorder, snapshot_store,
             )
 
         batcher = build_batcher(environment)
@@ -290,27 +352,28 @@ class PolicyServer:
             admin_token=config.reload_admin_token,
         )
 
+        import dataclasses
+
+        from policy_server_tpu.config.config import read_policies_file
+
+        def build_epoch_environment(policies):
+            return _build_environment(
+                dataclasses.replace(config, policies=dict(policies)),
+                builder_kwargs,
+            )
+
+        def build_oracle_environment(policies):
+            # the canary referee: the host-oracle backend over the
+            # SAME candidate set, sharing the boot module resolver
+            oracle_builder = EvaluationEnvironmentBuilder(
+                backend="oracle",
+                continue_on_errors=config.continue_on_errors,
+                **builder_kwargs,
+            )
+            return oracle_builder.build(dict(policies))
+
         if reload_enabled:
-            import dataclasses
-
-            from policy_server_tpu.config.config import read_policies_file
             from policy_server_tpu.lifecycle import PolicyLifecycleManager
-
-            def build_epoch_environment(policies):
-                return _build_environment(
-                    dataclasses.replace(config, policies=dict(policies)),
-                    builder_kwargs,
-                )
-
-            def build_oracle_environment(policies):
-                # the canary referee: the host-oracle backend over the
-                # SAME candidate set, sharing the boot module resolver
-                oracle_builder = EvaluationEnvironmentBuilder(
-                    backend="oracle",
-                    continue_on_errors=config.continue_on_errors,
-                    **builder_kwargs,
-                )
-                return oracle_builder.build(dict(policies))
 
             read_policies = None
             if config.policies_path:
@@ -373,6 +436,109 @@ class PolicyServer:
                 )
                 state.audit.watch_feed = state.audit_watch
             state.audit.start()
+
+        if tenants_manifest is not None:
+            # -- named tenants (round 16, tenancy.py): one full epoch
+            # stack per tenant — own environment (verdict cache +
+            # breaker), own batcher (admission quota, deadline class,
+            # degraded mode), own lifecycle (reload/canary/rollback +
+            # digest watch on ITS policies file). All tenants' policy
+            # sets lower over the same device fleet/mesh; the fair
+            # scheduler time-shares dispatch slots between them. The
+            # audit scanner stays scoped to the DEFAULT tenant: named
+            # tenants' traffic never feeds its snapshot store.
+            from policy_server_tpu import failpoints
+            from policy_server_tpu.lifecycle import (
+                PolicyLifecycleManager,
+                ShadowRecorder,
+            )
+            from policy_server_tpu.tenancy import (
+                Tenant,
+                TenantAdmission,
+                TenantManager,
+                TenantState,
+            )
+
+            manager = TenantManager(scheduler=fair_scheduler)
+            manager.add(
+                Tenant(DEFAULT_TENANT, default_spec, state,
+                       default_admission)
+            )
+            for tenant_name, spec in tenants_manifest.tenants.items():
+                t_policies = read_policies_file(spec.policies_path)
+                t_admission = None
+                if spec.quota_rows_per_second > 0 or spec.max_inflight > 0:
+                    t_admission = TenantAdmission(
+                        tenant_name,
+                        rows_per_second=spec.quota_rows_per_second,
+                        burst=spec.quota_burst,
+                        max_inflight=spec.max_inflight,
+                    )
+                t_recorder = (
+                    ShadowRecorder(capacity=config.reload_canary_requests)
+                    if reload_enabled else None
+                )
+                t_env = build_epoch_environment(t_policies)
+                t_state = TenantState(name=tenant_name)
+
+                def t_build_batcher(
+                    env, _n=tenant_name, _a=t_admission, _s=spec,
+                    _r=t_recorder,
+                ):
+                    return make_batcher(env, _n, _a, _s, _r, None)
+
+                def t_read_policies(_spec=spec):
+                    # the tenant.reload chaos site: an armed fault here
+                    # rejects THIS tenant's reload at the fetch stage
+                    # (last-good keeps serving); other tenants' pipelines
+                    # are untouched
+                    failpoints.fire("tenant.reload")
+                    return read_policies_file(_spec.policies_path)
+
+                t_batcher = t_build_batcher(t_env)
+                if config.warmup_at_boot and config.evaluation_backend == "jax":
+                    t_batcher.warmup()
+                t_batcher.start()
+                if reload_enabled:
+                    t_state.lifecycle = PolicyLifecycleManager(
+                        state=t_state,
+                        build_environment=build_epoch_environment,
+                        build_oracle_environment=build_oracle_environment,
+                        build_batcher=t_build_batcher,
+                        recorder=t_recorder,
+                        read_policies=t_read_policies,
+                        policies_path=spec.policies_path,
+                        mode=config.policy_reload_mode,
+                        canary_requests=config.reload_canary_requests,
+                        divergence_threshold=(
+                            config.reload_divergence_threshold
+                        ),
+                        warmup=(
+                            config.warmup_at_boot
+                            and config.evaluation_backend == "jax"
+                        ),
+                        tenant=tenant_name,
+                    )
+                    t_state.lifecycle.install_first_epoch(
+                        t_env, t_batcher, t_policies
+                    )
+                    t_state.lifecycle.start_watching()
+                else:
+                    t_state.evaluation_environment = t_env
+                    t_state.batcher = t_batcher
+                    t_state.ready = True
+                manager.add(
+                    Tenant(tenant_name, spec, t_state, t_admission)
+                )
+                logger.info(
+                    "tenant serving", extra={"span_fields": {
+                        "tenant": tenant_name,
+                        "policies": len(t_policies),
+                        "weight": spec.weight,
+                        "quota_rows_per_second": spec.quota_rows_per_second,
+                    }},
+                )
+            state.tenants = manager
 
         def runtime_stats():
             # one locked snapshot per scrape: bare attribute reads from
@@ -877,6 +1043,70 @@ class PolicyServer:
                 "loudly warned)",
                 pstats.get("interpret_mode", 0),
             )
+            # Multi-tenant serving (round 16): tenant-labelled
+            # admission / fair-dispatch / lifecycle families. Sample
+            # lists are empty without a --tenants manifest (the families
+            # still export so dashboard panels resolve everywhere).
+            tmgr = state.tenants
+            tstats = tmgr.stats() if tmgr is not None else {}
+            yield (
+                metrics_names.TENANT_SHED_ROWS, "counter",
+                "Rows shed by a tenant's admission quota (token bucket "
+                "+ in-flight cap; 429 + Retry-After)",
+                tstats.get("shed_rows", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_ADMITTED_ROWS, "counter",
+                "Rows admitted through a tenant's admission quota",
+                tstats.get("admitted_rows", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_INFLIGHT_ROWS, "gauge",
+                "Admitted-but-unresolved rows per tenant (the "
+                "max-inflight cap's numerator)",
+                tstats.get("inflight_rows", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_QUEUE_DEPTH, "gauge",
+                "Requests waiting in each tenant batcher's submission "
+                "queue",
+                tstats.get("queue_depth", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_DISPATCH_GRANTS, "counter",
+                "Weighted-fair dispatch slots granted per tenant "
+                "(live + audit classes)",
+                tstats.get("dispatch_grants", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_DISPATCH_WAIT_SECONDS, "counter",
+                "Cumulative time each tenant's batches waited for a "
+                "fair-scheduler dispatch slot",
+                tstats.get("dispatch_wait_seconds", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_EPOCH, "gauge",
+                "Each tenant's currently serving policy epoch",
+                tstats.get("epoch", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_ROLLBACKS, "counter",
+                "Per-tenant reverts to last-good (rejected canaries + "
+                "explicit rollbacks)",
+                tstats.get("rollbacks", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANT_READY, "gauge",
+                "Per-tenant honest readiness (1 ready, 0 degraded — "
+                "the /readiness/{tenant} verdict)",
+                tstats.get("ready", []), ("tenant",),
+            )
+            yield (
+                metrics_names.TENANTS_SERVING, "gauge",
+                "Tenants served by this process (0 without a tenants "
+                "manifest; includes the default tenant otherwise)",
+                tstats.get("serving", 0),
+            )
             soak = getattr(state, "soak", None) or {}
             yield (
                 metrics_names.SOAK_WINDOW_RPS, "gauge",
@@ -1227,6 +1457,10 @@ class PolicyServer:
             # stop sweeping BEFORE epochs tear down: a sweep racing the
             # batcher shutdown would only burn its retry budget
             self.state.audit.shutdown()
+        if self.state.tenants is not None:
+            # named tenants tear down first (each lifecycle closes its
+            # own epochs); the default tenant follows the paths below
+            self.state.tenants.shutdown()
         if self.lifecycle is not None:
             # the lifecycle manager owns every epoch (current, pinned
             # previous, staged): one teardown path closes them all
@@ -1269,7 +1503,12 @@ class PolicyServer:
                 name="sighup-cert-reload",
                 daemon=True,
             ).start()
-        if self.lifecycle is not None:
+        if self.state.tenants is not None:
+            # multi-tenant: one SIGHUP kicks EVERY tenant's independent
+            # reload pipeline (the default included); each failure is
+            # contained to its tenant
+            self.state.tenants.reload_all("sighup")
+        elif self.lifecycle is not None:
             self.lifecycle.request_reload("sighup")
 
     async def run_async(self) -> None:

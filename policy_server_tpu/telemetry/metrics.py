@@ -146,6 +146,25 @@ PREDICATE_ROW_BYTES_SAVED = "policy_server_predicate_row_bytes_saved"
 PALLAS_DISPATCHES = "policy_server_pallas_dispatches"
 PALLAS_BUCKETS_ARMED = "policy_server_pallas_buckets_armed"
 PALLAS_INTERPRET_MODE = "policy_server_pallas_interpret_mode"
+# round 16 — multi-tenant serving (tenancy.py + runtime/scheduler.py):
+# tenant-labelled admission/quota/fair-dispatch/lifecycle families.
+# These are the first LABELLED runtime-stats families: the yield's
+# value is a [(label_values, value), ...] list and the 5th tuple
+# element names the label schema (("tenant",)) — see
+# _RuntimeStatsCollector. All empty (no samples) without a --tenants
+# manifest, so the families still export and dashboard panels resolve.
+TENANT_SHED_ROWS = "policy_server_tenant_shed_rows"
+TENANT_ADMITTED_ROWS = "policy_server_tenant_admitted_rows"
+TENANT_INFLIGHT_ROWS = "policy_server_tenant_inflight_rows"
+TENANT_QUEUE_DEPTH = "policy_server_tenant_queue_depth"
+TENANT_DISPATCH_GRANTS = "policy_server_tenant_dispatch_grants"
+TENANT_DISPATCH_WAIT_SECONDS = (
+    "policy_server_tenant_dispatch_wait_seconds_total"
+)
+TENANT_EPOCH = "policy_server_tenant_policy_epoch"
+TENANT_ROLLBACKS = "policy_server_tenant_reload_rollbacks"
+TENANT_READY = "policy_server_tenant_ready"
+TENANTS_SERVING = "policy_server_tenants_serving"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".
@@ -252,10 +271,22 @@ class _RuntimeStatsCollector:
             GaugeMetricFamily,
         )
 
-        for name, kind, help_text, value in fn():
-            family = (
+        for item in fn():
+            name, kind, help_text, value = item[:4]
+            cls = (
                 CounterMetricFamily if kind == "counter" else GaugeMetricFamily
-            )(name, help_text, value=value)
+            )
+            if len(item) > 4:
+                # labelled family (round 16): value is a list of
+                # (label_values_tuple, value) samples, item[4] names the
+                # label schema — e.g. ("tenant",). The OTLP converter
+                # walks the same registry, so labels flow through as
+                # attributes unchanged.
+                family = cls(name, help_text, labels=list(item[4]))
+                for label_values, v in value:
+                    family.add_metric([str(x) for x in label_values], v)
+            else:
+                family = cls(name, help_text, value=value)
             yield family
 
 

@@ -1,0 +1,538 @@
+"""Multi-tenant serving — N policy-sets on one fleet with hard
+noisy-neighbor isolation (round 16).
+
+The reference deploys one Deployment per PolicyServer CR, so tenant
+isolation is free but the fleet multiplies. Here the epoch machinery
+(lifecycle.py) already gives ONE policy set an isolated environment
+(its own XLA programs, verdict cache, and circuit breaker) plus its
+own micro-batcher; this module generalizes epochs into named
+**tenants** so one process — and one accelerator mesh — serves many
+clusters:
+
+* **Tenants manifest** (``--tenants tenants.yml``)::
+
+      tenants:
+        team-a:
+          policies: team-a-policies.yml   # relative to the manifest
+          weight: 2.0                     # weighted-fair dispatch share
+          quota-rows-per-second: 500      # token bucket; 0 = unlimited
+          quota-burst: 250                # bucket depth (default: rate)
+          max-inflight: 512               # admitted-unresolved cap; 0 = off
+          request-timeout-ms: 5000        # per-tenant deadline class
+          degraded-mode: reject           # per-tenant breaker fallback
+      default:                            # optional default-tenant knobs
+        weight: 1.0
+        quota-rows-per-second: 0
+      max-concurrent-dispatches: 4        # FairDispatchScheduler cap
+
+* **Per-tenant epoch lifecycle.** Every tenant owns a full
+  :class:`~policy_server_tpu.lifecycle.PolicyLifecycleManager` over its
+  own policies file: independent digest watch, SIGHUP-triggered reload,
+  shadow canary, rollback, and epoch pinning — one tenant's poisoned
+  canary rolls back THAT tenant only, and its verdict cache / breaker /
+  canary ring can never observe another tenant's state (they live in
+  the tenant's environments).
+
+* **Admission quotas.** :class:`TenantAdmission` is a token bucket
+  (rows/s + burst) plus an in-flight cap, consulted by the tenant's
+  batcher at every submit; a denied admission answers HTTP 429 +
+  Retry-After and increments tenant-labelled shed counters, so one
+  tenant's overload storm sheds at ITS front door instead of queueing
+  into shared capacity.
+
+* **Weighted-fair dispatch.** All tenant batchers share one
+  :class:`~policy_server_tpu.runtime.scheduler.FairDispatchScheduler`
+  (live > per-tenant weighted shares > audit, runtime/scheduler.py).
+
+* **Routing.** ``POST /validate/{tenant}/{policy_id}`` (and the audit /
+  raw variants) picks the tenant from the path; every existing URL maps
+  to the reserved ``default`` tenant, so single-tenant deployments are
+  bit-identical to round 15. ``GET /readiness/{tenant}`` answers that
+  tenant's honest readiness; the global probe degrades only when EVERY
+  tenant is degraded.
+
+Device angle: every tenant's policy set lowers over the SAME device
+fleet — with a ``policy`` mesh axis each tenant's set packs across the
+axis as its own fused SPMD program with its own verdict slice, so N
+reference Deployments collapse onto one accelerator mesh that
+time-shares the tenants' programs.
+
+Failpoints: ``tenant.reload`` (per-tenant policies re-read) and
+``tenant.admission`` (quota check head) — both honor the thread-scoped
+arming in failpoints.py so chaos can fault ONE tenant.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+import yaml
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.runtime.batcher import ShedError
+
+#: the reserved tenant name every existing (un-prefixed) URL routes to
+DEFAULT_TENANT = "default"
+
+# names that would shadow literal routes (/audit/reports/...) or the
+# reserved default; rejected at manifest parse, not at serve time
+_RESERVED_TENANT_NAMES = frozenset({DEFAULT_TENANT, "reports"})
+
+
+def unknown_tenant_message(name: str) -> str:
+    """The ONE 404 body text for an unknown tenant — shared by the
+    aiohttp handlers and the native frontend's sink so both frontends
+    answer byte-identically."""
+    return f"unknown tenant: {name}"
+
+
+class TenantConfigError(ValueError):
+    """Malformed tenants manifest."""
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's parsed manifest entry."""
+
+    name: str
+    policies_path: str | None = None  # None only for the default tenant
+    weight: float = 1.0
+    quota_rows_per_second: float = 0.0  # 0 = unlimited
+    quota_burst: float = 0.0  # 0 = default to one second of rate
+    max_inflight: int = 0  # 0 = uncapped
+    request_timeout_ms: float | None = None  # None = server default
+    degraded_mode: str | None = None  # None = server default
+
+    def validate(self) -> None:
+        if not self.name or "/" in self.name:
+            raise TenantConfigError(
+                f"invalid tenant name {self.name!r} (must be non-empty, "
+                "no '/')"
+            )
+        if self.name in _RESERVED_TENANT_NAMES and self.name != DEFAULT_TENANT:
+            raise TenantConfigError(
+                f"tenant name {self.name!r} is reserved (it would shadow "
+                "a literal route)"
+            )
+        if self.weight <= 0:
+            raise TenantConfigError(
+                f"tenant {self.name!r}: weight must be > 0"
+            )
+        if self.quota_rows_per_second < 0 or self.quota_burst < 0:
+            raise TenantConfigError(
+                f"tenant {self.name!r}: quota values must be >= 0"
+            )
+        if self.max_inflight < 0:
+            raise TenantConfigError(
+                f"tenant {self.name!r}: max-inflight must be >= 0"
+            )
+        if self.degraded_mode is not None and self.degraded_mode not in (
+            "oracle", "monitor", "reject"
+        ):
+            raise TenantConfigError(
+                f"tenant {self.name!r}: invalid degraded-mode "
+                f"{self.degraded_mode!r}"
+            )
+
+
+@dataclass
+class TenantManifest:
+    """The parsed tenants file: named tenant specs, optional overrides
+    for the reserved default tenant, and the shared scheduler cap."""
+
+    tenants: dict[str, TenantSpec] = field(default_factory=dict)
+    default: TenantSpec = field(
+        default_factory=lambda: TenantSpec(name=DEFAULT_TENANT)
+    )
+    max_concurrent_dispatches: int = 4
+
+
+def _spec_from_doc(name: str, doc: Mapping, base_dir: Path) -> TenantSpec:
+    if not isinstance(doc, Mapping):
+        raise TenantConfigError(
+            f"tenant {name!r}: entry must be a mapping, got "
+            f"{type(doc).__name__}"
+        )
+    known = {
+        "policies", "weight", "quota-rows-per-second", "quota-burst",
+        "max-inflight", "request-timeout-ms", "degraded-mode",
+    }
+    unknown = set(doc) - known
+    if unknown:
+        raise TenantConfigError(
+            f"tenant {name!r}: unknown keys {sorted(unknown)} "
+            f"(expected {sorted(known)})"
+        )
+    policies = doc.get("policies")
+    if policies is not None:
+        p = Path(policies)
+        if not p.is_absolute():
+            p = base_dir / p
+        policies = str(p)
+    rt = doc.get("request-timeout-ms")
+    spec = TenantSpec(
+        name=name,
+        policies_path=policies,
+        weight=float(doc.get("weight", 1.0)),
+        quota_rows_per_second=float(doc.get("quota-rows-per-second", 0.0)),
+        quota_burst=float(doc.get("quota-burst", 0.0)),
+        max_inflight=int(doc.get("max-inflight", 0)),
+        request_timeout_ms=None if rt is None else float(rt),
+        degraded_mode=doc.get("degraded-mode"),
+    )
+    spec.validate()
+    return spec
+
+
+def read_tenants_file(path: str | Path) -> TenantManifest:
+    """Parse a tenants manifest (see module docstring for the shape).
+    Relative per-tenant policies paths resolve against the manifest's
+    own directory — the manifest is self-contained wherever it lives."""
+    path = Path(path)
+    with open(path, "r", encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, Mapping):
+        raise TenantConfigError("tenants file must be a YAML mapping")
+    unknown = set(doc) - {"tenants", "default", "max-concurrent-dispatches"}
+    if unknown:
+        raise TenantConfigError(
+            f"unknown top-level keys {sorted(unknown)} in tenants file"
+        )
+    base_dir = path.resolve().parent
+    tenants_doc = doc.get("tenants") or {}
+    if not isinstance(tenants_doc, Mapping) or not tenants_doc:
+        raise TenantConfigError(
+            "tenants file must define at least one tenant under 'tenants:'"
+        )
+    tenants: dict[str, TenantSpec] = {}
+    for name, entry in tenants_doc.items():
+        name = str(name)
+        if name in _RESERVED_TENANT_NAMES:
+            raise TenantConfigError(
+                f"tenant name {name!r} is reserved (the default tenant is "
+                "configured under the top-level 'default:' key)"
+            )
+        spec = _spec_from_doc(name, entry or {}, base_dir)
+        if spec.policies_path is None:
+            raise TenantConfigError(
+                f"tenant {name!r}: 'policies' is required"
+            )
+        tenants[name] = spec
+    default_doc = doc.get("default") or {}
+    default = _spec_from_doc(DEFAULT_TENANT, default_doc, base_dir)
+    if default.policies_path is not None:
+        raise TenantConfigError(
+            "the default tenant's policies come from --policies, not the "
+            "tenants manifest"
+        )
+    cap = int(doc.get("max-concurrent-dispatches", 4))
+    if cap < 1:
+        raise TenantConfigError("max-concurrent-dispatches must be >= 1")
+    return TenantManifest(
+        tenants=tenants, default=default, max_concurrent_dispatches=cap
+    )
+
+
+def split_tenant_path(policy_id: str) -> tuple[str | None, str]:
+    """``"tenant/policy"`` → ``("tenant", "policy")``; a bare policy id
+    → ``(None, policy_id)``. The native frontend routes two-segment
+    evaluation paths through here so both frontends agree."""
+    tenant, sep, rest = policy_id.partition("/")
+    if not sep:
+        return None, policy_id
+    return tenant, rest
+
+
+def lookup_tenant(state: Any, name: str):
+    """The ONE tenant-registry lookup every surface uses (aiohttp
+    handlers, readiness probe, native sink, prefork bridge): the
+    :class:`Tenant` for ``name``, or None when unknown — including
+    every name on a deployment with no tenants manifest."""
+    mgr = getattr(state, "tenants", None)
+    return mgr.get(name) if mgr is not None else None
+
+
+def resolve_tenant_batcher(state: Any, policy_id: str):
+    """Resolve a wire policy id (possibly ``"tenant/policy"``) to the
+    serving batcher: ``(batcher, bare_policy_id, None)``, or
+    ``(None, bare_policy_id, unknown_tenant_name)`` — the caller
+    packages the 404 for its transport with
+    :func:`unknown_tenant_message`, so resolution RULES live in exactly
+    one place and the frontends stay byte-identical by construction."""
+    tenant, pid = split_tenant_path(policy_id)
+    if tenant is None:
+        return state.batcher, policy_id, None
+    t = lookup_tenant(state, tenant)
+    if t is None:
+        return None, pid, tenant
+    return t.state.batcher, pid, None
+
+
+class TenantAdmission:
+    """Per-tenant admission quota: a token bucket over admitted ROWS
+    (refilled continuously at ``rows_per_second`` up to ``burst``) plus
+    an in-flight cap on admitted-but-unresolved rows. Denials raise
+    :class:`~policy_server_tpu.runtime.batcher.ShedError` with an
+    honest Retry-After derived from the refill rate — the webhook
+    caller can actually use it. Cheap by construction: one lock, a few
+    float ops, called once per submit burst (never per row on the bulk
+    path)."""
+
+    def __init__(
+        self,
+        tenant: str,
+        rows_per_second: float = 0.0,
+        burst: float = 0.0,
+        max_inflight: int = 0,
+    ) -> None:
+        self.tenant = tenant
+        self.rate = max(0.0, float(rows_per_second))
+        self.burst = float(burst) if burst > 0 else max(1.0, self.rate)
+        self.max_inflight = max(0, int(max_inflight))
+        self._lock = threading.Lock()
+        self._tokens = self.burst  # guarded-by: _lock
+        self._refilled_at = time.monotonic()  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
+        # tenant-labelled counters (/metrics)
+        self._admitted_rows = 0  # guarded-by: _lock
+        self._quota_sheds = 0  # guarded-by: _lock
+        self._inflight_sheds = 0  # guarded-by: _lock
+
+    def admit(self, n: int = 1) -> None:
+        """Admit ``n`` rows or raise ShedError. The chaos site fires
+        FIRST so an armed ``tenant.admission`` fault is an admission-
+        layer fault (in-band error), not a quota answer; it fires under
+        THIS tenant's scope (admission runs on handler threads that
+        carry no ambient scope, so the quota sets its own)."""
+        with failpoints.scope(self.tenant):
+            failpoints.fire("tenant.admission")
+        with self._lock:
+            if self.max_inflight and self._inflight + n > self.max_inflight:
+                self._inflight_sheds += n
+                raise ShedError(0.05)
+            if self.rate > 0:
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst,
+                    self._tokens + (now - self._refilled_at) * self.rate,
+                )
+                self._refilled_at = now
+                # a burst larger than the bucket DEPTH must still be
+                # admittable (the native frontend admits whole poll
+                # bursts as units): require only a full bucket's worth
+                # up front and let the balance go into deficit — later
+                # admissions shed until the deficit repays at ``rate``,
+                # so the average rate stays bounded and the advertised
+                # Retry-After is a wait that can actually succeed
+                need = min(float(n), self.burst)
+                if self._tokens < need:
+                    self._quota_sheds += n
+                    raise ShedError((need - self._tokens) / self.rate)
+                self._tokens -= n
+            self._inflight += n
+            self._admitted_rows += n
+
+    def release(self, n: int = 1) -> None:
+        """A previously admitted row resolved (any outcome). Floored at
+        zero: a rare double-resolution during shutdown's self-drain
+        must never wedge the cap negative-side."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - n)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "admitted_rows": self._admitted_rows,
+                "quota_sheds": self._quota_sheds,
+                "inflight_sheds": self._inflight_sheds,
+                "inflight": self._inflight,
+                "shed_rows": self._quota_sheds + self._inflight_sheds,
+            }
+
+
+@dataclass
+class TenantState:
+    """A named tenant's epoch pointer — the duck-typed analog of
+    :class:`~policy_server_tpu.api.state.ApiServerState` that the
+    lifecycle manager rebinds on promotion/rollback (the default
+    tenant's pointer IS the ApiServerState, so existing deployments
+    are untouched)."""
+
+    name: str
+    evaluation_environment: Any = None
+    batcher: Any = None
+    ready: bool = False
+    lifecycle: Any = None
+
+    def readiness(self) -> tuple[int, str]:
+        from policy_server_tpu.api.state import readiness_verdict
+
+        return readiness_verdict(
+            self.ready, self.batcher, self.evaluation_environment
+        )
+
+
+class Tenant:
+    """One serving tenant: its spec, its epoch pointer (state), and its
+    admission quota. ``state`` is a :class:`TenantState` for named
+    tenants and the process ApiServerState for the default tenant."""
+
+    def __init__(
+        self, name: str, spec: TenantSpec, state: Any,
+        admission: TenantAdmission | None,
+    ) -> None:
+        self.name = name
+        self.spec = spec
+        self.state = state
+        self.admission = admission
+
+    @property
+    def lifecycle(self):
+        return self.state.lifecycle
+
+    def readiness(self) -> tuple[int, str]:
+        """THIS tenant's honest verdict — always computed from the raw
+        epoch-pointer fields (for the default tenant, ``state`` is the
+        ApiServerState whose own readiness() is the process-wide
+        AGGREGATE; calling it here would recurse)."""
+        from policy_server_tpu.api.state import readiness_verdict
+
+        s = self.state
+        return readiness_verdict(
+            getattr(s, "ready", True),
+            s.batcher,
+            s.evaluation_environment,
+        )
+
+    def request_reload(self, reason: str) -> bool:
+        lc = self.state.lifecycle
+        if lc is None:
+            return False
+        return lc.request_reload(reason)
+
+
+class TenantManager:
+    """The tenant registry: name → :class:`Tenant`, including the
+    reserved default. Built once at bootstrap; the mapping is immutable
+    afterwards (tenant onboarding is a restart — per-tenant POLICY
+    changes hot-reload through each tenant's lifecycle)."""
+
+    def __init__(
+        self, scheduler: Any = None
+    ) -> None:
+        self.scheduler = scheduler
+        self._tenants: dict[str, Tenant] = {}  # immutable post-bootstrap
+
+    def add(self, tenant: Tenant) -> None:
+        self._tenants[tenant.name] = tenant
+
+    def get(self, name: str) -> Tenant | None:
+        return self._tenants.get(name)
+
+    def named(self) -> list[Tenant]:
+        """Every tenant EXCEPT the default (whose epoch stack the server
+        owns through its own lifecycle/teardown paths)."""
+        return [
+            t for t in self._tenants.values() if t.name != DEFAULT_TENANT
+        ]
+
+    def all(self) -> list[Tenant]:
+        return list(self._tenants.values())
+
+    # -- aggregate readiness (the partial-outage contract) ----------------
+
+    def any_ready(self) -> bool:
+        return any(t.readiness()[0] == 200 for t in self._tenants.values())
+
+    def degraded_names(self) -> list[str]:
+        return [
+            t.name for t in self._tenants.values()
+            if t.readiness()[0] != 200
+        ]
+
+    # -- fan-out operations ------------------------------------------------
+
+    def reload_all(self, reason: str) -> int:
+        """Kick a background reload on every tenant that has a
+        lifecycle (the SIGHUP contract: one signal reloads certs, the
+        default policy set, and every named tenant — each pipeline
+        independent, each failure contained to its tenant)."""
+        started = 0
+        for t in self._tenants.values():
+            if t.request_reload(reason):
+                started += 1
+        return started
+
+    def shutdown(self) -> None:
+        """Tear down every NAMED tenant's epoch stack (the default
+        tenant's lifecycle is shut down by the server, which owns it)."""
+        for t in self.named():
+            lc = t.state.lifecycle
+            if lc is not None:
+                try:
+                    lc.shutdown()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            else:
+                try:
+                    t.state.batcher.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    t.state.evaluation_environment.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # -- the /metrics surface ---------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """Tenant-labelled sample lists for the runtime-stats collector:
+        ``{family_key: [((tenant,), value), ...]}`` plus the serving
+        count. One pass; each underlying read is its owner's one-lock
+        snapshot."""
+        sched_stats = (
+            self.scheduler.stats() if self.scheduler is not None else {}
+        )
+        shed, admitted, inflight = [], [], []
+        queue_depth, grants, wait_s = [], [], []
+        epoch, rollbacks, ready = [], [], []
+        for name, t in self._tenants.items():
+            key = (name,)
+            adm = (
+                t.admission.stats() if t.admission is not None else None
+            )
+            if adm is not None:
+                shed.append((key, float(adm["shed_rows"])))
+                admitted.append((key, float(adm["admitted_rows"])))
+                inflight.append((key, float(adm["inflight"])))
+            batcher = t.state.batcher
+            if batcher is not None:
+                queue_depth.append((key, float(batcher.queue_depth())))
+            ss = sched_stats.get(name)
+            if ss is not None:
+                grants.append((key, float(ss["grants"])))
+                wait_s.append((key, ss["wait_ns"] / 1e9))
+            lc = t.state.lifecycle
+            if lc is not None:
+                ls = lc.stats()
+                epoch.append((key, float(ls["epoch"])))
+                rollbacks.append((key, float(ls["rollbacks"])))
+            ready.append(
+                (key, 1.0 if t.readiness()[0] == 200 else 0.0)
+            )
+        return {
+            "shed_rows": shed,
+            "admitted_rows": admitted,
+            "inflight_rows": inflight,
+            "queue_depth": queue_depth,
+            "dispatch_grants": grants,
+            "dispatch_wait_seconds": wait_s,
+            "epoch": epoch,
+            "rollbacks": rollbacks,
+            "ready": ready,
+            "serving": len(self._tenants),
+        }

@@ -880,3 +880,38 @@ def test_on_promote_mode_drains_deletions_between_sweeps(env):
     finally:
         scanner.shutdown()
         batcher.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant scoping (round 16, tenancy.py): the audit scanner serves
+# the DEFAULT tenant only — a named tenant's validate traffic must never
+# feed the default snapshot store (or its report rows).
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_traffic_never_feeds_the_audit_snapshot(env):
+    """server.py wires named-tenant batchers with audit_tracker=None:
+    the snapshot store (and therefore every report row derived from it)
+    stays scoped to objects admitted through the DEFAULT tenant."""
+    store = SnapshotStore(max_bytes=10 * 1024 * 1024)
+    default_batcher = MicroBatcher(
+        env, max_batch_size=8, policy_timeout=10.0, audit_tracker=store,
+    ).start()
+    tenant_batcher = MicroBatcher(
+        env, max_batch_size=8, policy_timeout=10.0, audit_tracker=None,
+        tenant="ten-a",
+    ).start()
+    try:
+        default_batcher.submit(
+            "priv", pod_review("from-default"), RequestOrigin.VALIDATE
+        ).result(timeout=30)
+        tenant_batcher.submit(
+            "priv", pod_review("from-tenant-a"), RequestOrigin.VALIDATE
+        ).result(timeout=30)
+        keys = [k for k, _ in store.collect()]
+        assert any("from-default" in k for k in keys)
+        assert not any("from-tenant-a" in k for k in keys)
+        assert len(store) == 1
+    finally:
+        default_batcher.shutdown()
+        tenant_batcher.shutdown()

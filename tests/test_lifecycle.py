@@ -21,6 +21,7 @@ The contract under test, end to end:
 
 from __future__ import annotations
 
+import threading
 import time
 
 import pytest
@@ -658,5 +659,141 @@ def test_file_watch_redetects_change_landing_during_inflight_reload(
         assert h.serve("happy").allowed is True, (
             "the change written during the in-flight reload was lost"
         )
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant epoch isolation (round 16, tenancy.py): tenants are
+# independent lifecycle managers over their own TenantState — one
+# tenant's reload/rollback/ring can never touch another's.
+# ---------------------------------------------------------------------------
+
+
+class TenantHarness:
+    """Two Harness-shaped stacks keyed by tenant name, each a
+    PolicyLifecycleManager over its own TenantState (exactly how
+    server.py wires named tenants)."""
+
+    def __init__(self):
+        from policy_server_tpu.tenancy import TenantState
+
+        self.tenants: dict[str, PolicyLifecycleManager] = {}
+        self.states: dict[str, TenantState] = {}
+        self.recorders: dict[str, ShadowRecorder] = {}
+        for name in ("ten-a", "ten-b"):
+            recorder = ShadowRecorder(capacity=16)
+            env = EvaluationEnvironmentBuilder(backend="jax").build(
+                policies_v1()
+            )
+            batcher = MicroBatcher(
+                env, max_batch_size=4, batch_timeout_ms=1.0,
+                policy_timeout=5.0, host_fastpath_threshold=64,
+                shadow_recorder=recorder, tenant=name,
+            ).start()
+            state = TenantState(name=name)
+            manager = PolicyLifecycleManager(
+                state=state,
+                build_environment=lambda p: (
+                    EvaluationEnvironmentBuilder(backend="jax").build(dict(p))
+                ),
+                build_oracle_environment=lambda p: (
+                    EvaluationEnvironmentBuilder(backend="oracle").build(
+                        dict(p)
+                    )
+                ),
+                build_batcher=lambda env, _r=recorder, _n=name: MicroBatcher(
+                    env, max_batch_size=4, batch_timeout_ms=1.0,
+                    policy_timeout=5.0, host_fastpath_threshold=64,
+                    shadow_recorder=_r, tenant=_n,
+                ),
+                recorder=recorder,
+                warmup=False,
+                tenant=name,
+            )
+            state.lifecycle = manager
+            manager.install_first_epoch(env, batcher, policies_v1())
+            self.tenants[name] = manager
+            self.states[name] = state
+            self.recorders[name] = recorder
+
+    def serve(self, tenant: str, policy_id: str, namespace=None):
+        return self.states[tenant].batcher.submit(
+            policy_id, review(namespace), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+
+    def close(self):
+        for m in self.tenants.values():
+            m.shutdown()
+
+
+def test_tenant_reloads_promote_independent_epochs():
+    """Concurrent reloads on two tenants each advance THEIR epoch only;
+    verdict caches and canary rings stay tenant-scoped."""
+    h = TenantHarness()
+    try:
+        # seed distinct traffic into each tenant's canary ring
+        assert h.serve("ten-a", "ns").allowed is True
+        assert h.serve("ten-b", "ns", namespace="blocked").allowed is False
+
+        threads = [
+            threading.Thread(
+                target=h.tenants[n].reload,
+                kwargs=dict(policies=policies_v2(), reason="test"),
+            )
+            for n in ("ten-a", "ten-b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert h.tenants["ten-a"].current_epoch == 1
+        assert h.tenants["ten-b"].current_epoch == 1
+        # each tenant serves ITS promoted set
+        assert h.serve("ten-a", "happy").allowed is True
+        assert h.serve("ten-b", "happy").allowed is True
+        # per-tenant rollback reverts only that tenant
+        assert h.tenants["ten-a"].rollback() == "rolled-back"
+        assert h.tenants["ten-a"].current_epoch == 0
+        assert h.tenants["ten-b"].current_epoch == 1
+        assert h.serve("ten-b", "happy").allowed is True
+    finally:
+        h.close()
+
+
+def test_tenant_scoped_canary_fault_rolls_back_one_tenant():
+    """A reload.canary fault scoped to tenant A rejects A's candidate
+    (last-good keeps serving, rollback counter increments) while tenant
+    B's SAME reload promotes — the per-tenant containment contract."""
+    h = TenantHarness()
+    try:
+        def boom():
+            raise failpoints.FailpointError("canary infrastructure down")
+
+        failpoints.set_failpoint("reload.canary", boom, scope="ten-a")
+        with pytest.raises(ReloadRejected):
+            h.tenants["ten-a"].reload(policies=policies_v2(), reason="x")
+        assert h.tenants["ten-b"].reload(
+            policies=policies_v2(), reason="x"
+        ) == "promoted"
+        a_stats = h.tenants["ten-a"].stats()
+        b_stats = h.tenants["ten-b"].stats()
+        assert a_stats["epoch"] == 0 and a_stats["rollbacks"] == 1
+        assert b_stats["epoch"] == 1 and b_stats["rollbacks"] == 0
+        # A still serves last-good; B serves the new set
+        assert h.serve("ten-a", "ns").allowed is True
+        assert h.serve("ten-b", "happy").allowed is True
+    finally:
+        h.close()
+
+
+def test_tenant_canary_rings_do_not_cross():
+    h = TenantHarness()
+    try:
+        assert h.serve("ten-a", "ns").allowed is True
+        ring_a = h.recorders["ten-a"].snapshot()
+        ring_b = h.recorders["ten-b"].snapshot()
+        assert len(ring_a) >= 1
+        assert ring_b == []  # B never saw A's traffic
     finally:
         h.close()

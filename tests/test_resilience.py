@@ -453,12 +453,9 @@ def test_shed_error_maps_to_http_429_with_retry_after():
         async def submit_async(self, *args):
             raise ShedError(2.3)
 
-    class FakeState:
-        batcher = FakeBatcher()
-
     resp = asyncio.run(
         handlers._evaluate(
-            FakeState(), "ns", review(), RequestOrigin.VALIDATE
+            FakeBatcher(), "ns", review(), RequestOrigin.VALIDATE
         )
     )
     assert resp.status == 429
@@ -1584,3 +1581,240 @@ def test_mesh_sighup_reload_under_load_zero_non_2xx():
     assert not non_2xx, f"non-2xx during mesh SIGHUP reload: {non_2xx[:5]}"
     for _code, allowed, privileged in results:
         assert allowed is (not privileged)  # bit-exact through the flip
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant fault containment (round 16, tenancy.py): a fault scoped
+# to one tenant trips/rolls back THAT tenant only — other tenants see
+# zero non-2xx, bit-exact verdicts, and no oracle fallbacks.
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_scoped_device_fault_trips_one_tenant_only():
+    """An armed device.fetch fault scoped to tenant A trips A's breaker
+    (A degrades to its bit-exact host oracle); tenant B — concurrently
+    serving through the SAME fair scheduler on the same host — never
+    sees the fault: zero errors, correct verdicts, breaker closed, no
+    oracle short-circuits."""
+    from policy_server_tpu.runtime.scheduler import FairDispatchScheduler
+
+    env_a = make_env()
+    env_b = make_env()
+    sched = FairDispatchScheduler(max_concurrent=2)
+    batchers = {}
+    for name, env in (("ten-a", env_a), ("ten-b", env_b)):
+        env.warmup((1, 4))
+        batchers[name] = MicroBatcher(
+            env, max_batch_size=4, batch_timeout_ms=1.0,
+            policy_timeout=5.0, host_fastpath_threshold=0,
+            latency_budget_ms=0, scheduler=sched, tenant=name,
+        ).start()
+    try:
+        failpoints.set_failpoint(
+            "device.fetch",
+            lambda: (_ for _ in ()).throw(
+                failpoints.FailpointError("injected device fault")
+            ),
+            scope="ten-a",
+        )
+        b_results: list = []
+        b_errors: list = []
+        stop = threading.Event()
+
+        def b_traffic():
+            i = 0
+            while not stop.is_set():
+                blocked = i % 2 == 0
+                i += 1
+                try:
+                    resp = batchers["ten-b"].submit(
+                        "ns",
+                        review(namespace="blocked" if blocked else None),
+                        RequestOrigin.VALIDATE,
+                    ).result(timeout=10)
+                    b_results.append((resp.allowed, blocked))
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    b_errors.append(e)
+                    return
+
+        bt = threading.Thread(target=b_traffic, daemon=True)
+        bt.start()
+
+        # A's first two dispatches fault -> breaker trips; then the
+        # bit-exact host oracle answers A's traffic correctly
+        for _ in range(2):
+            with pytest.raises(failpoints.FailpointError):
+                batchers["ten-a"].submit(
+                    "ns", review(), RequestOrigin.VALIDATE
+                ).result(timeout=10)
+        assert env_a.breaker_stats["trips"] == 1
+        ok = batchers["ten-a"].submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        bad = batchers["ten-a"].submit(
+            "ns", review(namespace="blocked"), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert ok.allowed is True and bad.allowed is False
+        assert env_a.breaker_stats["short_circuited_requests"] >= 2
+
+        time.sleep(0.3)  # let B serve through the whole fault window
+        stop.set()
+        bt.join(timeout=10)
+
+        # containment: B never saw the fault
+        assert not b_errors
+        assert len(b_results) >= 5
+        assert all(allowed is (not blocked) for allowed, blocked in b_results)
+        b_stats = env_b.breaker_stats
+        assert b_stats["trips"] == 0
+        assert b_stats["open_shards"] == 0
+        assert b_stats["short_circuited_requests"] == 0
+        assert (getattr(env_b, "oracle_fallbacks", 0) or 0) == 0
+    finally:
+        for b in batchers.values():
+            b.shutdown()
+        env_a.close()
+        env_b.close()
+
+
+def test_tenant_admission_fault_contained_to_its_tenant():
+    """An armed tenant.admission fault scoped to tenant A answers A's
+    submissions with an in-band error; tenant B's admission (its OWN
+    quota object) keeps admitting."""
+    from policy_server_tpu.tenancy import TenantAdmission
+
+    env = make_env(failure_threshold=100)
+    env.warmup((1, 4))
+    adm_a = TenantAdmission("ten-a", rows_per_second=1000.0)
+    adm_b = TenantAdmission("ten-b", rows_per_second=1000.0)
+    batcher_a = MicroBatcher(
+        env, max_batch_size=4, policy_timeout=5.0, admission=adm_a,
+        tenant="ten-a",
+    ).start()
+    batcher_b = MicroBatcher(
+        env, max_batch_size=4, policy_timeout=5.0, admission=adm_b,
+        tenant="ten-b",
+    ).start()
+    try:
+        failpoints.set_failpoint(
+            "tenant.admission",
+            lambda: (_ for _ in ()).throw(
+                failpoints.FailpointError("admission layer down")
+            ),
+            scope="ten-a",
+        )
+        with pytest.raises(failpoints.FailpointError):
+            batcher_a.submit("ns", review(), RequestOrigin.VALIDATE)
+        resp = batcher_b.submit(
+            "ns", review(), RequestOrigin.VALIDATE
+        ).result(timeout=10)
+        assert resp.allowed is True
+        assert adm_b.stats()["admitted_rows"] == 1
+        assert adm_a.stats()["admitted_rows"] == 0
+        # in-flight accounting drained for B
+        assert adm_b.stats()["inflight"] == 0
+    finally:
+        batcher_a.shutdown()
+        batcher_b.shutdown()
+        env.close()
+
+
+def test_tenant_reload_fault_contained_across_sighup_fanout():
+    """The SIGHUP fan-out (reload_all) with a tenant.reload fault scoped
+    to tenant A: A's pipeline rejects at the fetch stage and keeps
+    serving last-good; tenant B and the default tenant promote their
+    epochs — under sustained tenant-B traffic with zero non-2xx and
+    bit-exact verdicts through the flips."""
+    import requests as rq
+
+    from test_server import ServerHandle, pod_review_body
+    from test_tenancy import _tenant_config
+
+    import tempfile
+    from pathlib import Path
+
+    tmp_dir = Path(tempfile.mkdtemp(prefix="tenant-chaos-"))
+    handle = ServerHandle(_tenant_config(tmp_dir))
+    mgr = handle.server.state.tenants
+    stop = threading.Event()
+    results: list[tuple[int, bool | None, bool]] = []
+    errors: list[Exception] = []
+
+    def b_traffic(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            privileged = (i + worker) % 2 == 0
+            i += 1
+            try:
+                r = rq.post(
+                    handle.url("/validate/ten-b/common"),
+                    json=pod_review_body(privileged), timeout=30,
+                )
+                allowed = (
+                    r.json()["response"]["allowed"]
+                    if r.status_code == 200 else None
+                )
+                results.append((r.status_code, allowed, privileged))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=b_traffic, args=(w,), daemon=True)
+        for w in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+
+        failpoints.set_failpoint(
+            "tenant.reload",
+            lambda: (_ for _ in ()).throw(
+                failpoints.FailpointError("tenant manifest unreadable")
+            ),
+            scope="ten-a",
+        )
+        started = mgr.reload_all("chaos-sighup")
+        assert started >= 3  # default + ten-a + ten-b (+ ten-q)
+
+        # wait for every tenant's pipeline to settle
+        deadline = time.monotonic() + 120
+        lcs = {
+            name: mgr.get(name).state.lifecycle
+            for name in ("ten-a", "ten-b")
+        }
+        lcs["default"] = handle.server.lifecycle
+        while time.monotonic() < deadline:
+            if not any(lc.reload_in_flight() for lc in lcs.values()):
+                break
+            time.sleep(0.2)
+
+        a_stats = lcs["ten-a"].stats()
+        assert a_stats["epoch"] == 0, "faulted tenant must NOT promote"
+        assert a_stats["reload_failures"] == 1
+        assert a_stats["rollbacks"] == 1
+        assert lcs["ten-b"].stats()["epoch"] == 1
+        assert lcs["default"].stats()["epoch"] == 1
+
+        # A keeps serving last-good
+        r = rq.post(
+            handle.url("/validate/ten-a/only-a"),
+            json=pod_review_body(True), timeout=30,
+        )
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is False
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert len(results) >= 10
+        non_2xx = [s for s, _a, _p in results if s != 200]
+        assert non_2xx == [], f"tenant B saw non-2xx: {non_2xx[:5]}"
+        assert all(
+            allowed is (not privileged) for _s, allowed, privileged in results
+        )
+    finally:
+        stop.set()
+        handle.stop()

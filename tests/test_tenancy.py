@@ -1,0 +1,762 @@
+"""Multi-tenant serving (tenancy.py + runtime/scheduler.py, round 16).
+
+The contract under test:
+
+* tenants manifest parsing (per-tenant policies files, quotas, weights,
+  deadline classes; reserved names rejected);
+* token-bucket admission: rows/s + burst + in-flight cap, 429 +
+  Retry-After with tenant-labelled counters;
+* weighted-fair dispatch scheduler: live before audit, grant counts
+  converging to weight ratios, bounded waits;
+* tenant-scoped failpoints (thread-local ambient scope);
+* end-to-end routing: /validate/{tenant}/{policy_id} picks the tenant,
+  every un-prefixed URL stays the default tenant, unknown tenants 404
+  identically on both frontends;
+* hard isolation: per-tenant verdict caches, shadow-canary rings, and
+  epoch lifecycles never observe another tenant's state; one tenant's
+  quota overload sheds at ITS front door while the others keep serving;
+* honest readiness: /readiness/{tenant} per tenant, the global probe
+  503 only when EVERY tenant is degraded (partial-outage regression).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+import requests
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.runtime.batcher import ShedError
+from policy_server_tpu.runtime import scheduler as fair
+from policy_server_tpu.runtime.scheduler import FairDispatchScheduler
+from policy_server_tpu.tenancy import (
+    DEFAULT_TENANT,
+    Tenant,
+    TenantAdmission,
+    TenantConfigError,
+    TenantManager,
+    TenantSpec,
+    TenantState,
+    read_tenants_file,
+    split_tenant_path,
+    unknown_tenant_message,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+# ---------------------------------------------------------------------------
+# Manifest parsing
+# ---------------------------------------------------------------------------
+
+
+def _write_manifest(tmp_path, text: str):
+    p = tmp_path / "tenants.yml"
+    p.write_text(text, encoding="utf-8")
+    return p
+
+
+def test_manifest_parses_specs_and_resolves_relative_paths(tmp_path):
+    (tmp_path / "a.yml").write_text("x:\n  module: builtin://always-happy\n")
+    manifest = read_tenants_file(_write_manifest(tmp_path, """\
+tenants:
+  team-a:
+    policies: a.yml
+    weight: 2.5
+    quota-rows-per-second: 100
+    quota-burst: 25
+    max-inflight: 64
+    request-timeout-ms: 5000
+    degraded-mode: reject
+default:
+  weight: 0.5
+  quota-rows-per-second: 10
+max-concurrent-dispatches: 3
+"""))
+    spec = manifest.tenants["team-a"]
+    assert spec.policies_path == str(tmp_path / "a.yml")
+    assert spec.weight == 2.5
+    assert spec.quota_rows_per_second == 100.0
+    assert spec.quota_burst == 25.0
+    assert spec.max_inflight == 64
+    assert spec.request_timeout_ms == 5000.0
+    assert spec.degraded_mode == "reject"
+    assert manifest.default.weight == 0.5
+    assert manifest.default.quota_rows_per_second == 10.0
+    assert manifest.max_concurrent_dispatches == 3
+
+
+@pytest.mark.parametrize("text", [
+    "tenants: {}\n",                                      # empty
+    "tenants:\n  default:\n    policies: a.yml\n",        # reserved
+    "tenants:\n  reports:\n    policies: a.yml\n",        # shadows route
+    "tenants:\n  t:\n    policies: a.yml\n    bogus: 1\n",  # unknown key
+    "tenants:\n  t:\n    policies: a.yml\n    weight: 0\n",  # bad weight
+    "tenants:\n  t: {}\n",                                # missing policies
+    "tenants:\n  t:\n    policies: a.yml\ndefault:\n  policies: b.yml\n",
+    "tenants:\n  t:\n    policies: a.yml\nmax-concurrent-dispatches: 0\n",
+])
+def test_manifest_rejects_malformed(tmp_path, text):
+    with pytest.raises(TenantConfigError):
+        read_tenants_file(_write_manifest(tmp_path, text))
+
+
+def test_split_tenant_path():
+    assert split_tenant_path("pod-privileged") == (None, "pod-privileged")
+    assert split_tenant_path("team-a/pol") == ("team-a", "pol")
+    # deeper nesting stays with the tenant segment split-once; the
+    # policy-id lookup then 404s naturally
+    assert split_tenant_path("a/b/c") == ("a", "b/c")
+
+
+# ---------------------------------------------------------------------------
+# Admission quota
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_sheds_past_burst_and_refills():
+    adm = TenantAdmission("t", rows_per_second=50.0, burst=5.0)
+    adm.admit(5)
+    with pytest.raises(ShedError) as e:
+        adm.admit(1)
+    assert e.value.retry_after_seconds > 0
+    stats = adm.stats()
+    assert stats["admitted_rows"] == 5
+    assert stats["quota_sheds"] == 1
+    time.sleep(0.1)  # 50 rows/s -> ~5 tokens back
+    adm.admit(2)
+    assert adm.stats()["admitted_rows"] == 7
+
+
+def test_token_bucket_admits_bursts_larger_than_depth():
+    """A submit burst bigger than the bucket DEPTH (the native frontend
+    admits whole poll bursts as units) still admits when the bucket is
+    full — the balance goes into deficit and later admissions shed
+    until the deficit repays at ``rate``, keeping the average bounded
+    and the advertised Retry-After honest."""
+    adm = TenantAdmission("t", rows_per_second=100.0, burst=8.0)
+    adm.admit(16)  # bucket 8 - 16 -> deficit of 8
+    assert adm.stats()["admitted_rows"] == 16
+    with pytest.raises(ShedError) as e:
+        adm.admit(1)  # in deficit: sheds, with a FINITE honest retry
+    assert 0 < e.value.retry_after_seconds < 1.0
+    time.sleep(0.15)  # 100 rows/s repays the -8 deficit
+    adm.admit(1)
+    assert adm.stats()["admitted_rows"] == 17
+
+
+def test_inflight_cap_sheds_and_release_reopens():
+    adm = TenantAdmission("t", max_inflight=3)
+    adm.admit(3)
+    with pytest.raises(ShedError):
+        adm.admit(1)
+    assert adm.stats()["inflight_sheds"] == 1
+    adm.release(2)
+    adm.admit(2)
+    assert adm.stats()["inflight"] == 3
+    # over-release floors at zero (shutdown double-resolve tolerance)
+    adm.release(100)
+    assert adm.stats()["inflight"] == 0
+
+
+def test_tenant_admission_failpoint_fires_in_admit():
+    adm = TenantAdmission("t", rows_per_second=1000.0)
+    with failpoints.active(
+        "tenant.admission",
+        lambda: (_ for _ in ()).throw(failpoints.FailpointError("boom")),
+    ):
+        with pytest.raises(failpoints.FailpointError):
+            adm.admit(1)
+    assert failpoints.fired_count("tenant.admission") == 1
+    # nothing was admitted: the fault precedes the quota math
+    assert adm.stats()["admitted_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Tenant-scoped failpoints
+# ---------------------------------------------------------------------------
+
+
+def test_failpoint_scope_is_thread_local_and_restored():
+    hits: list[str] = []
+    failpoints.set_failpoint(
+        "tenant.admission", lambda: hits.append("hit"), scope="tenant-a"
+    )
+    failpoints.fire("tenant.admission")  # unscoped thread: no-op
+    assert hits == []
+    with failpoints.scope("tenant-b"):
+        failpoints.fire("tenant.admission")  # other tenant: no-op
+        with failpoints.scope("tenant-a"):
+            failpoints.fire("tenant.admission")  # match
+        assert failpoints.current_scope() == "tenant-b"
+    assert failpoints.current_scope() is None
+    assert hits == ["hit"]
+
+    # another thread never inherits the scope
+    def other():
+        failpoints.fire("tenant.admission")
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert hits == ["hit"]
+
+
+# ---------------------------------------------------------------------------
+# Weighted-fair dispatch scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_fast_path_and_release():
+    s = FairDispatchScheduler(max_concurrent=2)
+    assert s.acquire("a")
+    assert s.acquire("b")
+    granted = []
+    t = threading.Thread(
+        target=lambda: granted.append(s.acquire("c", timeout=5))
+    )
+    t.start()
+    time.sleep(0.05)
+    assert granted == []  # cap reached: c waits
+    s.release("a")
+    t.join(timeout=5)
+    assert granted == [True]
+    stats = s.stats()
+    assert stats["a"]["grants"] == 1
+    assert stats["c"]["grants"] == 1
+    assert stats["c"]["wait_ns"] > 0
+
+
+def test_scheduler_timeout_and_abort():
+    s = FairDispatchScheduler(max_concurrent=1)
+    assert s.acquire("a")
+    t0 = time.perf_counter()
+    assert not s.acquire("b", timeout=0.15)
+    assert time.perf_counter() - t0 < 2.0
+    assert not s.acquire("b", should_abort=lambda: True)
+    # releasing after abandoned waiters must not wedge
+    s.release("a")
+    assert s.acquire("b")
+
+
+def test_scheduler_weighted_shares_converge():
+    """With the slot permanently contended, grant counts track the
+    weight ratio (stride scheduling)."""
+    s = FairDispatchScheduler(
+        max_concurrent=1, weights={"heavy": 3.0, "light": 1.0}
+    )
+    done = threading.Event()
+    counts = {"heavy": 0, "light": 0}
+    lock = threading.Lock()
+
+    def worker(name: str) -> None:
+        while not done.is_set():
+            if s.acquire(name, timeout=1.0, should_abort=done.is_set):
+                with lock:
+                    counts[name] += 1
+                s.release(name)
+
+    threads = [
+        threading.Thread(target=worker, args=(n,), daemon=True)
+        for n in ("heavy", "light") for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)
+    done.set()
+    for t in threads:
+        t.join(timeout=5)
+    total = counts["heavy"] + counts["light"]
+    assert total > 50
+    share = counts["heavy"] / total
+    # 3:1 weights -> 0.75 share; generous band for scheduling noise
+    assert 0.6 < share < 0.9, counts
+
+
+def test_audit_grants_do_not_charge_the_live_share():
+    """A quiet-window audit sweep must not inflate its tenant's LIVE
+    virtual clock: after many AUDIT grants for tenant a, a contended
+    LIVE round still grants a before a later-queued equal-weight b
+    (tie broken FIFO — an audit-charged clock would hand b the slot)."""
+    s = FairDispatchScheduler(
+        max_concurrent=1, weights={"a": 1.0, "b": 1.0, "c": 1.0}
+    )
+    for _ in range(5):
+        assert s.acquire("a", fair.AUDIT)
+        s.release("a")
+    assert s.acquire("c", fair.LIVE)  # occupy the slot
+    order: list[str] = []
+
+    def live_waiter(name: str) -> None:
+        assert s.acquire(name, fair.LIVE, timeout=10)
+        order.append(name)
+        s.release(name)
+
+    ta = threading.Thread(target=live_waiter, args=("a",))
+    ta.start()
+    time.sleep(0.05)
+    tb = threading.Thread(target=live_waiter, args=("b",))
+    tb.start()
+    time.sleep(0.05)
+    s.release("c")
+    ta.join(timeout=5)
+    tb.join(timeout=5)
+    assert order == ["a", "b"]
+
+
+def test_scheduler_audit_yields_to_live():
+    s = FairDispatchScheduler(max_concurrent=1)
+    assert s.acquire("a", fair.LIVE)
+    order: list[str] = []
+
+    def waiter(name: str, prio: int) -> None:
+        assert s.acquire(name, prio, timeout=10)
+        order.append(name)
+        time.sleep(0.02)
+        s.release(name)
+
+    t_audit = threading.Thread(target=waiter, args=("aud", fair.AUDIT))
+    t_audit.start()
+    time.sleep(0.05)  # audit waiter queued first
+    t_live = threading.Thread(target=waiter, args=("live", fair.LIVE))
+    t_live.start()
+    time.sleep(0.05)
+    s.release("a")
+    t_audit.join(timeout=5)
+    t_live.join(timeout=5)
+    assert order == ["live", "aud"]  # live granted first despite FIFO
+
+
+# ---------------------------------------------------------------------------
+# Readiness aggregation (the partial-outage regression)
+# ---------------------------------------------------------------------------
+
+
+def _stub_state(ready: bool) -> TenantState:
+    return TenantState(name="x", ready=ready)
+
+
+def test_global_readiness_503_only_when_every_tenant_degraded():
+    from policy_server_tpu.api.state import ApiServerState
+
+    state = ApiServerState(
+        evaluation_environment=None, batcher=None, ready=True
+    )
+    mgr = TenantManager()
+    mgr.add(Tenant(DEFAULT_TENANT, TenantSpec(name=DEFAULT_TENANT),
+                   state, None))
+    t_a = Tenant("a", TenantSpec(name="a"), _stub_state(ready=False), None)
+    t_b = Tenant("b", TenantSpec(name="b"), _stub_state(ready=True), None)
+    mgr.add(t_a)
+    mgr.add(t_b)
+    state.tenants = mgr
+
+    # partial outage: tenant a degraded -> global stays in rotation
+    status, text = state.readiness()
+    assert status == 200
+    assert "a" in text
+    assert t_a.readiness()[0] == 503
+    assert t_b.readiness()[0] == 200
+
+    # every tenant degraded -> global 503
+    t_b.state.ready = False
+    state.ready = False
+    status, text = state.readiness()
+    assert status == 503
+    assert "every tenant" in text
+
+    # single-tenant (no manager): unchanged verdict logic
+    state.tenants = None
+    assert state.readiness()[0] == 503
+    state.ready = True
+    assert state.readiness() == (200, "ok")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: a real server with a 2-tenant manifest
+# ---------------------------------------------------------------------------
+
+_TENANT_POLICIES = {
+    "ten-a": """\
+only-a:
+  module: builtin://pod-privileged
+common:
+  module: builtin://pod-privileged
+""",
+    "ten-b": """\
+only-b:
+  module: builtin://pod-privileged
+common:
+  module: builtin://pod-privileged
+""",
+}
+
+_MANIFEST = """\
+tenants:
+  ten-a:
+    policies: ten-a.yml
+    weight: 1.0
+  ten-b:
+    policies: ten-b.yml
+    weight: 2.0
+  ten-q:
+    policies: ten-a.yml
+    quota-rows-per-second: 2
+    quota-burst: 3
+    max-inflight: 64
+"""
+
+
+def _tenant_config(tmp_dir, **overrides):
+    from policy_server_tpu.config.config import read_policies_file
+    from test_server import make_config
+
+    for name, text in _TENANT_POLICIES.items():
+        (tmp_dir / f"{name}.yml").write_text(text, encoding="utf-8")
+    manifest_path = tmp_dir / "tenants.yml"
+    manifest_path.write_text(_MANIFEST, encoding="utf-8")
+    default_path = tmp_dir / "policies.yml"
+    default_path.write_text(
+        "pod-privileged:\n  module: builtin://pod-privileged\n",
+        encoding="utf-8",
+    )
+    manifest = read_tenants_file(manifest_path)
+    return make_config(
+        policies=read_policies_file(default_path),
+        policies_path=str(default_path),
+        policy_timeout_seconds=5.0,
+        tenants_path=str(manifest_path),
+        tenants=manifest,
+        # everything through the device path: the cache-isolation assert
+        # below reads the encode-side dedup tiers
+        host_fastpath_threshold=0,
+        **overrides,
+    )
+
+
+@pytest.fixture(scope="module")
+def tenant_server(tmp_path_factory):
+    from policy_server_tpu.telemetry import metrics as metrics_mod
+    from test_server import ServerHandle
+
+    metrics_mod.reset_metrics_for_tests()
+    tmp_dir = tmp_path_factory.mktemp("tenants")
+    handle = ServerHandle(_tenant_config(tmp_dir))
+    yield handle
+    handle.stop()
+
+
+def _pod_body(privileged: bool) -> dict:
+    from test_server import pod_review_body
+
+    return pod_review_body(privileged)
+
+
+def test_tenant_routes_resolve_their_own_policy_sets(tenant_server):
+    # default URL unchanged
+    r = requests.post(
+        tenant_server.url("/validate/pod-privileged"),
+        json=_pod_body(False), timeout=30,
+    )
+    assert r.status_code == 200 and r.json()["response"]["allowed"]
+    # tenant routes serve THEIR policies
+    r = requests.post(
+        tenant_server.url("/validate/ten-a/only-a"),
+        json=_pod_body(True), timeout=30,
+    )
+    assert r.status_code == 200
+    assert r.json()["response"]["allowed"] is False
+    # a policy of tenant B does not exist for tenant A
+    r = requests.post(
+        tenant_server.url("/validate/ten-a/only-b"),
+        json=_pod_body(False), timeout=30,
+    )
+    assert r.status_code == 404
+    # the default set does not know tenant policies
+    r = requests.post(
+        tenant_server.url("/validate/only-a"),
+        json=_pod_body(False), timeout=30,
+    )
+    assert r.status_code == 404
+
+
+def test_unknown_tenant_404_with_shared_message(tenant_server):
+    r = requests.post(
+        tenant_server.url("/validate/nope/pod-privileged"),
+        json=_pod_body(False), timeout=30,
+    )
+    assert r.status_code == 404
+    assert r.json()["message"] == unknown_tenant_message("nope")
+
+
+def test_per_tenant_and_global_readiness(tenant_server):
+    for path, expect in (
+        ("/readiness", 200),
+        ("/readiness/ten-a", 200),
+        ("/readiness/ten-b", 200),
+    ):
+        r = requests.get(tenant_server.readiness_url(path), timeout=10)
+        assert r.status_code == expect, path
+    r = requests.get(
+        tenant_server.readiness_url("/readiness/nope"), timeout=10
+    )
+    assert r.status_code == 404
+
+
+def test_quota_overload_sheds_tenant_q_while_b_serves(tenant_server):
+    """Tenant Q past its 2 rows/s / burst-3 quota answers 429 +
+    Retry-After; tenant B's simultaneous traffic is all 2xx — the
+    noisy-neighbor front door."""
+    statuses_a: list[int] = []
+    retry_after_seen = []
+
+    def flood_a():
+        s = requests.Session()
+        for _ in range(25):
+            r = s.post(
+                tenant_server.url("/validate/ten-q/common"),
+                json=_pod_body(False), timeout=30,
+            )
+            statuses_a.append(r.status_code)
+            if r.status_code == 429:
+                retry_after_seen.append(r.headers.get("Retry-After"))
+
+    statuses_b: list[int] = []
+
+    def steady_b():
+        s = requests.Session()
+        for _ in range(15):
+            r = s.post(
+                tenant_server.url("/validate/ten-b/common"),
+                json=_pod_body(False), timeout=30,
+            )
+            statuses_b.append(r.status_code)
+            time.sleep(0.01)
+
+    ta = threading.Thread(target=flood_a)
+    tb = threading.Thread(target=steady_b)
+    ta.start(); tb.start()
+    ta.join(timeout=60); tb.join(timeout=60)
+
+    assert statuses_a.count(429) >= 5, statuses_a
+    assert all(s == 200 for s in statuses_b), statuses_b
+    assert retry_after_seen and all(
+        ra is not None and int(ra) >= 1 for ra in retry_after_seen
+    )
+    # tenant-labelled shed counters reached the admission object
+    tenant_a = tenant_server.server.state.tenants.get("ten-q")
+    assert tenant_a.admission.stats()["quota_sheds"] >= 5
+    # in-flight claims fully released once the burst resolved
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if tenant_a.admission.stats()["inflight"] == 0:
+            break
+        time.sleep(0.05)
+    assert tenant_a.admission.stats()["inflight"] == 0
+
+
+def test_cross_tenant_verdict_cache_isolation(tenant_server):
+    """The same (policy name, payload) served through tenant A must
+    never warm tenant B's verdict cache — the caches live in per-tenant
+    environments."""
+    mgr = tenant_server.server.state.tenants
+    env_b = mgr.get("ten-b").state.evaluation_environment
+    before_b = dict(env_b.dedup_stats)
+    for _ in range(3):
+        r = requests.post(
+            tenant_server.url("/validate/ten-a/common"),
+            json=_pod_body(True), timeout=30,
+        )
+        assert r.status_code == 200
+    after_b = dict(env_b.dedup_stats)
+    for key in (
+        "blob_cache_hits", "blob_cache_misses", "cache_hits",
+        "cache_misses",
+    ):
+        assert after_b.get(key, 0) == before_b.get(key, 0), key
+    # B's first identical request is a MISS in B's own cache (nothing
+    # leaked over from A's replays)
+    r = requests.post(
+        tenant_server.url("/validate/ten-b/common"),
+        json=_pod_body(True), timeout=30,
+    )
+    assert r.status_code == 200
+    miss_b = dict(env_b.dedup_stats)
+    assert (
+        miss_b.get("blob_cache_misses", 0) + miss_b.get("cache_misses", 0)
+        > before_b.get("blob_cache_misses", 0)
+        + before_b.get("cache_misses", 0)
+    )
+
+
+def test_shadow_canary_rings_are_tenant_scoped(tenant_server):
+    """Each tenant's reload canary replays ITS recorded traffic only —
+    the rings live on per-tenant lifecycles. Probe with unique policy
+    ids (the ring records every SUBMITTED id, even unknown ones that
+    later 404, which is exactly why a shared ring would leak)."""
+    # unknown ids still record at batch formation, then 404 in
+    # evaluation — perfect unique markers
+    requests.post(
+        tenant_server.url("/validate/ten-a/ring-probe-a"),
+        json=_pod_body(False), timeout=30,
+    )
+    requests.post(
+        tenant_server.url("/validate/ten-b/ring-probe-b"),
+        json=_pod_body(False), timeout=30,
+    )
+    mgr = tenant_server.server.state.tenants
+
+    def rings():
+        ring_a = [
+            pid for pid, _ in
+            mgr.get("ten-a").state.lifecycle.recorder.snapshot()
+        ]
+        ring_b = [
+            pid for pid, _ in
+            mgr.get("ten-b").state.lifecycle.recorder.snapshot()
+        ]
+        return ring_a, ring_b
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ring_a, ring_b = rings()
+        if "ring-probe-a" in ring_a and "ring-probe-b" in ring_b:
+            break
+        time.sleep(0.05)
+    ring_a, ring_b = rings()
+    assert "ring-probe-a" in ring_a and "ring-probe-a" not in ring_b
+    assert "ring-probe-b" in ring_b and "ring-probe-b" not in ring_a
+    # and the default tenant's ring saw neither probe
+    default_ring = [
+        pid for pid, _ in
+        tenant_server.server.lifecycle.recorder.snapshot()
+    ]
+    assert "ring-probe-a" not in default_ring
+    assert "ring-probe-b" not in default_ring
+
+
+def test_per_tenant_reload_advances_one_epoch_only(tenant_server):
+    mgr = tenant_server.server.state.tenants
+    lc_a = mgr.get("ten-a").state.lifecycle
+    lc_b = mgr.get("ten-b").state.lifecycle
+    epoch_b = lc_b.current_epoch
+    epoch_default = tenant_server.server.lifecycle.current_epoch
+    before_a = lc_a.current_epoch
+    assert lc_a.reload(reason="test") == "promoted"
+    assert lc_a.current_epoch == before_a + 1
+    assert lc_b.current_epoch == epoch_b
+    assert tenant_server.server.lifecycle.current_epoch == epoch_default
+    # the promoted epoch still serves tenant A's set
+    r = requests.post(
+        tenant_server.url("/validate/ten-a/only-a"),
+        json=_pod_body(False), timeout=30,
+    )
+    assert r.status_code == 200
+
+
+def test_tenant_metrics_families_exported(tenant_server):
+    text = requests.get(
+        tenant_server.readiness_url("/metrics"), timeout=10
+    ).text
+    assert 'policy_server_tenant_admitted_rows_total{tenant="ten-q"}' in text
+    assert 'policy_server_tenant_shed_rows_total{tenant="ten-q"}' in text
+    assert 'policy_server_tenant_policy_epoch{tenant="ten-b"}' in text
+    assert 'policy_server_tenant_queue_depth{tenant="ten-a"}' in text
+    assert 'policy_server_tenant_ready{tenant="default"}' in text
+    assert "policy_server_tenants_serving 4.0" in text
+
+
+def test_scheduler_accounts_tenant_grants(tenant_server):
+    stats = tenant_server.server.state.tenants.scheduler.stats()
+    # traffic flowed through both tenant batchers under the shared
+    # scheduler by the time this test runs (module ordering)
+    assert stats.get("ten-a", {}).get("grants", 0) > 0
+    assert stats.get("ten-b", {}).get("grants", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Native frontend parity (two-segment routing through C++)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_native_frontend_tenant_routing_parity(tmp_path):
+    from test_server import ServerHandle
+
+    config = _tenant_config(tmp_path, frontend="native")
+    handle = ServerHandle(config)
+    try:
+        if handle.server._native_frontend is None:
+            pytest.skip("native frontend unavailable in this container")
+        r = requests.post(
+            handle.url("/validate/ten-a/only-a"),
+            json=_pod_body(True), timeout=30,
+        )
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is False
+        # unknown tenant: the sink answers the SAME body the aiohttp
+        # router produces
+        r = requests.post(
+            handle.url("/validate/nope/only-a"),
+            json=_pod_body(False), timeout=30,
+        )
+        assert r.status_code == 404
+        assert r.json()["message"] == unknown_tenant_message("nope")
+        # three segments stay a plain 404 (no route)
+        r = requests.post(
+            handle.url("/validate/a/b/c"),
+            json=_pod_body(False), timeout=30,
+        )
+        assert r.status_code == 404
+        # default URL through the native frontend still serves
+        r = requests.post(
+            handle.url("/validate/pod-privileged"),
+            json=_pod_body(False), timeout=30,
+        )
+        assert r.status_code == 200
+    finally:
+        handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# Prefork parity (tenant ids cross the bridge as "tenant/policy")
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_prefork_workers_route_tenants_over_the_bridge(tmp_path):
+    from test_server import ServerHandle
+
+    config = _tenant_config(tmp_path, http_workers=2)
+    handle = ServerHandle(config)
+    try:
+        # hit repeatedly: SO_REUSEPORT spreads connections over the main
+        # process AND the worker, so both the in-process router and the
+        # bridge path must agree on tenant routing
+        for _ in range(12):
+            r = requests.post(
+                handle.url("/validate/ten-a/only-a"),
+                json=_pod_body(True), timeout=30,
+            )
+            assert r.status_code == 200
+            assert r.json()["response"]["allowed"] is False
+            r = requests.post(
+                handle.url("/validate/nope/only-a"),
+                json=_pod_body(False), timeout=30,
+            )
+            assert r.status_code == 404
+            assert r.json()["message"] == unknown_tenant_message("nope")
+            r = requests.post(
+                handle.url("/validate/pod-privileged"),
+                json=_pod_body(False), timeout=30,
+            )
+            assert r.status_code == 200
+    finally:
+        handle.stop()

@@ -150,6 +150,16 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         emit("mixed_live_audit_scan", 0.0, "error", 0.0,
              error=repr(e)[:300])
+    try:
+        # round-16 tentpole: noisy-neighbor isolation A/B — tenant A
+        # saturated past its admission quota vs idle, tenant B's p99
+        # delta + A's shed rate (tenancy.py + runtime/scheduler.py)
+        from tools.bench.tenancy import bench_multi_tenant_isolation
+
+        bench_multi_tenant_isolation(quick=quick)
+    except Exception as e:  # noqa: BLE001
+        emit("multi_tenant_isolation", 0.0, "error", 0.0,
+             error=repr(e)[:300])
     emit_summary()
     # headline LAST: the driver records the final JSON line
     try:

@@ -1,0 +1,186 @@
+"""multi_tenant_isolation — the noisy-neighbor A/B (round 16).
+
+Two tenant stacks (own environment + batcher each, tenancy.py) share
+one process, one device, and one weighted-fair dispatch scheduler —
+exactly the round-16 serving topology. Tenant B runs a paced victim
+load twice: SOLO (baseline) and MIXED with tenant A flooding bulk
+submissions far past A's token-bucket quota. The line records B's
+p50/p99 delta between the two runs and A's shed rate — the isolation
+claim is that A's overload sheds at ITS admission quota (429s) instead
+of degrading B's latency through the shared capacity.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+
+from tools.bench.common import emit, pct
+
+_VICTIM_RPS = 100.0
+_WAVE_SECONDS = 3.0
+_WAVES = 3
+_STORM_BURST = 16
+# ~640 attempted rows/s against a 20 rows/s quota: >95% shed at the
+# admission front door, the admitted trickle is negligible capacity
+_STORM_INTERVAL_SECONDS = 0.025
+_STORM_QUOTA_RPS = 20.0
+
+
+def _build_stack(name, scheduler, admission):
+    from policy_server_tpu.evaluation.environment import (
+        EvaluationEnvironmentBuilder,
+    )
+    from policy_server_tpu.models.policy import parse_policy_entry
+    from policy_server_tpu.runtime.batcher import MicroBatcher
+
+    env = EvaluationEnvironmentBuilder(backend="jax").build({
+        "pod-privileged": parse_policy_entry(
+            "pod-privileged", {"module": "builtin://pod-privileged"}
+        ),
+    })
+    batcher = MicroBatcher(
+        env,
+        max_batch_size=64,
+        batch_timeout_ms=1.0,
+        policy_timeout=10.0,
+        host_fastpath_threshold=0,  # the shared DEVICE path is the bench
+        latency_budget_ms=0,
+        request_timeout_ms=10_000.0,
+        scheduler=scheduler,
+        admission=admission,
+        tenant=name,
+    )
+    batcher.warmup()
+    batcher.start()
+    return env, batcher
+
+
+def _victim_wave(batcher, request, seconds: float) -> list[float]:
+    """Paced solo-style victim load; returns per-request ms latencies."""
+    from policy_server_tpu.api.service import RequestOrigin
+
+    period = 1.0 / _VICTIM_RPS
+    latencies: list[float] = []
+    end = time.perf_counter() + seconds
+    while time.perf_counter() < end:
+        t0 = time.perf_counter()
+        resp = batcher.submit(
+            "pod-privileged", request, RequestOrigin.VALIDATE
+        ).result(timeout=30)
+        assert resp.uid is not None  # a real verdict, allow or deny
+        latencies.append((time.perf_counter() - t0) * 1000.0)
+        elapsed = time.perf_counter() - t0
+        if elapsed < period:
+            time.sleep(period - elapsed)
+    return latencies
+
+
+class _NullSink:
+    """Sink-granular completion like the native frontend's bulk path —
+    the storm must measure QUOTA isolation, not the cost of allocating
+    and resolving tens of thousands of storm-side Future objects the
+    real serving path never creates."""
+
+    def deliver_many(self, items) -> None:
+        pass
+
+
+def _storm(batcher, request, stop: threading.Event) -> None:
+    """Open-loop bulk flood far past the quota (bounded attempt rate):
+    nearly every row sheds at admission with a 429."""
+    from policy_server_tpu.api.service import RequestOrigin
+
+    items = [("pod-privileged", request)] * _STORM_BURST
+    sink = _NullSink()
+    tokens = list(range(_STORM_BURST))
+    while not stop.is_set():
+        batcher.submit_many(
+            items, RequestOrigin.VALIDATE, sink=sink, tokens=tokens
+        )
+        stop.wait(_STORM_INTERVAL_SECONDS)
+
+
+def bench_multi_tenant_isolation(quick: bool = False) -> None:
+    from policy_server_tpu.runtime.scheduler import FairDispatchScheduler
+    from policy_server_tpu.tenancy import TenantAdmission
+    from tools.bench.common import build_requests
+
+    waves = 1 if quick else _WAVES
+    seconds = 1.5 if quick else _WAVE_SECONDS
+    scheduler = FairDispatchScheduler(
+        max_concurrent=2, weights={"ten-a": 1.0, "ten-b": 1.0}
+    )
+    admission_a = TenantAdmission(
+        "ten-a", rows_per_second=_STORM_QUOTA_RPS,
+        burst=_STORM_QUOTA_RPS,
+    )
+    env_a, batcher_a = _build_stack("ten-a", scheduler, admission_a)
+    env_b, batcher_b = _build_stack("ten-b", scheduler, None)
+    request = build_requests(1, seed=7)[0]
+    try:
+        solo_p50, solo_p99, mixed_p50, mixed_p99 = [], [], [], []
+        shed_rates = []
+        for _ in range(waves):
+            lat = sorted(_victim_wave(batcher_b, request, seconds))
+            solo_p50.append(pct(lat, 0.50))
+            solo_p99.append(pct(lat, 0.99))
+
+            shed_before = batcher_a.stats_snapshot()["shed_requests"]
+            adm_before = admission_a.stats()["admitted_rows"]
+            stop = threading.Event()
+            storm_thread = threading.Thread(
+                target=_storm, args=(batcher_a, request, stop), daemon=True
+            )
+            storm_thread.start()
+            time.sleep(0.2)  # the storm reaches steady shed state
+            lat = sorted(_victim_wave(batcher_b, request, seconds))
+            stop.set()
+            storm_thread.join(timeout=10)
+            mixed_p50.append(pct(lat, 0.50))
+            mixed_p99.append(pct(lat, 0.99))
+            shed = batcher_a.stats_snapshot()["shed_requests"] - shed_before
+            admitted = admission_a.stats()["admitted_rows"] - adm_before
+            shed_rates.append(shed / max(1, shed + admitted))
+
+        b_solo_p99 = statistics.median(solo_p99)
+        b_mixed_p99 = statistics.median(mixed_p99)
+        delta_pct = (
+            (b_mixed_p99 - b_solo_p99) / max(1e-9, b_solo_p99) * 100.0
+        )
+        shed_rate = statistics.median(shed_rates)
+        emit(
+            "multi_tenant_isolation",
+            round(delta_pct, 2),
+            "% (tenant B p99 delta, noisy neighbor vs solo)",
+            # >= 1.0 means the 10%-DEGRADATION acceptance bound is met
+            # (a negative delta is B running faster under the mix —
+            # measurement noise, never a violation)
+            round(10.0 / max(delta_pct, 10.0), 4),
+            b_solo_p50_ms=round(statistics.median(solo_p50), 2),
+            b_solo_p99_ms=round(b_solo_p99, 2),
+            b_mixed_p50_ms=round(statistics.median(mixed_p50), 2),
+            b_mixed_p99_ms=round(b_mixed_p99, 2),
+            a_shed_rate=round(shed_rate, 4),
+            a_quota_rows_per_second=_STORM_QUOTA_RPS,
+            victim_rps=_VICTIM_RPS,
+            waves=waves,
+            scheduler_stats=scheduler.stats(),
+            note=(
+                "two tenant stacks sharing one process/device/fair "
+                "scheduler; tenant A floods bulk submissions past its "
+                "token-bucket quota (shedding at admission) while "
+                "tenant B's paced load is timed solo vs mixed. Honest "
+                "dev-box caveat: on a 2-core GIL-shared CPU host the "
+                "storm's admission work itself competes for cycles, so "
+                "the delta here is an UPPER bound on what a real "
+                "accelerator host (device-bound serving, C++ framing) "
+                "would see"
+            ),
+        )
+    finally:
+        batcher_a.shutdown()
+        batcher_b.shutdown()
+        env_a.close()
+        env_b.close()

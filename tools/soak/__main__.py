@@ -32,6 +32,9 @@ def main(argv: list[str] | None = None) -> int:
                     default=None)
     ap.add_argument("--http-workers", type=int, default=None)
     ap.add_argument("--p99-budget-ms", type=float, default=None)
+    ap.add_argument("--tenants", type=int, default=None,
+                    help="tenancy mix size: ten-0 storms a tight quota, "
+                         "the rest are paced victims (0/1 disables)")
     ap.add_argument("--artifact", default=None)
     ap.add_argument("--tag", default=None)
     args = ap.parse_args(argv)
@@ -44,7 +47,7 @@ def main(argv: list[str] | None = None) -> int:
         ("target_rps", "target_rps"), ("objects", "objects"),
         ("frontend", "frontend"), ("http_workers", "http_workers"),
         ("p99_budget_ms", "p99_budget_ms"), ("artifact", "artifact"),
-        ("tag", "tag"),
+        ("tag", "tag"), ("tenants", "tenants"),
     ):
         v = getattr(args, name)
         if v is not None:

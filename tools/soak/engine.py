@@ -87,15 +87,30 @@ class SoakSettings:
     # under load — the reload digest watch detects each one and the
     # predicate optimizer re-runs for every candidate epoch. 0 disables.
     policy_rewrites: int = 0
+    # tenancy mix (round 16, tenancy.py): N tenants on the manifest —
+    # ten-0 runs an UNPACED overload storm against a tight admission
+    # quota (it must shed 429s, never queue into shared capacity) while
+    # ten-1..N-1 are paced victims whose p99 must hold the soak budget;
+    # every mid-soak SIGHUP reloads EVERY tenant's epoch independently.
+    # 0/1 disables (single-tenant soak, the pre-round-16 shape).
+    tenants: int = 0
+    tenant_storm_quota_rps: float = 50.0
+    tenant_victim_rps: float = 30.0  # total across victim tenants
 
     @classmethod
     def smoke(cls, **over) -> "SoakSettings":
-        """The <=60 s CI mini-soak (make soak-smoke)."""
+        """The <=60 s CI mini-soak (make soak-smoke). The p99 budget is
+        above the single-tenant 750 ms calibration because every SIGHUP
+        now fans out N+1 CONCURRENT reload pipelines (default + each
+        tenant, round 16) whose candidate compiles contend for the
+        2-core box's GIL mid-soak — observed whole-soak p99 ≈390-760 ms
+        run-to-run with the tenancy mix on."""
         base = dict(
             duration=20.0, clients=3, target_rps=220.0,
             n_trace_items=2500, objects=20_000,
             churn_ops_per_second=300.0, window_seconds=2.5,
             preset="smoke", tag="r13_smoke", policy_rewrites=2,
+            tenants=2, p99_budget_ms=950.0,
         )
         base.update(over)
         return cls(**base)
@@ -103,13 +118,15 @@ class SoakSettings:
     @classmethod
     def full(cls, **over) -> "SoakSettings":
         """The cluster-scale soak: 100k+ watched objects, prefork
-        workers in the kill rotation, a longer storm."""
+        workers in the kill rotation, a longer storm, a 4-tenant mix."""
         base = dict(
             duration=300.0, clients=6, target_rps=700.0,
             n_trace_items=20_000, objects=120_000,
             churn_ops_per_second=800.0, window_seconds=10.0,
             http_workers=2, preset="full", tag="r13_full",
-            policy_rewrites=5,
+            # 4-tenant mix: every SIGHUP runs 5 concurrent reload
+            # pipelines (see smoke's budget note)
+            policy_rewrites=5, tenants=4, p99_budget_ms=950.0,
         )
         base.update(over)
         return cls(**base)
@@ -171,15 +188,24 @@ class SoakEngine:
 
     # -- bring-up ----------------------------------------------------------
 
-    def _build_config(self, policies_path: Path):
+    def _build_config(self, policies_path: Path, tenants_path=None):
         from policy_server_tpu.config.config import (
             Config,
             TlsConfig,
             read_policies_file,
         )
 
+        tenants = None
+        if tenants_path is not None:
+            from policy_server_tpu.tenancy import read_tenants_file
+
+            tenants = read_tenants_file(tenants_path)
         s = self.settings
         return Config(
+            tenants_path=(
+                str(tenants_path) if tenants_path is not None else None
+            ),
+            tenants=tenants,
             addr="127.0.0.1",
             port=0,
             readiness_probe_port=0,
@@ -263,6 +289,147 @@ class SoakEngine:
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode() + body
+
+    # -- tenancy mix (round 16) --------------------------------------------
+
+    _TENANT_POLICIES_YAML = (
+        "pod-privileged:\n  module: builtin://pod-privileged\n"
+    )
+
+    @staticmethod
+    def _tenant_review_body() -> bytes:
+        return json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "soak-tenant",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "resource": {
+                    "group": "", "version": "v1", "resource": "pods",
+                },
+                "name": "t", "namespace": "default",
+                "operation": "CREATE",
+                "userInfo": {"username": "soak"},
+                "object": {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "t", "namespace": "default"},
+                    "spec": {"containers": [
+                        {"name": "c", "image": "nginx"},
+                    ]},
+                },
+            },
+        }, separators=(",", ":")).encode()
+
+    def _write_tenants(self, tmp: Path) -> tuple[Path, list[str]]:
+        """tenants.yml + the shared tiny per-tenant policies file:
+        ten-0 is the storm tenant (tight token-bucket quota), the rest
+        are victims with a 2x fair-dispatch weight."""
+        s = self.settings
+        names = [f"ten-{i}" for i in range(s.tenants)]
+        (tmp / "tenant-policies.yml").write_text(
+            self._TENANT_POLICIES_YAML, encoding="utf-8"
+        )
+        lines = ["tenants:"]
+        for i, name in enumerate(names):
+            lines += [f"  {name}:", "    policies: tenant-policies.yml"]
+            if i == 0:
+                lines += [
+                    f"    quota-rows-per-second: {s.tenant_storm_quota_rps:g}",
+                    f"    quota-burst: {max(8.0, s.tenant_storm_quota_rps / 2):g}",
+                    "    weight: 1.0",
+                ]
+            else:
+                lines += ["    weight: 2.0"]
+        path = tmp / "tenants.yml"
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        return path, names
+
+    def _tenant_storm_loop(
+        self, tenant: str, stop: threading.Event, stats: dict
+    ) -> None:
+        """UNPACED flood of tenant-0 far past its quota: the admission
+        bucket must shed 429s at the front door (legal, counted) — the
+        victims' p99 is the isolation judge."""
+        body = self._tenant_review_body()
+        wire = self._wire(f"/validate/{tenant}/pod-privileged", body)
+        conn = None
+        while not stop.is_set():
+            try:
+                if conn is None:
+                    conn = _HttpConn(self.api_port)
+                conn.sendall(wire * 8)
+                for _ in range(8):
+                    status, _h, _b = conn.read_response()
+                    from tools.soak import slo as slo_mod
+
+                    cls = self.recorder.classify(status, "ok")
+                    with self._tenant_lock:
+                        stats["requests"] += 1
+                        if cls == slo_mod.SHED:
+                            stats["sheds"] += 1
+                        elif cls == slo_mod.UNEXPLAINED:
+                            stats["errors"] += 1
+                    self.recorder.record(
+                        status, 0.0, "ok", detail=f"tenant-storm {tenant}"
+                    )
+            except Exception:  # noqa: BLE001 — reconnect and continue
+                if conn is not None:
+                    conn.close()
+                conn = None
+                stop.wait(0.05)
+                continue
+            stop.wait(0.005)  # ~1.6k req/s ceiling: a storm, not a DoS
+        if conn is not None:
+            conn.close()
+
+    def _tenant_victim_loop(
+        self, tenant: str, rps: float, stop: threading.Event, stats: dict
+    ) -> None:
+        """Paced victim traffic whose per-request latency is recorded —
+        the tenancy gate requires its p99 inside the soak budget while
+        the storm tenant floods."""
+        body = self._tenant_review_body()
+        wire = self._wire(f"/validate/{tenant}/pod-privileged", body)
+        period = 1.0 / max(1.0, rps)
+        conn = None
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                if conn is None:
+                    conn = _HttpConn(self.api_port)
+                conn.sendall(wire)
+                status, _h, _b = conn.read_response()
+                latency_ms = (time.perf_counter() - t0) * 1000.0
+                # the recorder's classifier owns the fault-window logic:
+                # a 5xx inside a DECLARED fault window (frontend burst
+                # fault, worker kill) is explained — loudly counted, but
+                # not an isolation breach
+                from tools.soak import slo as slo_mod
+
+                cls = self.recorder.classify(status, "ok")
+                with self._tenant_lock:
+                    stats["requests"] += 1
+                    if cls == slo_mod.OK:
+                        stats["latencies_ms"].append(latency_ms)
+                    elif cls == slo_mod.SHED:
+                        stats["sheds"] += 1
+                    elif cls == slo_mod.UNEXPLAINED:
+                        stats["errors"] += 1
+                self.recorder.record(
+                    status, latency_ms, "ok",
+                    detail=f"tenant-victim {tenant}",
+                )
+            except Exception:  # noqa: BLE001 — reconnect and continue
+                if conn is not None:
+                    conn.close()
+                conn = None
+                stop.wait(0.05)
+                continue
+            elapsed = time.perf_counter() - t0
+            if elapsed < period:
+                stop.wait(period - elapsed)
+        if conn is not None:
+            conn.close()
 
     # -- abuse driver ------------------------------------------------------
 
@@ -465,7 +632,16 @@ class SoakEngine:
         tmp = tempfile.mkdtemp(prefix="policy-server-soak-")
         policies_path = Path(tmp) / "policies.yml"
         policies_path.write_text(_POLICIES_YAML, encoding="utf-8")
-        config = self._build_config(policies_path)
+        tenants_path = None
+        tenant_names: list[str] = []
+        if s.tenants >= 2:
+            tenants_path, tenant_names = self._write_tenants(Path(tmp))
+            self._say(
+                f"tenancy mix: {s.tenants} tenants (storm={tenant_names[0]} "
+                f"quota={s.tenant_storm_quota_rps:g} rows/s, victims="
+                f"{tenant_names[1:]})"
+            )
+        config = self._build_config(policies_path, tenants_path)
 
         handle = _ServerThread(config)
         server = handle.server
@@ -549,6 +725,34 @@ class SoakEngine:
             name="soak-abuse", daemon=True,
         )
         abuser.start()
+        # tenancy mix: one unpaced storm tenant + paced victims
+        self._tenant_lock = threading.Lock()
+        tenant_stats: dict[str, dict] = {}
+        tenant_threads: list[threading.Thread] = []
+        if tenant_names:
+            storm_name = tenant_names[0]
+            tenant_stats[storm_name] = {
+                "role": "storm", "requests": 0, "sheds": 0, "errors": 0,
+            }
+            tenant_threads.append(threading.Thread(
+                target=self._tenant_storm_loop,
+                args=(storm_name, stop, tenant_stats[storm_name]),
+                name="soak-tenant-storm", daemon=True,
+            ))
+            victims = tenant_names[1:]
+            per_victim = s.tenant_victim_rps / max(1, len(victims))
+            for name in victims:
+                tenant_stats[name] = {
+                    "role": "victim", "requests": 0, "sheds": 0,
+                    "errors": 0, "latencies_ms": [],
+                }
+                tenant_threads.append(threading.Thread(
+                    target=self._tenant_victim_loop,
+                    args=(name, per_victim, stop, tenant_stats[name]),
+                    name=f"soak-tenant-{name}", daemon=True,
+                ))
+            for t in tenant_threads:
+                t.start()
         storm.start(t0)
         self._say("traffic + churn + storm running")
 
@@ -557,6 +761,8 @@ class SoakEngine:
             time.sleep(min(2.0, max(0.1, end - time.monotonic())))
         stop.set()
         for t in threads:
+            t.join(timeout=30)
+        for t in tenant_threads:
             t.join(timeout=30)
         churner.join(timeout=5)
         policy_churner.join(timeout=5)
@@ -591,6 +797,62 @@ class SoakEngine:
                     break
                 time.sleep(0.3)  # watcher poll is 1 s; wait a tick
 
+        # drain the NAMED tenants' in-flight reloads too: the per-tenant
+        # SIGHUP fan-out gate judges settled lifecycles
+        tenant_mix = None
+        if tenant_names:
+            mgr = server.state.tenants
+            drain_end = time.monotonic() + 60.0
+            while time.monotonic() < drain_end:
+                busy = [
+                    n for n in tenant_names
+                    if (lc := mgr.get(n).state.lifecycle) is not None
+                    and lc.reload_in_flight()
+                ]
+                if not busy:
+                    break
+                time.sleep(0.25)
+            from tools.bench.common import pct
+
+            victim_lat = sorted(
+                v
+                for st in tenant_stats.values()
+                if st["role"] == "victim"
+                for v in st["latencies_ms"]
+            )
+            reloads_per_tenant = {}
+            for n in tenant_names:
+                lc = mgr.get(n).state.lifecycle
+                reloads_per_tenant[n] = (
+                    lc.stats()["reloads"] if lc is not None else 0
+                )
+            storm_st = tenant_stats[tenant_names[0]]
+            tenant_mix = {
+                "tenants": len(tenant_names),
+                "storm_tenant": tenant_names[0],
+                "storm_requests": storm_st["requests"],
+                "storm_sheds": storm_st["sheds"],
+                "storm_shed_rate": round(
+                    storm_st["sheds"] / max(1, storm_st["requests"]), 4
+                ),
+                "victim_requests": sum(
+                    st["requests"] for st in tenant_stats.values()
+                    if st["role"] == "victim"
+                ),
+                # OK-classified responses only — the gate requires this
+                # to be nonzero so an all-shed victim outage can never
+                # pass on a vacuous p99 of 0.0
+                "victim_ok": len(victim_lat),
+                "victim_p50_ms": round(pct(victim_lat, 0.50), 2),
+                "victim_p99_ms": round(pct(victim_lat, 0.99), 2),
+                "victim_unexplained": sum(
+                    st["errors"] for st in tenant_stats.values()
+                    if st["role"] == "victim"
+                ),
+                "reloads_per_tenant": reloads_per_tenant,
+            }
+            self._say(f"tenancy mix {json.dumps(tenant_mix)}")
+
         lifecycle_stats = (
             server.lifecycle.stats() if server.lifecycle else {}
         )
@@ -609,6 +871,7 @@ class SoakEngine:
                 }
                 if s.policy_rewrites else None
             ),
+            tenant_mix=tenant_mix,
         )
         feed_stats = feed.stats()
         scanner_stats = server.state.audit.stats()
@@ -678,6 +941,10 @@ class SoakEngine:
                         ) or {}
                     ),
                 },
+                # the tenancy-mix receipts (round 16): the noisy
+                # neighbor's shed rate, the victims' p50/p99, and each
+                # tenant's promoted-reload count across the SIGHUPs
+                "tenancy": tenant_mix,
             },
         )
         self._say(

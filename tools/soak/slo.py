@@ -208,6 +208,7 @@ class SLORecorder:
         min_fault_events: int = 3,
         promoted_reloads: int | None = None,
         policy_rewrites: "dict | None" = None,
+        tenant_mix: "dict | None" = None,
     ) -> dict[str, Any]:
         t = self.totals()
         sighups = [
@@ -246,6 +247,30 @@ class SLORecorder:
                 and policy_rewrites.get("applied", 0)
                 >= policy_rewrites["planned"]
                 and bool(policy_rewrites.get("landed"))
+            )
+        if tenant_mix is not None:
+            # tenancy mix (round 16): the storm tenant PROVABLY shed at
+            # its admission quota (it overloaded, and the quota answered
+            # 429 instead of letting it queue into shared capacity)
+            # while every victim tenant held the p99 budget with zero
+            # unexplained non-2xx — the noisy-neighbor isolation claim,
+            # gate-checked
+            checks["tenant_isolation_held"] = (
+                tenant_mix.get("storm_sheds", 0) > 0
+                # victims must have SUCCEEDED, not merely tried: an
+                # all-shed victim outage yields a vacuous p99 of 0.0
+                # over zero samples, which must never read as held
+                and tenant_mix.get("victim_ok", 0) > 0
+                and tenant_mix.get("victim_p99_ms", float("inf"))
+                <= p99_budget_ms
+                and tenant_mix.get("victim_unexplained", 1) == 0
+            )
+            # every tenant's independent pipeline promoted at least one
+            # epoch across the mid-soak SIGHUP fan-outs (the per-tenant
+            # reload interaction, not just the default's)
+            reloads = tenant_mix.get("reloads_per_tenant") or {}
+            checks["tenant_reloads_promoted"] = bool(reloads) and all(
+                v >= 1 for v in reloads.values()
             )
         return {
             "passed": all(checks.values()),
