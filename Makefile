@@ -5,9 +5,10 @@ IMG ?= policy-server-tpu:latest
 
 .PHONY: all test unit-tests integration-tests bench chaos check docs \
         docs-check fastenc httpfront natives soak-smoke soak image \
-        dev-stack dev-stack-down dryrun-multichip multichip clean
+        dev-stack dev-stack-down dryrun-multichip multichip \
+        restart-drill clean
 
-all: natives test check soak-smoke multichip
+all: natives test check soak-smoke multichip restart-drill
 
 # full suite on the 8-virtual-device CPU backend (tests/conftest.py)
 test:
@@ -48,6 +49,17 @@ soak-smoke:
 # feed, prefork workers in the kill rotation, a 5-minute storm
 soak:
 	JAX_PLATFORMS=cpu python -m tools.soak --preset full
+
+# the crash-tolerance acceptance (round 17, tools/restart_drill.py):
+# cold-boot a REAL server process fetching policies from a local HTTP
+# registry, SIGKILL it under load, then warm-boot it with the registry
+# DOWN and FAILPOINTS=fetch.http armed — the state store must supply
+# every artifact (zero network), verdicts must be bit-exact across the
+# restart, and warm time-to-ready must be <= 0.5x cold (persistent XLA
+# cache + pinned artifact cache). Emits the restart_mttr bench line and
+# BENCH_restart_mttr.json.
+restart-drill:
+	JAX_PLATFORMS=cpu python -m tools.restart_drill
 
 # the graftcheck CI gate (tools/graftcheck/): concurrency lint
 # (guarded-by + lock-order cycles), trace-purity lint, observability

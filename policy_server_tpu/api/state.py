@@ -77,6 +77,30 @@ class ApiServerState:
     # deployments (no --tenants manifest) — every existing URL then maps
     # to this state's own epoch pointer, unchanged
     tenants: Any = None
+    # the durable last-good state store (statestore.StateStore); None
+    # without --state-dir — /metrics reads its counters through here
+    statestore: Any = None
+    # the boot report dict (warm/cold, time-to-ready, cache accounting);
+    # populated by new_from_config, also persisted into the state dir
+    boot_report: Any = None
+    # supervision counters (supervision.SupervisorStats): worker
+    # respawn/backoff/give-up + self-heal revives; None when embedding
+    # without the server bootstrap
+    supervisor: Any = None
+
+    def _supervisor_note(self, body: str) -> str:
+        """Append the honest-degradation note to a 200 readiness body:
+        a pod serving with abandoned frontend worker slots is UP but
+        degraded, and the probe's body must say so."""
+        if self.supervisor is None:
+            return body
+        given_up = self.supervisor.stats().get("worker_slots_given_up", 0)
+        if given_up:
+            return (
+                f"{body} (degraded: {given_up} frontend worker slot(s) "
+                "gave up respawning after crash-looping)"
+            )
+        return body
 
     def readiness(self) -> tuple[int, str]:
         """The process-wide /readiness verdict. Single-tenant: this
@@ -86,8 +110,11 @@ class ApiServerState:
         must keep landing here), with the degraded tenant names in the
         200 body; per-tenant probes live at /readiness/{tenant}."""
         if self.tenants is None:
-            return readiness_verdict(
+            code, body = readiness_verdict(
                 self.ready, self.batcher, self.evaluation_environment
+            )
+            return code, (
+                self._supervisor_note(body) if code == 200 else body
             )
         # the registry holds EVERY tenant incl. the default (whose
         # per-tenant verdict comes from the same readiness_verdict over
@@ -99,5 +126,7 @@ class ApiServerState:
                 "every tenant is degraded: " + ", ".join(degraded),
             )
         if degraded:
-            return 200, "ok (degraded tenants: " + ", ".join(degraded) + ")"
-        return 200, "ok"
+            return 200, self._supervisor_note(
+                "ok (degraded tenants: " + ", ".join(degraded) + ")"
+            )
+        return 200, self._supervisor_note("ok")

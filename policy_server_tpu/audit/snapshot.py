@@ -164,6 +164,40 @@ class SnapshotStore:
             self._dirty.discard(key)
             self._evicted += 1
 
+    # -- durable spill (round 17, statestore.py) ---------------------------
+
+    def export_rows(self) -> list[tuple[str, bytes]]:
+        """One locked snapshot of the inventory as ``(key, payload_json)``
+        pairs — the audit-spill corpus (payload_json is memoized, so this
+        is serialization-free for rows the live path already encoded)."""
+        with self._lock:
+            items = list(self._rows.items())
+        return [(key, req.payload_json()) for key, (req, _n) in items]
+
+    def restore_rows(self, pairs: Iterable[tuple[str, bytes]]) -> int:
+        """Rebuild inventory rows from a spill's pre-encoded payloads (a
+        warm boot's snapshot seed — the watch feed then RESUMES from its
+        spilled resourceVersion instead of re-LISTing the cluster).
+        Undecodable rows are skipped loudly; the next full re-LIST
+        repairs whatever a damaged spill lost."""
+        import json as _json
+
+        restored: list[ValidateRequest] = []
+        skipped = 0
+        for _key, payload in pairs:
+            try:
+                req = AdmissionRequest.from_dict(_json.loads(payload))
+                restored.append(ValidateRequest.from_admission(req))
+            except Exception:  # noqa: BLE001 — a damaged row must not
+                skipped += 1  # fail the boot; the resync repairs it
+        self.observe(restored)
+        if skipped:
+            logger.warning(
+                "audit spill restore skipped %d undecodable row(s); the "
+                "next full re-LIST resync repairs the inventory", skipped,
+            )
+        return len(restored)
+
     # -- seeding -----------------------------------------------------------
 
     def seed_from_file(self, path: str) -> int:

@@ -95,6 +95,10 @@ class WatchFeed:
         refresh_seconds: float = 30.0,
         max_queue_events: int = 65536,
         resync_multiplier: int = 10,
+        statestore: Any = None,
+        spill_interval_seconds: float = 30.0,
+        resume_rvs: dict[str, str] | None = None,
+        resume_fed: dict[str, dict[tuple, str]] | None = None,
     ) -> None:
         self.fetcher = fetcher
         self.resources = tuple(resources)
@@ -102,14 +106,36 @@ class WatchFeed:
         self.refresh_seconds = float(refresh_seconds)
         self.max_queue_events = max(1, int(max_queue_events))
         self.resync_multiplier = int(resync_multiplier)
+        # durable audit spill (round 17, statestore.py): a DEDICATED
+        # spiller thread periodically writes the per-kind resourceVersion
+        # cursors + the fed-object map + the snapshot inventory, so a
+        # restarted process RESUMES the watch streams (resume_rvs/
+        # resume_fed seed the loops) instead of re-LISTing the whole
+        # cluster. Off the applier thread on purpose: serializing a
+        # 100k-row inventory must never stall event application into a
+        # queue-overflow re-LIST. None = no --state-dir, bit-identical
+        # pre-round-17 behavior.
+        self.statestore = statestore
+        self.spill_interval_seconds = float(spill_interval_seconds)
+        self._resume_rvs = dict(resume_rvs or {})
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._cond = threading.Condition()
-        # ("event", kind_key, etype, obj) | ("replace", kind_key, items)
+        # ("event", kind_key, etype, obj, rv) | ("replace", kind_key,
+        # items, rv)
         self._queue: collections.deque = collections.deque()  # guarded-by: _cond
         # per kind: object identity -> snapshot-store key, for DELETE
-        # synthesis on replace (applier-thread-confined)
-        self._fed: dict[str, dict[tuple, str]] = {}  # graftcheck: lockfree — applier-thread-confined
+        # synthesis on replace (applier-written; the spiller copies it,
+        # so mutations AND copies hold the lock)
+        self._fed: dict[str, dict[tuple, str]] = dict(resume_fed or {})  # guarded-by: _cond
+        # per kind: the LIST rv the watcher last announced (watcher-
+        # thread confined per kind; attached to the queued replace)
+        self._list_rvs: dict[str, str] = {}  # graftcheck: lockfree — per-kind watcher-thread-confined
+        # per kind: newest APPLIED resourceVersion — the spill cursor.
+        # Advanced only after the snapshot observed the event/LIST, so a
+        # spill can never persist a cursor ahead of its inventory (a
+        # crash between would silently skip those events on resume).
+        self._rvs: dict[str, str] = dict(resume_rvs or {})  # guarded-by: _cond
         self._events_applied = 0  # guarded-by: _cond
         self._events_dropped = 0  # guarded-by: _cond
         self._resyncs = 0  # guarded-by: _cond
@@ -117,6 +143,8 @@ class WatchFeed:
         self._streams_opened = 0  # guarded-by: _cond
         self._replaces = 0  # guarded-by: _cond
         self._deletes_synthesized = 0  # guarded-by: _cond
+        self._spills = 0  # guarded-by: _cond
+        self._resumed_kinds = len(self._resume_rvs)  # graftcheck: lockfree — set once pre-start
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -128,6 +156,12 @@ class WatchFeed:
         )
         applier.start()
         self._threads.append(applier)
+        if self.statestore is not None:
+            spiller = threading.Thread(
+                target=self._spill_loop, name="audit-spill", daemon=True
+            )
+            spiller.start()
+            self._threads.append(spiller)
         for r in self.resources:
             t = threading.Thread(
                 target=self._watch_one,
@@ -181,6 +215,12 @@ class WatchFeed:
                 key, reason,
             )
 
+        def on_rv(key: str, rv: str) -> None:
+            # announced before replace_kind on the same watcher thread:
+            # _enqueue_replace attaches it to the queued LIST, and the
+            # cursor only ADVANCES when the applier lands the inventory
+            self._list_rvs[key] = rv
+
         run_watch_loop(
             self.fetcher,
             resource,
@@ -188,13 +228,20 @@ class WatchFeed:
             refresh_seconds=self.refresh_seconds,
             replace_kind=self._enqueue_replace,
             apply_event=self._enqueue_event,
-            rv=None,  # the loop's first pass does the boot LIST
+            # a spilled resourceVersion RESUMES the watch where the
+            # crashed process left off (no boot LIST); a stale cursor
+            # degrades to the loop's standard 410/error re-LIST path.
+            # None = the loop's first pass does the boot LIST.
+            rv=self._resume_rvs.get(resource_key(resource)),
             resync_multiplier=self.resync_multiplier,
             on_resync=on_resync,
             on_stream=on_stream,
+            on_rv=on_rv,
         )
 
     def _enqueue_event(self, key: str, etype: str, obj: Any) -> None:
+        rv = ((obj.get("metadata") or {}).get("resourceVersion")
+              if isinstance(obj, dict) else None)
         with self._cond:
             if len(self._queue) >= self.max_queue_events:
                 self._events_dropped += 1
@@ -205,7 +252,9 @@ class WatchFeed:
                     f"watch event queue full ({self.max_queue_events}); "
                     f"dropping {etype} for {key} and forcing a resync"
                 )
-            self._queue.append(("event", key, etype, obj))
+            self._queue.append(
+                ("event", key, etype, obj, str(rv) if rv else None)
+            )
             self._cond.notify()
 
     def _enqueue_replace(self, key: str, items: Iterable[Any]) -> None:
@@ -216,7 +265,9 @@ class WatchFeed:
             self._queue = collections.deque(
                 e for e in self._queue if e[1] != key
             )
-            self._queue.append(("replace", key, items))
+            self._queue.append(
+                ("replace", key, items, self._list_rvs.get(key))
+            )
             self._cond.notify()
 
     # -- applier side ------------------------------------------------------
@@ -238,27 +289,68 @@ class WatchFeed:
                 # truth eventually
                 logger.error("audit watch feed apply failed: %s", e)
 
+    # -- spiller side ------------------------------------------------------
+
+    def _spill_loop(self) -> None:
+        while not self._stop.wait(self.spill_interval_seconds):
+            self._spill_once()
+        # final spill on clean shutdown so the next boot resumes from
+        # the freshest possible cursor
+        self._spill_once()
+
+    def _spill_once(self) -> None:
+        """One durable spill: cursor map + fed map + the whole snapshot
+        inventory, one atomic journal replace. The cursors are copied
+        BEFORE the inventory export, so concurrent application can only
+        leave the inventory AHEAD of the cursor — the resume then
+        replays overlapping events, which the store's supersede
+        semantics absorb; a cursor ahead of its inventory (silently
+        skipped events) is impossible by construction. Contained — a
+        full disk degrades durability, never the feed."""
+        if self.statestore is None:
+            return
+        try:
+            with self._cond:
+                rvs = dict(self._rvs)
+                fed = {k: dict(m) for k, m in self._fed.items()}
+            self.statestore.spill_audit(
+                rvs, fed, self.snapshot.export_rows()
+            )
+            with self._cond:
+                self._spills += 1
+        except Exception as e:  # noqa: BLE001 — durability is best-effort
+            logger.error("audit spill failed: %s", e)
+
     def _apply_batch(self, batch: list) -> None:
         from policy_server_tpu.context.service import _object_key
 
         reviews: list = []
         applied = 0
         deletes = 0
+        # kind -> newest rv in this batch's EVENT entries; committed to
+        # the spill cursor only after the final observe below lands the
+        # buffered reviews in the snapshot
+        event_rvs: dict[str, str] = {}
         for entry in batch:
             if entry[0] == "replace":
                 # flush ordered work queued before this replace first
                 if reviews:
                     self.snapshot.observe(reviews)
                     reviews = []
-                _kind, key, items = entry
+                _kind, key, items, list_rv = entry
                 reviews_r, deletes_r = self._replace_reviews(key, items)
                 self.snapshot.observe(reviews_r)
                 deletes += deletes_r
                 with self._cond:
                     self._replaces += 1
                     self._deletes_synthesized += deletes_r
+                    if list_rv:
+                        # the LIST is now fully applied: the cursor may
+                        # advance past everything it superseded
+                        self._rvs[key] = list_rv
+                event_rvs.pop(key, None)
                 continue
-            _tag, key, etype, obj = entry
+            _tag, key, etype, obj, rv = entry
             op = {
                 "ADDED": "CREATE",
                 "MODIFIED": "UPDATE",
@@ -269,21 +361,26 @@ class WatchFeed:
             review = synthesize_review(obj, op)
             if review is None:
                 continue
-            fed = self._fed.setdefault(key, {})
             okey = _object_key(obj)
-            if op == "DELETE":
-                fed.pop(okey, None)
-            else:
-                skey = snapshot_key(review)
-                if skey is not None:
+            skey = snapshot_key(review)
+            with self._cond:
+                fed = self._fed.setdefault(key, {})
+                if op == "DELETE":
+                    fed.pop(okey, None)
+                elif skey is not None:
                     fed[okey] = skey
+            if rv:
+                event_rvs[key] = rv
             reviews.append(review)
             applied += 1
         if reviews:
             self.snapshot.observe(reviews)
-        if applied:
-            with self._cond:
+        with self._cond:
+            if applied:
                 self._events_applied += applied
+            # every buffered review is in the snapshot now: commit the
+            # batch's event cursors
+            self._rvs.update(event_rvs)
 
     def _replace_reviews(self, key: str, items: tuple) -> tuple[list, int]:
         """A full LIST for one kind → CREATE reviews for the inventory
@@ -291,7 +388,8 @@ class WatchFeed:
         while the stream was down (their report rows must prune)."""
         from policy_server_tpu.context.service import _object_key
 
-        fed = self._fed.setdefault(key, {})
+        with self._cond:
+            fed = dict(self._fed.get(key) or {})
         fresh: dict[tuple, str] = {}
         reviews: list = []
         for obj in items:
@@ -325,7 +423,8 @@ class WatchFeed:
             if review is not None:
                 reviews.append(review)
                 deletes += 1
-        self._fed[key] = fresh
+        with self._cond:
+            self._fed[key] = fresh
         return reviews, deletes
 
     # -- introspection -----------------------------------------------------
@@ -341,4 +440,6 @@ class WatchFeed:
                 "replaces": self._replaces,
                 "deletes_synthesized": self._deletes_synthesized,
                 "queue_depth": len(self._queue),
+                "spills": self._spills,
+                "resumed_kinds": self._resumed_kinds,
             }

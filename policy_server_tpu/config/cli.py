@@ -373,6 +373,50 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "admin endpoints (POST /policies/reload, /policies/"
                    "promote, /policies/rollback on the readiness port); "
                    "unset disables them")),
+        ("--state-dir", "KUBEWARDEN_STATE_DIR",
+         dict(default=None, metavar="DIR",
+              help="Durable last-good state directory (round 17, "
+                   "statestore.py): a crash-consistent store (atomic "
+                   "tmp+fsync+rename writes, CRC-framed generation-"
+                   "numbered journals) holding (a) a content-addressed "
+                   "policy artifact cache shared by boot and hot-reload "
+                   "fetch, (b) per-tenant last-good epoch manifests "
+                   "persisted on every promotion/rollback so the "
+                   "rollback pin survives restarts, and (c) the audit "
+                   "snapshot spill (resourceVersion cursors + "
+                   "inventory) so the watch feed RESUMES instead of "
+                   "re-LISTing the cluster. A warm boot whose policies "
+                   "config matches the last-good manifest loads pinned "
+                   "artifacts from the cache with ZERO network fetches; "
+                   "a failed fetch degrades loudly to last-good instead "
+                   "of fail-closing. Corrupt or torn entries are "
+                   "quarantined by the boot fsck pass, never fatal. "
+                   "Pair with --compilation-cache-dir inside it so "
+                   "compiled programs survive too. Unset = amnesiac "
+                   "restarts (every boot refetches and re-LISTs)")),
+        ("--state-audit-spill-seconds", "KUBEWARDEN_STATE_AUDIT_SPILL_SECONDS",
+         dict(type=float, default=30.0, metavar="SECONDS",
+              help="Cadence of the audit snapshot spill into the state "
+                   "dir (one atomic journal replace per tick; also "
+                   "spilled on clean shutdown). Only with --state-dir "
+                   "and --audit-watch")),
+        ("--selfheal-interval-seconds", "KUBEWARDEN_SELFHEAL_INTERVAL_SECONDS",
+         dict(type=float, default=5.0, metavar="SECONDS",
+              help="Main-process self-heal watchdog cadence "
+                   "(supervision.py): every tick it verifies the "
+                   "batcher dispatch loops (every tenant's) and the "
+                   "native frontend's drainer thread are alive, and "
+                   "REBUILDS a wedged one instead of serving zombies "
+                   "(counted on /metrics as "
+                   "policy_server_selfheal_*_revives). 0 disables")),
+        ("--worker-respawn-giveup", "KUBEWARDEN_WORKER_RESPAWN_GIVEUP",
+         dict(type=int, default=5, metavar="N",
+              help="Prefork respawn breaker: a frontend worker slot "
+                   "that crash-loops N consecutive times within the "
+                   "crash window stops respawning (exponential backoff "
+                   "applies before the cap); the remaining processes "
+                   "keep serving and /readiness reports the degraded "
+                   "slot honestly")),
         ("--mesh", "KUBEWARDEN_MESH",
          dict(default="auto", metavar="MESH_SPEC",
               help="Device mesh spec, e.g. 'auto', 'data:8', 'data:4,policy:2'")),

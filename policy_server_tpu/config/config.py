@@ -280,6 +280,25 @@ class Config:
     native_idle_timeout_seconds: float = 75.0
     native_read_timeout_seconds: float = 30.0
     native_max_connections: int = 0
+    # durable last-good state store (round 17, statestore.py): the
+    # crash-tolerance directory holding the content-addressed policy
+    # artifact cache, the per-tenant last-good epoch manifests, and the
+    # audit snapshot spill — a warm boot loads pinned artifacts with
+    # zero network, degrades loudly to last-good when fetch fails, and
+    # resumes the audit watch instead of re-LISTing. None = amnesiac
+    # restarts (pre-round-17 behavior)
+    state_dir: str | None = None
+    # audit-spill cadence: how often the watch feed spills its
+    # resourceVersion cursors + snapshot inventory to the state dir
+    state_audit_spill_seconds: float = 30.0
+    # main-process self-heal watchdog (supervision.py): rebuild a wedged
+    # batcher dispatch loop / native-frontend drainer instead of serving
+    # zombies; the check cadence in seconds (0 disables)
+    selfheal_interval_seconds: float = 5.0
+    # prefork respawn breaker: consecutive fast crash-loop deaths after
+    # which a worker slot stops respawning (readiness then reports the
+    # degraded slot honestly)
+    worker_respawn_giveup: int = 5
     mesh: MeshSpec = field(default_factory=MeshSpec)
     # how a >1 policy axis executes (round 14): 'fused' lowers the whole
     # policy set as ONE SPMD program over the (data x policy) mesh —
@@ -399,6 +418,12 @@ class Config:
             raise ValueError(
                 "--audit-watch-max-queue-events must be >= 1"
             )
+        if self.state_audit_spill_seconds <= 0:
+            raise ValueError("--state-audit-spill-seconds must be > 0")
+        if self.selfheal_interval_seconds < 0:
+            raise ValueError("--selfheal-interval-seconds must be >= 0")
+        if self.worker_respawn_giveup < 1:
+            raise ValueError("--worker-respawn-giveup must be >= 1")
         if self.native_idle_timeout_seconds < 0:
             raise ValueError("--native-idle-timeout-seconds must be >= 0")
         if self.native_read_timeout_seconds < 0:
@@ -550,6 +575,10 @@ class Config:
                 args.native_read_timeout_seconds
             ),
             native_max_connections=int(args.native_max_connections),
+            state_dir=args.state_dir or None,
+            state_audit_spill_seconds=float(args.state_audit_spill_seconds),
+            selfheal_interval_seconds=float(args.selfheal_interval_seconds),
+            worker_respawn_giveup=int(args.worker_respawn_giveup),
             mesh=MeshSpec.parse(args.mesh),
             mesh_dispatch=args.mesh_dispatch,
             warmup_at_boot=not args.no_warmup,
@@ -577,9 +606,19 @@ def _read_tenants(path: str | None):
 
 def read_policies_file(path: str | Path) -> dict[str, PolicyOrPolicyGroup]:
     """config.rs:449-453 + parse (config.rs:219-258)."""
+    return read_policies_source(path)[0]
+
+
+def read_policies_source(
+    path: str | Path,
+) -> tuple[dict[str, PolicyOrPolicyGroup], str]:
+    """Read + parse a policies file, returning the parsed mapping AND
+    the exact text it was parsed from — the durable-manifest path
+    (round 17) persists the bytes that were actually compiled/canaried,
+    never a re-read that could have changed underneath the reload."""
     with open(path, "r", encoding="utf-8") as f:
-        doc = yaml.safe_load(f)
-    return parse_policies(doc)
+        text = f.read()
+    return parse_policies(yaml.safe_load(text)), text
 
 
 def build_client_tls_config_from_env(prefix: str = "OTEL_EXPORTER_OTLP") -> dict[str, str]:

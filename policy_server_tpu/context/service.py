@@ -239,6 +239,7 @@ def run_watch_loop(
     resync_multiplier: int = 10,
     on_resync: Any = None,
     on_stream: Any = None,
+    on_rv: Any = None,
 ) -> None:
     """The ONE list+watch state machine (round 13: extracted so the audit
     snapshot feed shares it with the context service instead of re-growing
@@ -276,6 +277,14 @@ def run_watch_loop(
             if rv is None or time.monotonic() - last_list > resync_interval:
                 reason = pending_reason or "interval"
                 items, rv = fetcher.list_with_version(resource)
+                if on_rv is not None and rv is not None:
+                    # the LIST's collection resourceVersion — the durable
+                    # resume cursor the audit spill records (round 17).
+                    # Announced BEFORE replace_kind so the consumer can
+                    # attach it to the queued replace and only ADVANCE
+                    # its cursor once the inventory is applied; per-event
+                    # rvs reach the consumer via apply_event.
+                    on_rv(key, str(rv))
                 replace_kind(key, items)
                 last_list = time.monotonic()
                 pending_reason = None

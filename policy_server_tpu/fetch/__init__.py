@@ -70,7 +70,10 @@ _TRUST_ROOT_UNSET = object()
 
 
 def make_module_resolver(
-    config: "Config", trust_root=_TRUST_ROOT_UNSET
+    config: "Config",
+    trust_root=_TRUST_ROOT_UNSET,
+    statestore=None,
+    pinned_artifacts: dict[str, str] | None = None,
 ) -> Callable[[str], "PolicyModule"]:
     """The server's module resolver (lib.rs:134-143 download step folded
     into evaluation bootstrap): builtin:// and known upstream refs resolve
@@ -83,7 +86,17 @@ def make_module_resolver(
     TUF fetch, lib.rs:81-89). Loaded here only when the caller did not
     already attempt the load (the server loads once and shares,
     including its failure: a malformed root degrades with a warning,
-    it must not crash boot on the reload)."""
+    it must not crash boot on the reload).
+
+    ``statestore``/``pinned_artifacts`` (round 17, statestore.py): the
+    durable artifact cache shared by boot and hot-reload. A url whose
+    digest is PINNED by the last-good manifest (the current policies
+    config is byte-identical to what last served) loads straight from
+    the cache — zero network, the warm-boot fast path that makes a
+    restart survivable during a registry outage. Unpinned urls prefer
+    the live fetch (the cache is refreshed on success) and degrade
+    LOUDLY to the newest cached bytes when the fetch fails — last-good
+    keeps serving instead of the boot fail-closing the cluster."""
     from policy_server_tpu.policies import resolve_builtin
 
     if trust_root is _TRUST_ROOT_UNSET:
@@ -109,6 +122,58 @@ def make_module_resolver(
     dest = Path(config.policies_download_dir)
     cache: dict[str, "PolicyModule"] = {}
 
+    pinned = dict(pinned_artifacts or {})
+
+    def _fetch_with_last_good(url: str) -> Path:
+        """Live-preferred acquisition over the durable cache: pinned
+        urls skip the network outright; everything else fetches live and
+        falls back to the newest cached artifact — loudly — on any
+        fetch failure (the round-17 crash-tolerance contract)."""
+        if statestore is not None and url in pinned:
+            hit = statestore.cached_artifact(url, digest=pinned[url])
+            if hit is not None:
+                logger.info(
+                    "module %s loaded from the state-store artifact cache "
+                    "(pinned by the last-good manifest; no network fetch)",
+                    url,
+                )
+                return hit
+            # pin points at a blob fsck quarantined or never cached:
+            # fall through to the live fetch
+        try:
+            path = downloader.fetch_policy(url, dest)
+        except (FetchError, OSError) as e:
+            if statestore is not None:
+                hit = statestore.cached_artifact(url)
+                if hit is not None:
+                    statestore.count_degraded_load()
+                    logger.error(
+                        "fetch of %s FAILED (%s); DEGRADED to the "
+                        "last-good cached artifact — update the source "
+                        "and reload to clear this", url, e,
+                    )
+                    return hit
+            raise
+        if statestore is not None:
+            try:
+                # the detached-signature sidecar travels WITH the
+                # artifact into the cache: a cache-served module must
+                # verify exactly like a live-fetched one
+                sidecar_path = Path(str(path) + ".sig.json")
+                sidecar = (
+                    sidecar_path.read_bytes()
+                    if sidecar_path.exists() else None
+                )
+                statestore.record_artifact(
+                    url, path.read_bytes(), sidecar=sidecar
+                )
+            except OSError as e:  # cache write failure must not fail boot
+                logger.warning(
+                    "could not cache artifact %s in the state store: %s",
+                    url, e,
+                )
+        return path
+
     def resolve(url: str) -> "PolicyModule":
         if url in cache:
             return cache[url]
@@ -116,7 +181,7 @@ def make_module_resolver(
         if builtin is not None:
             cache[url] = builtin
             return builtin
-        path = downloader.fetch_policy(url, dest)
+        path = _fetch_with_last_good(url)
         digest = None
         if config.verification_config is not None:
             digest = verify_artifact(

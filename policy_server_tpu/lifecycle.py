@@ -99,21 +99,25 @@ class ShadowRecorder:
 
 class Epoch:
     """One serving generation: an evaluation environment + micro-batcher
-    pair and the policy mapping they were built from."""
+    pair, the policy mapping they were built from, and (when the set
+    came from a file) the exact yaml text that was parsed — the durable
+    manifest persists THESE bytes, never a re-read that could have been
+    rewritten while the candidate compiled."""
 
     __slots__ = (
-        "number", "environment", "batcher", "policies", "created_at",
-        "drain_thread",
+        "number", "environment", "batcher", "policies", "policies_yaml",
+        "created_at", "drain_thread",
     )
 
     def __init__(
         self, number: int, environment: Any, batcher: Any,
-        policies: Mapping[str, Any],
+        policies: Mapping[str, Any], policies_yaml: str | None = None,
     ):
         self.number = number
         self.environment = environment
         self.batcher = batcher
         self.policies = dict(policies)
+        self.policies_yaml = policies_yaml
         self.created_at = time.time()
         self.drain_thread: threading.Thread | None = None
 
@@ -190,6 +194,8 @@ class PolicyLifecycleManager:
         divergence_threshold: float = 0.0,
         warmup: bool = True,
         tenant: str = "default",
+        statestore: Any = None,
+        fingerprint: str | None = None,
     ) -> None:
         self.state = state
         # the tenant this lifecycle serves (round 16, tenancy.py): names
@@ -229,6 +235,12 @@ class PolicyLifecycleManager:
         self._canary_replays = 0  # guarded-by: _swap_lock
         self._canary_divergences = 0  # guarded-by: _swap_lock
         self._last_outcome = "none"  # guarded-by: _swap_lock
+        # durable last-good manifest sink (round 17, statestore.py):
+        # persisted on every promotion/rollback/boot so the rollback pin
+        # and the warm-boot artifact pins survive a crash; None = no
+        # --state-dir, bit-identical pre-round-17 behavior
+        self.statestore = statestore
+        self._fingerprint = fingerprint
         self._stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
         self._reload_inflight = threading.BoundedSemaphore(1)
@@ -260,19 +272,62 @@ class PolicyLifecycleManager:
         except Exception as e:  # noqa: BLE001 — observers must not fail
             logger.error("epoch-transition hook failed: %s", e)
 
+    # -- durable last-good manifest (round 17) -----------------------------
+
+    def _persist_manifest(self, epoch: Epoch, outcome: str) -> None:
+        """Record this epoch as the tenant's last-good in the state
+        store: the policies file's raw bytes + digest (a warm boot can
+        rebuild the exact set when the live read fails), the artifact
+        digests its modules resolved to (the warm-boot cache pins), and
+        the compile fingerprint. Best-effort and contained — a full disk
+        must never fail a promotion."""
+        store = self.statestore
+        if store is None:
+            return
+        try:
+            # the yaml captured when the epoch's set was READ — never a
+            # re-read of the file, which a concurrent rewrite could have
+            # changed into a config this epoch never compiled or canaried
+            yaml_text = epoch.policies_yaml
+            digests: dict = {}
+            try:
+                from policy_server_tpu.fetch import iter_module_urls
+
+                urls = set(iter_module_urls(epoch.policies).values())
+                digests = store.artifact_digests(urls)
+            except ImportError:
+                pass  # fetch subsystem absent: builtin-only set
+            store.persist_manifest(
+                self.tenant,
+                epoch=epoch.number,
+                outcome=outcome,
+                policy_ids=list(epoch.policies),
+                policies_yaml=yaml_text,
+                artifact_digests=digests,
+                fingerprint=self._fingerprint,
+            )
+        except Exception as e:  # noqa: BLE001 — durability is best-effort
+            logger.error(
+                "could not persist the last-good manifest for tenant "
+                "%s: %s", self.tenant, e,
+            )
+
     # -- bootstrap ---------------------------------------------------------
 
     def install_first_epoch(self, environment: Any, batcher: Any,
-                            policies: Mapping[str, Any]) -> Epoch:
+                            policies: Mapping[str, Any],
+                            policies_yaml: str | None = None) -> Epoch:
         """Adopt the boot-built environment/batcher pair as epoch 0 and
         mark the server ready (readiness honesty: /readiness serves 503
         until this runs — the first epoch is compiled AND warmed)."""
         with self._swap_lock:
-            epoch = Epoch(self._epoch_counter, environment, batcher, policies)
+            epoch = Epoch(self._epoch_counter, environment, batcher,
+                          policies, policies_yaml)
             self._current = epoch
         self.state.evaluation_environment = environment
         self.state.batcher = batcher
         self.state.ready = True
+        self._persist_manifest(epoch, "boot")
         return epoch
 
     def start_watching(self) -> None:
@@ -376,6 +431,7 @@ class PolicyLifecycleManager:
             t0 = time.perf_counter()
             candidate_env = None
             candidate_batcher = None
+            policies_yaml: str | None = None
             try:
                 # stage 1 — fetch: re-read config + re-resolve modules
                 # (the builder below resolves through the boot module
@@ -383,7 +439,7 @@ class PolicyLifecycleManager:
                 stage = "fetch"
                 failpoints.fire("reload.fetch")
                 if policies is None:
-                    policies = self._fetch_policies()
+                    policies, policies_yaml = self._fetch_policies()
                 # stage 2 — compile + warm the candidate epoch entirely
                 # off the serving path (the persistent XLA cache makes
                 # unchanged programs cheap)
@@ -420,7 +476,7 @@ class PolicyLifecycleManager:
                 self._epoch_counter += 1
                 epoch = Epoch(
                     self._epoch_counter, candidate_env, candidate_batcher,
-                    policies,
+                    policies, policies_yaml,
                 )
             if self.mode == "manual":
                 self._stage(epoch)
@@ -439,14 +495,20 @@ class PolicyLifecycleManager:
             )
             return outcome
 
-    def _fetch_policies(self) -> Mapping[str, Any]:
+    def _fetch_policies(self) -> tuple[Mapping[str, Any], str | None]:
+        """(policies, yaml_text) — the text is the exact source the
+        mapping was parsed from (None for programmatic sets). Closures
+        returning a bare mapping (embedders, older tests) still work."""
         if self._read_policies is not None:
-            return self._read_policies()
+            result = self._read_policies()
+            if isinstance(result, tuple):
+                return result
+            return result, None
         with self._swap_lock:
             current = self._current
         if current is None:
             raise ReloadRejected("fetch", "no current epoch to reload from")
-        return current.policies
+        return current.policies, current.policies_yaml
 
     def _reject(
         self, stage: str, env: Any, batcher: Any, reason: str,
@@ -623,6 +685,9 @@ class PolicyLifecycleManager:
             # one generation is the pin window: the epoch demoted two
             # promotions ago closes for good
             self._retire(beyond_pin, close_env=True)
+        # durable last-good: the pin must survive a crash that lands
+        # right after this flip (round 17)
+        self._persist_manifest(epoch, "promoted")
         # post-promote observers (audit scanner: full re-scan under the
         # newly serving set)
         self._fire_hook(self._on_promote, epoch.number)
@@ -712,6 +777,7 @@ class PolicyLifecycleManager:
             revived = Epoch(
                 prev.number, prev.environment,
                 self._build_batcher(prev.environment), prev.policies,
+                prev.policies_yaml,
             )
             revived.batcher.start()
             with self._swap_lock:
@@ -724,6 +790,9 @@ class PolicyLifecycleManager:
             self.state.batcher = revived.batcher
             if demoted is not None:
                 self._retire(demoted, close_env=False)
+            # the revived pin is the new last-good — a crash after a
+            # rollback must come back on the ROLLED-BACK-TO set
+            self._persist_manifest(revived, "rolled-back")
             # post-rollback observers (audit scanner: reports stamped by
             # the rolled-back epoch go stale, then full re-scan)
             self._fire_hook(

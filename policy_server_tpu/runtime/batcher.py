@@ -399,6 +399,34 @@ class MicroBatcher:
             self._thread.start()
         return self
 
+    # -- self-heal surface (round 17, supervision.SelfHealWatchdog) --------
+
+    def dispatch_wedged(self) -> bool:
+        """True when the dispatch loop thread DIED outside shutdown — a
+        zombie batcher: submissions still enqueue, nothing ever forms a
+        batch, every request times out while readiness answers 200."""
+        t = self._thread
+        return (
+            t is not None
+            and not t.is_alive()
+            and not self._stopping
+            and not self._stop.is_set()
+        )
+
+    def revive_dispatch(self) -> bool:
+        """Rebuild a dead dispatch loop (the self-heal watchdog's repair
+        action): queued work is still in the submission queue, the pools
+        are still up — only the forming loop needs a fresh thread.
+        Returns False when there is nothing to revive (alive, never
+        started, or shutting down)."""
+        if not self.dispatch_wedged():
+            return False
+        self._thread = threading.Thread(
+            target=self._loop, name="micro-batcher-revived", daemon=True
+        )
+        self._thread.start()
+        return True
+
     def shutdown(self) -> None:
         """Stop the dispatch thread and resolve every queued/waiting future.
 

@@ -316,6 +316,31 @@ class NativeFrontend:
                 return
             self._lib.httpfront_stop_accepting(self._handle)
 
+    # -- self-heal surface (round 17, supervision.SelfHealWatchdog) --------
+
+    def drainer_wedged(self) -> bool:
+        """True when the drain thread DIED while the frontend is still
+        serving: the native loops keep framing requests into the rings,
+        but nothing moves them to the batcher — every accepted request
+        rots until its webhook timeout."""
+        with self._lock:
+            closed = self._closed
+        t = self._drainer
+        return not closed and t is not None and not t.is_alive()
+
+    def revive_drainer(self) -> bool:
+        """Rebuild a dead drain thread (the watchdog's repair action) —
+        the SPSC ring's single-consumer contract holds because the old
+        consumer is provably dead before the new one starts."""
+        if not self.drainer_wedged():
+            return False
+        self._drainer = threading.Thread(
+            target=self._drain_loop, name="httpfront-drain-revived",
+            daemon=True,
+        )
+        self._drainer.start()
+        return True
+
     def shutdown(self, timeout: float = 10.0) -> None:
         """Stop serving: wait for every in-flight request's completion to
         flush (the batcher/bridge shutdown resolved their futures before

@@ -70,6 +70,9 @@ class PolicyServer:
         # native HTTP frontend (runtime/native_frontend.py); None under
         # --frontend python or after a native-load fallback
         self._native_frontend = None
+        # self-heal watchdog (supervision.py): rebuilds a wedged batcher
+        # dispatch loop / frontend drainer; started with the servers
+        self._selfheal = None
 
     # The serving environment/batcher are the CURRENT EPOCH's — a hot
     # reload (lifecycle.py) rebinds the state fields, so everything that
@@ -95,6 +98,9 @@ class PolicyServer:
         config: Config,
         module_resolver: Callable[[str], PolicyModule] | None = None,
     ) -> "PolicyServer":
+        import time as _time
+
+        boot_t0 = _time.monotonic()
         if config.enable_metrics:
             registry = setup_metrics()
             # Reference pushes metrics over OTLP gRPC (metrics.rs:14-29).
@@ -146,6 +152,69 @@ class PolicyServer:
                 }},
             )
 
+        # -- durable last-good state store (round 17, statestore.py) ------
+        # Opened BEFORE any fetch/compile so the whole boot can lean on
+        # it: the fsck pass quarantines torn/corrupt entries (never
+        # fatal), the last-good manifest pins artifact digests for the
+        # zero-network warm path, and the boot report below records how
+        # warm this boot actually was.
+        statestore = None
+        boot_report: dict = {"warm": False}
+        fingerprint = None
+        pinned_artifacts: dict[str, str] = {}
+
+        def _read_text(path) -> str | None:
+            if not path:
+                return None
+            try:
+                from pathlib import Path as _Path
+
+                return _Path(path).read_text(encoding="utf-8")
+            except OSError:
+                return None
+
+        if config.state_dir:
+            from policy_server_tpu.statestore import (
+                StateStore,
+                compute_fingerprint,
+            )
+
+            statestore = StateStore(config.state_dir)
+            fingerprint = compute_fingerprint({
+                "policy_ids": sorted(config.policies),
+                "backend": config.evaluation_backend,
+                "predicate_opt": config.predicate_opt,
+                "kernel": config.kernel,
+                "columnar": config.columnar,
+                "jax": _jax_version(),
+            })
+            manifest = statestore.last_good_manifest("default")
+            boot_report.update(
+                manifest_epoch=(
+                    manifest.get("epoch") if manifest is not None else None
+                ),
+                manifest_found=manifest is not None,
+                fingerprint_match=(
+                    manifest is not None
+                    and manifest.get("fingerprint") == fingerprint
+                ),
+            )
+            # warm-boot artifact pins: tenants whose CURRENT policies
+            # config is byte-identical to their last-good manifest load
+            # those artifacts straight from the cache — zero network
+            pinned_artifacts.update(
+                statestore.pinned_digests(
+                    "default", _read_text(config.policies_path)
+                )
+            )
+            if config.tenants is not None:
+                for t_name, t_spec in config.tenants.tenants.items():
+                    pinned_artifacts.update(
+                        statestore.pinned_digests(
+                            t_name, _read_text(t_spec.policies_path)
+                        )
+                    )
+
         # offline sigstore trust root, loaded ONCE and shared by the
         # module resolver (artifact verification) and the evaluation
         # builder (wasm keyless v2/verify capability). The fetch/crypto
@@ -183,7 +252,12 @@ class PolicyServer:
                     "or fetch settings, but the fetch subsystem is not "
                     "available"
                 ) from e
-            resolver = make_module_resolver(config, trust_root=trust_root)
+            resolver = make_module_resolver(
+                config,
+                trust_root=trust_root,
+                statestore=statestore,
+                pinned_artifacts=pinned_artifacts,
+            )
 
         context_service = _build_context_service(config)
 
@@ -250,12 +324,30 @@ class PolicyServer:
         # reloads for the same reason the canary ring does)
         audit_enabled = config.audit_mode != "off"
         snapshot_store = None
+        audit_resume: dict | None = None
         if audit_enabled:
             from policy_server_tpu.audit import SnapshotStore
 
             snapshot_store = SnapshotStore(
                 max_bytes=config.audit_max_snapshot_bytes
             )
+            if statestore is not None:
+                # warm boot: rebuild the inventory from the audit spill
+                # so the watch feed RESUMES from its spilled cursors
+                # instead of re-LISTing the whole cluster (round 17)
+                audit_resume = statestore.load_audit_spill()
+                if audit_resume is not None:
+                    restored = snapshot_store.restore_rows(
+                        audit_resume["rows"]
+                    )
+                    boot_report["audit_rows_restored"] = restored
+                    logger.info(
+                        "audit snapshot restored from the state-store "
+                        "spill", extra={"span_fields": {
+                            "rows": restored,
+                            "kinds_with_cursor": len(audit_resume["rvs"]),
+                        }},
+                    )
             if config.audit_resources_file:
                 snapshot_store.seed_from_file(config.audit_resources_file)
 
@@ -343,6 +435,8 @@ class PolicyServer:
             batcher.warmup()
         batcher.start()
 
+        from policy_server_tpu.supervision import SupervisorStats
+
         state = ApiServerState(
             evaluation_environment=environment,
             batcher=batcher,
@@ -350,11 +444,12 @@ class PolicyServer:
             enable_pprof=config.enable_pprof,
             ready=not reload_enabled,  # lifecycle flips it below
             admin_token=config.reload_admin_token,
+            statestore=statestore,
+            boot_report=boot_report,
+            supervisor=SupervisorStats(),
         )
 
         import dataclasses
-
-        from policy_server_tpu.config.config import read_policies_file
 
         def build_epoch_environment(policies):
             return _build_environment(
@@ -380,7 +475,14 @@ class PolicyServer:
                 path = config.policies_path
 
                 def read_policies():
-                    return read_policies_file(path)
+                    # (policies, yaml_text): the manifest must persist
+                    # the exact bytes this reload parsed, never a later
+                    # re-read (config/config.read_policies_source)
+                    from policy_server_tpu.config.config import (
+                        read_policies_source,
+                    )
+
+                    return read_policies_source(path)
 
             state.lifecycle = PolicyLifecycleManager(
                 state=state,
@@ -397,11 +499,15 @@ class PolicyServer:
                     config.warmup_at_boot
                     and config.evaluation_backend == "jax"
                 ),
+                statestore=statestore,
+                fingerprint=fingerprint,
             )
             # first epoch = the boot build; flips state.ready (readiness
-            # honesty: compiled + warmed before the probe says 200)
+            # honesty: compiled + warmed before the probe says 200). The
+            # yaml text is the same read the warm-boot pin decision used.
             state.lifecycle.install_first_epoch(
-                environment, batcher, config.policies
+                environment, batcher, config.policies,
+                policies_yaml=_read_text(config.policies_path),
             )
             state.lifecycle.start_watching()
 
@@ -432,7 +538,8 @@ class PolicyServer:
                 # inventory tracks the cluster instead of only webhook
                 # traffic (audit/watch_feed.py)
                 state.audit_watch = _build_audit_watch_feed(
-                    config, snapshot_store
+                    config, snapshot_store,
+                    statestore=statestore, resume=audit_resume,
                 )
                 state.audit.watch_feed = state.audit_watch
             state.audit.start()
@@ -464,8 +571,51 @@ class PolicyServer:
                 Tenant(DEFAULT_TENANT, default_spec, state,
                        default_admission)
             )
+
+            def read_tenant_boot_policies(name: str, spec):
+                """One tenant's boot-time ``(policies, yaml_text)`` read,
+                carrying the crash-tolerance contract: the
+                ``tenant.reload`` chaos site fires here too (an
+                unreadable manifest at BOOT is the same failure as one
+                at reload), and with a state store the read degrades
+                LOUDLY to the tenant's last-good manifest bytes instead
+                of fail-closing the whole boot."""
+                import yaml as _yaml
+
+                from policy_server_tpu.config.config import (
+                    read_policies_source,
+                )
+                from policy_server_tpu.models.policy import parse_policies
+
+                try:
+                    with failpoints.scope(name):
+                        failpoints.fire("tenant.reload")
+                    return read_policies_source(spec.policies_path)
+                except Exception as e:  # noqa: BLE001 — every read
+                    # failure takes the same last-good path
+                    if statestore is not None:
+                        m = statestore.last_good_manifest(name)
+                        if m is not None and m.get("policies_yaml"):
+                            statestore.count_degraded_load()
+                            logger.error(
+                                "tenant %s policies read FAILED (%s); "
+                                "booting DEGRADED on the last-good "
+                                "manifest (epoch %s) — fix the manifest "
+                                "and reload to clear this",
+                                name, e, m.get("epoch"),
+                            )
+                            return (
+                                parse_policies(
+                                    _yaml.safe_load(m["policies_yaml"])
+                                ),
+                                m["policies_yaml"],
+                            )
+                    raise
+
             for tenant_name, spec in tenants_manifest.tenants.items():
-                t_policies = read_policies_file(spec.policies_path)
+                t_policies, t_policies_yaml = read_tenant_boot_policies(
+                    tenant_name, spec
+                )
                 t_admission = None
                 if spec.quota_rows_per_second > 0 or spec.max_inflight > 0:
                     t_admission = TenantAdmission(
@@ -491,9 +641,14 @@ class PolicyServer:
                     # the tenant.reload chaos site: an armed fault here
                     # rejects THIS tenant's reload at the fetch stage
                     # (last-good keeps serving); other tenants' pipelines
-                    # are untouched
+                    # are untouched. Returns (policies, yaml_text) so the
+                    # manifest persists what this reload actually parsed.
+                    from policy_server_tpu.config.config import (
+                        read_policies_source,
+                    )
+
                     failpoints.fire("tenant.reload")
-                    return read_policies_file(_spec.policies_path)
+                    return read_policies_source(_spec.policies_path)
 
                 t_batcher = t_build_batcher(t_env)
                 if config.warmup_at_boot and config.evaluation_backend == "jax":
@@ -518,9 +673,12 @@ class PolicyServer:
                             and config.evaluation_backend == "jax"
                         ),
                         tenant=tenant_name,
+                        statestore=statestore,
+                        fingerprint=fingerprint,
                     )
                     t_state.lifecycle.install_first_epoch(
-                        t_env, t_batcher, t_policies
+                        t_env, t_batcher, t_policies,
+                        policies_yaml=t_policies_yaml,
                     )
                     t_state.lifecycle.start_watching()
                 else:
@@ -1124,6 +1282,124 @@ class PolicyServer:
                 "Shed (429) fraction of the current soak window",
                 soak.get("shed_rate", 0.0),
             )
+            # Crash-tolerant serving (round 17): boot shape, the durable
+            # state store's cache/journal/fsck accounting, and the
+            # supervision counters (worker respawn breaker + self-heal
+            # watchdog). All zero without --state-dir / prefork workers
+            # (families still export so dashboard panels resolve
+            # everywhere).
+            boot = getattr(state, "boot_report", None) or {}
+            yield (
+                metrics_names.BOOT_TIME_TO_READY, "gauge",
+                "Seconds from process bootstrap start to the first "
+                "serving epoch compiled+warmed (the MTTR numerator)",
+                boot.get("time_to_ready_seconds", 0.0),
+            )
+            yield (
+                metrics_names.BOOT_WARM, "gauge",
+                "1 when this boot was WARM: a last-good manifest was "
+                "found in the state store (artifact pins / audit resume "
+                "applied where eligible)",
+                1 if boot.get("warm") else 0,
+            )
+            yield (
+                metrics_names.BOOT_DEGRADED_SOURCES, "gauge",
+                "Policy sources this boot served from last-good state "
+                "because the live read/fetch FAILED (loud degradation, "
+                "not an outage)",
+                boot.get("degraded_sources", 0),
+            )
+            sstats = (
+                state.statestore.stats()
+                if state.statestore is not None else {}
+            )
+            yield (
+                metrics_names.STATESTORE_ARTIFACTS, "gauge",
+                "Content-addressed policy artifacts resident in the "
+                "state store's cache",
+                sstats.get("artifacts_resident", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_BYTES, "gauge",
+                "Bytes resident in the state store's artifact cache",
+                sstats.get("bytes_resident", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_CACHE_HITS, "counter",
+                "Artifact-cache hits (pinned warm-boot loads + degraded "
+                "last-good fallbacks)",
+                sstats.get("artifact_cache_hits", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_CACHE_MISSES, "counter",
+                "Artifact-cache misses (url unknown, blob missing, or "
+                "content-address verification failed)",
+                sstats.get("artifact_cache_misses", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_MANIFESTS_PERSISTED, "counter",
+                "Last-good epoch manifests persisted (boot, promotion, "
+                "rollback — the durable rollback pin)",
+                sstats.get("manifests_persisted", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_JOURNAL_RECORDS, "gauge",
+                "Live records across the state store's journals "
+                "(manifest history + url map)",
+                sstats.get("journal_records", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_FSCK_QUARANTINED, "counter",
+                "Torn/corrupt state-dir entries the fsck pass moved to "
+                "quarantine (boot continued on surviving state)",
+                sstats.get("fsck_quarantined", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_AUDIT_SPILLS, "counter",
+                "Audit snapshot spills written (cursors + fed map + "
+                "inventory, one atomic journal replace each)",
+                sstats.get("audit_spills", 0),
+            )
+            yield (
+                metrics_names.STATESTORE_AUDIT_ROWS_RESTORED, "gauge",
+                "Audit inventory rows restored from the spill at this "
+                "boot (the re-LIST the warm boot did NOT pay)",
+                sstats.get("audit_rows_restored", 0),
+            )
+            sup = (
+                state.supervisor.stats()
+                if state.supervisor is not None else {}
+            )
+            yield (
+                metrics_names.WORKER_RESPAWNS, "counter",
+                "Prefork frontend workers respawned after dying",
+                sup.get("worker_respawns", 0),
+            )
+            yield (
+                metrics_names.WORKER_RESPAWN_BACKOFF_SECONDS, "counter",
+                "Cumulative crash-loop backoff applied before worker "
+                "respawns",
+                sup.get("worker_backoff_seconds", 0.0),
+            )
+            yield (
+                metrics_names.WORKER_SLOTS_GIVEN_UP, "gauge",
+                "Frontend worker slots abandoned by the respawn breaker "
+                "(crash-looped past the give-up cap; /readiness reports "
+                "the degradation)",
+                sup.get("worker_slots_given_up", 0),
+            )
+            yield (
+                metrics_names.SELFHEAL_BATCHER_REVIVES, "counter",
+                "Batcher dispatch loops the self-heal watchdog found "
+                "dead and rebuilt",
+                sup.get("batcher_revives", 0),
+            )
+            yield (
+                metrics_names.SELFHEAL_FRONTEND_REVIVES, "counter",
+                "Native-frontend drainer threads the self-heal watchdog "
+                "found dead and rebuilt",
+                sup.get("frontend_revives", 0),
+            )
 
         from policy_server_tpu.telemetry import default_registry
 
@@ -1142,6 +1418,37 @@ class PolicyServer:
                 ) from e
             tls_context = create_tls_config_and_watch_certificate_changes(
                 config.tls_config
+            )
+
+        # -- boot report (round 17): how warm this boot actually was ------
+        # "warm" = the state store carried a last-good manifest forward;
+        # the drill additionally checks artifacts_from_cache/fetches to
+        # prove the zero-network property.
+        if statestore is not None:
+            ss = statestore.stats()
+            boot_report.update(
+                warm=bool(boot_report.get("manifest_found")),
+                time_to_ready_seconds=round(
+                    _time.monotonic() - boot_t0, 3
+                ),
+                artifacts_from_cache=ss["artifact_cache_hits"],
+                degraded_sources=boot_report.get("degraded_sources", 0)
+                + ss["degraded_loads"],
+                fsck_quarantined=ss["fsck_quarantined"],
+            )
+            try:
+                from policy_server_tpu.fetch.downloader import retry_stats
+
+                boot_report["fetch_retry_giveups"] = retry_stats()["giveups"]
+            except ImportError:
+                pass
+            statestore.record_boot_report(boot_report)
+            logger.info(
+                "boot report", extra={"span_fields": dict(boot_report)}
+            )
+        else:
+            boot_report["time_to_ready_seconds"] = round(
+                _time.monotonic() - boot_t0, 3
             )
 
         return cls(config, state, tls_context)
@@ -1200,6 +1507,18 @@ class PolicyServer:
             self.config.readiness_probe_port
         )
         self._runners.append(ready_runner)
+
+        if (
+            self.config.selfheal_interval_seconds > 0
+            and self.state.supervisor is not None
+        ):
+            from policy_server_tpu.supervision import SelfHealWatchdog
+
+            self._selfheal = SelfHealWatchdog(
+                self.state,
+                self.state.supervisor,
+                interval_seconds=self.config.selfheal_interval_seconds,
+            ).start()
 
         self._ready.set()
         logger.info(
@@ -1327,28 +1646,30 @@ class PolicyServer:
     # crash-loop discipline (the reference defers to kubelet's restart
     # backoff; the in-box supervisor needs the same): a worker dying
     # within the crash window of its spawn is a crash-loop death —
-    # respawn with exponential backoff, give up on the slot after K
-    # consecutive fast deaths (a worker that boots on a bad port/config
-    # would otherwise respawn forever at 0.5 Hz)
+    # respawn with exponential backoff, give up on the slot after the
+    # --worker-respawn-giveup cap of consecutive fast deaths (a worker
+    # that boots on a bad port/config would otherwise respawn forever
+    # at 0.5 Hz). The give-up is the RESPAWN BREAKER: readiness then
+    # reports the degraded slot honestly, and the counters export.
     _WORKER_CRASH_WINDOW_SECONDS = 5.0
     _WORKER_BACKOFF_BASE_SECONDS = 0.5
     _WORKER_BACKOFF_CAP_SECONDS = 30.0
-    _WORKER_CRASH_GIVEUP = 5
 
     async def _supervise_workers(self) -> None:
         """Respawn dead frontend workers (the in-box analog of kubelet
         restarting reference replicas): a crashed worker otherwise shrinks
         the SO_REUSEPORT accept pool until restart. Fast-crashing workers
         back off exponentially and the slot is abandoned after
-        ``_WORKER_CRASH_GIVEUP`` consecutive fast deaths."""
+        ``--worker-respawn-giveup`` consecutive fast deaths."""
         import subprocess
         import time as _time
 
+        giveup = self.config.worker_respawn_giveup
+        supervisor = self.state.supervisor
         now = _time.monotonic()
         spawned_at = [now] * len(self._worker_procs)
         fast_deaths = [0] * len(self._worker_procs)
         respawn_at = [0.0] * len(self._worker_procs)
-        self._worker_slots_given_up = 0
 
         while True:
             await asyncio.sleep(self._WORKER_RESPAWN_INTERVAL_SECONDS)
@@ -1365,7 +1686,7 @@ class PolicyServer:
                     fast_deaths[i] += 1
                 else:
                     fast_deaths[i] = 0
-                if fast_deaths[i] >= self._WORKER_CRASH_GIVEUP:
+                if fast_deaths[i] >= giveup:
                     logger.error(
                         "frontend worker slot %d crash-looped %d times "
                         "within %.1fs of spawn (rc=%s); giving up on the "
@@ -1374,7 +1695,10 @@ class PolicyServer:
                         self._WORKER_CRASH_WINDOW_SECONDS, proc.returncode,
                     )
                     self._worker_procs[i] = None
-                    self._worker_slots_given_up += 1
+                    # SupervisorStats is the ONE authority for the
+                    # give-up count (readiness + /metrics read it)
+                    if supervisor is not None:
+                        supervisor.count_slot_given_up()
                     continue
                 backoff = 0.0
                 if fast_deaths[i]:
@@ -1391,6 +1715,8 @@ class PolicyServer:
                 )
                 # mark the slot pending; actual spawn below when due
                 self._worker_procs[i] = _PendingRespawn(proc.returncode)
+                if supervisor is not None:
+                    supervisor.count_respawn(backoff)
             for i, proc in enumerate(list(self._worker_procs)):
                 if (
                     isinstance(proc, _PendingRespawn)
@@ -1403,6 +1729,11 @@ class PolicyServer:
         import contextlib
         import os as _os
 
+        if self._selfheal is not None:
+            # the watchdog goes FIRST: shutting-down threads must not be
+            # mistaken for wedged ones and "revived" mid-teardown
+            self._selfheal.stop()
+            self._selfheal = None
         if self._native_frontend is not None:
             # stop ACCEPTING first; in-flight native requests drain below
             # once the batcher shutdown resolves their futures
@@ -1592,6 +1923,18 @@ def _daemonize(config: Config) -> None:
     os.dup2(err.fileno(), sys.stderr.fileno())
 
 
+def _jax_version() -> str:
+    """The jax version string for the compile fingerprint (a version
+    bump invalidates the persistent XLA cache's hit expectations); ""
+    when the backend is not importable (oracle-only deployments)."""
+    try:
+        import jax
+
+        return str(jax.__version__)
+    except ImportError:
+        return ""
+
+
 def _bound_port(runner: web.AppRunner) -> int | None:
     for site in runner.sites:
         server = getattr(site, "_server", None)
@@ -1600,7 +1943,9 @@ def _bound_port(runner: web.AppRunner) -> int | None:
     return None
 
 
-def _build_audit_watch_feed(config: Config, snapshot_store):
+def _build_audit_watch_feed(
+    config: Config, snapshot_store, statestore=None, resume=None
+):
     """--audit-watch bring-up: the in-cluster list+watch client feeding
     the audit snapshot store (audit/watch_feed.py). Connection failure
     follows the context-service contract: fatal unless
@@ -1633,6 +1978,10 @@ def _build_audit_watch_feed(config: Config, snapshot_store):
         snapshot_store,
         refresh_seconds=config.context_refresh_seconds,
         max_queue_events=config.audit_watch_max_queue_events,
+        statestore=statestore,
+        spill_interval_seconds=config.state_audit_spill_seconds,
+        resume_rvs=(resume or {}).get("rvs"),
+        resume_fed=(resume or {}).get("fed"),
     ).start()
 
 

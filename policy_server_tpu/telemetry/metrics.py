@@ -165,6 +165,35 @@ TENANT_EPOCH = "policy_server_tenant_policy_epoch"
 TENANT_ROLLBACKS = "policy_server_tenant_reload_rollbacks"
 TENANT_READY = "policy_server_tenant_ready"
 TENANTS_SERVING = "policy_server_tenants_serving"
+# round 17 — crash-tolerant serving (statestore.py + supervision.py):
+# boot shape (warm/cold + the time-to-ready MTTR gauge), the durable
+# state store's cache/journal/fsck accounting, and the supervision
+# counters (prefork respawn breaker + the self-heal watchdog). All zero
+# without --state-dir / prefork workers — the families still export so
+# dashboard panels resolve on every deployment.
+BOOT_TIME_TO_READY = "policy_server_boot_time_to_ready_seconds"
+BOOT_WARM = "policy_server_boot_warm"
+BOOT_DEGRADED_SOURCES = "policy_server_boot_degraded_sources"
+STATESTORE_ARTIFACTS = "policy_server_statestore_artifacts_resident"
+STATESTORE_BYTES = "policy_server_statestore_bytes_resident"
+STATESTORE_CACHE_HITS = "policy_server_statestore_artifact_cache_hits"
+STATESTORE_CACHE_MISSES = "policy_server_statestore_artifact_cache_misses"
+STATESTORE_MANIFESTS_PERSISTED = (
+    "policy_server_statestore_manifests_persisted"
+)
+STATESTORE_JOURNAL_RECORDS = "policy_server_statestore_journal_records"
+STATESTORE_FSCK_QUARANTINED = "policy_server_statestore_fsck_quarantined"
+STATESTORE_AUDIT_SPILLS = "policy_server_statestore_audit_spills"
+STATESTORE_AUDIT_ROWS_RESTORED = (
+    "policy_server_statestore_audit_rows_restored"
+)
+WORKER_RESPAWNS = "policy_server_worker_respawns"
+WORKER_RESPAWN_BACKOFF_SECONDS = (
+    "policy_server_worker_respawn_backoff_seconds_total"
+)
+WORKER_SLOTS_GIVEN_UP = "policy_server_worker_slots_given_up"
+SELFHEAL_BATCHER_REVIVES = "policy_server_selfheal_batcher_revives"
+SELFHEAL_FRONTEND_REVIVES = "policy_server_selfheal_frontend_revives"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".

@@ -1,0 +1,26 @@
+"""Clean FS01 fixture: every raw write lives inside annotated atomic
+helpers; callers route through them."""
+
+import os
+
+
+def atomic_write_bytes(path, data):  # graftcheck: fs-atomic
+    tmp = str(path) + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def quarantine(path, dest):  # graftcheck: fs-atomic
+    os.replace(path, dest)
+
+
+def persist(path, payload):
+    atomic_write_bytes(path, payload)
+
+
+def load(path):
+    with open(path, "rb") as f:
+        return f.read()

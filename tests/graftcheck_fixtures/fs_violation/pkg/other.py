@@ -1,0 +1,12 @@
+"""Seeded FS01 violation: a module OUTSIDE statestore.py writing into
+the state dir behind the atomic helper's back."""
+
+
+def spill_behind_the_helpers_back(state_dir, data):
+    (state_dir / "rogue.bin").write_bytes(data)  # FS01: state_dir write
+
+
+def unrelated_write(tmp_path, data):
+    # no state_dir reference: other modules' ordinary file writes are
+    # not this checker's business
+    (tmp_path / "scratch.bin").write_bytes(data)

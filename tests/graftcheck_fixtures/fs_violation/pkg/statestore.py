@@ -1,0 +1,29 @@
+"""Seeded FS01 violations: raw writes in a statestore module outside
+the annotated atomic helper."""
+
+import os
+
+
+def atomic_write_bytes(path, data):  # graftcheck: fs-atomic
+    tmp = str(path) + ".tmp"
+    with open(tmp, "wb") as f:  # blessed: inside the annotated helper
+        f.write(data)
+    os.replace(tmp, path)  # blessed
+
+
+def sneaky_direct_write(path, data):
+    with open(path, "wb") as f:  # FS01: raw write, no atomicity
+        f.write(data)
+
+
+def sneaky_path_write(path, text):
+    path.write_text(text)  # FS01: Path.write_text outside the helper
+
+
+def sneaky_rename(src, dst):
+    os.rename(src, dst)  # FS01: rename is the commit step — helper-only
+
+
+def reader_is_fine(path):
+    with open(path, "rb") as f:  # reads are not writes
+        return f.read()

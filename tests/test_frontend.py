@@ -176,7 +176,9 @@ def test_crash_looping_worker_backs_off_and_gives_up():
     from policy_server_tpu.telemetry import metrics as metrics_mod
 
     metrics_mod.reset_metrics_for_tests()
-    config = make_config(http_workers=2)  # main + 1 child worker
+    # main + 1 child worker; the respawn breaker caps at 3 (the
+    # --worker-respawn-giveup knob, round 17)
+    config = make_config(http_workers=2, worker_respawn_giveup=3)
     server = PolicyServer.new_from_config(config)
     # fast supervision so the whole loop fits in test time; a WIDE crash
     # window because python subprocess startup alone can take seconds on
@@ -184,7 +186,6 @@ def test_crash_looping_worker_backs_off_and_gives_up():
     server._WORKER_RESPAWN_INTERVAL_SECONDS = 0.1
     server._WORKER_CRASH_WINDOW_SECONDS = 60.0
     server._WORKER_BACKOFF_BASE_SECONDS = 0.05
-    server._WORKER_CRASH_GIVEUP = 3
 
     async def scenario():
         await server.start()
@@ -201,7 +202,9 @@ def test_crash_looping_worker_backs_off_and_gives_up():
                     break
                 await asyncio.sleep(0.1)
             assert server._worker_procs[0] is None, "slot must be abandoned"
-            assert server._worker_slots_given_up == 1
+            assert server.state.supervisor.stats()[
+                "worker_slots_given_up"
+            ] == 1
             # the main process keeps serving after giving the slot up
             async with aiohttp.ClientSession() as s:
                 body = pod_review_body(False)
@@ -213,6 +216,22 @@ def test_crash_looping_worker_backs_off_and_gives_up():
                     assert r.status == 200
                     doc = await r.json()
                     assert doc["response"]["allowed"] is True
+                # the respawn-breaker surface (round 17): counters
+                # exported through the supervisor stats block...
+                sup = server.state.supervisor.stats()
+                assert sup["worker_slots_given_up"] == 1
+                # giveup=3 means two respawn attempts before the breaker
+                assert sup["worker_respawns"] == 2
+                assert sup["worker_backoff_seconds"] > 0
+                # ...and readiness stays UP but degrades HONESTLY — the
+                # probe body names the abandoned slot
+                ready_url = (
+                    f"http://127.0.0.1:{server.readiness_port}/readiness"
+                )
+                async with s.get(ready_url) as r:
+                    assert r.status == 200
+                    text = await r.text()
+                    assert "1 frontend worker slot(s) gave up" in text
         finally:
             await server.stop()
 

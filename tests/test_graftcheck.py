@@ -161,6 +161,43 @@ def test_failpoint_repo_sites_all_armed_and_documented():
 
 
 # ---------------------------------------------------------------------------
+# Checker — state-dir write discipline (FS01, round 17)
+# ---------------------------------------------------------------------------
+
+
+def test_statestore_fs_violation_fixture_flagged():
+    from tools.graftcheck import statestore_fs
+
+    findings = statestore_fs.check(FIXTURES / "fs_violation", "pkg")
+    assert rules_of(findings) == {"FS01"}
+    by_file = {(f.path, f.line) for f in findings}
+    # the three raw writes in the statestore module outside the
+    # annotated helper: open("wb"), Path.write_text, os.rename
+    assert ("pkg/statestore.py", 15) in by_file
+    assert ("pkg/statestore.py", 20) in by_file
+    assert ("pkg/statestore.py", 24) in by_file
+    # the package-wide rule: another module writing into the state dir
+    assert ("pkg/other.py", 6) in by_file
+    # the annotated helper's own writes and plain reads are clean, and
+    # other modules' non-state-dir writes are not this checker's business
+    assert len(findings) == 4
+
+
+def test_statestore_fs_clean_fixture_passes():
+    from tools.graftcheck import statestore_fs
+
+    assert statestore_fs.check(FIXTURES / "fs_clean", "pkg") == []
+
+
+def test_statestore_fs_repo_clean():
+    """FS01 over the real tree: every state-dir write goes through the
+    atomic helper (baseline stays empty)."""
+    from tools.graftcheck import statestore_fs
+
+    assert statestore_fs.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline mechanics
 # ---------------------------------------------------------------------------
 

@@ -1818,3 +1818,141 @@ def test_tenant_reload_fault_contained_across_sighup_fanout():
     finally:
         stop.set()
         handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# Round 17 — crash tolerance: the state store under chaos load
+# ---------------------------------------------------------------------------
+
+
+def test_statestore_armed_reload_under_load_then_warm_reboot(tmp_path):
+    """The state store under the chaos contract (and the lock-order
+    sanitizer, via make chaos): sustained traffic across a hot reload
+    with ``--state-dir`` armed — zero non-2xx, the last-good manifest
+    following every promotion — then a stop + warm re-boot with the
+    registry failpoint armed: the manifest pin carries over, verdicts
+    stay bit-exact, and the fsck pass quarantines a deliberately
+    bit-flipped journal on a THIRD boot instead of crashing it."""
+    import requests as rq
+
+    from policy_server_tpu import failpoints
+    from policy_server_tpu.statestore import StateStore
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    policies_path = tmp_path / "policies.yml"
+    policies_path.write_text(
+        "pod-privileged:\n  module: builtin://pod-privileged\n"
+    )
+
+    from policy_server_tpu.config.config import read_policies_file
+
+    def build_config():
+        return make_config(
+            policies=read_policies_file(policies_path),
+            policies_path=str(policies_path),
+            policy_timeout_seconds=5.0,
+            max_batch_size=4,
+            state_dir=str(tmp_path / "state"),
+            selfheal_interval_seconds=0.2,
+        )
+
+    handle = ServerHandle(build_config())
+    stop = threading.Event()
+    results: list[int] = []
+    errors: list[Exception] = []
+
+    def client():
+        body = pod_review_body(False)
+        while not stop.is_set():
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=body, timeout=30,
+                )
+                results.append(r.status_code)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        store = handle.server.state.statestore
+        assert store is not None
+        assert store.last_good_manifest()["outcome"] == "boot"
+        # promote a reload mid-traffic: the manifest must follow
+        policies_path.write_text(
+            "pod-privileged:\n  module: builtin://pod-privileged\n"
+            "happy:\n  module: builtin://always-happy\n"
+        )
+        assert handle.server.lifecycle.request_reload("chaos")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            m = store.last_good_manifest()
+            if m["outcome"] == "promoted" and m["epoch"] >= 1:
+                break
+            time.sleep(0.1)
+        m = store.last_good_manifest()
+        assert m["outcome"] == "promoted" and "happy" in m["policy_ids"]
+        # the self-heal watchdog ran under load without reviving anything
+        assert handle.server.state.supervisor.stats()[
+            "batcher_revives"
+        ] == 0
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert results and all(s == 200 for s in results)
+        r = rq.post(
+            handle.url("/validate/pod-privileged"),
+            json=pod_review_body(True), timeout=30,
+        )
+        pre_denied = r.json()["response"]["allowed"]
+        assert pre_denied is False
+    finally:
+        stop.set()
+        handle.stop()
+
+    # warm re-boot with the registry failpoint armed: builtin policies
+    # need no fetch, and the manifest pin must carry the epoch forward
+    with failpoints.active(
+        "fetch.http",
+        lambda: (_ for _ in ()).throw(
+            failpoints.FailpointError("registry outage")
+        ),
+    ):
+        handle2 = ServerHandle(build_config())
+    try:
+        report = handle2.server.state.boot_report
+        assert report["warm"] is True
+        assert report["manifest_epoch"] >= 1
+        r = rq.post(
+            handle2.url("/validate/pod-privileged"),
+            json=pod_review_body(True), timeout=30,
+        )
+        assert r.status_code == 200
+        assert r.json()["response"]["allowed"] is False
+    finally:
+        handle2.stop()
+
+    # bit-flip the manifests journal: the THIRD boot must fsck-
+    # quarantine it and come up clean-cold, never crash
+    journal = tmp_path / "state" / StateStore.MANIFESTS_JOURNAL
+    data = bytearray(journal.read_bytes())
+    data[8] ^= 0xFF
+    journal.write_bytes(bytes(data))
+    handle3 = ServerHandle(build_config())
+    try:
+        assert handle3.server.state.boot_report[
+            "fsck_quarantined"
+        ] >= 1
+        r = rq.post(
+            handle3.url("/validate/pod-privileged"),
+            json=pod_review_body(False), timeout=30,
+        )
+        assert r.status_code == 200
+    finally:
+        handle3.stop()

@@ -346,6 +346,10 @@ def test_mini_soak_engine_gates_green():
     settings = SoakSettings.smoke(
         duration=12.0, objects=2000, clients=2, target_rps=120.0,
         n_trace_items=1200, artifact=artifact,
+        # no restart cycle in the 12 s mini: a warm reboot is longer
+        # than the whole window — make soak-smoke carries the
+        # restart_storm_survived gate (round 17)
+        restarts=0,
     )
     rc = SoakEngine(settings).run()
     doc = json.loads(open(artifact).read())

@@ -180,13 +180,13 @@ def test_resync_repair_keeps_recreated_object_with_new_uid(setup):
 
     key = "v1/Pod"
     feed._apply_batch([
-        ("event", key, "ADDED", pod("uid-old")),
-        ("event", key, "ADDED", pod("uid-gone", name="web-1")),
+        ("event", key, "ADDED", pod("uid-old"), None),
+        ("event", key, "ADDED", pod("uid-gone", name="web-1"), None),
     ])
     assert len(store) == 2
     store.take_deletions()
     # outage: web-0 deleted + re-created (new uid), web-1 truly vanished
-    feed._apply_batch([("replace", key, (pod("uid-new"),))])
+    feed._apply_batch([("replace", key, (pod("uid-new"),), None)])
     assert len(store) == 1, "re-created object was evicted by the repair"
     assert feed.stats()["deletes_synthesized"] == 1  # web-1 only
     pruned = store.take_deletions()
@@ -262,3 +262,45 @@ def test_100k_churning_cluster_bounded_bytes():
     finally:
         feed.stop()
         cluster.stop()
+
+
+def test_spill_cursor_never_ahead_of_applied_inventory(setup, tmp_path):
+    """Round-17 crash-consistency of the audit spill: an event that was
+    ENQUEUED but not yet applied to the snapshot must not advance the
+    spilled resume cursor — otherwise a crash between spill and apply
+    would resume the watch past events the inventory never saw. Applied
+    events DO advance it, and the spilled state restores."""
+    from policy_server_tpu.statestore import StateStore
+
+    cluster, store, make_feed = setup
+    statestore = StateStore(tmp_path / "state")
+    feed = make_feed(
+        statestore=statestore, spill_interval_seconds=3600.0
+    )  # not started: this test drives the applier/spiller by hand
+
+    key = "v1/Pod"
+
+    def pod(rv):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"uid": f"u{rv}", "name": f"p{rv}",
+                         "namespace": "ns", "resourceVersion": str(rv)},
+            "spec": {"containers": []},
+        }
+
+    # enqueued but NOT applied: the cursor must not move
+    feed._enqueue_event(key, "ADDED", pod(7))
+    feed._spill_once()
+    spilled = StateStore(tmp_path / "state").load_audit_spill()
+    assert spilled["rvs"].get(key) is None
+    assert spilled["rows"] == []
+
+    # applied: cursor and inventory advance TOGETHER
+    with feed._cond:
+        batch = list(feed._queue)
+        feed._queue.clear()
+    feed._apply_batch(batch)
+    feed._spill_once()
+    spilled = StateStore(tmp_path / "state").load_audit_spill()
+    assert spilled["rvs"][key] == "7"
+    assert len(spilled["rows"]) == 1

@@ -17,7 +17,13 @@ import sys
 import tempfile
 from pathlib import Path
 
-from tools.graftcheck import concurrency, failpoint_drift, observability, tracepurity
+from tools.graftcheck import (
+    concurrency,
+    failpoint_drift,
+    observability,
+    statestore_fs,
+    tracepurity,
+)
 from tools.graftcheck.base import (
     Finding,
     apply_baseline,
@@ -63,6 +69,7 @@ def run_checkers(root: Path, skip_docs: bool = False) -> list[Finding]:
     findings += tracepurity.check(root)
     findings += observability.check(root)
     findings += failpoint_drift.check(root)
+    findings += statestore_fs.check(root)
     if not skip_docs:
         findings += docs_drift(root)
     return findings

@@ -1,0 +1,137 @@
+"""Checker 5 — state-dir write discipline (FS01).
+
+The state store's crash-consistency contract (statestore.py) is that
+EVERY observable on-disk state is a complete generation: writes go
+through the atomic tmp+fsync+rename helper, so a crash at any point
+leaves either the old complete file or the new complete file. One raw
+``open(path, "w")`` sneaked in anywhere under the state dir silently
+voids the whole contract — the classic way durable stores rot.
+
+Rule:
+
+* **FS01** — a raw filesystem write outside an ``# graftcheck:
+  fs-atomic`` annotated function, in either scope:
+
+  - **statestore modules** (any ``statestore.py`` in the package): ALL
+    raw writes must live inside annotated helpers — the module IS the
+    state dir's write surface, so the blessed zone is explicit and
+    reviewable;
+  - **package-wide**: any raw write whose call text references
+    ``state_dir`` (another module writing into the state dir behind the
+    helper's back).
+
+  Raw writes recognized: ``open(..)`` with a w/a/x mode, ``.write_bytes
+  (..)`` / ``.write_text(..)``, and ``os.replace`` / ``os.rename``
+  (renames are the atomic-commit step — only the helper may perform
+  them on state-dir paths).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.graftcheck.base import Finding, iter_py_files
+
+_ANNOTATION = "# graftcheck: fs-atomic"
+
+
+def _annotated_ranges(tree: ast.Module, source_lines: list[str]) -> list[tuple[int, int]]:
+    """(start, end) line ranges of functions whose def line (or any
+    decorator line) carries the fs-atomic annotation."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        first = min(
+            [node.lineno] + [d.lineno for d in node.decorator_list]
+        )
+        # the annotation may ride the def line itself or the line the
+        # signature closes on (black-style wrapped signatures)
+        sig_end = node.body[0].lineno if node.body else node.lineno
+        annotated = any(
+            _ANNOTATION in source_lines[i - 1]
+            for i in range(first, min(sig_end + 1, len(source_lines) + 1))
+        )
+        if annotated:
+            out.append((node.lineno, node.end_lineno or node.lineno))
+    return out
+
+
+def _write_mode(call: ast.Call) -> bool:
+    """open(...) with a writing mode (w/a/x/+)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(c in mode.value for c in "wax+")
+    return True  # computed mode: assume the worst
+
+
+def _raw_writes(tree: ast.Module) -> list[tuple[int, str, str]]:
+    """(line, kind, call-source-ish) for every raw-write call."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            if _write_mode(node):
+                out.append((node.lineno, "open-write", ast.dump(node)))
+        elif isinstance(f, ast.Attribute):
+            if f.attr in ("write_bytes", "write_text"):
+                out.append((node.lineno, f.attr, ast.dump(node)))
+            elif (
+                f.attr in ("replace", "rename")
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "os"
+            ):
+                out.append((node.lineno, f"os.{f.attr}", ast.dump(node)))
+    return out
+
+
+def check(root: str | Path, package: str = "policy_server_tpu") -> list[Finding]:
+    root = Path(root)
+    findings: list[Finding] = []
+    for path in iter_py_files(root, package):
+        relpath = str(path.relative_to(root))
+        source = path.read_text()
+        tree = ast.parse(source)
+        lines = source.splitlines()
+        is_statestore = path.name == "statestore.py"
+        ranges = _annotated_ranges(tree, lines)
+
+        def in_annotated(line: int) -> bool:
+            return any(a <= line <= b for a, b in ranges)
+
+        for line, kind, dump in _raw_writes(tree):
+            if in_annotated(line):
+                continue
+            if is_statestore:
+                findings.append(
+                    Finding(
+                        "statestore_fs", "FS01", relpath, line,
+                        f"rawwrite:{kind}:{line}",
+                        f"raw filesystem write ({kind}) in a statestore "
+                        "module outside a '# graftcheck: fs-atomic' "
+                        "helper — every state-dir write must be "
+                        "tmp+fsync+rename atomic",
+                    )
+                )
+            elif "state_dir" in dump:
+                findings.append(
+                    Finding(
+                        "statestore_fs", "FS01", relpath, line,
+                        f"rawwrite:{kind}:{line}",
+                        f"raw filesystem write ({kind}) targeting a "
+                        "state_dir path outside statestore.py's atomic "
+                        "helpers — the crash-consistency contract only "
+                        "holds if the state dir has ONE write surface",
+                    )
+                )
+    return findings

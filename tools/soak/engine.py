@@ -96,21 +96,33 @@ class SoakSettings:
     tenants: int = 0
     tenant_storm_quota_rps: float = 50.0
     tenant_victim_rps: float = 30.0  # total across victim tenants
+    # restart storm (round 17, statestore.py): N mid-soak server
+    # restarts — stop, then re-boot the SAME config with the registry
+    # failpoint armed; the warm boot must come from the state store
+    # (gate `restart_storm_survived`: warm-boot-used + bit-exact
+    # pre/post-restart probe verdicts + zero unexplained after ready).
+    # The in-process engine cannot SIGKILL itself, so the crash model
+    # is what the state store actually guarantees: nothing beyond the
+    # crash-consistent periodic spill and the promotion-time manifests
+    # is carried across (make restart-drill does the real SIGKILL).
+    restarts: int = 0
 
     @classmethod
     def smoke(cls, **over) -> "SoakSettings":
-        """The <=60 s CI mini-soak (make soak-smoke). The p99 budget is
+        """The CI mini-soak (make soak-smoke). The p99 budget is
         above the single-tenant 750 ms calibration because every SIGHUP
         now fans out N+1 CONCURRENT reload pipelines (default + each
         tenant, round 16) whose candidate compiles contend for the
         2-core box's GIL mid-soak — observed whole-soak p99 ≈390-760 ms
-        run-to-run with the tenancy mix on."""
+        run-to-run with the tenancy mix on. Round 17 stretched the
+        smoke window (20→45 s) to fit ONE mid-soak restart event before
+        the late reload."""
         base = dict(
-            duration=20.0, clients=3, target_rps=220.0,
+            duration=45.0, clients=3, target_rps=220.0,
             n_trace_items=2500, objects=20_000,
             churn_ops_per_second=300.0, window_seconds=2.5,
             preset="smoke", tag="r13_smoke", policy_rewrites=2,
-            tenants=2, p99_budget_ms=950.0,
+            tenants=2, p99_budget_ms=950.0, restarts=1,
         )
         base.update(over)
         return cls(**base)
@@ -118,7 +130,8 @@ class SoakSettings:
     @classmethod
     def full(cls, **over) -> "SoakSettings":
         """The cluster-scale soak: 100k+ watched objects, prefork
-        workers in the kill rotation, a longer storm, a 4-tenant mix."""
+        workers in the kill rotation, a longer storm, a 4-tenant mix,
+        a 2-cycle restart storm."""
         base = dict(
             duration=300.0, clients=6, target_rps=700.0,
             n_trace_items=20_000, objects=120_000,
@@ -127,6 +140,7 @@ class SoakSettings:
             # 4-tenant mix: every SIGHUP runs 5 concurrent reload
             # pipelines (see smoke's budget note)
             policy_rewrites=5, tenants=4, p99_budget_ms=950.0,
+            restarts=2,
         )
         base.update(over)
         return cls(**base)
@@ -188,7 +202,8 @@ class SoakEngine:
 
     # -- bring-up ----------------------------------------------------------
 
-    def _build_config(self, policies_path: Path, tenants_path=None):
+    def _build_config(self, policies_path: Path, tenants_path=None,
+                      state_dir: Path | None = None):
         from policy_server_tpu.config.config import (
             Config,
             TlsConfig,
@@ -202,6 +217,16 @@ class SoakEngine:
             tenants = read_tenants_file(tenants_path)
         s = self.settings
         return Config(
+            # durable state (round 17): the restart storm's warm boots
+            # ride the state store + the persistent XLA compile cache;
+            # the spill cadence is shortened so a mid-soak restart
+            # resumes a fresh audit inventory
+            state_dir=str(state_dir) if state_dir is not None else None,
+            compilation_cache_dir=(
+                str(state_dir / "xla-cache")
+                if state_dir is not None else None
+            ),
+            state_audit_spill_seconds=5.0,
             tenants_path=(
                 str(tenants_path) if tenants_path is not None else None
             ),
@@ -275,6 +300,10 @@ class SoakEngine:
                 if sock_ is not None:
                     sock_.close()
                 sock_ = None
+                # brief backoff: a dead port (mid-restart downtime)
+                # must not turn reconnects into a busy loop that starves
+                # the rebooting server of CPU
+                stop.wait(0.05)
                 continue
             elapsed = time.perf_counter() - t_burst
             if elapsed < burst_sleep:
@@ -447,6 +476,12 @@ class SoakEngine:
                 stop.wait(0.2)
             if stop.is_set():
                 return
+            while self._restart_in_progress and not stop.is_set():
+                # an abuse wave against a mid-reboot server proves only
+                # that a down server is down; wait for the swap
+                stop.wait(0.2)
+            if stop.is_set():
+                return
             try:
                 result = self._run_wave(wave)
             except Exception as e:  # noqa: BLE001 — an abuse wave must
@@ -609,6 +644,137 @@ class SoakEngine:
             )
             self._say(f"policies.yml rewritten ({rw.note})")
 
+    # -- restart storm (round 17) ------------------------------------------
+
+    def _probe(self, probes: list) -> list:
+        """Serve the fixed probe corpus and return (path, status, body)
+        triples — the bit-exactness witness across a restart."""
+        out = []
+        conn = _HttpConn(self.api_port)
+        try:
+            for it in probes:
+                conn.sendall(self._wire(it.path, it.body))
+                status, _h, body = conn.read_response()
+                out.append((it.path, status, body))
+        finally:
+            conn.close()
+        return out
+
+    def _do_restart(self, idx: int, t0: float) -> None:
+        """One restart cycle: probe → stop → re-boot the same config
+        with the registry failpoint armed → rebind traffic/feed/storm to
+        the new server → probe again. The fault window opens generously
+        (reboot length is compile-bound) and is CLOSED the moment the
+        post-restart probe answers, so post-ready errors stay visible."""
+        from policy_server_tpu import failpoints
+        from policy_server_tpu.audit import WatchFeed
+
+        self.recorder.note_fault_window("server_restart", duration=600.0)
+        self._restart_in_progress = True
+        pre = self._probe(self._restart_probes)
+        down_at = time.monotonic()
+        self._say(f"restart {idx}: stopping server (pre-probe recorded)")
+        self.feed.stop()  # spills its final cursor/inventory state
+        feed_stopped = time.monotonic()
+        self.handle.stop()
+        stopped = time.monotonic()
+        # the registry outage: any network fetch during the reboot
+        # raises — the warm boot must come entirely from the state store
+        failpoints.configure(
+            "fetch.http=raise:soak-restart-registry-outage"
+        )
+        try:
+            handle = _ServerThread(
+                self._build_config(*self._config_paths)
+            )
+        finally:
+            failpoints.configure("fetch.http=off")
+        booted = time.monotonic()
+        server = handle.server
+        self.handle = handle
+        self.server = server
+        self.api_port = server.api_port
+        self.native_active = server._native_frontend is not None
+        self.recorder.soak_state = server.state
+        self.storm.server = server
+        # rebuild the live feed on the NEW server's snapshot store,
+        # RESUMING from the spilled cursors (the cluster object survives
+        # the restart — it IS the cluster)
+        statestore = server.state.statestore
+        resume = (
+            statestore.load_audit_spill() if statestore is not None
+            else None
+        )
+        feed = WatchFeed(
+            self.cluster,
+            self.cluster.kinds,
+            server.state.audit.snapshot,
+            refresh_seconds=5.0,
+            max_queue_events=65536,
+            statestore=statestore,
+            spill_interval_seconds=5.0,
+            resume_rvs=(resume or {}).get("rvs"),
+            resume_fed=(resume or {}).get("fed"),
+        ).start()
+        server.state.audit_watch = feed
+        server.state.audit.watch_feed = feed
+        self.feed = feed
+        post = self._probe(self._restart_probes)
+        self.recorder.close_fault_window("server_restart")
+        self._restart_in_progress = False
+        report = dict(server.state.boot_report or {})
+        event = {
+            "at": round(down_at - t0, 1),
+            "down_s": round(time.monotonic() - down_at, 1),
+            "feed_stop_s": round(feed_stopped - down_at, 1),
+            "server_stop_s": round(stopped - feed_stopped, 1),
+            "boot_s": round(booted - stopped, 1),
+            "warm_boot_used": bool(report.get("warm")),
+            "verdicts_bit_exact": pre == post,
+            "audit_rows_restored": report.get("audit_rows_restored", 0),
+            "resumed_kinds": len((resume or {}).get("rvs") or {}),
+            "boot_report": report,
+        }
+        self._restarts_done.append(event)
+        self._say(
+            f"restart {idx} complete: warm={event['warm_boot_used']} "
+            f"bit_exact={event['verdicts_bit_exact']} "
+            f"down={event['down_s']}s "
+            f"rows_restored={event['audit_rows_restored']}"
+        )
+
+    def _restart_loop(self, stop: threading.Event, t0: float) -> None:
+        s = self.settings
+        # a single restart goes LATE-middle (0.6): after the pinned mid
+        # sighup / device-fault windows, so their interactions are not
+        # swallowed by the downtime; a multi-restart storm spreads from
+        # 0.30 (the full preset's window is long enough to serve real
+        # traffic between cycles)
+        if s.restarts == 1:
+            offsets = [0.60 * s.duration]
+        else:
+            offsets = [
+                (0.30 + 0.25 * i) * s.duration for i in range(s.restarts)
+            ]
+        for i, off in enumerate(offsets):
+            while not stop.is_set() and time.monotonic() < t0 + off:
+                stop.wait(0.2)
+            if stop.is_set():
+                return
+            try:
+                self._do_restart(i, t0)
+            except Exception as e:  # noqa: BLE001 — a failed restart is
+                # a FAILED GATE, never a crashed soak
+                self._restart_in_progress = False
+                self.recorder.close_fault_window("server_restart")
+                self._restarts_done.append({
+                    "at": round(time.monotonic() - t0, 1),
+                    "error": str(e)[:300],
+                    "warm_boot_used": False,
+                    "verdicts_bit_exact": False,
+                })
+                self._say(f"restart {i} FAILED: {e}")
+
     # -- the run -----------------------------------------------------------
 
     def run(self) -> int:
@@ -641,10 +807,20 @@ class SoakEngine:
                 f"quota={s.tenant_storm_quota_rps:g} rows/s, victims="
                 f"{tenant_names[1:]})"
             )
-        config = self._build_config(policies_path, tenants_path)
+        state_dir = Path(tmp) / "state" if s.restarts else None
+        config = self._build_config(
+            policies_path, tenants_path, state_dir=state_dir
+        )
+        # the restart storm re-builds the config from the SAME paths so
+        # a reboot re-reads whatever policies.yml says by then — exactly
+        # what a real process restart does (the churn storm may have
+        # rewritten it while the server was down)
+        self._config_paths = (policies_path, tenants_path, state_dir)
 
         handle = _ServerThread(config)
         server = handle.server
+        self.handle = handle
+        self.server = server
         self.api_port = server.api_port
         self.native_active = server._native_frontend is not None
         if s.frontend == "native" and not self.native_active:
@@ -654,14 +830,15 @@ class SoakEngine:
             )
         self._say(f"server up on :{self.api_port} native={self.native_active}")
 
-        # SIGHUP: a REAL signal when we own the main thread
+        # SIGHUP: a REAL signal when we own the main thread (the handler
+        # reads THROUGH self.server so it follows restart-storm swaps)
         sighup_registered = False
         if (
             hasattr(signal, "SIGHUP")
             and threading.current_thread() is threading.main_thread()
         ):
             signal.signal(
-                signal.SIGHUP, lambda *_a: server.reload_signal()
+                signal.SIGHUP, lambda *_a: self.server.reload_signal()
             )
             sighup_registered = True
 
@@ -675,9 +852,14 @@ class SoakEngine:
             server.state.audit.snapshot,
             refresh_seconds=5.0,
             max_queue_events=65536,
+            statestore=server.state.statestore,
+            spill_interval_seconds=(
+                config.state_audit_spill_seconds
+            ),
         ).start()
         server.state.audit_watch = feed
         server.state.audit.watch_feed = feed
+        self.feed = feed
 
         self.recorder = SLORecorder(
             window_seconds=s.window_seconds, soak_state=server.state
@@ -689,6 +871,15 @@ class SoakEngine:
             workers=s.http_workers > 1,
         )
         storm.recorder = self.recorder
+        self.storm = storm
+        self._restart_in_progress = False
+        storm.hold = lambda: self._restart_in_progress
+        # restart-storm probe corpus: fixed, expectation-OK trace items
+        # whose responses must be BIT-EXACT across every restart
+        self._restart_probes = [
+            it for it in trace.items if it.expect == "ok"
+        ][:4]
+        self._restarts_done: list[dict] = []
 
         # policy-churn storm (round 15): seeded policies.yml rewrites
         # under load — the digest watch reloads each one, and the
@@ -753,6 +944,13 @@ class SoakEngine:
                 ))
             for t in tenant_threads:
                 t.start()
+        restarter = None
+        if s.restarts:
+            restarter = threading.Thread(
+                target=self._restart_loop, args=(stop, t0),
+                name="soak-restart", daemon=True,
+            )
+            restarter.start()
         storm.start(t0)
         self._say("traffic + churn + storm running")
 
@@ -760,6 +958,12 @@ class SoakEngine:
         while time.monotonic() < end:
             time.sleep(min(2.0, max(0.1, end - time.monotonic())))
         stop.set()
+        if restarter is not None:
+            # a restart mid-flight finishes its swap before collection
+            # (compile-bound; collection must not race a half-swapped
+            # server)
+            restarter.join(timeout=240)
+        server = self.server  # the restart storm may have swapped it
         for t in threads:
             t.join(timeout=30)
         for t in tenant_threads:
@@ -872,8 +1076,12 @@ class SoakEngine:
                 if s.policy_rewrites else None
             ),
             tenant_mix=tenant_mix,
+            restart_storm=(
+                {"planned": s.restarts, "events": self._restarts_done}
+                if s.restarts else None
+            ),
         )
-        feed_stats = feed.stats()
+        feed_stats = self.feed.stats()
         scanner_stats = server.state.audit.stats()
         batcher_stats = server.batcher.stats_snapshot()
         native_stats = (
@@ -945,6 +1153,17 @@ class SoakEngine:
                 # neighbor's shed rate, the victims' p50/p99, and each
                 # tenant's promoted-reload count across the SIGHUPs
                 "tenancy": tenant_mix,
+                # the restart storm's receipts (round 17): every cycle's
+                # downtime, warm-boot flag, bit-exactness witness, and
+                # the full boot reports + state-store accounting
+                "restart_storm": {
+                    "planned": s.restarts,
+                    "events": self._restarts_done,
+                    "statestore": (
+                        server.state.statestore.stats()
+                        if server.state.statestore is not None else None
+                    ),
+                },
             },
         )
         self._say(
@@ -956,9 +1175,9 @@ class SoakEngine:
         )
         self._say(f"artifact: {artifact_path}")
 
-        feed.stop()
+        self.feed.stop()
         self.cluster.stop()
-        handle.stop()
+        self.handle.stop()
         if sighup_registered:
             signal.signal(signal.SIGHUP, signal.SIG_DFL)
         return 0 if gate["passed"] else 1

@@ -73,6 +73,10 @@ class FaultStorm:
     # device fault) declare a short window so the recorder counts them
     # as fault_injected — loudly, but not as unexplained
     recorder: Any = None
+    # optional gate: while hold() is true (the engine's restart storm is
+    # mid-swap), due events WAIT instead of applying — a SIGHUP delivered
+    # to a half-rebooted server tests nothing and loses the reload
+    hold: Any = None
     events: list[FaultEvent] = field(default_factory=list)
     # blast-radius window: recorder fault windows AND the device-fault
     # auto-disarm share it, so an armed fault can never outlive the
@@ -184,6 +188,12 @@ class FaultStorm:
                 self._stop.wait(min(delay, 0.2))
             if self._stop.is_set():
                 return
+            while (
+                self.hold is not None
+                and self.hold()
+                and not self._stop.is_set()
+            ):
+                self._stop.wait(0.2)
             try:
                 self._apply(event)
                 event.applied_at = time.monotonic() - t0

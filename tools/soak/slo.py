@@ -98,6 +98,19 @@ class SLORecorder:
         with self._lock:
             self._fault_windows.append((kind, now, now + duration))
 
+    def close_fault_window(self, kind: str) -> None:
+        """End the newest still-open window of ``kind`` NOW — callers
+        whose blast radius has a measured end (the server restart: probe
+        answered after ready) must not leave a generous pre-declared
+        window masking later unexplained errors."""
+        now = time.monotonic() - self._t0
+        with self._lock:
+            for i in range(len(self._fault_windows) - 1, -1, -1):
+                k, a, b = self._fault_windows[i]
+                if k == kind and b > now:
+                    self._fault_windows[i] = (k, a, now)
+                    break
+
     # -- recording ---------------------------------------------------------
 
     def classify(self, status: int, expect: str) -> str:
@@ -209,6 +222,7 @@ class SLORecorder:
         promoted_reloads: int | None = None,
         policy_rewrites: "dict | None" = None,
         tenant_mix: "dict | None" = None,
+        restart_storm: "dict | None" = None,
     ) -> dict[str, Any]:
         t = self.totals()
         sighups = [
@@ -271,6 +285,27 @@ class SLORecorder:
             reloads = tenant_mix.get("reloads_per_tenant") or {}
             checks["tenant_reloads_promoted"] = bool(reloads) and all(
                 v >= 1 for v in reloads.values()
+            )
+        if restart_storm is not None:
+            # restart storm (round 17): every scheduled mid-soak server
+            # restart happened, used the WARM boot path (the state store
+            # carried the last-good manifest forward, with the registry
+            # failpoint armed during the reboot), and the pre/post-
+            # restart probe verdicts were BIT-EXACT. Unexplained non-2xx
+            # after ready is covered by the global zero-unexplained
+            # check — the restart's fault window is CLOSED the moment
+            # the post-restart probe answers, so nothing after ready
+            # hides behind it.
+            events = restart_storm.get("events") or []
+            checks["restart_storm_survived"] = (
+                restart_storm.get("planned", 0) > 0
+                and len(events) >= restart_storm["planned"]
+                and all(
+                    e.get("warm_boot_used")
+                    and e.get("verdicts_bit_exact")
+                    and not e.get("error")
+                    for e in events
+                )
             )
         return {
             "passed": all(checks.values()),
