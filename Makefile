@@ -6,9 +6,9 @@ IMG ?= policy-server-tpu:latest
 .PHONY: all test unit-tests integration-tests bench chaos check docs \
         docs-check fastenc httpfront natives soak-smoke soak image \
         dev-stack dev-stack-down dryrun-multichip multichip \
-        restart-drill clean
+        restart-drill phase-report clean
 
-all: natives test check soak-smoke multichip restart-drill
+all: natives test check soak-smoke multichip restart-drill phase-report
 
 # full suite on the 8-virtual-device CPU backend (tests/conftest.py)
 test:
@@ -60,6 +60,14 @@ soak:
 # BENCH_restart_mttr.json.
 restart-drill:
 	JAX_PLATFORMS=cpu python -m tools.restart_drill
+
+# flight-recorder phase attribution (round 18, tools/bench/
+# phasereport.py): drive a short serving burst with the recorder armed,
+# reconcile summed phase time against per-batch wall time, and GATE the
+# unattributed residual at <25% of wall — the host floor is measured,
+# not guessed. Emits BENCH_phase_attribution.json.
+phase-report:
+	JAX_PLATFORMS=cpu python -m tools.bench.phasereport --gate
 
 # the graftcheck CI gate (tools/graftcheck/): concurrency lint
 # (guarded-by + lock-order cycles), trace-purity lint, observability

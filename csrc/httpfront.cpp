@@ -97,9 +97,16 @@ const char* reason_of(int status) {
 //                 3=validate-fallback 4=audit-fallback
 //   u8  flags     bit0: namespace present
 //   u16 policy_len | u16 uid_len | u16 ns_len | u16 op_len | u16 gvk_len
-//   u16 pad
+//   u16 tp_len    (W3C traceparent header, verbatim; 0 when absent)
 //   u32 payload_len
-//   bytes: policy_id, uid, namespace, operation, requestKind.kind, payload
+//   i64 t_first_ns | i64 t_parse_ns | i64 t_push_ns
+//       flight-recorder stamps on CLOCK_MONOTONIC (the clock Python's
+//       perf_counter_ns reads on Linux): request first byte observed,
+//       request fully received (canonicalize begins), record pushed to
+//       the ring. t_first is 0 when the request arrived in one read
+//       (the arrival window never opened).
+//   bytes: policy_id, uid, namespace, operation, requestKind.kind,
+//          traceparent, payload
 // Parsed kinds carry the canonical payload; raw/fallback carry the raw body.
 
 constexpr int K_VALIDATE = 0, K_AUDIT = 1, K_RAW = 2, K_VALIDATE_FB = 3,
@@ -110,16 +117,19 @@ struct RecHeader {
   uint64_t req_id;
   uint8_t kind;
   uint8_t flags;
-  uint16_t policy_len, uid_len, ns_len, op_len, gvk_len, pad;
+  uint16_t policy_len, uid_len, ns_len, op_len, gvk_len, tp_len;
   uint32_t payload_len;
+  int64_t t_first_ns, t_parse_ns, t_push_ns;
 } __attribute__((packed));
 
 uint8_t* build_record(uint64_t req_id, int kind, bool has_ns,
                       const std::string& policy, const std::string& uid,
                       const std::string& ns, const std::string& op,
-                      const std::string& gvk, const std::string& payload) {
+                      const std::string& gvk, const std::string& tp,
+                      const std::string& payload, int64_t t_first,
+                      int64_t t_parse, int64_t t_push) {
   size_t total = sizeof(RecHeader) + policy.size() + uid.size() + ns.size() +
-                 op.size() + gvk.size() + payload.size();
+                 op.size() + gvk.size() + tp.size() + payload.size();
   uint8_t* blob = (uint8_t*)malloc(total);
   RecHeader h;
   h.total_len = (uint32_t)total;
@@ -131,8 +141,11 @@ uint8_t* build_record(uint64_t req_id, int kind, bool has_ns,
   h.ns_len = (uint16_t)ns.size();
   h.op_len = (uint16_t)op.size();
   h.gvk_len = (uint16_t)gvk.size();
-  h.pad = 0;
+  h.tp_len = (uint16_t)tp.size();
   h.payload_len = (uint32_t)payload.size();
+  h.t_first_ns = t_first;
+  h.t_parse_ns = t_parse;
+  h.t_push_ns = t_push;
   uint8_t* p = blob;
   memcpy(p, &h, sizeof(h)); p += sizeof(h);
   memcpy(p, policy.data(), policy.size()); p += policy.size();
@@ -140,6 +153,7 @@ uint8_t* build_record(uint64_t req_id, int kind, bool has_ns,
   memcpy(p, ns.data(), ns.size()); p += ns.size();
   memcpy(p, op.data(), op.size()); p += op.size();
   memcpy(p, gvk.data(), gvk.size()); p += gvk.size();
+  memcpy(p, tp.data(), tp.size()); p += tp.size();
   memcpy(p, payload.data(), payload.size());
   return blob;
 }
@@ -886,6 +900,9 @@ struct Conn {
   int64_t total_body = 0;
   int route = -1;  // 0 validate 1 raw 2 audit; -1 miss; -2 method miss
   std::string policy_id;
+  // incoming W3C traceparent header, carried verbatim across the ring
+  // so Python parents the request's spans to the webhook caller's trace
+  std::string traceparent;
   bool expect_continue = false;
 };
 
@@ -1065,7 +1082,8 @@ void respond_static_idx(Loop* lp, Conn* c, int slot, int64_t actual_body) {
 }
 
 // hand the parsed request to Python via the submission ring
-void submit_request(Loop* lp, Conn* c, const std::string& body) {
+void submit_request(Loop* lp, Conn* c, const std::string& body,
+                    int64_t t_first) {
   Front* f = lp->front;
   int64_t t0 = now_ns();
   uint64_t id = ((uint64_t)(lp->idx & 0x7F) << 56) | lp->next_seq++;
@@ -1075,7 +1093,8 @@ void submit_request(Loop* lp, Conn* c, const std::string& body) {
   pr->http10 = c->http10;
   uint8_t* rec = nullptr;
   if (c->route == 1) {  // validate_raw: Python parses the raw body
-    rec = build_record(id, K_RAW, false, c->policy_id, "", "", "", "", body);
+    rec = build_record(id, K_RAW, false, c->policy_id, "", "", "", "",
+                       c->traceparent, body, t_first, t0, now_ns());
   } else {
     CanonResult cr;
     // ensure_ascii escaping can expand multibyte UTF-8 up to 3x: a
@@ -1091,11 +1110,12 @@ void submit_request(Loop* lp, Conn* c, const std::string& body) {
       f->stats[S_PARSED].fetch_add(1, std::memory_order_relaxed);
       rec = build_record(id, c->route == 2 ? K_AUDIT : K_VALIDATE, cr.has_ns,
                          c->policy_id, cr.uid, cr.ns, cr.op, cr.gvk,
-                         cr.payload);
+                         c->traceparent, cr.payload, t_first, t0, now_ns());
     } else {
       f->stats[S_FALLBACKS].fetch_add(1, std::memory_order_relaxed);
       rec = build_record(id, c->route == 2 ? K_AUDIT_FB : K_VALIDATE_FB,
-                         false, c->policy_id, "", "", "", "", body);
+                         false, c->policy_id, "", "", "", "",
+                         c->traceparent, body, t_first, t0, now_ns());
     }
   }
   int pushed = lp->ring.push(rec);
@@ -1123,6 +1143,10 @@ void submit_request(Loop* lp, Conn* c, const std::string& body) {
 void finish_request(Loop* lp, Conn* c, const std::string& body) {
   Front* f = lp->front;
   f->stats[S_REQUESTS].fetch_add(1, std::memory_order_relaxed);
+  // flight recorder: the read-timeout clock doubles as the request's
+  // arrival stamp (first byte of an incomplete request) — capture it
+  // before the reset below zeroes it
+  int64_t t_first = c->request_start_ns;
   // a request ARRIVED in full: reset the read-timeout clock so a
   // healthy client pipelining back-to-back requests (whose buffer
   // never drains to a clean boundary) is not reaped mid-stream; the
@@ -1142,7 +1166,7 @@ void finish_request(Loop* lp, Conn* c, const std::string& body) {
     respond_static_idx(lp, c, ST_413,
                        std::max((int64_t)body.size(), c->total_body));
   } else {
-    submit_request(lp, c, body);
+    submit_request(lp, c, body, t_first);
   }
   if (c->req_close || c->http10) c->closing = true;  // parse no further
   c->state = 0;
@@ -1156,6 +1180,7 @@ void finish_request(Loop* lp, Conn* c, const std::string& body) {
   c->total_body = 0;
   c->route = -1;
   c->policy_id.clear();
+  c->traceparent.clear();
   c->expect_continue = false;
 }
 
@@ -1249,6 +1274,19 @@ bool conn_parse(Loop* lp, Conn* c) {
           else if (ieq(v, vlen, "keep-alive")) keep_alive_hdr = true;
         } else if (ieq(hp, nlen, "expect")) {
           if (ieq(v, vlen, "100-continue")) c->expect_continue = true;
+        } else if (ieq(hp, nlen, "traceparent")) {
+          // carried verbatim but GATED to printable ASCII (bounded: the
+          // W3C form is 55 chars; a malformed oversize value is
+          // dropped, never truncated into something that parses).
+          // HTTP/1.1 field values legally carry obs-text bytes
+          // 0x80-0xFF — those must never cross the ring, or Python's
+          // strict decode would kill the drainer on attacker input.
+          bool clean = vlen <= 128;
+          for (size_t ti = 0; clean && ti < vlen; ti++) {
+            unsigned char ch = (unsigned char)v[ti];
+            if (ch < 0x20 || ch > 0x7e) clean = false;
+          }
+          if (clean) c->traceparent.assign(v, vlen);
         }
         hp = he + 2;
       }

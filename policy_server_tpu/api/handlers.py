@@ -117,6 +117,19 @@ def _tenant_span_field(request: web.Request) -> dict:
     return {} if name is None else {"tenant": name}
 
 
+def _incoming_trace(request: web.Request):
+    """The W3C ``traceparent`` parent of this request, when the span
+    pipeline is installed (round 18): webhook-originated traces then
+    correlate end-to-end instead of starting fresh roots. None keeps
+    the historical fresh-root behavior (and skips the header parse
+    entirely when no tracer exists)."""
+    from policy_server_tpu.telemetry import otlp
+
+    if otlp.tracer() is None:
+        return None
+    return otlp.parse_traceparent(request.headers.get("traceparent"))
+
+
 def _tenant_state(state: ApiServerState, request: web.Request):
     """Resolve the serving tenant from the request path (round 16,
     tenancy.py): un-prefixed routes keep the default epoch pointer (the
@@ -194,7 +207,8 @@ async def validate_handler(request: web.Request) -> web.Response:
     if isinstance(review, web.Response):
         return review
     with span(
-        "validation", host=state.hostname, policy_id=policy_id,
+        "validation", parent_ctx=_incoming_trace(request),
+        host=state.hostname, policy_id=policy_id,
         **_tenant_span_field(request),
         **_span_fields_from_admission(review),
     ) as fields:
@@ -219,7 +233,8 @@ async def audit_handler(request: web.Request) -> web.Response:
     if isinstance(review, web.Response):
         return review
     with span(
-        "audit", host=state.hostname, policy_id=policy_id,
+        "audit", parent_ctx=_incoming_trace(request),
+        host=state.hostname, policy_id=policy_id,
         **_tenant_span_field(request),
         **_span_fields_from_admission(review),
     ) as fields:
@@ -260,7 +275,8 @@ async def validate_raw_handler(request: web.Request) -> web.Response:
     except (KeyError, TypeError, ValueError) as e:
         return json_body_error(f"Failed to deserialize the JSON body: {e}")
     with span(
-        "validation_raw", host=state.hostname, policy_id=policy_id,
+        "validation_raw", parent_ctx=_incoming_trace(request),
+        host=state.hostname, policy_id=policy_id,
         **_tenant_span_field(request),
     ) as fields:
         result = await _evaluate(
@@ -390,6 +406,27 @@ async def metrics_handler(request: web.Request) -> web.Response:
     )
 
 
+async def timeline_handler(request: web.Request) -> web.Response:
+    """GET /debug/timeline (round 18): the flight recorder's ring as
+    Chrome/Perfetto trace JSON — batch phase tracks, native-frontend
+    burst aggregates, sampled-row tracks, plus the current tail
+    exemplars and ring accounting under ``otherData``. Load the body in
+    https://ui.perfetto.dev or chrome://tracing. 404 when
+    --flight-recorder off. Served on the readiness port (always the
+    main process, cluster-internal like /metrics) and on the
+    python-frontend API port."""
+    from policy_server_tpu.telemetry import flightrec
+
+    rec = flightrec.recorder()
+    if rec is None:
+        return api_error(404, "the flight recorder is disabled")
+    # snapshot + JSON render walk the whole ring: off the event loop
+    body = await asyncio.get_running_loop().run_in_executor(
+        None, rec.chrome_trace_json
+    )
+    return web.Response(body=body, content_type="application/json")
+
+
 async def pprof_cpu_handler(request: web.Request) -> web.Response:
     """GET /debug/pprof/cpu?interval= (handlers.rs:193-223). Interval is
     seconds (default 30, profiling.rs:48-51); runs off the event loop."""
@@ -456,6 +493,10 @@ def build_router(state: ApiServerState) -> web.Application:
     if state.enable_pprof:
         app.router.add_get("/debug/pprof/cpu", pprof_cpu_handler)
         app.router.add_get("/debug/pprof/heap", pprof_heap_handler)
+    # flight-recorder timeline (round 18): also on the API port for the
+    # python frontend (the native frontend serves only the evaluation
+    # POSTs; the readiness-port copy below is the always-there surface)
+    app.router.add_get("/debug/timeline", timeline_handler)
     return app
 
 
@@ -479,4 +520,8 @@ def build_readiness_router(state: ApiServerState) -> web.Application:
     # surface), cluster-internal like /metrics
     app.router.add_get("/audit/reports", audit_reports_handler)
     app.router.add_get("/audit/reports/{namespace}", audit_reports_handler)
+    # flight-recorder timeline (round 18): the main-process ring is the
+    # one with the batcher/device phases, and the readiness port is
+    # always served by the main process — the canonical surface
+    app.router.add_get("/debug/timeline", timeline_handler)
     return app

@@ -400,6 +400,37 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "dir (one atomic journal replace per tick; also "
                    "spilled on clean shutdown). Only with --state-dir "
                    "and --audit-watch")),
+        ("--flight-recorder", "KUBEWARDEN_FLIGHT_RECORDER",
+         dict(default="on", metavar="MODE", choices=["on", "off"],
+              help="Always-on flight recorder (round 18, telemetry/"
+                   "flightrec.py): a lock-free per-process ring of "
+                   "nanosecond-stamped phase events covering the full "
+                   "request lifecycle — native accept/parse/ring-cross "
+                   "(stamped in the C++ frontend and carried across the "
+                   "SPSC ring), batcher admission/queue-wait/formation, "
+                   "encode, dispatch, device execute, fetch, deliver, "
+                   "native verdict serialize — at <2% overhead (one "
+                   "clock read per phase boundary per BATCH; per-row "
+                   "events only on sampled rows). Read surfaces: GET "
+                   "/debug/timeline (Chrome/Perfetto trace JSON, on the "
+                   "readiness port and the python-frontend API port), "
+                   "per-phase latency histograms + tail exemplars on "
+                   "/metrics and OTLP, and the phase-attribution report "
+                   "(make phase-report). 'off' disables the recorder "
+                   "and the timeline endpoint")),
+        ("--recorder-ring-events", "KUBEWARDEN_RECORDER_RING_EVENTS",
+         dict(type=int, default=65536, metavar="N",
+              help="Flight-recorder ring capacity in events (rounded up "
+                   "to a power of two; ~10 batch events per dispatched "
+                   "batch, so the default holds the last ~6.5k batches; "
+                   "older events are overwritten, never blocked on)")),
+        ("--recorder-row-sample-rate", "KUBEWARDEN_RECORDER_ROW_SAMPLE_RATE",
+         dict(type=float, default=0.01, metavar="FRACTION",
+              help="Fraction of delivered rows that record per-row "
+                   "timeline segments on the flight recorder "
+                   "(deterministic 1-in-round(1/FRACTION) stride — no "
+                   "RNG on the serving path; 0 disables row sampling "
+                   "while batch events and tail exemplars remain)")),
         ("--selfheal-interval-seconds", "KUBEWARDEN_SELFHEAL_INTERVAL_SECONDS",
          dict(type=float, default=5.0, metavar="SECONDS",
               help="Main-process self-heal watchdog cadence "

@@ -295,6 +295,20 @@ class Config:
     # batcher dispatch loop / native-frontend drainer instead of serving
     # zombies; the check cadence in seconds (0 disables)
     selfheal_interval_seconds: float = 5.0
+    # flight recorder (round 18, telemetry/flightrec.py): always-on
+    # batch-granular phase timelines + per-phase histograms + tail
+    # exemplars at <2% overhead; False disables the recorder AND the
+    # GET /debug/timeline surface (the phase histogram family still
+    # exports, empty)
+    flight_recorder: bool = True
+    # preallocated phase-event ring capacity (rounded up to a power of
+    # two); at ~10 batch events per batch, the default holds the last
+    # ~6.5k batches
+    recorder_ring_events: int = 65536
+    # fraction of delivered rows that record per-row timeline segments
+    # (deterministic 1-in-round(1/rate) stride, no RNG on the serving
+    # path); 0 disables row sampling (batch events and exemplars remain)
+    recorder_row_sample_rate: float = 0.01
     # prefork respawn breaker: consecutive fast crash-loop deaths after
     # which a worker slot stops respawning (readiness then reports the
     # degraded slot honestly)
@@ -578,6 +592,9 @@ class Config:
             state_dir=args.state_dir or None,
             state_audit_spill_seconds=float(args.state_audit_spill_seconds),
             selfheal_interval_seconds=float(args.selfheal_interval_seconds),
+            flight_recorder=args.flight_recorder == "on",
+            recorder_ring_events=int(args.recorder_ring_events),
+            recorder_row_sample_rate=float(args.recorder_row_sample_rate),
             worker_respawn_giveup=int(args.worker_respawn_giveup),
             mesh=MeshSpec.parse(args.mesh),
             mesh_dispatch=args.mesh_dispatch,

@@ -40,6 +40,7 @@ import numpy as np
 
 from policy_server_tpu import failpoints
 from policy_server_tpu.resilience import CircuitBreaker
+from policy_server_tpu.telemetry import flightrec
 from policy_server_tpu.evaluation import groups as groups_mod
 from policy_server_tpu.evaluation import oracle as oracle_mod
 from policy_server_tpu.evaluation.errors import (
@@ -1974,12 +1975,32 @@ class EvaluationEnvironment:
                 self.breaker.record_failure()
             raise
 
-    def _scoped_device_fetch(self, scope_name: str | None, dev_out: Any):
+    def _scoped_device_fetch(
+        self,
+        scope_name: str | None,
+        dev_out: Any,
+        rec_batch: int = -1,
+        rec_rows: int = 0,
+    ):
         """_device_fetch on a drain-pool thread, re-applying the
         submitter's ambient failpoint scope — tenant-scoped chaos
-        (failpoints.scope) must cross the pool boundary with the work."""
+        (failpoints.scope) must cross the pool boundary with the work.
+        ``rec_batch``/``rec_rows`` carry the submitter's flight-recorder
+        attribution the same way: the device_get window recorded here is
+        the host-observed device-execute segment of that batch's
+        timeline (it runs UNDER the materialize fetch wait, so the
+        attribution report treats it as informational, never additive)."""
         with failpoints.scope(scope_name):
-            return self._device_fetch(dev_out)
+            rec = flightrec.recorder()
+            if rec is None:
+                return self._device_fetch(dev_out)
+            t0 = time.perf_counter_ns()
+            out = self._device_fetch(dev_out)
+            rec.record_phase(
+                flightrec.PH_DEVICE_EXECUTE, t0, time.perf_counter_ns(),
+                rows=rec_rows, batch=rec_batch,
+            )
+            return out
 
     def _device_fetch(self, dev_out: Any) -> Any:
         """The choke point every device RESULT FETCH goes through (plain
@@ -2585,6 +2606,13 @@ class EvaluationEnvironment:
         wasm_infos: dict[int, dict] = {}
         uniform_tid: int | None = None
         uniform_target = True
+        # flight recorder: the target-resolution + payload-blob loop is
+        # its own phase — round 18's first phase-report run measured it
+        # as ~90 µs/row of UNATTRIBUTED dispatch time on the all-cache-
+        # hit serving shape (exactly the guesswork the recorder exists
+        # to retire)
+        _rec = flightrec.recorder()
+        _t_prep = time.perf_counter_ns() if _rec is not None else 0
         for i, (policy_id, request) in enumerate(items):
             try:
                 target = self._fast_target(policy_id)
@@ -2627,6 +2655,11 @@ class EvaluationEnvironment:
                 pending.append(i)
             except Exception as e:  # noqa: BLE001 — per-item error channel
                 results[i] = e
+        if _rec is not None:
+            _rec.record_phase(
+                flightrec.PH_PREPARE, _t_prep, time.perf_counter_ns(),
+                rows=len(items), batch=flightrec.current_batch(),
+            )
 
         # Tier-1 blob dedup: exact payload replays are answered here and
         # never reach the encoder (ONE locked batch lookup; wasm-involving
@@ -2649,10 +2682,16 @@ class EvaluationEnvironment:
                     results[i] = self._materialize(
                         targets[i], items[i][1], row
                     )
+            t1 = time.perf_counter_ns()
             self._profile_add(
-                bookkeeping_ns=time.perf_counter_ns() - t0,
+                bookkeeping_ns=t1 - t0,
                 bookkeeping_rows=len(pending),
             )
+            if _rec is not None:
+                _rec.record_phase(
+                    flightrec.PH_BLOB_DEDUP, t0, t1,
+                    rows=len(pending), batch=flightrec.current_batch(),
+                )
             pending = still
 
         for schema in self.schemas:
@@ -2771,6 +2810,12 @@ class EvaluationEnvironment:
             pending[c : c + chunk_size]
             for c in range(0, len(pending), chunk_size)
         ]
+        # flight recorder (round 18): the ambient batch id rides the
+        # encode-thread's scope (batcher._scoped_rec); closures below
+        # capture it so drain/device-pool events still attribute to the
+        # submitting batch
+        _rec = flightrec.recorder()
+        _bid = flightrec.current_batch() if _rec is not None else -1
         overflowed: list[int] = []
         # (device future, slot rows, wasm stash, row-tier insertions,
         # blob-tier insertions) per chunk
@@ -2793,16 +2838,21 @@ class EvaluationEnvironment:
             out = schema.native.encode_batch(
                 bl, self.bucket_for(len(bl)), self.table
             )
-            self._profile_add(
-                encode_ns=time.perf_counter_ns() - t0, encode_rows=len(chunk)
-            )
+            t1 = time.perf_counter_ns()
+            self._profile_add(encode_ns=t1 - t0, encode_rows=len(chunk))
+            if _rec is not None:
+                _rec.record_phase(
+                    flightrec.PH_ENCODE, t0, t1, rows=len(chunk),
+                    batch=_bid,
+                )
             return bl, out
 
         def materialize(entry) -> None:
             fut, slot_rows, stash, lru_inserts, blob_inserts = entry
             t0 = time.perf_counter_ns()
             raw = fut.result()
-            self._profile_add(dispatch_wait_ns=time.perf_counter_ns() - t0)
+            t1 = time.perf_counter_ns()
+            self._profile_add(dispatch_wait_ns=t1 - t0)
             outputs = self._unpack(raw)
             outputs.update(stash)
             if lru_inserts or blob_inserts:
@@ -2831,6 +2881,16 @@ class EvaluationEnvironment:
                 _, request = items[i]
                 results[i] = self._materialize(
                     targets[i], request, _RowView(outputs, slot)
+                )
+            if _rec is not None:
+                t2 = time.perf_counter_ns()
+                _rec.record_phase(
+                    flightrec.PH_FETCH, t0, t1, rows=len(slot_rows),
+                    batch=_bid,
+                )
+                _rec.record_phase(
+                    flightrec.PH_MATERIALIZE, t1, t2,
+                    rows=len(slot_rows), batch=_bid,
                 )
 
         # encode ahead on the pool (bounded window), dispatch in order
@@ -3085,9 +3145,13 @@ class EvaluationEnvironment:
                 # ns only: these rows were already counted once by the
                 # blob-tier pre-pass (bookkeeping_rows must mean ROWS, not
                 # stage-passes, or the µs/row denominator doubles)
-                self._profile_add(
-                    bookkeeping_ns=time.perf_counter_ns() - t_book,
-                )
+                t_book_end = time.perf_counter_ns()
+                self._profile_add(bookkeeping_ns=t_book_end - t_book)
+                if _rec is not None:
+                    _rec.record_phase(
+                        flightrec.PH_BOOKKEEPING, t_book, t_book_end,
+                        rows=len(chunk), batch=_bid,
+                    )
                 if not slot_rows:
                     continue  # entire chunk answered from the caches
                 if not keep_uncompacted:
@@ -3113,6 +3177,7 @@ class EvaluationEnvironment:
                 self._drain_pool.submit(
                     self._scoped_device_fetch,
                     failpoints.current_scope(), dev_out,
+                    _bid, n_dispatched,
                 ),
                 slot_rows,
                 stash,

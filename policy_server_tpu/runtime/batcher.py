@@ -50,7 +50,7 @@ from policy_server_tpu.evaluation.environment import (
 from policy_server_tpu.evaluation.errors import PolicyInitializationError
 from policy_server_tpu.evaluation.policy_id import PolicyID
 from policy_server_tpu.models import AdmissionResponse, ValidateRequest
-from policy_server_tpu.telemetry import otlp
+from policy_server_tpu.telemetry import flightrec, otlp
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
 # a request whose propagated deadline passed while it sat in the queue:
@@ -171,6 +171,32 @@ def _deliver_sink(sink, items: list) -> None:
         from policy_server_tpu.telemetry.tracing import logger
 
         logger.exception("completion sink failed; batch dropped on floor")
+
+
+class _BatchRec:
+    """One dispatched batch's flight-recorder context: the batch id and
+    the phase boundary stamps the batcher reads anyway (formed_at,
+    phase-1 end, dispatch window). Rows reuse these for their exemplar
+    phase breakdowns, so the per-row cost stays one float compare +
+    one counter tick (flightrec.row_flags)."""
+
+    __slots__ = ("rec", "bid", "formed_at", "form_ns", "disp_ns")
+
+    def __init__(self, rec, formed_at: float):
+        self.rec = rec
+        self.bid = rec.next_batch()
+        self.formed_at = formed_at
+        self.form_ns = 0  # phase-1 duration, stamped when PH_FORM records
+        self.disp_ns = 0  # dispatch duration, stamped when PH_DISPATCH records
+
+    def row_breakdown(self, enqueued_at: float) -> dict:
+        return {
+            flightrec.PH_QUEUE_WAIT: int(
+                max(0.0, self.formed_at - enqueued_at) * 1e9
+            ),
+            flightrec.PH_FORM: self.form_ns,
+            flightrec.PH_DISPATCH: self.disp_ns,
+        }
 
 
 class _AuditJob:
@@ -573,6 +599,27 @@ class MicroBatcher:
         with failpoints.scope(self.tenant):
             return fn(*args, **kwargs)
 
+    def _scoped_rec(self, bid: int, fn, *args, **kwargs):
+        """_scoped plus the flight-recorder batch scope: the
+        environment's phase events (encode, fetch, bookkeeping) must
+        attribute to the submitting batch across the encode/device pool
+        boundary, exactly like tenant-scoped chaos."""
+        with failpoints.scope(self.tenant), flightrec.batch_scope(bid):
+            return fn(*args, **kwargs)
+
+    def _scoped_rec_timed(self, bid: int, fn, *args, **kwargs):
+        """_scoped_rec returning ``(result, start_ns, end_ns)`` — the
+        worker-side stamps let the submitting batch worker measure the
+        POOL HANDOFF gaps (submit → worker pickup, work end → future
+        wake) as the flight recorder's ``handoff`` phase. Round 18's
+        first phase-report runs found exactly this gap as the dominant
+        unattributed dispatch time on the sandboxed kernel (condition-
+        variable wakes ride the GIL switch interval)."""
+        with failpoints.scope(self.tenant), flightrec.batch_scope(bid):
+            t0 = time.perf_counter_ns()
+            out = fn(*args, **kwargs)
+            return out, t0, time.perf_counter_ns()
+
     def warmup(self) -> None:
         """Compile every batch bucket at boot (reference precompiles all
         policies via rayon at boot, src/lib.rs:287-307) and seed the
@@ -732,6 +779,7 @@ class MicroBatcher:
         origin: service.RequestOrigin,
         sink: Any = None,
         tokens: list | None = None,
+        trace_ctxs: list | None = None,
     ) -> list[Future] | None:
         """Array-at-a-time admission (round 12): enqueue a whole burst
         with ONE deadline stamp, ONE shed estimate, and ONE queue-lock
@@ -752,7 +800,14 @@ class MicroBatcher:
         Deadline/shed semantics match submit_nowait: every row is
         stamped with the same admission instant, so the burst sheds or
         admits as a unit; rows that outlive their deadline in the queue
-        still drop pre-encode per row."""
+        still drop pre-encode per row.
+
+        ``trace_ctxs`` (round 18): an optional parallel list of
+        per-row ``otlp.SpanContext`` parents — the native frontend
+        propagates incoming W3C ``traceparent`` headers through here so
+        webhook-originated traces correlate end-to-end. Rows with None
+        keep the burst's ambient context (usually none on the native
+        path)."""
         now = time.perf_counter()
         deadline = (
             now + self.request_timeout
@@ -766,7 +821,11 @@ class MicroBatcher:
             p = _Pending(
                 policy_id, request, origin,
                 Future() if sink is None else None,
-                enqueued_at=now, trace_ctx=trace_ctx,
+                enqueued_at=now,
+                trace_ctx=(
+                    trace_ctxs[i] if trace_ctxs is not None
+                    and trace_ctxs[i] is not None else trace_ctx
+                ),
             )
             p.deadline = deadline
             if sink is not None:
@@ -1331,6 +1390,21 @@ class MicroBatcher:
             self.queue_wait_ns += int(
                 sum(formed_at - p.enqueued_at for p in batch) * 1e9
             )
+        # flight recorder (round 18): one _BatchRec per dispatched batch;
+        # every phase boundary below reuses a clock read the batcher
+        # already pays (formed_at, dispatch_start, done_at), so the
+        # always-on cost is array stores + one histogram observe per
+        # phase per BATCH
+        rec = flightrec.recorder()
+        brec = None
+        if rec is not None:
+            brec = _BatchRec(rec, formed_at)
+            rec.record_phase(
+                flightrec.PH_QUEUE_WAIT,
+                int(min(p.enqueued_at for p in batch) * 1e9),
+                int(formed_at * 1e9),
+                rows=len(batch), batch=brec.bid,
+            )
         if self.shadow_recorder is not None:
             try:
                 self.shadow_recorder.observe(
@@ -1432,12 +1506,19 @@ class MicroBatcher:
                     continue
             runnable.append(p)
         delivery.flush()
+        if brec is not None:
+            phase1_end = time.perf_counter()
+            brec.form_ns = int((phase1_end - formed_at) * 1e9)
+            brec.rec.record_phase(
+                flightrec.PH_FORM, int(formed_at * 1e9),
+                int(phase1_end * 1e9), rows=len(batch), batch=brec.bid,
+            )
         if not runnable:
             return
         sched = self.scheduler
         if sched is None:
             # single-tenant: no slot gate — the round-15 path, unchanged
-            self._evaluate_runnable(runnable)
+            self._evaluate_runnable(runnable, brec)
             return
         from policy_server_tpu.runtime import scheduler as _fair
 
@@ -1453,11 +1534,13 @@ class MicroBatcher:
                 self._reject_stopping(p)
             return
         try:
-            self._evaluate_runnable(runnable)
+            self._evaluate_runnable(runnable, brec)
         finally:
             sched.release(self.tenant)
 
-    def _evaluate_runnable(self, runnable: list[_Pending]) -> None:
+    def _evaluate_runnable(
+        self, runnable: list[_Pending], brec: "_BatchRec | None" = None
+    ) -> None:
         """Phases 2-3 for a formed batch's runnable rows: degraded-mode
         gate, host/device dispatch under the watchdog, service-layer
         post-processing. Split from :meth:`_dispatch` so the round-16
@@ -1533,6 +1616,7 @@ class MicroBatcher:
         # serve time. One poisoned EWMA sample would otherwise route the
         # firehose host-side for the rest of the run.
         compiles_before = getattr(self.env, "plane_program_compiles", 0)
+        rec_bid = brec.bid if brec is not None else -1
         dispatch_start_ns = time.time_ns()
         dispatch_start = time.perf_counter()
         if self.policy_timeout is None:
@@ -1540,11 +1624,15 @@ class MicroBatcher:
             # run inline (host fast-path or device alike)
             try:
                 results = (
-                    self.env.validate_batch(
-                        pairs, run_hooks=False, prefer_host=True
+                    self._scoped_rec(
+                        rec_bid, self.env.validate_batch,
+                        pairs, run_hooks=False, prefer_host=True,
                     )
                     if use_host
-                    else self.env.validate_batch(pairs, run_hooks=False)
+                    else self._scoped_rec(
+                        rec_bid, self.env.validate_batch,
+                        pairs, run_hooks=False,
+                    )
                 )
             except Exception as e:  # noqa: BLE001
                 for p in runnable:
@@ -1576,17 +1664,33 @@ class MicroBatcher:
                     begin_fn = None
             handle = None
             live = runnable
+            # pool-handoff gaps (submit → worker pickup, work end →
+            # future wake): collected here, recorded as the ``handoff``
+            # phase after dispatch completes — the measured cost of
+            # crossing the encode/device pool boundaries
+            handoffs: list | None = [] if brec is not None else None
             if begin_fn is not None:
+                t_submit = (
+                    time.perf_counter_ns() if handoffs is not None else 0
+                )
                 enc_future = self._encode_pool.submit(
-                    self._scoped, begin_fn, pairs, run_hooks=False
+                    self._scoped_rec_timed, rec_bid, begin_fn, pairs,
+                    run_hooks=False,
                 )
                 try:
-                    handle, live = self._watchdog_wait(enc_future, runnable)
+                    wrapped, live = self._watchdog_wait(
+                        enc_future, runnable
+                    )
                 except Exception as e:  # noqa: BLE001 — begin raised
                     for p in runnable:
                         self._fail(p, e)
                     return
-                if handle is None and not live:
+                if wrapped is not None:
+                    handle, t_start, t_end = wrapped
+                    if handoffs is not None:
+                        handoffs.append((t_submit, t_start))
+                        handoffs.append((t_end, time.perf_counter_ns()))
+                if wrapped is None and not live:
                     # every item expired during the host half; the encode
                     # worker finishes (and its device work is discarded)
                     # in the background. A long stall here IS a
@@ -1602,13 +1706,15 @@ class MicroBatcher:
                         compiles_before=compiles_before,
                     )
                     return
+            t_submit = time.perf_counter_ns() if handoffs is not None else 0
             if handle is not None:
                 dev_future = self._device_pool.submit(
-                    self._scoped, self.env.validate_batch_finish, handle
+                    self._scoped_rec_timed, rec_bid,
+                    self.env.validate_batch_finish, handle,
                 )
             elif use_host:
                 dev_future = self._device_pool.submit(
-                    self._scoped,
+                    self._scoped_rec_timed, rec_bid,
                     self.env.validate_batch,
                     pairs,
                     run_hooks=False,
@@ -1618,15 +1724,21 @@ class MicroBatcher:
                 # non-native environment (begin unavailable or returned
                 # None): the single-call path, still watchdog-bounded
                 dev_future = self._device_pool.submit(
-                    self._scoped, self.env.validate_batch, pairs,
-                    run_hooks=False,
+                    self._scoped_rec_timed, rec_bid,
+                    self.env.validate_batch, pairs, run_hooks=False,
                 )
             try:
-                results, live = self._watchdog_wait(dev_future, live)
+                wrapped, live = self._watchdog_wait(dev_future, live)
             except Exception as e:  # noqa: BLE001 — validate_batch raised
                 for p in live:
                     self._fail(p, e)
                 return
+            results = None
+            if wrapped is not None:
+                results, t_start, t_end = wrapped
+                if handoffs is not None:
+                    handoffs.append((t_submit, t_start))
+                    handoffs.append((t_end, time.perf_counter_ns()))
             if results is None:
                 # the elapsed time is a LOWER bound on this bucket's RTT —
                 # teach the router the device is slow right now
@@ -1642,10 +1754,28 @@ class MicroBatcher:
                     compiles_before=compiles_before,
                 )
                 return  # every item deadline-rejected; device work abandoned
+        done_at = time.perf_counter()
         self._observe_dispatch(
-            use_host, bucket, n, time.perf_counter() - dispatch_start,
+            use_host, bucket, n, done_at - dispatch_start,
             compiles_before=compiles_before,
         )
+        if brec is not None:
+            # done_at doubles as the dispatch end AND phase 3's shared
+            # clock read — no extra syscall for the recorder
+            brec.disp_ns = int((done_at - dispatch_start) * 1e9)
+            brec.rec.record_phase(
+                flightrec.PH_DISPATCH, int(dispatch_start * 1e9),
+                int(done_at * 1e9), rows=n, batch=brec.bid,
+            )
+            if self.policy_timeout is not None:
+                # the pool-handoff gaps collected around the encode and
+                # device legs (ONE textual record site — OB08)
+                for h0, h1 in handoffs:
+                    if h1 > h0:
+                        brec.rec.record_phase(
+                            flightrec.PH_HANDOFF, h0, h1, rows=n,
+                            batch=brec.bid,
+                        )
 
         # Phase 3 (host): service-layer constraints + metrics per item.
         # Items the watchdog already rejected are skipped — their verdicts
@@ -1657,7 +1787,6 @@ class MicroBatcher:
         live_ids = {id(p) for p in live}
         delivery = _DeliveryBatch()
         metrics_sink: list = []
-        done_at = time.perf_counter()
         for p, result in zip(runnable, results):
             if id(p) not in live_ids:
                 continue
@@ -1698,6 +1827,32 @@ class MicroBatcher:
         delivery.flush()
         if metrics_sink:
             service._registry().record_evaluations_batch(metrics_sink)
+        if brec is not None:
+            brec.rec.record_phase(
+                flightrec.PH_DELIVER, int(done_at * 1e9),
+                time.perf_counter_ns(), rows=len(live), batch=brec.bid,
+            )
+            if live:
+                # per-row recorder work is BATCH-granular by design (the
+                # <=2% overhead contract): one exemplar offer — the
+                # batch's oldest live row is its slowest, since every
+                # row shares done_at — and one stride reservation for
+                # the sampled-row timeline segments
+                done_ns = int(done_at * 1e9)
+                oldest = min(live, key=lambda q: q.enqueued_at)
+                brec.rec.offer_exemplar(
+                    oldest.request.uid(), oldest.policy_id,
+                    int(oldest.enqueued_at * 1e9), done_ns,
+                    brec.row_breakdown(oldest.enqueued_at),
+                )
+                for i in brec.rec.sample_indices(len(live)):
+                    p = live[i]
+                    brec.rec.record_row(
+                        p.request.uid(), p.policy_id,
+                        int(p.enqueued_at * 1e9), done_ns, brec.bid,
+                        brec.row_breakdown(p.enqueued_at),
+                        flightrec.FlightRecorder.ROW_SAMPLED,
+                    )
 
     def _observe_dispatch(
         self,

@@ -40,10 +40,12 @@ import subprocess
 import sys
 import sysconfig
 import threading
+import time
 from pathlib import Path
 from typing import Any
 
 from policy_server_tpu import failpoints
+from policy_server_tpu.telemetry import flightrec
 from policy_server_tpu.telemetry.tracing import logger
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
@@ -59,9 +61,10 @@ MAX_BODY_BYTES = 8 * 1024**2
 # record kinds (csrc/httpfront.cpp)
 K_VALIDATE, K_AUDIT, K_RAW, K_VALIDATE_FB, K_AUDIT_FB = 0, 1, 2, 3, 4
 
-# u32 total | u64 req_id | u8 kind | u8 flags | u16 policy/uid/ns/op/gvk/pad
-# | u32 payload_len
-_REC = struct.Struct("<IQBB6HI")
+# u32 total | u64 req_id | u8 kind | u8 flags | u16 policy/uid/ns/op/gvk/tp
+# | u32 payload_len | i64 t_first/t_parse/t_push (flight-recorder stamps
+# on CLOCK_MONOTONIC — the clock perf_counter_ns reads on Linux)
+_REC = struct.Struct("<IQBB6HI3q")
 
 _STAT_NAMES = (
     "connections_accepted",
@@ -464,6 +467,36 @@ class NativeFrontend:
 
     # -- the drainer ------------------------------------------------------
 
+    @staticmethod
+    def _record_burst_phases(burst: list[tuple]) -> None:
+        """Flight-recorder native phases for one drained poll burst,
+        from the CLOCK_MONOTONIC stamps httpfront carried across the
+        SPSC ring: accept (first byte → fully received), parse
+        (received → canonicalized + pushed), ring-cross (pushed →
+        drained here). Burst AGGREGATES — min start to max end across
+        the burst's records, one event per phase per burst, so the
+        always-on cost is one clock read per drain cycle."""
+        rec = flightrec.recorder()
+        if rec is None:
+            return
+        t_drain = time.perf_counter_ns()
+        rows = len(burst)
+        # t_first is 0 for requests that arrived in a single read (the
+        # arrival window never opened) — substitute the parse stamp so
+        # the accept aggregate stays on the timeline's timebase
+        firsts = [r[9] if r[9] else r[10] for r in burst]
+        parses = [r[10] for r in burst]
+        pushes = [r[11] for r in burst]
+        rec.record_phase(
+            flightrec.PH_NATIVE_ACCEPT, min(firsts), max(parses), rows=rows
+        )
+        rec.record_phase(
+            flightrec.PH_NATIVE_PARSE, min(parses), max(pushes), rows=rows
+        )
+        rec.record_phase(
+            flightrec.PH_RING_CROSS, min(pushes), t_drain, rows=rows
+        )
+
     def _drain_loop(self) -> None:
         buf = ctypes.create_string_buffer(self._poll_cap)
         lib = self._lib
@@ -490,7 +523,7 @@ class NativeFrontend:
             while off < n:
                 (
                     total, req_id, kind, flags, plen, ulen, nslen, oplen,
-                    glen, _pad, paylen,
+                    glen, tplen, paylen, t_first, t_parse, t_push,
                 ) = unpack_from(data, off)
                 p = off + rec_size
                 policy = data[p : p + plen].decode()
@@ -503,11 +536,26 @@ class NativeFrontend:
                 p += oplen
                 gvk = data[p : p + glen].decode()
                 p += glen
+                # errors="replace": the C++ side gates the header to
+                # printable ASCII, but a client-controlled field must
+                # NEVER be able to kill the drain thread with a strict-
+                # decode raise (replaced chars fail traceparent parsing
+                # → fresh root, which is the malformed contract)
+                tp = (
+                    data[p : p + tplen].decode(errors="replace")
+                    if tplen else ""
+                )
+                p += tplen
                 payload = data[p : p + paylen]
                 off += total
                 burst.append(
-                    (req_id, kind, policy, uid, ns, op, gvk, payload)
+                    (
+                        req_id, kind, policy, uid, ns, op, gvk, payload,
+                        tp, t_first, t_parse, t_push,
+                    )
                 )
+            if burst:
+                self._record_burst_phases(burst)
             # chaos site: a fault at frontend intake (drainer dies mid-
             # handoff / sink wiring broken) must answer every request of
             # the burst in-band, never strand them — fired per BURST,
@@ -540,7 +588,10 @@ class NativeFrontend:
                     for rec in burst:
                         self.complete(rec[0], 500, body)
                 continue
-            for req_id, kind, policy, uid, ns, op, gvk, payload in burst:
+            for (
+                req_id, kind, policy, uid, ns, op, gvk, payload,
+                _tp, _tf, _tpr, _tpu,
+            ) in burst:
                 try:
                     sink.handle(
                         self, req_id, kind, policy, uid, ns, op, gvk, payload
@@ -641,12 +692,23 @@ class BatcherSink:
         construction."""
         from policy_server_tpu.api.service import RequestOrigin
         from policy_server_tpu.runtime.frontend import WireValidateRequest
+        from policy_server_tpu.telemetry import otlp
 
-        # (id(batcher), origin) → [batcher, origin, items, tokens] — one
-        # bulk admission per serving batcher per burst; the single-tenant
-        # common case degenerates to the historical one-group-per-origin
+        rec = flightrec.recorder()
+        t_admit = time.perf_counter_ns() if rec is not None else 0
+        # parse incoming W3C traceparent headers only when a span
+        # pipeline exists to parent to (--log-fmt otlp); the common
+        # deployment skips the per-record parse entirely
+        tp_enabled = otlp.tracer() is not None
+        # (id(batcher), origin) → [batcher, origin, items, tokens, ctxs]
+        # — one bulk admission per serving batcher per burst; the
+        # single-tenant common case degenerates to the historical
+        # one-group-per-origin
         groups: dict = {}
-        for req_id, kind, policy_id, uid, ns, op, gvk, payload in burst:
+        for (
+            req_id, kind, policy_id, uid, ns, op, gvk, payload,
+            tp, _tf, _tpr, _tpu,
+        ) in burst:
             if kind in (K_VALIDATE, K_AUDIT):
                 batcher, pid, not_found = self._route(policy_id)
                 if batcher is None:
@@ -664,10 +726,14 @@ class BatcherSink:
                     else RequestOrigin.VALIDATE
                 )
                 g = groups.setdefault(
-                    (id(batcher), origin), [batcher, origin, [], []]
+                    (id(batcher), origin), [batcher, origin, [], [], []]
                 )
                 g[2].append((pid, request))
                 g[3].append((frontend, req_id, False))
+                g[4].append(
+                    otlp.parse_traceparent(tp)
+                    if tp_enabled and tp else None
+                )
             else:
                 try:
                     self._handle_fallback(
@@ -684,16 +750,25 @@ class BatcherSink:
         # answer only ITS records — another group may already be
         # submitted (double-completing admitted rows would race their
         # real verdicts), and fallback records above already answered
-        for batcher, origin, g_items, g_tokens in groups.values():
+        for batcher, origin, g_items, g_tokens, g_ctxs in groups.values():
             try:
                 batcher.submit_many(
-                    g_items, origin, sink=self, tokens=g_tokens
+                    g_items, origin, sink=self, tokens=g_tokens,
+                    trace_ctxs=(
+                        g_ctxs if any(c is not None for c in g_ctxs)
+                        else None
+                    ),
                 )
             except Exception as e:  # noqa: BLE001 — answer, don't hang
                 logger.error("bulk submission failed: %s", e)
                 body = _api_error_body(500, "Something went wrong")
                 for _fe, req_id, _raw in g_tokens:
                     frontend.complete(req_id, 500, body)
+        if rec is not None and groups:
+            rec.record_phase(
+                flightrec.PH_ADMIT, t_admit, time.perf_counter_ns(),
+                rows=sum(len(g[2]) for g in groups.values()),
+            )
 
     def _handle_fallback(
         self, frontend, req_id, kind, policy_id, payload
@@ -792,20 +867,34 @@ class BatcherSink:
                     )
                 except Exception:  # noqa: BLE001 — frontend gone
                     pass
+        rec = flightrec.recorder()
+        t_ser = (
+            time.perf_counter_ns()
+            if rec is not None and bulk_by_frontend else 0
+        )
         for frontend, records in bulk_by_frontend.items():
             try:
                 frontend.complete_verdict_bulk(records)
             except Exception as e:  # noqa: BLE001 — last resort: the
                 # packed fill failed as a unit; answer each in-band
                 logger.error("bulk completion fill failed: %s", e)
-                for rec in records:
+                for record in records:
                     try:
                         frontend.complete(
-                            rec[0], 500,
+                            record[0], 500,
                             _api_error_body(500, "Something went wrong"),
                         )
                     except Exception:  # noqa: BLE001
                         pass
+        if t_ser:
+            # the verdict handoff + native serialize enqueue window (the
+            # event-loop thread renders the bytes asynchronously; the
+            # C++ framing_ns counter carries that side)
+            rec.record_phase(
+                flightrec.PH_NATIVE_SERIALIZE, t_ser,
+                time.perf_counter_ns(),
+                rows=sum(len(r) for r in bulk_by_frontend.values()),
+            )
 
     def _deliver_one(
         self, bulk_by_frontend, frontend, req_id, raw_shape, response, exc

@@ -113,6 +113,25 @@ class PolicyServer:
 
             if _os.environ.get(_otlp.ENDPOINT_ENV):
                 _otlp.install_metrics_pusher(registry)
+        # flight recorder (round 18, telemetry/flightrec.py): installed
+        # BEFORE any batcher/environment is built so warmup dispatches
+        # already record. Always on by default; the phase histogram
+        # feeds the process-wide metrics registry (one funnel: /metrics
+        # pull + OTLP push).
+        from policy_server_tpu.telemetry import flightrec as _flightrec
+
+        if config.flight_recorder:
+            from policy_server_tpu.telemetry import default_registry as _dr
+
+            _flightrec.install(
+                _flightrec.FlightRecorder(
+                    capacity=config.recorder_ring_events,
+                    row_sample_rate=config.recorder_row_sample_rate,
+                    registry=_dr(),
+                )
+            )
+        else:
+            _flightrec.install(None)
         if config.enable_pprof:
             profiling.activate_memory_profiling()
             if config.http_workers > 1:
@@ -1399,6 +1418,47 @@ class PolicyServer:
                 "Native-frontend drainer threads the self-heal watchdog "
                 "found dead and rebuilt",
                 sup.get("frontend_revives", 0),
+            )
+            # Flight recorder (round 18, telemetry/flightrec.py): event
+            # volume, row-sampling volume, and the tail-exemplar table —
+            # the slowest rows of the current window, labelled by their
+            # trace id (request uid) so a dashboard p99 blip links to
+            # its /debug/timeline. The sample set rebuilds per scrape,
+            # so rotated-out exemplars disappear instead of lingering.
+            # All zero/empty with --flight-recorder off (families still
+            # export so dashboard panels resolve everywhere).
+            from policy_server_tpu.telemetry import flightrec as _frec
+
+            frec = _frec.recorder()
+            yield (
+                metrics_names.FLIGHT_RECORDER_EVENTS, "counter",
+                "Phase events written to the flight-recorder ring",
+                frec.events_recorded() if frec is not None else 0,
+            )
+            yield (
+                metrics_names.FLIGHT_RECORDER_ROWS_SAMPLED, "counter",
+                "Rows that recorded per-row timeline segments "
+                "(--recorder-row-sample-rate stride)",
+                frec.rows_sampled() if frec is not None else 0,
+            )
+            yield (
+                metrics_names.TAIL_EXEMPLAR_LATENCY_SECONDS, "gauge",
+                "Tail exemplars: the slowest rows of the current "
+                "flight-recorder window, with trace id and slowest "
+                "phase (full phase breakdown on /debug/timeline)",
+                [
+                    (
+                        (
+                            ex["trace_id"], ex["policy_id"],
+                            ex["slowest_phase"],
+                        ),
+                        ex["latency_seconds"],
+                    )
+                    for ex in (
+                        frec.exemplars() if frec is not None else ()
+                    )
+                ],
+                ("trace_id", "policy_id", "slowest_phase"),
             )
 
         from policy_server_tpu.telemetry import default_registry

@@ -194,6 +194,19 @@ WORKER_RESPAWN_BACKOFF_SECONDS = (
 WORKER_SLOTS_GIVEN_UP = "policy_server_worker_slots_given_up"
 SELFHEAL_BATCHER_REVIVES = "policy_server_selfheal_batcher_revives"
 SELFHEAL_FRONTEND_REVIVES = "policy_server_selfheal_frontend_revives"
+# round 18 — flight recorder (telemetry/flightrec.py): per-phase latency
+# histogram (the first phase-granular instrument — until now only
+# whole-request latency existed), the tail-exemplar table (slowest rows
+# per window, labelled by their trace id so a p99 blip links to its
+# /debug/timeline), and the recorder's own volume counters. The
+# histogram registers directly as a prometheus instrument below; the
+# exemplar family is the labelled-gauge runtime_stats pattern from
+# round 16 (the sample set is rebuilt per scrape, so rotated-out
+# exemplars disappear instead of lingering as stale series).
+PHASE_LATENCY_SECONDS = "policy_server_phase_latency_seconds"
+TAIL_EXEMPLAR_LATENCY_SECONDS = "policy_server_tail_exemplar_latency_seconds"
+FLIGHT_RECORDER_EVENTS = "policy_server_flight_recorder_events"
+FLIGHT_RECORDER_ROWS_SAMPLED = "policy_server_flight_recorder_rows_sampled"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".
@@ -215,6 +228,14 @@ _INIT_LABELS = ("policy_name", "initialization_error")
 _LATENCY_BUCKETS_MS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
     100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+)
+
+# Second buckets for the per-phase histogram (flight recorder): phases
+# span ~10 µs (bookkeeping on a warm batch) to ~100 ms (a cold device
+# dispatch), so the grid is log-spaced across five decades.
+_PHASE_BUCKETS_S = (
+    25e-6, 50e-6, 100e-6, 250e-6, 500e-6, 1e-3, 2.5e-3, 5e-3,
+    10e-3, 25e-3, 50e-3, 100e-3, 250e-3, 1.0,
 )
 
 
@@ -369,6 +390,21 @@ class MetricsRegistry:
                 _INIT_LABELS,
                 registry=self.registry,
             )
+            # flight-recorder per-phase latency (round 18): batch-granular
+            # phase durations labelled by lifecycle phase. Fed by
+            # telemetry/flightrec.py through observe_phase; OTLP export
+            # rides prometheus_to_otlp like every histogram here.
+            self._prom_phase = prometheus_client.Histogram(
+                PHASE_LATENCY_SECONDS,
+                "Per-batch serving-phase latency in seconds "
+                "(flight recorder)",
+                ("phase",),
+                buckets=_PHASE_BUCKETS_S,
+                registry=self.registry,
+            )
+            # phase-name cardinality is the closed flightrec.PHASES set;
+            # children cache like _prom_children (GIL-atomic dict ops)
+            self._phase_children: dict[str, Any] = {}  # graftcheck: lockfree — GIL-atomic dict ops; racing builders store identical children
         else:  # pragma: no cover
             self.registry = None
 
@@ -470,6 +506,18 @@ class MetricsRegistry:
             )
         if self.registry is not None:
             self._prom_init_errors.labels(**labels).inc()
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        """One flight-recorder phase observation (the recorder's /metrics
+        + OTLP funnel). Hot-path discipline: one dict get + one
+        prometheus observe per BATCH per phase."""
+        if self.registry is None:  # pragma: no cover
+            return
+        child = self._phase_children.get(phase)
+        if child is None:
+            child = self._prom_phase.labels(phase=phase)
+            self._phase_children[phase] = child
+        child.observe(seconds)
 
     def attach_runtime_stats(self, snapshot_fn) -> None:
         """Install (or replace) the serving-runtime stats provider:

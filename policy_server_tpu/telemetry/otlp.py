@@ -143,6 +143,42 @@ def current_span_context() -> SpanContext | None:
     return _current_span.get()
 
 
+def parse_traceparent(value: str | None) -> SpanContext | None:
+    """Parse a W3C ``traceparent`` header (round 18): spans for
+    webhook-originated requests parent to the caller's trace instead of
+    starting fresh roots — on BOTH frontends (aiohttp reads the header
+    directly; the native frontend carries it across the SPSC ring).
+    Strict per the spec: version-format ``00``-style 2-hex version (ff
+    reserved), 32-hex trace id, 16-hex span id, neither all-zero;
+    anything malformed returns None (fresh root, never a crash)."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_hex, span_hex, flags = (
+        parts[0], parts[1], parts[2], parts[3],
+    )
+    if len(version) != 2 or version.lower() == "ff":
+        return None
+    # version 00 defines EXACTLY four fields; only future versions may
+    # append more (W3C Trace Context §2.2). Flags are always 2 hex.
+    if version == "00" and len(parts) != 4:
+        return None
+    if len(trace_hex) != 32 or len(span_hex) != 16 or len(flags) != 2:
+        return None
+    try:
+        bytes.fromhex(version)
+        bytes.fromhex(flags)
+        trace_id = bytes.fromhex(trace_hex)
+        span_id = bytes.fromhex(span_hex)
+    except ValueError:
+        return None
+    if trace_id == bytes(16) or span_id == bytes(8):
+        return None
+    return SpanContext(trace_id, span_id)
+
+
 class Tracer:
     """Produces spans and hands finished ones to the batch processor."""
 
@@ -202,7 +238,11 @@ class ActiveSpan:
             _current_span.reset(self._token)
         if exc is not None and self.data.status_code == 0:
             self.set_error(str(exc))
-        self.data.end_unix_nano = time.time_ns()
+        # a caller that already pinned the end time (tracing.span aligns
+        # it to its logged elapsed_ms so the exported duration and the
+        # log line agree) wins; only unpinned spans stamp exit time here
+        if self.data.end_unix_nano == 0:
+            self.data.end_unix_nano = time.time_ns()
         self.tracer.processor.on_end(self.data)
 
 

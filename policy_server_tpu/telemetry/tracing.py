@@ -149,25 +149,46 @@ logger = logging.getLogger(SERVICE_NAME)
 
 
 @contextlib.contextmanager
-def span(span_name: str, **fields: Any) -> Iterator[dict[str, Any]]:
+def span(
+    span_name: str, parent_ctx: Any = None, **fields: Any
+) -> Iterator[dict[str, Any]]:
     """A request span: yields a mutable field dict (handlers record verdict
     fields into it, mirroring populate_span_with_policy_evaluation_results,
     handlers.rs:308-319) and logs one structured line on exit with the
     elapsed time. When the OTLP pipeline is installed (--log-fmt otlp), a
     REAL span with the same name/fields is exported and its trace id is
-    added to the log line."""
+    added to the log line.
+
+    ``parent_ctx`` (round 18): an explicit ``otlp.SpanContext`` parent —
+    the handlers pass the parsed W3C ``traceparent`` header here so
+    webhook-originated traces correlate end-to-end instead of starting
+    fresh roots.
+
+    The exported span's end time is PINNED to ``start + elapsed_ms``
+    (the same perf_counter window the log line reports) rather than
+    stamped at context-manager exit — the exit path runs set_attributes
+    and the trace-id hex AFTER the elapsed reading, and letting the
+    exporter stamp later made the exported duration disagree with the
+    logged elapsed_ms (parity-tested in tests/test_otlp.py)."""
     from policy_server_tpu.telemetry import otlp
 
     start = time.perf_counter()
     data = dict(fields)
     tr = otlp.tracer()
-    active = tr.start_span(span_name) if tr is not None else None
+    active = (
+        tr.start_span(span_name, parent=parent_ctx)
+        if tr is not None else None
+    )
     with active if active is not None else contextlib.nullcontext():
         try:
             yield data
         finally:
-            data["elapsed_ms"] = round((time.perf_counter() - start) * 1e3, 3)
+            elapsed_ms = round((time.perf_counter() - start) * 1e3, 3)
+            data["elapsed_ms"] = elapsed_ms
             if active is not None:
                 active.set_attributes(data)
                 data["trace_id"] = active.context.trace_id.hex()
+                active.data.end_unix_nano = (
+                    active.data.start_unix_nano + int(elapsed_ms * 1e6)
+                )
             logger.info(span_name, extra={"span_fields": data})

@@ -1,0 +1,6 @@
+"""Clean OB08 fixture: every phase stamped by exactly one site."""
+
+PH_ALPHA = "alpha"
+PH_BETA = "beta"
+
+PHASES = (PH_ALPHA, PH_BETA)

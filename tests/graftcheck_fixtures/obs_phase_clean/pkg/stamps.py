@@ -1,0 +1,6 @@
+"""Clean stamping sites: one record_phase call per PHASES member."""
+
+
+def serve(rec, flightrec):
+    rec.record_phase(flightrec.PH_ALPHA, 0, 1)
+    rec.record_phase(flightrec.PH_BETA, 0, 1)

@@ -1,0 +1,5 @@
+"""Fixture server: no runtime_stats yields."""
+
+
+def runtime_stats():
+    return iter(())

@@ -1,0 +1,7 @@
+"""Seeded OB08 fixture: gamma is never stamped, beta is stamped twice."""
+
+PH_ALPHA = "alpha"
+PH_BETA = "beta"
+PH_GAMMA = "gamma"
+
+PHASES = (PH_ALPHA, PH_BETA, PH_GAMMA)

@@ -1,0 +1,9 @@
+"""Seeded OB08 fixture metrics: a histogram with no dashboard panel."""
+
+import prometheus_client
+
+FIXTURE_PHASE_SECONDS = "policy_server_fixture_phase_seconds"
+
+_h = prometheus_client.Histogram(
+    FIXTURE_PHASE_SECONDS, "fixture phase histogram", ("phase",)
+)

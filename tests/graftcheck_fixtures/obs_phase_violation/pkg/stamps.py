@@ -1,0 +1,11 @@
+"""Seeded stamping sites: alpha once (clean), beta twice (OB08 multi),
+gamma never (OB08 unstamped)."""
+
+
+def serve(rec, flightrec):
+    rec.record_phase(flightrec.PH_ALPHA, 0, 1)
+    rec.record_phase(flightrec.PH_BETA, 0, 1)
+
+
+def serve_again(rec, PH_BETA="beta"):
+    rec.record_phase(PH_BETA, 0, 1)  # second site for beta

@@ -1,0 +1,6 @@
+"""Fixture server: no runtime_stats yields (the histogram is the only
+exported family)."""
+
+
+def runtime_stats():
+    return iter(())

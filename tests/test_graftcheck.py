@@ -136,6 +136,40 @@ def test_observability_repo_mapping_is_total():
     assert observability.check(REPO_ROOT) == []
 
 
+def test_ob08_phase_violation_fixture_flagged():
+    """OB08 (round 18): an unstamped phase, a double-stamped phase, and
+    a histogram family with no dashboard panel are all flagged; the
+    once-stamped phase is not."""
+    findings = observability.check(
+        FIXTURES / "obs_phase_violation",
+        metrics_path="metrics_fix.py",
+        server_path="server_fix.py",
+        dashboard_path="dash.json",
+        flightrec_path="flightrec_fix.py",
+        package_path="pkg",
+    )
+    ob08 = [f for f in findings if f.rule == "OB08"]
+    assert any("phase:unstamped:gamma" == f.symbol for f in ob08)
+    assert any("phase:multi:beta" == f.symbol for f in ob08)
+    assert any(
+        "histogram:policy_server_fixture_phase_seconds" == f.symbol
+        for f in ob08
+    )
+    assert not any("alpha" in f.symbol for f in ob08)
+
+
+def test_ob08_phase_clean_fixture_passes():
+    findings = observability.check(
+        FIXTURES / "obs_phase_clean",
+        metrics_path="metrics_fix.py",
+        server_path="server_fix.py",
+        dashboard_path="dash.json",
+        flightrec_path="flightrec_fix.py",
+        package_path="pkg",
+    )
+    assert [f for f in findings if f.rule == "OB08"] == []
+
+
 # ---------------------------------------------------------------------------
 # Checker 4 — failpoint drift
 # ---------------------------------------------------------------------------

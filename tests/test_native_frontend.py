@@ -784,3 +784,107 @@ def test_connection_cap_rejects_inband_503():
         held[1].close()
     finally:
         front.shutdown(timeout=5)
+
+
+def test_record_timestamps_and_traceparent_cross_the_ring():
+    """Round 18: every record carries CLOCK_MONOTONIC stamps (received,
+    canonicalized+pushed) plus the verbatim traceparent header; the
+    drainer records the native accept/parse/ring-cross phase aggregates
+    on the flight recorder."""
+    import threading as _threading
+    import time as _time
+
+    from policy_server_tpu.telemetry import flightrec
+
+    class _CaptureSink:
+        def __init__(self):
+            self.bursts = []
+            self.got = _threading.Event()
+
+        def handle_burst(self, frontend, burst):
+            self.bursts.append(list(burst))
+            for rec in burst:
+                frontend.complete(rec[0], 200, b'{"ok": true}')
+            self.got.set()
+
+    rec = flightrec.install(flightrec.FlightRecorder(capacity=1024))
+    sink = _CaptureSink()
+    front, port = _mini_frontend(sink)
+    try:
+        tp = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+        body = review()
+        t_before = _time.perf_counter_ns()
+        req = (
+            b"POST /validate/priv HTTP/1.1\r\nHost: x\r\n"
+            + f"traceparent: {tp}\r\n".encode()
+            + f"Content-Length: {len(body)}\r\n\r\n".encode()
+            + body
+        )
+        send_raw(port, req)
+        assert sink.got.wait(timeout=15)
+        t_after = _time.perf_counter_ns()
+    finally:
+        front.shutdown()
+        flightrec.install(None)
+    (burst,) = sink.bursts
+    (record,) = burst
+    # tuple: (req_id, kind, policy, uid, ns, op, gvk, payload, tp,
+    #         t_first, t_parse, t_push)
+    assert record[8] == tp
+    _tf, t_parse, t_push = record[9], record[10], record[11]
+    assert t_before < t_parse <= t_push < t_after
+    phases = {e["phase"] for e in rec.snapshot()}
+    assert {
+        flightrec.PH_NATIVE_ACCEPT,
+        flightrec.PH_NATIVE_PARSE,
+        flightrec.PH_RING_CROSS,
+    } <= phases
+    for e in rec.snapshot():
+        assert e["end_ns"] >= e["start_ns"]
+
+
+def test_obs_text_traceparent_never_kills_the_drainer():
+    """Post-review regression: HTTP/1.1 field values legally carry
+    obs-text bytes 0x80-0xFF; a traceparent full of them must be
+    dropped at the C++ header gate (and the Python decode is
+    errors='replace' as defense in depth) — never a strict-decode
+    raise that kills the drain thread and strands the burst."""
+    import threading as _threading
+
+    class _CaptureSink:
+        def __init__(self):
+            self.records = []
+            self.got = _threading.Event()
+
+        def handle_burst(self, frontend, burst):
+            self.records.extend(burst)
+            for rec in burst:
+                frontend.complete(rec[0], 200, b'{"ok": true}')
+            if len(self.records) >= 2:
+                self.got.set()
+
+    sink = _CaptureSink()
+    front, port = _mini_frontend(sink)
+    try:
+        body = review()
+        bad = (
+            b"POST /validate/priv HTTP/1.1\r\nHost: x\r\n"
+            b"traceparent: \xff\xfe\x80garbage\r\n"
+            + f"Content-Length: {len(body)}\r\n\r\n".encode()
+            + body
+        )
+        resp = send_raw(port, bad)
+        assert b"200" in resp.split(b"\r\n", 1)[0]
+        # the drainer survived: a SECOND request still drains and answers
+        ok = (
+            b"POST /validate/priv HTTP/1.1\r\nHost: x\r\n"
+            + f"Content-Length: {len(body)}\r\n\r\n".encode()
+            + body
+        )
+        resp = send_raw(port, ok)
+        assert b"200" in resp.split(b"\r\n", 1)[0]
+        assert sink.got.wait(timeout=15)
+    finally:
+        front.shutdown()
+    # the obs-text header never crossed the ring
+    assert sink.records[0][8] == ""

@@ -260,3 +260,91 @@ def test_batcher_emits_child_spans_with_propagated_trace_id(collector):
         s for s in collector.spans() if s.name == "policy_evaluation"
     ]
     assert children and children[0].trace_id == trace_id
+
+
+# ---------------------------------------------------------------------------
+# W3C traceparent propagation + span-duration parity (round 18)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_traceparent_vectors():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    sid = "00f067aa0ba902b7"
+    ctx = otlp.parse_traceparent(f"00-{tid}-{sid}-01")
+    assert ctx is not None
+    assert ctx.trace_id == bytes.fromhex(tid)
+    assert ctx.span_id == bytes.fromhex(sid)
+    # tolerated: surrounding whitespace; a FUTURE version with extra
+    # fields (only versions > 00 may append fields, W3C §2.2)
+    assert otlp.parse_traceparent(f"  01-{tid}-{sid}-01-extra  ") is not None
+    # rejected: absent, malformed, reserved version, all-zero ids,
+    # version-00 with extra fields, bad flags
+    for bad in (
+        None,
+        "",
+        "garbage",
+        f"00-{tid}-{sid}",  # missing flags
+        f"ff-{tid}-{sid}-01",  # reserved version
+        f"00-{'0' * 32}-{sid}-01",  # zero trace id
+        f"00-{tid}-{'0' * 16}-01",  # zero span id
+        f"00-{tid[:-2]}-{sid}-01",  # short trace id
+        f"00-{tid}-{sid}zz-01",  # non-hex
+        f"00-{tid}-{sid}-01-extra",  # version 00 forbids extra fields
+        f"00-{tid}-{sid}-zz",  # non-hex flags
+        f"00-{tid}-{sid}-0",  # short flags
+    ):
+        assert otlp.parse_traceparent(bad) is None, bad
+
+
+def test_handler_span_parents_to_incoming_traceparent(collector):
+    """The aiohttp handlers pass the parsed traceparent into span():
+    the exported request span must join the caller's trace instead of
+    starting a fresh root."""
+    from policy_server_tpu.telemetry.tracing import span
+
+    otlp.install_tracer(collector.endpoint)
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    sid = "00f067aa0ba902b7"
+    parent = otlp.parse_traceparent(f"00-{tid}-{sid}-01")
+    with span("validation", parent_ctx=parent, policy_id="priv"):
+        pass
+    otlp._processor.force_flush()  # noqa: SLF001 — test drives the flush
+    assert collector.wait()
+    val = next(s for s in collector.spans() if s.name == "validation")
+    assert val.trace_id == bytes.fromhex(tid)
+    assert val.parent_span_id == bytes.fromhex(sid)
+
+
+def test_span_duration_matches_logged_elapsed_ms(collector):
+    """Satellite (round 18): tracing.span() pins the exported end time
+    to start + elapsed_ms, so the OTLP duration and the logged
+    elapsed_ms agree EXACTLY — previously the context-manager exit
+    stamped end time after set_attributes, skewing the export."""
+    import time as _time
+
+    from policy_server_tpu.telemetry.tracing import span
+
+    otlp.install_tracer(collector.endpoint)
+    with span("validation", policy_id="priv") as fields:
+        _time.sleep(0.02)
+    otlp._processor.force_flush()  # noqa: SLF001 — test drives the flush
+    assert collector.wait()
+    val = next(s for s in collector.spans() if s.name == "validation")
+    exported_ms = (val.end_time_unix_nano - val.start_time_unix_nano) / 1e6
+    assert exported_ms == pytest.approx(fields["elapsed_ms"], abs=1e-6)
+    attrs = {kv.key: kv.value for kv in val.attributes}
+    assert attrs["elapsed_ms"].double_value == fields["elapsed_ms"]
+
+
+def test_explicit_end_time_survives_context_exit(collector):
+    """ActiveSpan.__exit__ must not overwrite a pinned end time (the
+    parity contract's mechanism)."""
+    tracer = otlp.install_tracer(collector.endpoint)
+    with tracer.start_span("pinned") as sp:
+        sp.data.end_unix_nano = sp.data.start_unix_nano + 12345
+    otlp._processor.force_flush()  # noqa: SLF001 — test drives the flush
+    assert collector.wait()
+    pinned = next(s for s in collector.spans() if s.name == "pinned")
+    assert (
+        pinned.end_time_unix_nano - pinned.start_time_unix_nano == 12345
+    )

@@ -268,6 +268,35 @@ def test_readiness_and_metrics(server):
     assert "policy_server_oracle_fallbacks_total" in r.text
 
 
+def test_debug_timeline_serves_live_trace(server):
+    """GET /debug/timeline (round 18): a Perfetto-loadable Chrome trace
+    for live traffic — batch phase slices, metadata track names, and
+    the exemplar table — on the readiness port AND the python-frontend
+    API port; the per-phase histogram rides /metrics."""
+    doc = build_admission_review_dict()
+    for _ in range(8):
+        requests.post(
+            server.url("/validate/pod-privileged"), json=doc, timeout=10
+        )
+    for url in (
+        server.readiness_url("/debug/timeline"),
+        server.url("/debug/timeline"),
+    ):
+        r = requests.get(url, timeout=10)
+        assert r.status_code == 200
+        trace = r.json()
+        slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert slices, "no phase slices for a live burst"
+        phases = {e["name"] for e in slices}
+        assert {"queue_wait", "form", "dispatch", "deliver"} <= phases
+        assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in slices)
+        assert isinstance(trace["exemplars"], list)
+    m = requests.get(server.readiness_url("/metrics"), timeout=10).text
+    assert "policy_server_phase_latency_seconds_bucket" in m
+    assert "policy_server_flight_recorder_events_total" in m
+    assert "policy_server_tail_exemplar_latency_seconds" in m
+
+
 def test_pprof_endpoints(server):
     r = requests.get(server.url("/debug/pprof/cpu?interval=0.05"), timeout=30)
     assert r.status_code == 200 and len(r.content) > 0

@@ -109,6 +109,7 @@ def bench_batcher_serving(quick: bool = False) -> None:
     )
     from policy_server_tpu.policies.flagship import flagship_policies
     from policy_server_tpu.runtime.batcher import MicroBatcher
+    from policy_server_tpu.telemetry import default_registry, flightrec
 
     env = EvaluationEnvironmentBuilder(backend="jax").build(
         flagship_policies()
@@ -152,15 +153,74 @@ def bench_batcher_serving(quick: bool = False) -> None:
         fake_server = SimpleNamespace(
             batcher=batcher, environment=env, _native_frontend=None
         )
+        # recorder A/B (round 18): the flight recorder is ON by default
+        # in production, so the HEADLINE waves run recorder-on; the
+        # recorder-off waves are the overhead control (the <=2%
+        # always-on contract, also unit-tested in tests/test_flightrec).
+        # Waves INTERLEAVE off/on pairs — this box drifts several k
+        # req/s wave-over-wave, and a sequential A-then-B layout read
+        # that drift as ±17% "overhead"; pairwise deltas cancel it.
         before = _decomp_snapshot(fake_server)
         prof_before = env.host_profile
-        bulk_runs = [
-            n / _drive_bulk(batcher, items, origin, burst, outstanding)
-            for _ in range(5)
-        ]
+        rec = flightrec.FlightRecorder(registry=default_registry())
+        off_runs, bulk_runs, pair_overheads = [], [], []
+        events_before = rec.events_recorded()
+        on_wall = 0.0
+        for i in range(6):
+            # alternate the within-pair order so a monotone box drift
+            # (this sandbox's wave-over-wave throughput swings 2x)
+            # cancels in the pairwise deltas instead of reading as
+            # recorder cost
+            order = ("off", "on") if i % 2 == 0 else ("on", "off")
+            pair = {}
+            for mode in order:
+                flightrec.install(rec if mode == "on" else None)
+                try:
+                    wave_wall = _drive_bulk(
+                        batcher, items, origin, burst, outstanding
+                    )
+                finally:
+                    flightrec.install(None)
+                pair[mode] = n / wave_wall
+                if mode == "on":
+                    on_wall += wave_wall
+            off_runs.append(pair["off"])
+            bulk_runs.append(pair["on"])
+            pair_overheads.append(
+                (pair["off"] - pair["on"]) / pair["off"] * 100.0
+            )
         decomp = _decompose(before, _decomp_snapshot(fake_server))
         host_prof = profile_delta(env.host_profile, prof_before)
         s_bulk = trimmed_spread(bulk_runs)
+        s_off = trimmed_spread(off_runs)
+        pair_overheads.sort()
+        recorder_overhead_pct = round(
+            (
+                pair_overheads[len(pair_overheads) // 2 - 1]
+                + pair_overheads[len(pair_overheads) // 2]
+            )
+            / 2.0,
+            2,
+        )
+        # deterministic overhead model, immune to the box's wave drift:
+        # events the recorder actually wrote during the ON waves, costed
+        # at the measured per-call price of its primitives on this box
+        events_on = rec.events_recorded() - events_before
+        t0 = time.perf_counter()
+        # same registry as the real recorder: the per-event price must
+        # include the prometheus histogram observe, not just the ring
+        # stores — the <=2% contract is judged on this number
+        probe = flightrec.FlightRecorder(
+            capacity=4096, registry=default_registry()
+        )
+        for _i in range(2000):
+            probe.record_phase(
+                flightrec.PH_DISPATCH, _i, _i + 100, rows=burst, batch=_i
+            )
+        per_event_s = (time.perf_counter() - t0) / 2000
+        recorder_overhead_modeled_pct = round(
+            events_on * per_event_s / max(1e-9, on_wall) * 100.0, 3
+        )
 
         # the legacy per-request A/B (round-11 shape): smaller n — the
         # point is the ratio, not a long soak
@@ -179,6 +239,15 @@ def bench_batcher_serving(quick: bool = False) -> None:
             rps_min=round(s_bulk["min"], 1),
             rps_max=round(s_bulk["max"], 1),
             rps_runs=s_bulk["runs"],
+            rps_recorder_off=round(s_off["median"], 1),
+            rps_recorder_off_min=round(s_off["min"], 1),
+            rps_recorder_off_max=round(s_off["max"], 1),
+            recorder_overhead_pct=recorder_overhead_pct,
+            recorder_overhead_pct_pairs=[
+                round(p, 2) for p in pair_overheads
+            ],
+            recorder_overhead_modeled_pct=recorder_overhead_modeled_pct,
+            recorder_events_per_on_waves=events_on,
             rps_per_request_path=round(s_seq["median"], 1),
             rps_per_request_min=round(s_seq["min"], 1),
             rps_per_request_max=round(s_seq["max"], 1),
@@ -200,7 +269,17 @@ def bench_batcher_serving(quick: bool = False) -> None:
             "anywhere; vs_baseline is against the 13k req/s round-12 "
             "acceptance floor (2x the round-11 6.5k measurement); "
             "rps_per_request_path is the legacy submit_nowait + "
-            "per-future-callback path on the same box",
+            "per-future-callback path on the same box; the HEADLINE "
+            "waves run with the flight recorder ON (the production "
+            "default) and rps_recorder_off is the A/B control: "
+            "order-alternating off/on pairs, recorder_overhead_pct = "
+            "median pairwise delta. This sandbox's throughput swings "
+            "~2x wave-over-wave under zero load, so the macro A/B's "
+            "noise floor is far above the 2% contract — "
+            "recorder_overhead_modeled_pct is the deterministic "
+            "companion (events actually recorded during the ON waves x "
+            "the measured per-event cost / ON wall), which is the "
+            "number the <=2% contract is judged on",
         )
     finally:
         batcher.shutdown()

@@ -34,6 +34,15 @@ Rules:
   that the server exports — a stats key the observability funnel does
   not carry is invisible work (and OB03/OB04 then anchor the constant
   to a registration and a dashboard panel).
+* **OB08** — flight-recorder phase totality (round 18): every phase
+  name in ``telemetry/flightrec.py``'s ``PHASES`` tuple must be a
+  module constant stamped by exactly ONE ``record_phase`` call site in
+  the package (zero sites = a phase the timeline can never show;
+  multiple sites = double-attributed time the phase-attribution
+  report silently inflates), and every HISTOGRAM family registered in
+  metrics.py must appear on a dashboard panel (OB04 covers families
+  generally; this re-asserts it for histograms specifically, whose
+  ``_bucket`` sample-name indirection makes dead panels easy to miss).
 """
 
 from __future__ import annotations
@@ -256,12 +265,79 @@ def _stat_key_tuples(environment_path: Path) -> dict[str, tuple[str, ...]]:
     return out
 
 
+def _flightrec_phases(flightrec_path: Path) -> tuple[dict[str, str], tuple]:
+    """(PH_* constant name → phase string, PHASES member names) from
+    telemetry/flightrec.py. Fixture trees without a flightrec module
+    have no phase contract to enforce."""
+    if not flightrec_path.exists():
+        return {}, ()
+    tree = ast.parse(flightrec_path.read_text())
+    consts: dict[str, str] = {}
+    members: tuple = ()
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            name = node.targets[0].id
+            if (
+                name.startswith("PH_")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                consts[name] = node.value.value
+            elif name == "PHASES" and isinstance(node.value, ast.Tuple):
+                members = tuple(
+                    e.id for e in node.value.elts if isinstance(e, ast.Name)
+                )
+    return consts, members
+
+
+def _phase_record_sites(
+    package_root: Path, ph_consts: dict[str, str]
+) -> dict[str, list[tuple[str, int]]]:
+    """phase string → [(relpath, line), ...] for every ``record_phase``
+    call whose first argument names a PH_ constant. The recorder's own
+    internal writes (variable phase args, row-segment replay) do not
+    count — the contract is about the STAMPING sites."""
+    sites: dict[str, list[tuple[str, int]]] = {}
+    for py in sorted(package_root.rglob("*.py")):
+        rel = str(py.relative_to(package_root.parent))
+        try:
+            tree = ast.parse(py.read_text())
+        except SyntaxError:  # pragma: no cover — unparseable file
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name)
+                else None
+            )
+            if fname != "record_phase" or not node.args:
+                continue
+            arg = node.args[0]
+            ident = (
+                arg.attr if isinstance(arg, ast.Attribute)
+                else arg.id if isinstance(arg, ast.Name) else None
+            )
+            phase = ph_consts.get(ident) if ident else None
+            if phase is not None:
+                sites.setdefault(phase, []).append((rel, node.lineno))
+    return sites
+
+
 def check(
     root: str | Path,
     metrics_path: str = "policy_server_tpu/telemetry/metrics.py",
     server_path: str = "policy_server_tpu/server.py",
     dashboard_path: str = "kubewarden-dashboard.json",
     environment_path: str = "policy_server_tpu/evaluation/environment.py",
+    flightrec_path: str = "policy_server_tpu/telemetry/flightrec.py",
+    package_path: str = "policy_server_tpu",
 ) -> list[Finding]:
     root = Path(root)
     findings: list[Finding] = []
@@ -374,6 +450,52 @@ def check(
                     "referencing it",
                 )
             )
+
+    # OB08: flight-recorder phase totality — every PHASES member stamped
+    # by exactly one record_phase site, every histogram family on a
+    # panel. Trees without a flightrec module have no phase contract.
+    ph_consts, ph_members = _flightrec_phases(root / flightrec_path)
+    if ph_members:
+        member_values = sorted(
+            ph_consts[m] for m in ph_members if m in ph_consts
+        )
+        sites = _phase_record_sites(root / package_path, ph_consts)
+        for phase in member_values:
+            hits = sites.get(phase, [])
+            if len(hits) == 0:
+                findings.append(
+                    Finding(
+                        "observability", "OB08", flightrec_path, 0,
+                        f"phase:unstamped:{phase}",
+                        f"flight-recorder phase '{phase}' is in PHASES "
+                        "but no record_phase call site stamps it — the "
+                        "timeline can never show this phase",
+                    )
+                )
+            elif len(hits) > 1:
+                where = ", ".join(f"{p}:{ln}" for p, ln in hits)
+                findings.append(
+                    Finding(
+                        "observability", "OB08", flightrec_path, 0,
+                        f"phase:multi:{phase}",
+                        f"flight-recorder phase '{phase}' is stamped by "
+                        f"{len(hits)} sites ({where}) — double-stamped "
+                        "time inflates the phase-attribution report",
+                    )
+                )
+        for family, kind in sorted(instruments.items()):
+            if kind != "histogram":
+                continue
+            if family not in referenced_families:
+                findings.append(
+                    Finding(
+                        "observability", "OB08", dashboard_path, 0,
+                        f"histogram:{family}",
+                        f"histogram family '{family}' has no dashboard "
+                        "panel referencing any of its _bucket/_sum/"
+                        "_count samples",
+                    )
+                )
 
     # OB06: label schema consistency for the reference instruments
     eval_labels = set(labels.get("_EVAL_LABELS", ())) | {"le"}

@@ -195,6 +195,23 @@ class SoakEngine:
     settings: SoakSettings
     log: list[str] = field(default_factory=list)
 
+    @staticmethod
+    def _phase_attribution() -> dict | None:
+        """The flight recorder's wall-vs-summed-phases reconciliation
+        over whatever its ring currently holds (the soak's own recent
+        traffic) — recorded into the artifact at gate time so the
+        unattributed-residual number trends round-over-round. None when
+        the recorder is disabled."""
+        from policy_server_tpu.telemetry import flightrec
+
+        rec = flightrec.recorder()
+        if rec is None:
+            return None
+        try:
+            return rec.attribution()
+        except Exception:  # noqa: BLE001 — accounting must not fail soaks
+            return None
+
     def _say(self, msg: str) -> None:
         line = f"[soak +{time.monotonic() - self._t0:6.1f}s] {msg}"
         self.log.append(line)
@@ -1124,6 +1141,12 @@ class SoakEngine:
                 "watch_feed": feed_stats,
                 "scanner": scanner_stats,
                 "snapshot": snapshot_stats,
+                # flight-recorder phase attribution over the soak's own
+                # traffic (round 18): the same wall-vs-summed-phases
+                # reconciliation `make phase-report` gates, computed at
+                # soak-gate time so the residual trends with every soak
+                # artifact. None when the recorder is off.
+                "phase_attribution": self._phase_attribution(),
                 "batcher": {
                     k: batcher_stats[k]
                     for k in (
