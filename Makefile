@@ -65,9 +65,12 @@ restart-drill:
 # phasereport.py): drive a short serving burst with the recorder armed,
 # reconcile summed phase time against per-batch wall time, and GATE the
 # unattributed residual at <25% of wall — the host floor is measured,
-# not guessed. Emits BENCH_phase_attribution.json.
+# not guessed. Emits BENCH_phase_attribution.json. Round 19: every run
+# also DIFFS against the committed artifact (read before the overwrite)
+# so per-phase regressions/wins print as numbers, not narration.
 phase-report:
-	JAX_PLATFORMS=cpu python -m tools.bench.phasereport --gate
+	JAX_PLATFORMS=cpu python -m tools.bench.phasereport --gate \
+	  --baseline BENCH_phase_attribution.json
 
 # the graftcheck CI gate (tools/graftcheck/): concurrency lint
 # (guarded-by + lock-order cycles), trace-purity lint, observability

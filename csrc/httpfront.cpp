@@ -25,12 +25,19 @@
 // frontend stays the correctness oracle, and 422 bodies are bit-exact by
 // construction because Python renders them.
 //
-// Response serialization: the common verdict shape (uid/allowed/status
-// message+code, no patch/warnings/annotations) is serialized natively with
-// json.dumps' default separators; everything else arrives pre-rendered from
-// Python. HTTP response heads mirror aiohttp's (status line, Content-Type,
-// Content-Length, Date, Server, Connection) so the differential framing
-// corpus can require byte-parity modulo the Date value.
+// Response serialization (round 19: batch-granular native response
+// assembly): verdict shapes up to and including patches (patchType +
+// base64 JSONPatch), warnings lists, and full status objects (message,
+// code, reason, details.causes tables — group denials) serialize
+// natively from packed v2 verdict records (parse_verdict_record) with
+// json.dumps' default separators, byte-exact vs the Python responder
+// (tests/test_native_assembly.py differential corpus; graftcheck RS01/
+// RS02 pin the field classification and key order). Only
+// auditAnnotations and non-encodable strings arrive pre-rendered from
+// Python — the per-row oracle for hooks/mutations. HTTP response heads
+// mirror aiohttp's (status line, Content-Type, Content-Length, Date,
+// Server, Connection) so the differential framing corpus can require
+// byte-parity modulo the Date value.
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
 
@@ -48,6 +55,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <array>
 #include <atomic>
 #include <cstdint>
 #include <deque>
@@ -1686,37 +1694,169 @@ void push_comp(Front* f, uint64_t req_id, int status, int retry_after,
 
 // ------------------------------------------------------------------ C ABI --
 
-// Shared verdict-body builder: the single source of the byte-exact
-// json.dumps(AdmissionReviewResponse(...).to_dict()) serialization both
-// the per-request and bulk completion entry points emit.
-static std::string build_verdict_body(const uint8_t* uid, int64_t uid_len,
-                                      int allowed, int64_t code,
-                                      const uint8_t* msg, int64_t msg_len,
-                                      int raw_shape) {
+// Batch-granular native response assembly (round 19): parse ONE v2
+// verdict record at buf+off (bounds-checked against len), advance off,
+// and build the byte-exact json.dumps(AdmissionReviewResponse(...)
+// .to_dict()) body — default separators, key order pinned to the Python
+// to_dict() (graftcheck RS02 checks the literal key sequence below
+// against models/admission.py). Record layout
+// (runtime/native_frontend.py _BULK_REC — the one packing path):
+//   u64 req_id | u8 allowed | u8 raw_shape | u8 flags | u8 n_warnings
+//   | i32 code | i32 uid_len | i32 msg_len | i32 patch_len
+//   | i32 reason_len | i32 n_causes
+//   | uid | msg | patch | reason
+//   | n_warnings x (u32 len | bytes)
+//   | n_causes  x (i32 field_len | i32 msg_len | field | msg)
+// -1 lengths mean the field is absent; flags bit0 = status object
+// present (possibly empty), bit1 = warnings list present (possibly
+// empty); a present patch always renders patchType "JSONPatch" (the
+// Python packer refuses anything else). auditAnnotations never travels
+// natively — the Python responder stays the oracle for it.
+static bool parse_verdict_record(const uint8_t* buf, int64_t len,
+                                 int64_t& off, uint64_t& req_id,
+                                 std::string& body) {
+  if (off + 36 > len) return false;
+  memcpy(&req_id, buf + off, 8);
+  uint8_t allowed = buf[off + 8];
+  uint8_t raw_shape = buf[off + 9];
+  uint8_t flags = buf[off + 10];
+  uint8_t n_warn = buf[off + 11];
+  int32_t code, uid_len, msg_len, patch_len, reason_len, n_causes;
+  memcpy(&code, buf + off + 12, 4);
+  memcpy(&uid_len, buf + off + 16, 4);
+  memcpy(&msg_len, buf + off + 20, 4);
+  memcpy(&patch_len, buf + off + 24, 4);
+  memcpy(&reason_len, buf + off + 28, 4);
+  memcpy(&n_causes, buf + off + 32, 4);
+  off += 36;
+  if (uid_len < 0) return false;
+  auto take = [&](int32_t n, const uint8_t*& p) -> bool {
+    if (n < 0) {
+      p = nullptr;
+      return true;
+    }
+    if (off + n > len) return false;
+    p = buf + off;
+    off += n;
+    return true;
+  };
+  const uint8_t *uid, *msg, *patch, *reason;
+  if (!take(uid_len, uid) || !take(msg_len, msg) ||
+      !take(patch_len, patch) || !take(reason_len, reason))
+    return false;
+  // variable tails parsed in layout order BEFORE building (the body
+  // interleaves them differently than the wire does). Every
+  // caller-supplied length/count is bounds-checked against the buffer
+  // BEFORE any allocation or pointer math — httpfront_render_verdict
+  // is exported for arbitrary test/fuzz input and must answer
+  // malformed records with false, never a crash (a u32 warning length
+  // >= 2^31 must not wrap into take()'s signed "absent" sentinel, and
+  // an n_causes count must not drive a giant reserve()).
+  std::vector<std::pair<int64_t, const uint8_t*>> warns;
+  warns.reserve(n_warn);
+  for (uint8_t wi = 0; wi < n_warn; wi++) {
+    if (off + 4 > len) return false;
+    uint32_t wlen;
+    memcpy(&wlen, buf + off, 4);
+    off += 4;
+    if ((int64_t)wlen > len - off) return false;
+    warns.emplace_back((int64_t)wlen, buf + off);
+    off += (int64_t)wlen;
+  }
+  std::vector<std::array<std::pair<int32_t, const uint8_t*>, 2>> causes;
+  if (n_causes > 0) {
+    if ((int64_t)n_causes * 8 > len - off) return false;  // 8 B/cause min
+    causes.reserve((size_t)n_causes);
+  }
+  for (int32_t ci = 0; ci < n_causes; ci++) {
+    if (off + 8 > len) return false;
+    int32_t flen, mlen;
+    memcpy(&flen, buf + off, 4);
+    memcpy(&mlen, buf + off + 4, 4);
+    off += 8;
+    const uint8_t *fld, *cmsg;
+    if (!take(flen, fld) || !take(mlen, cmsg)) return false;
+    causes.push_back({std::make_pair(flen, fld), std::make_pair(mlen, cmsg)});
+  }
   std::string resp;
-  resp.reserve(128 + (size_t)uid_len + (size_t)(msg_len > 0 ? msg_len : 0));
+  resp.reserve(160 + (size_t)uid_len + (size_t)(msg_len > 0 ? msg_len : 0) +
+               (size_t)(patch_len > 0 ? patch_len : 0));
   resp += "{\"uid\": ";
   py_escape(std::string((const char*)uid, (size_t)uid_len), resp);
   resp += ", \"allowed\": ";
   resp += allowed ? "true" : "false";
-  if (code >= 0 || msg_len >= 0) {
+  if (patch_len >= 0) {
+    resp += ", \"patchType\": \"JSONPatch\", \"patch\": ";
+    py_escape(std::string((const char*)patch, (size_t)patch_len), resp);
+  }
+  if (flags & 1) {
     resp += ", \"status\": {";
+    bool first = true;
+    auto sep = [&]() {
+      if (!first) resp += ", ";
+      first = false;
+    };
     if (msg_len >= 0) {
+      sep();
       resp += "\"message\": ";
       py_escape(std::string((const char*)msg, (size_t)msg_len), resp);
-      if (code >= 0) resp += ", ";
     }
     if (code >= 0) {
+      sep();
       char tmp[24];
-      snprintf(tmp, sizeof(tmp), "\"code\": %lld", (long long)code);
+      snprintf(tmp, sizeof(tmp), "\"code\": %d", code);
       resp += tmp;
+    }
+    if (reason_len >= 0) {
+      sep();
+      resp += "\"reason\": ";
+      py_escape(std::string((const char*)reason, (size_t)reason_len), resp);
+    }
+    if (n_causes >= 0) {
+      sep();
+      resp += "\"details\": {\"causes\": [";
+      for (size_t ci = 0; ci < causes.size(); ci++) {
+        if (ci) resp += ", ";
+        resp += "{";
+        int32_t flen = causes[ci][0].first, mlen = causes[ci][1].first;
+        if (flen >= 0) {
+          resp += "\"field\": ";
+          py_escape(
+              std::string((const char*)causes[ci][0].second, (size_t)flen),
+              resp);
+        }
+        if (mlen >= 0) {
+          if (flen >= 0) resp += ", ";
+          resp += "\"message\": ";
+          py_escape(
+              std::string((const char*)causes[ci][1].second, (size_t)mlen),
+              resp);
+        }
+        resp += "}";
+      }
+      resp += "]}";
     }
     resp += "}";
   }
+  if (flags & 2) {
+    resp += ", \"warnings\": [";
+    for (size_t wi = 0; wi < warns.size(); wi++) {
+      if (wi) resp += ", ";
+      py_escape(std::string((const char*)warns[wi].second,
+                            (size_t)warns[wi].first),
+                resp);
+    }
+    resp += "]";
+  }
   resp += "}";
-  if (raw_shape) return "{\"response\": " + resp + "}";
-  return "{\"apiVersion\": \"admission.k8s.io/v1\", \"kind\": "
-         "\"AdmissionReview\", \"response\": " + resp + "}";
+  if (raw_shape)
+    body = "{\"response\": " + resp + "}";
+  else
+    body =
+        "{\"apiVersion\": \"admission.k8s.io/v1\", \"kind\": "
+        "\"AdmissionReview\", \"response\": " +
+        resp + "}";
+  return true;
 }
 
 extern "C" {
@@ -1902,58 +2042,44 @@ void httpfront_complete(void* h, uint64_t req_id, int status,
             std::string((const char*)body, (size_t)body_len));
 }
 
-// Native serialization of the common verdict shape: exactly the bytes of
-// json.dumps(AdmissionReviewResponse(resp).to_dict()) (default separators)
-// for a response with uid/allowed and optional status{message, code}.
-// raw_shape=1 emits the RawReviewResponse envelope instead.
-void httpfront_complete_verdict(void* h, uint64_t req_id, const uint8_t* uid,
-                                int64_t uid_len, int allowed, int64_t code,
-                                const uint8_t* msg, int64_t msg_len,
-                                int raw_shape) {
-  Front* f = (Front*)h;
-  int64_t t0 = now_ns();
-  std::string body =
-      build_verdict_body(uid, uid_len, allowed, code, msg, msg_len, raw_shape);
-  f->stats[S_NATIVE_SER].fetch_add(1, std::memory_order_relaxed);
-  f->stats[S_FRAMING_NS].fetch_add(now_ns() - t0, std::memory_order_relaxed);
-  push_comp(f, req_id, 200, 0, std::move(body));
-}
-
-// Batch-granular completion fill (round 12): one call per dispatched
-// batch. `buf` is a packed little-endian record sequence, each record
-//   u64 req_id | u8 allowed | u8 raw_shape | i32 code(-1 = absent)
-//   | i32 uid_len | i32 msg_len(-1 = absent) | uid bytes | msg bytes
-// — the Python side builds it once per batch and pays ONE ctypes
-// crossing + ONE frontend lock instead of one per request.
+// Batch-granular completion fill (round 12; v2 records round 19): one
+// call per dispatched batch. `buf` is the packed little-endian record
+// sequence documented at parse_verdict_record — the Python side builds
+// it once per batch and pays ONE ctypes crossing + ONE frontend lock
+// instead of one per request, and the full response shape (patches,
+// warnings, status reason/details tables) renders natively.
 void httpfront_complete_verdict_bulk(void* h, const uint8_t* buf,
                                      int64_t len, int64_t count) {
   Front* f = (Front*)h;
   int64_t t0 = now_ns();
   int64_t off = 0;
   int64_t done = 0;
-  while (done < count && off + 22 <= len) {
-    uint64_t req_id;
-    memcpy(&req_id, buf + off, 8);
-    uint8_t allowed = buf[off + 8];
-    uint8_t raw_shape = buf[off + 9];
-    int32_t code, uid_len, msg_len;
-    memcpy(&code, buf + off + 10, 4);
-    memcpy(&uid_len, buf + off + 14, 4);
-    memcpy(&msg_len, buf + off + 18, 4);
-    off += 22;
-    int64_t payload = (int64_t)uid_len + (msg_len > 0 ? msg_len : 0);
-    if (uid_len < 0 || off + payload > len) break;  // malformed: stop
-    const uint8_t* uid = buf + off;
-    off += uid_len;
-    const uint8_t* msg = msg_len >= 0 ? buf + off : nullptr;
-    if (msg_len > 0) off += msg_len;
-    push_comp(f, req_id, 200, 0,
-              build_verdict_body(uid, uid_len, allowed, code, msg, msg_len,
-                                 raw_shape));
+  uint64_t req_id;
+  std::string body;
+  while (done < count) {
+    if (!parse_verdict_record(buf, len, off, req_id, body)) break;
+    push_comp(f, req_id, 200, 0, std::move(body));
     done++;
   }
   f->stats[S_NATIVE_SER].fetch_add(done, std::memory_order_relaxed);
   f->stats[S_FRAMING_NS].fetch_add(now_ns() - t0, std::memory_order_relaxed);
+}
+
+// Differential-corpus export (tests/test_native_assembly.py): render ONE
+// v2 verdict record's response body into `out` without touching any
+// connection state. Returns the body length, or -1 on malformed input /
+// insufficient capacity. This is the SAME parse+emit path serving uses,
+// so the byte-exactness the corpus proves is the byte-exactness
+// production emits.
+int64_t httpfront_render_verdict(const uint8_t* buf, int64_t len,
+                                 uint8_t* out, int64_t cap) {
+  int64_t off = 0;
+  uint64_t rid;
+  std::string body;
+  if (!parse_verdict_record(buf, len, off, rid, body)) return -1;
+  if ((int64_t)body.size() > cap) return -1;
+  memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
 }
 
 int64_t httpfront_outstanding(void* h) {

@@ -58,6 +58,8 @@ from policy_server_tpu.evaluation.settings import PolicyEvaluationSettings
 from policy_server_tpu.evaluation.verdict_cache import VerdictCache, extract_row
 from policy_server_tpu.models import (
     AdmissionResponse,
+    FragTemplate,
+    FragVerdict,
     StatusCause,
     StatusDetails,
     ValidateRequest,
@@ -108,6 +110,40 @@ DEFAULT_VERDICT_CACHE_SIZE = 256 * 1024 * 1024
 
 _donation_warning_silenced = False
 
+# -- pre-serialized cache-hit fragments (round 19) ---------------------------
+# Cached-row key under which a row dict carries its per-target
+# FragTemplate map ({cache_key_of(target): FragTemplate | False}) — the
+# materializers never read it, extract_row copies it along, and the hit
+# loops splice it instead of rebuilding AdmissionResponse rows per hit.
+FRAG_KEY = "__frag__"
+
+# Thread-local arming flag: FragVerdicts are only returned to callers
+# that PROVABLY handle them (the MicroBatcher's fused pipeline, which
+# runs begin+finish on one thread — batcher._fused_validate). Direct
+# validate_batch callers (tests, canary replay, audit scanner, the
+# single-request API) keep getting AdmissionResponse rows.
+_frag_scope = threading.local()
+
+
+class fragment_responses:
+    """Context manager arming the cache-hit fragment fast lane on this
+    thread (see FRAG_KEY). Entered by the batcher around the fused
+    encode→device→fetch chain."""
+
+    __slots__ = ("_prev",)
+
+    def __enter__(self) -> "fragment_responses":
+        self._prev = getattr(_frag_scope, "on", False)
+        _frag_scope.on = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _frag_scope.on = self._prev
+
+
+def _fragments_enabled() -> bool:
+    return getattr(_frag_scope, "on", False)
+
 
 def _silence_donation_decline_warning() -> None:
     """XLA:CPU declines to alias donated inputs larger than every output
@@ -128,6 +164,24 @@ def _silence_donation_decline_warning() -> None:
         message="Some donated buffers were not usable",
         category=UserWarning,
     )
+
+
+class _InlineFetch:
+    """Drain-future stand-in for the single-chunk serving path (round
+    19): ``.result()`` runs the device fetch ON the calling thread — the
+    fused pipeline worker that would otherwise park on a drain-pool
+    future — instead of paying a pool crossing + future-wake per chunk.
+    Multi-chunk passes keep the drain pool so fetch latency overlaps
+    across chunks."""
+
+    __slots__ = ("_fn", "_args")
+
+    def __init__(self, fn: Callable, *args: Any) -> None:
+        self._fn = fn
+        self._args = args
+
+    def result(self) -> Any:
+        return self._fn(*self._args)
 
 
 class _RowView:
@@ -692,6 +746,12 @@ class EvaluationEnvironment:
         self._target_memo: dict[str, Any] = {}
         self._hooks_memo: dict[int, list] = {}
         self._blob_plain_memo: dict[int, bool] = {}
+        # fragment eligibility per target (round 19): whether a cached
+        # row's response is a pure function of (target, output row) + uid
+        # with identity constraints — see _frag_eligible
+        self._frag_eligible_memo: dict[int, bool] = {}  # graftcheck: lockfree — GIL-atomic dict ops; racing builders store identical values
+        # rows answered as pre-serialized fragments (metrics surface)
+        self._frag_hits = 0  # guarded-by: _fallback_lock
         # Pre-built output-key strings per policy/group: the per-row
         # f-string construction in the materializers showed up in the
         # round-6 profile at ~7 µs/row on group targets.
@@ -1054,6 +1114,114 @@ class EvaluationEnvironment:
             self._blob_plain_memo[id(target)] = plain
         return plain
 
+    def _frag_eligible(self, target: "BoundPolicy | BoundGroup") -> bool:
+        """True when a cached output row's RESPONSE (not just its verdict
+        bits) is a pure function of (target, row) plus the request uid,
+        AND the service layer's post_evaluate constraints are provably
+        the identity on it — the conditions under which a pre-built
+        FragTemplate may answer cache hits with zero per-row
+        materialization:
+
+        * protect mode (monitor mode logs + rewrites every response);
+        * no mutators and no wasm anywhere in the target (patches and
+          host verdicts depend on the per-request payload / wall clock);
+        * every reachable rule message is a static string (dynamic
+          messages are payload functions).
+
+        Memoized per target — the registry is immutable post-boot."""
+        hit = self._frag_eligible_memo.get(id(target))
+        if hit is not None:
+            return hit
+        ok = self._cacheable(target)
+        if ok:
+            if isinstance(target, BoundGroup):
+                _ak, members, risky = self._group_mat[target.name]
+                ok = (
+                    target.policy_mode is PolicyMode.PROTECT
+                    and not risky
+                    and isinstance(target.message, str)
+                    and all(
+                        isinstance(r.message, str)
+                        for e in members
+                        for r in e[1].precompiled.program.rules
+                    )
+                )
+            else:
+                prog = target.precompiled.program
+                ok = (
+                    target.eval_settings.policy_mode is PolicyMode.PROTECT
+                    and prog.mutator is None
+                    and prog.host_evaluator is None
+                    and all(isinstance(r.message, str) for r in prog.rules)
+                )
+        self._frag_eligible_memo[id(target)] = ok
+        return ok
+
+    def _frag_of(
+        self, target: "BoundPolicy | BoundGroup", row: Mapping[str, Any]
+    ) -> "FragTemplate | None":
+        """The cached row's FragTemplate for ``target`` — built lazily on
+        the FIRST hit (one materialize-equivalent pass per cached row ×
+        target, amortized over every later hit) and attached to the row
+        dict under FRAG_KEY. Dict stores are GIL-atomic and racing
+        builders produce identical templates; the attachment is not
+        counted by the eviction estimate, which is fine — it is bounded
+        to one tiny template per (row, target) pair. Returns None for
+        ineligible targets (the caller materializes normally)."""
+        frags = row.get(FRAG_KEY)
+        if frags is None:
+            frags = {}
+            row[FRAG_KEY] = frags  # type: ignore[index]
+        ckey = self._cache_key_of(target)
+        tmpl = frags.get(ckey)
+        if tmpl is None:
+            if not self._frag_eligible(target):
+                frags[ckey] = False
+                return None
+            # eligibility guarantees the payload is never touched and
+            # the uid is spliced per row, so materialize once with inert
+            # stand-ins and capture the template
+            resp = self._materialize_from_row(target, "", row)
+            st = resp.status
+            try:
+                tmpl = FragTemplate(
+                    allowed=resp.allowed,
+                    code=None if st is None else st.code,
+                    message=None if st is None else st.message,
+                    causes=(
+                        tuple(
+                            (c.field, c.message) for c in st.details.causes
+                        )
+                        if st is not None and st.details is not None
+                        else None
+                    ),
+                )
+            except UnicodeEncodeError:
+                # a static message json can represent but utf-8 cannot
+                # encode (lone surrogates survive json.loads): this
+                # target is permanently Python-rendered — the per-row
+                # path serializes it fine, a raised batch would not
+                frags[ckey] = False
+                return None
+            frags[ckey] = tmpl
+        return tmpl or None  # False sentinel → None
+
+    def _materialize_from_row(
+        self, target: "BoundPolicy | BoundGroup", uid: str, row: Mapping[str, Any]
+    ) -> AdmissionResponse:
+        """_materialize for a bare output row with no request in hand
+        (fragment-template construction): eligible targets never touch
+        the payload, so a raising stand-in keeps that claim checked."""
+
+        def _no_payload() -> Any:
+            raise RuntimeError(
+                "fragment-eligible target touched the request payload"
+            )
+
+        if isinstance(target, BoundGroup):
+            return self._materialize_group(target, uid, _no_payload, row)
+        return self._materialize_single(target, uid, _no_payload, row)
+
     def _row_cache_key(self, target, blob: bytes) -> tuple | None:
         """(target, packed row bytes) verdict-cache key for ONE request —
         the host fast-path's entry into the same key space the device
@@ -1254,6 +1422,7 @@ class EvaluationEnvironment:
             stats["blob_" + k] = v
         with self._fallback_lock:
             stats["batch_dup_hits"] = self._batch_dedup_hits
+            stats["fragment_hits"] = self._frag_hits
         return stats
 
     def has_policy(self, policy_id: str) -> bool:
@@ -2675,13 +2844,28 @@ class EvaluationEnvironment:
             ]
             rows = bcache.get_many(keys)
             still: list[int] = []
+            # cache-hit fast lane (round 19): under the batcher's
+            # fragment scope a hit row answers as uid + pre-built
+            # template — the per-row AdmissionResponse/ValidationStatus
+            # construction the round-18 profile measured at ~61 µs/row
+            # happens once per cached row, not once per hit
+            frag_on = _fragments_enabled()
+            n_frag = 0
             for i, row in zip(pending, rows):
                 if row is None:
                     still.append(i)
+                    continue
+                tmpl = self._frag_of(targets[i], row) if frag_on else None
+                if tmpl is not None:
+                    results[i] = FragVerdict(items[i][1].uid(), tmpl)
+                    n_frag += 1
                 else:
                     results[i] = self._materialize(
                         targets[i], items[i][1], row
                     )
+            if n_frag:
+                with self._fallback_lock:
+                    self._frag_hits += n_frag
             t1 = time.perf_counter_ns()
             self._profile_add(
                 bookkeeping_ns=t1 - t0,
@@ -2893,16 +3077,26 @@ class EvaluationEnvironment:
                     rows=len(slot_rows), batch=_bid,
                 )
 
-        # encode ahead on the pool (bounded window), dispatch in order
+        # encode ahead on the pool (bounded window), dispatch in order.
+        # A SINGLE chunk — every serving batch up to max_dispatch_batch —
+        # encodes inline instead (round 19): with nothing to overlap, the
+        # pool submit + future-wake per chunk was pure handoff cost.
+        single = len(chunks) == 1
         window = self.max_inflight_dispatches
         encode_futs: dict[int, Any] = {}
         drained = 0
         for ci, chunk in enumerate(chunks):
-            for cj in range(ci, min(ci + 4, len(chunks))):
-                if cj not in encode_futs:
-                    encode_futs[cj] = self._encode_pool.submit(encode, chunks[cj])
+            if not single:
+                for cj in range(ci, min(ci + 4, len(chunks))):
+                    if cj not in encode_futs:
+                        encode_futs[cj] = self._encode_pool.submit(
+                            encode, chunks[cj]
+                        )
             try:
-                chunk_blobs, (features, status) = encode_futs.pop(ci).result()
+                chunk_blobs, (features, status) = (
+                    encode(chunk) if single
+                    else encode_futs.pop(ci).result()
+                )
             except ValueError:
                 # arena/records overflow on a pathological chunk: keep
                 # per-item isolation — route the whole chunk to the next
@@ -3036,10 +3230,29 @@ class EvaluationEnvironment:
                     if hit_rows.size:
                         hit_items = item_arr[dedup_pos[hit_rows]].tolist()
                         hit_combos = combo_inverse[hit_rows].tolist()
+                        # same fragment fast lane as the blob tier: the
+                        # row tier serves uid-varying duplicates, whose
+                        # responses differ ONLY in uid for eligible
+                        # targets
+                        frag_on = _fragments_enabled()
+                        n_frag = 0
                         for i, k in zip(hit_items, hit_combos):
-                            results[i] = self._materialize(
-                                targets[i], items[i][1], cached[k]
+                            tmpl = (
+                                self._frag_of(targets[i], cached[k])
+                                if frag_on else None
                             )
+                            if tmpl is not None:
+                                results[i] = FragVerdict(
+                                    items[i][1].uid(), tmpl
+                                )
+                                n_frag += 1
+                            else:
+                                results[i] = self._materialize(
+                                    targets[i], items[i][1], cached[k]
+                                )
+                        if n_frag:
+                            with self._fallback_lock:
+                                self._frag_hits += n_frag
                         if bcache is not None:
                             # Backfill the blob tier so the NEXT identical
                             # payload skips encoding entirely — bounded to
@@ -3174,7 +3387,13 @@ class EvaluationEnvironment:
                 dispatched_rows=n_dispatched, dispatched_chunks=1
             )
             entry = (
-                self._drain_pool.submit(
+                _InlineFetch(
+                    self._scoped_device_fetch,
+                    failpoints.current_scope(), dev_out,
+                    _bid, n_dispatched,
+                )
+                if single
+                else self._drain_pool.submit(
                     self._scoped_device_fetch,
                     failpoints.current_scope(), dev_out,
                     _bid, n_dispatched,

@@ -70,7 +70,13 @@ def entry_cost(key: Hashable, row: Mapping[str, Any]) -> int:
             if isinstance(part, (bytes, bytearray, str)):
                 cost += len(part)
     cost += _ROW_ITEM_COST * len(row)
-    for v in row.values():
+    # list() snapshots the view in one C-level pass (no thread switch):
+    # cached rows are MUTATED after insertion since round 19 — the
+    # fragment lane lazily attaches FRAG_KEY to a hit row, and a
+    # concurrent backfill re-inserting the same row object must not
+    # race that insert with a Python-level values() iteration
+    # (RuntimeError: dictionary changed size during iteration)
+    for v in list(row.values()):
         nbytes = getattr(v, "nbytes", None)
         if nbytes is not None:
             cost += int(nbytes)

@@ -10,6 +10,8 @@ from policy_server_tpu.models.admission import (
     AdmissionResponse,
     AdmissionReviewRequest,
     AdmissionReviewResponse,
+    FragTemplate,
+    FragVerdict,
     GroupVersionKind,
     GroupVersionResource,
     RawReviewRequest,
@@ -25,6 +27,8 @@ __all__ = [
     "AdmissionResponse",
     "AdmissionReviewRequest",
     "AdmissionReviewResponse",
+    "FragTemplate",
+    "FragVerdict",
     "GroupVersionKind",
     "GroupVersionResource",
     "RawReviewRequest",

@@ -43,13 +43,18 @@ from typing import Any
 
 from policy_server_tpu import failpoints
 from policy_server_tpu.api import service
+from policy_server_tpu.evaluation import environment
 from policy_server_tpu.evaluation.environment import (
     EvaluationEnvironment,
     bucket_size,
 )
 from policy_server_tpu.evaluation.errors import PolicyInitializationError
 from policy_server_tpu.evaluation.policy_id import PolicyID
-from policy_server_tpu.models import AdmissionResponse, ValidateRequest
+from policy_server_tpu.models import (
+    AdmissionResponse,
+    FragVerdict,
+    ValidateRequest,
+)
 from policy_server_tpu.telemetry import flightrec, otlp
 
 DEADLINE_MESSAGE = "execution deadline exceeded"
@@ -320,16 +325,21 @@ class MicroBatcher:
         self._overload_pool = DaemonExecutor(
             max_workers=8, thread_name_prefix="overload-wait"
         )
-        # Device-dispatch pool: when a policy timeout is configured, the
-        # device call runs here under the dispatch watchdog instead of on
-        # the dispatch thread, so a compile stall or a hung transport
-        # cannot wedge the batching loop. The pool width bounds leaked
-        # threads under a persistent hang — once every worker is wedged,
-        # later batches never start and their items resolve in-band via
-        # the same watchdog timeout, which is exactly the reference's
-        # behavior when every evaluation hits the epoch deadline
-        # (src/lib.rs:176-190). Daemon threads (workers.py): a wedged call
-        # is abandoned at exit, never joined.
+        # Pipeline pool: when a policy timeout is configured, the whole
+        # fused encode→device→fetch chain (_fused_validate) runs here
+        # under the dispatch watchdog instead of on the dispatch thread,
+        # so a compile stall or a hung transport cannot wedge the
+        # batching loop. Round 19 fused the former encode/device pool
+        # pair into this one pool: a batch is ONE worker submission, and
+        # cross-batch double-buffering comes from the pool width (batch
+        # N+1 encodes on a second worker while batch N's fetch blocks on
+        # the first). The width bounds leaked threads under a persistent
+        # hang — once every worker is wedged, later batches never start
+        # and their items resolve in-band via the same watchdog timeout,
+        # which is exactly the reference's behavior when every
+        # evaluation hits the epoch deadline (src/lib.rs:176-190).
+        # Daemon threads (workers.py): a wedged call is abandoned at
+        # exit, never joined.
         self._device_pool = DaemonExecutor(
             max_workers=4, thread_name_prefix="device-dispatch"
         )
@@ -346,17 +356,6 @@ class MicroBatcher:
             max_workers=self._batch_workers, thread_name_prefix="batch"
         )
         self._inflight = threading.BoundedSemaphore(self._batch_workers)
-        # Encode-stage pool (round-6 double-buffering): when the
-        # environment exposes the split host/device halves
-        # (validate_batch_begin / validate_batch_finish), a batch's host
-        # encode+dedup runs here while OTHER batches' device halves block
-        # in the device pool — batch N+1 encodes while batch N executes,
-        # and both stages stay under the dispatch watchdog. Width matches
-        # the batch pipeline so encodes never queue behind wedged device
-        # waits.
-        self._encode_pool = DaemonExecutor(
-            max_workers=self._batch_workers, thread_name_prefix="batch-encode"
-        )
         # _dispatch runs on concurrent batch-pool workers: counter updates
         # must be locked (+= is a racy read-modify-write).
         self._stats_lock = threading.Lock()
@@ -392,6 +391,10 @@ class MicroBatcher:
         # policy id -> True when the target has NO pre-eval hooks (the
         # common case: the whole hook machinery is skipped per batch)
         self._hookless: dict[str, bool] = {}  # graftcheck: lockfree — GIL-atomic dict ops; racing builders store identical values
+        # fragment-lane metric memo (round 19): label-tuple -> built
+        # metric dataclass, replacing per-row dataclass construction on
+        # the cache-hit fast lane (bounded; see _metric_of)
+        self._metric_memo: dict[tuple, Any] = {}  # graftcheck: lockfree — GIL-atomic dict ops; racing builders store identical values
         # -- audit lane counters (round 10; /metrics surface) -------------
         # best-effort audit batches actually dispatched
         self.audit_batches_dispatched = 0  # guarded-by: _stats_lock
@@ -485,7 +488,6 @@ class MicroBatcher:
         # wait=False: a wedged device call must not block shutdown — its
         # futures were already resolved by the watchdog.
         self._device_pool.shutdown(wait=False)
-        self._encode_pool.shutdown(wait=False)
         # audit lane: queued jobs reject (the scanner catches and re-marks
         # its keys dirty); an in-flight dispatch is abandoned, never joined
         self._drain_audit_rejecting()
@@ -1630,8 +1632,7 @@ class MicroBatcher:
                     )
                     if use_host
                     else self._scoped_rec(
-                        rec_bid, self.env.validate_batch,
-                        pairs, run_hooks=False,
+                        rec_bid, self._fused_validate, pairs,
                     )
                 )
             except Exception as e:  # noqa: BLE001
@@ -1646,73 +1647,29 @@ class MicroBatcher:
             # wall-clock) or slow context providers — no request future
             # may outlive policy_timeout unresolved, whichever path
             # served it.
-            begin_fn = None
-            if not use_host:
-                # Double-buffering (round 6): split the batch into its
-                # host half (encode + dedup + async device dispatch, on
-                # the encode pool) and its device half (block on device
-                # results, on the device pool). While THIS batch's device
-                # half waits, another batch worker's host half encodes —
-                # batch N+1 encodes while batch N executes. Both halves
-                # are watchdog-bounded, so deadline semantics are
-                # unchanged: a hung encode, compile stall, or transport
-                # hang all resolve in-band at the per-request deadline.
-                begin_fn = getattr(self.env, "validate_batch_begin", None)
-                if begin_fn is not None and not getattr(
-                    self.env, "native_encoding", False
-                ):
-                    begin_fn = None
-            handle = None
+            #
+            # Fused pipeline (round 19): ONE worker submission runs the
+            # whole encode→device→fetch chain (_fused_validate chains
+            # validate_batch_begin + validate_batch_finish on one
+            # pipeline thread), and this batch worker parks on ONE
+            # batch-granular completion instead of hopping the encode
+            # and device pools with a future-wake at each boundary —
+            # the round-18 flight recorder measured those pool
+            # crossings as the single largest host cost (``handoff``,
+            # ~82 µs/row on the 2-core box, PROFILE r18). Cross-batch
+            # overlap is preserved by the pool width: batch N+1's
+            # encode runs on a second pipeline worker while batch N's
+            # fetch blocks on the first. Both halves stay under the
+            # dispatch watchdog, so deadline semantics are unchanged: a
+            # hung encode, compile stall, or transport hang all resolve
+            # in-band at the per-request deadline.
             live = runnable
             # pool-handoff gaps (submit → worker pickup, work end →
-            # future wake): collected here, recorded as the ``handoff``
-            # phase after dispatch completes — the measured cost of
-            # crossing the encode/device pool boundaries
+            # future wake): one pair per batch now — the measured cost
+            # of the single remaining pool crossing
             handoffs: list | None = [] if brec is not None else None
-            if begin_fn is not None:
-                t_submit = (
-                    time.perf_counter_ns() if handoffs is not None else 0
-                )
-                enc_future = self._encode_pool.submit(
-                    self._scoped_rec_timed, rec_bid, begin_fn, pairs,
-                    run_hooks=False,
-                )
-                try:
-                    wrapped, live = self._watchdog_wait(
-                        enc_future, runnable
-                    )
-                except Exception as e:  # noqa: BLE001 — begin raised
-                    for p in runnable:
-                        self._fail(p, e)
-                    return
-                if wrapped is not None:
-                    handle, t_start, t_end = wrapped
-                    if handoffs is not None:
-                        handoffs.append((t_submit, t_start))
-                        handoffs.append((t_end, time.perf_counter_ns()))
-                if wrapped is None and not live:
-                    # every item expired during the host half; the encode
-                    # worker finishes (and its device work is discarded)
-                    # in the background. A long stall here IS a
-                    # device-path fault (the jit dispatch lives in begin)
-                    # — tell the breaker.
-                    self._record_device_failure(
-                        runnable, time.perf_counter() - dispatch_start
-                    )
-                    self._observe_dispatch(
-                        use_host, bucket, n,
-                        time.perf_counter() - dispatch_start,
-                        lower_bound=True,
-                        compiles_before=compiles_before,
-                    )
-                    return
             t_submit = time.perf_counter_ns() if handoffs is not None else 0
-            if handle is not None:
-                dev_future = self._device_pool.submit(
-                    self._scoped_rec_timed, rec_bid,
-                    self.env.validate_batch_finish, handle,
-                )
-            elif use_host:
+            if use_host:
                 dev_future = self._device_pool.submit(
                     self._scoped_rec_timed, rec_bid,
                     self.env.validate_batch,
@@ -1721,11 +1678,9 @@ class MicroBatcher:
                     prefer_host=True,
                 )
             else:
-                # non-native environment (begin unavailable or returned
-                # None): the single-call path, still watchdog-bounded
                 dev_future = self._device_pool.submit(
                     self._scoped_rec_timed, rec_bid,
-                    self.env.validate_batch, pairs, run_hooks=False,
+                    self._fused_validate, pairs,
                 )
             try:
                 wrapped, live = self._watchdog_wait(dev_future, live)
@@ -1768,8 +1723,9 @@ class MicroBatcher:
                 int(done_at * 1e9), rows=n, batch=brec.bid,
             )
             if self.policy_timeout is not None:
-                # the pool-handoff gaps collected around the encode and
-                # device legs (ONE textual record site — OB08)
+                # the pool-handoff gaps collected around the single
+                # fused pipeline submission (ONE textual record site —
+                # OB08)
                 for h0, h1 in handoffs:
                     if h1 > h0:
                         brec.rec.record_phase(
@@ -1791,6 +1747,39 @@ class MicroBatcher:
             if id(p) not in live_ids:
                 continue
             try:
+                if type(result) is FragVerdict:
+                    # pre-serialized cache-hit lane (round 19): fragment
+                    # eligibility proved the service-layer constraints
+                    # are the identity on this shape, so post_evaluate's
+                    # per-row object work collapses to one memoized
+                    # metric append; the native sink splices the
+                    # template bytes without ever building an
+                    # AdmissionResponse
+                    tmpl = result.tmpl
+                    metrics_sink.append(
+                        (
+                            (done_at - p.enqueued_at) * 1e3,
+                            self._metric_of(p, tmpl),
+                        )
+                    )
+                    self._resolve(
+                        p,
+                        result if p.sink is not None
+                        else result.to_response(),
+                        delivery,
+                    )
+                    if p.trace_ctx is not None:
+                        otlp.emit_span(
+                            "policy_evaluation",
+                            p.trace_ctx,
+                            dispatch_start_ns,
+                            {
+                                "policy_id": p.policy_id,
+                                "batch_size": len(runnable),
+                                "allowed": tmpl.allowed,
+                            },
+                        )
+                    continue
                 if isinstance(result, PolicyInitializationError):
                     self._resolve(
                         p,
@@ -1853,6 +1842,60 @@ class MicroBatcher:
                         brec.row_breakdown(p.enqueued_at),
                         flightrec.FlightRecorder.ROW_SAMPLED,
                     )
+
+    def _metric_of(self, p: "_Pending", tmpl) -> Any:
+        """Memoized metric dataclass for the fragment lane: a small
+        label-tuple key + dict get replaces per-row frozen-dataclass
+        construction (part of the measured ``deliver`` cost, PROFILE
+        r18). Fragment verdicts carry no patch, so mutated is always
+        False and error_code is the template's code. Bounded at 4096
+        entries — real traffic's label diversity is tiny; a hostile
+        high-cardinality stream falls back to plain construction."""
+        req = p.request
+        if req.is_raw:
+            key = (
+                p.policy_id, p.origin, tmpl.allowed, tmpl.code, True,
+                None, None, None,
+            )
+        else:
+            adm = req.admission_request
+            key = (
+                p.policy_id, p.origin, tmpl.allowed, tmpl.code, False,
+                adm.request_kind.kind if adm.request_kind else "",
+                adm.namespace, adm.operation,
+            )
+        memo = self._metric_memo
+        m = memo.get(key)
+        if m is None:
+            m = service._evaluation_metric(  # noqa: SLF001 — same package
+                self.env, p.policy_id, req, p.origin,
+                accepted=tmpl.allowed, mutated=False,
+                error_code=tmpl.code,
+            )
+            if len(memo) < 4096:
+                memo[key] = m
+        return m
+
+    def _fused_validate(self, pairs: list) -> list:
+        """The encode→device→fetch chain as ONE unit of pool work: the
+        native pipeline's host half (validate_batch_begin) and device
+        half (validate_batch_finish) run back-to-back on the SAME
+        pipeline thread — no pool hop, no future-wake between them —
+        and the cache-hit fast lane is armed (fragment_responses) so
+        blob/row-tier hits come back as pre-serialized FragVerdicts
+        instead of per-row AdmissionResponse construction. Environments
+        without the native split (oracle backend, sharded evaluators,
+        tripped breakers declining the pipeline) fall through to plain
+        validate_batch with identical semantics."""
+        with environment.fragment_responses():
+            begin_fn = getattr(self.env, "validate_batch_begin", None)
+            if begin_fn is not None and getattr(
+                self.env, "native_encoding", False
+            ):
+                handle = begin_fn(pairs, run_hooks=False)
+                if handle is not None:
+                    return self.env.validate_batch_finish(handle)
+            return self.env.validate_batch(pairs, run_hooks=False)
 
     def _observe_dispatch(
         self,

@@ -8,9 +8,15 @@ into the exact compact bytes ``json.dumps(AdmissionRequest.to_dict(),
 separators=(",", ":"))`` would produce, and serialize responses — all
 GIL-free. Python's per-request work shrinks to: pop a parsed record from a
 lock-free ring, submit it to the MicroBatcher, and complete the request
-when the batch verdict lands (the common verdict shape is serialized back
-to JSON natively; anything with patches/warnings/exotic status fields is
-rendered by Python for bit-exactness).
+when the batch verdict lands. Round 19 grew verdict serialization into
+full batch-granular native response assembly: patches, warnings, and
+complete status objects (message/code/reason/details.causes tables) pack
+into v2 records (pack_verdict_record — the ONE packing path) and render
+in C++ byte-exactly; cache-hit fragments splice uid + pre-encoded
+template bytes (pack_frag_record). Only the classified Python-only tail
+(audit annotations, surrogate strings) is rendered by Python — the
+per-row oracle; graftcheck RS01/RS02 pin the classification and the
+emitter's key order to models/admission.py.
 
 Build model mirrors ops/fastenc.py: compiled on demand with g++ into
 ``build/httpfront-<py>.so`` and cached; any failure (no compiler,
@@ -45,6 +51,7 @@ from pathlib import Path
 from typing import Any
 
 from policy_server_tpu import failpoints
+from policy_server_tpu.models import FragVerdict
 from policy_server_tpu.telemetry import flightrec
 from policy_server_tpu.telemetry.tracing import logger
 
@@ -163,13 +170,13 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_int64, ctypes.c_int,
         ]
-        pylib.httpfront_complete_verdict.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
-            ctypes.c_int64, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
-            ctypes.c_int64, ctypes.c_int,
-        ]
         pylib.httpfront_complete_verdict_bulk.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        pylib.httpfront_render_verdict.restype = ctypes.c_int64
+        pylib.httpfront_render_verdict.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
             ctypes.c_int64,
         ]
         pylib.httpfront_outstanding.restype = ctypes.c_int64
@@ -184,6 +191,24 @@ def _load() -> ctypes.CDLL | None:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def render_verdict_bytes(record: bytes) -> bytes | None:
+    """Render one packed v2 verdict record through the SAME native
+    emitter serving uses (httpfront_render_verdict) — the differential
+    corpus' entry point, so the byte-exactness it proves is the
+    byte-exactness production emits. None when the native library is
+    unavailable or the record is malformed."""
+    if _load() is None:
+        return None
+    # worst-case py_escape expansion is 6x (\uXXXX per char) plus the
+    # fixed envelope
+    cap = len(record) * 6 + 8192
+    out = ctypes.create_string_buffer(cap)
+    n = _pylib.httpfront_render_verdict(record, len(record), out, cap)
+    if n < 0:
+        return None
+    return ctypes.string_at(out, n)
 
 
 def server_header() -> str:
@@ -398,57 +423,28 @@ class NativeFrontend:
                 int(retry_after),
             )
 
-    def complete_verdict(
-        self,
-        req_id: int,
-        uid: str,
-        allowed: bool,
-        code: int | None,
-        message: str | None,
-        raw_shape: bool,
-    ) -> None:
-        uid_b = uid.encode()
-        msg_b = message.encode() if message is not None else None
-        with self._lock:
-            if self._closed or not self._handle:
-                return
-            self._pylib.httpfront_complete_verdict(
-                self._handle, req_id, uid_b, len(uid_b),
-                1 if allowed else 0,
-                -1 if code is None else int(code),
-                msg_b, -1 if msg_b is None else len(msg_b),
-                1 if raw_shape else 0,
-            )
-
-    # one bulk verdict record: u64 req_id | u8 allowed | u8 raw_shape |
-    # i32 code(-1 absent) | i32 uid_len | i32 msg_len(-1 absent)
-    _BULK_REC = struct.Struct("<QBBiii")
-
-    def complete_verdict_bulk(self, records: list[tuple]) -> None:
-        """Batch-granular completion fill: ``records`` is
-        [(req_id, uid_bytes, allowed, code|None, msg_bytes|None,
-        raw_shape), ...] — ONE frontend-lock acquisition and ONE native
+    def complete_verdict_bulk(self, records: list[bytes]) -> None:
+        """Batch-granular completion fill: ``records`` is a list of
+        pre-packed v2 verdict records (pack_verdict_record /
+        pack_frag_record) — ONE frontend-lock acquisition and ONE native
         call push every verdict of a dispatched batch onto the MPSC
-        completion stack."""
-        pack = self._BULK_REC.pack
-        parts: list[bytes] = []
-        for req_id, uid_b, allowed, code, msg_b, raw_shape in records:
-            parts.append(
-                pack(
-                    req_id, 1 if allowed else 0, 1 if raw_shape else 0,
-                    -1 if code is None else int(code),
-                    len(uid_b), -1 if msg_b is None else len(msg_b),
-                )
-            )
-            parts.append(uid_b)
-            if msg_b is not None:
-                parts.append(msg_b)
-        buf = b"".join(parts)
+        completion stack, and the C++ side renders the full response
+        shape (patches, warnings, status tables) per record."""
+        buf = b"".join(records)
         with self._lock:
             if self._closed or not self._handle:
                 return
             self._pylib.httpfront_complete_verdict_bulk(
                 self._handle, buf, len(buf), len(records)
+            )
+
+    def complete_verdict_rec(self, record: bytes) -> None:
+        """One packed v2 verdict record (the per-request legacy path)."""
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._pylib.httpfront_complete_verdict_bulk(
+                self._handle, record, len(record), 1
             )
 
     # -- introspection ----------------------------------------------------
@@ -624,24 +620,172 @@ def _api_error_body(status: int, message: str) -> bytes:
     return api_error_body(status, message)
 
 
-def _verdict_is_native(r: Any) -> bool:
-    """True when the native serializer reproduces json.dumps of this
-    AdmissionResponse byte-for-byte: uid/allowed plus at most a
-    status{message, code} — no patch, warnings, annotations, reason,
-    details, and no empty-status edge case."""
-    if (
-        r.patch is not None
-        or r.patch_type is not None
-        or r.audit_annotations is not None
-        or r.warnings is not None
+# -- native response assembly: the one source of truth (round 19) -----------
+# Classification of every AdmissionResponse / ValidationStatus field into
+# natively-serialized vs Python-rendered. graftcheck RS01 checks this
+# partition is TOTAL over models/admission.py's to_dict keys (a new model
+# field without a classification fails `make check`), and RS02 checks the
+# C++ emitter's literal key order against to_dict's. pack_verdict_record
+# below is the ONE packing path serving, tests, and the differential
+# corpus share.
+NATIVE_RESPONSE_FIELDS = frozenset(
+    {"uid", "allowed", "patch_type", "patch", "status", "warnings"}
+)
+PYTHON_ONLY_RESPONSE_FIELDS = frozenset({"audit_annotations"})
+NATIVE_STATUS_FIELDS = frozenset({"message", "code", "reason", "details"})
+PYTHON_ONLY_STATUS_FIELDS: frozenset = frozenset()
+
+# v2 bulk verdict record header (csrc/httpfront.cpp
+# parse_verdict_record documents the full layout):
+#   u64 req_id | u8 allowed | u8 raw_shape | u8 flags | u8 n_warnings |
+#   i32 code | i32 uid_len | i32 msg_len | i32 patch_len |
+#   i32 reason_len | i32 n_causes
+# then uid | msg | patch | reason | warnings (u32 len + bytes each) |
+# causes (i32 field_len | i32 msg_len | field | msg each). -1 lengths =
+# absent; flags bit0 = status present, bit1 = warnings list present.
+_BULK_REC = struct.Struct("<QBBBBiiiiii")
+_WARN_LEN = struct.Struct("<I")
+_CAUSE_LEN = struct.Struct("<ii")
+# status codes ride an i32 with -1 as the absent sentinel: anything
+# outside [0, 2^31) must take the Python renderer (json has no such
+# bound; struct.pack would raise, not truncate)
+_CODE_MAX = 0x7FFFFFFF
+
+
+def _pack_causes(causes_b) -> bytes:
+    """The (field_len | msg_len | field | msg) cause tail — ONE wire
+    encoding shared by pack_verdict_record and pack_frag_record."""
+    parts = []
+    for fb, mb in causes_b:
+        parts.append(
+            _CAUSE_LEN.pack(
+                -1 if fb is None else len(fb), -1 if mb is None else len(mb)
+            )
+        )
+        if fb is not None:
+            parts.append(fb)
+        if mb is not None:
+            parts.append(mb)
+    return b"".join(parts)
+
+
+def pack_verdict_record(req_id: int, r: Any, raw_shape: bool) -> bytes | None:
+    """Pack one AdmissionResponse-shaped verdict into the v2 record the
+    native serializer renders byte-exactly. Returns None when the shape
+    needs the Python renderer: audit annotations (the classified
+    python-only field), a patchType without a patch (or a non-JSONPatch
+    type), negative status codes, >255 warnings, or strings json can
+    serialize but utf-8 cannot encode (surrogates)."""
+    if r.audit_annotations is not None:
+        return None
+    patch = r.patch
+    if (patch is None) != (r.patch_type is None) or (
+        r.patch_type is not None and r.patch_type != "JSONPatch"
     ):
-        return False
+        return None
     st = r.status
-    if st is None:
-        return True
-    if st.reason is not None or st.details is not None:
-        return False
-    return st.message is not None or st.code is not None
+    warnings = r.warnings
+    try:
+        uid_b = r.uid.encode()
+        msg_b = reason_b = None
+        code = -1
+        n_causes = -1
+        causes_b: tuple = ()
+        flags = 0
+        if st is not None:
+            flags |= 1
+            if st.message is not None:
+                msg_b = st.message.encode()
+            if st.code is not None:
+                if not 0 <= st.code <= _CODE_MAX:
+                    # -1 is the absent sentinel and the wire is i32;
+                    # wasm host verdicts carry policy-controlled codes
+                    return None
+                code = int(st.code)
+            if st.reason is not None:
+                reason_b = st.reason.encode()
+            if st.details is not None:
+                causes_b = tuple(
+                    (
+                        c.field.encode() if c.field is not None else None,
+                        c.message.encode() if c.message is not None else None,
+                    )
+                    for c in st.details.causes
+                )
+                n_causes = len(causes_b)
+        patch_b = patch.encode() if patch is not None else None
+        warn_b = None
+        if warnings is not None:
+            if len(warnings) > 255:
+                return None
+            flags |= 2
+            warn_b = [w.encode() for w in warnings]
+    except (UnicodeEncodeError, AttributeError):
+        return None
+    parts = [
+        _BULK_REC.pack(
+            req_id, 1 if r.allowed else 0, 1 if raw_shape else 0,
+            flags, len(warn_b) if warn_b is not None else 0, code,
+            len(uid_b),
+            -1 if msg_b is None else len(msg_b),
+            -1 if patch_b is None else len(patch_b),
+            -1 if reason_b is None else len(reason_b),
+            n_causes,
+        ),
+        uid_b,
+    ]
+    if msg_b is not None:
+        parts.append(msg_b)
+    if patch_b is not None:
+        parts.append(patch_b)
+    if reason_b is not None:
+        parts.append(reason_b)
+    if warn_b:
+        for w in warn_b:
+            parts.append(_WARN_LEN.pack(len(w)))
+            parts.append(w)
+    if causes_b:
+        parts.append(_pack_causes(causes_b))
+    return b"".join(parts)
+
+
+def pack_frag_record(
+    req_id: int, frag: Any, raw_shape: bool
+) -> bytes | None:
+    """pack_verdict_record's cache-hit fast lane: a FragVerdict's
+    template already carries pre-encoded message/cause bytes, so a hit
+    row packs as one header + uid + the template's memoized tail — no
+    per-row string encoding beyond the uid. The tail is cached on the
+    template (native_tail) the first time a hit ships."""
+    t = frag.tmpl
+    try:
+        uid_b = frag.uid.encode()
+    except UnicodeEncodeError:
+        return None
+    tail = t.native_tail
+    if tail is None:
+        if t.code is not None and not 0 <= t.code <= _CODE_MAX:
+            return None  # outside the i32 wire range: Python renders
+        n_causes = -1 if t.causes_b is None else len(t.causes_b)
+        tail = (
+            t.allowed,
+            0 if t.status is None else 1,  # flags: status present
+            -1 if t.code is None else int(t.code),
+            t.msg_b,
+            n_causes,
+            _pack_causes(t.causes_b or ()),
+        )
+        t.native_tail = tail
+    allowed, flags, code, msg_b, n_causes, causes_tail = tail
+    header = _BULK_REC.pack(
+        req_id, 1 if allowed else 0, 1 if raw_shape else 0,
+        flags, 0, code, len(uid_b),
+        -1 if msg_b is None else len(msg_b),
+        -1, -1, n_causes,
+    )
+    if msg_b is None:
+        return b"".join((header, uid_b, causes_tail))
+    return b"".join((header, uid_b, msg_b, causes_tail))
 
 
 class BatcherSink:
@@ -877,11 +1021,12 @@ class BatcherSink:
                 frontend.complete_verdict_bulk(records)
             except Exception as e:  # noqa: BLE001 — last resort: the
                 # packed fill failed as a unit; answer each in-band
+                # (req_id is the v2 record's leading u64)
                 logger.error("bulk completion fill failed: %s", e)
                 for record in records:
                     try:
                         frontend.complete(
-                            record[0], 500,
+                            struct.unpack_from("<Q", record)[0], 500,
                             _api_error_body(500, "Something went wrong"),
                         )
                     except Exception:  # noqa: BLE001
@@ -903,25 +1048,19 @@ class BatcherSink:
             self._deliver_exc(frontend, req_id, exc)
             return
         r = response
-        if _verdict_is_native(r):
-            try:
-                uid_b = r.uid.encode()
-                st = r.status
-                msg_b = (
-                    st.message.encode()
-                    if st is not None and st.message is not None
-                    else None
-                )
-                bulk_by_frontend.setdefault(frontend, []).append(
-                    (
-                        req_id, uid_b, r.allowed,
-                        st.code if st is not None else None,
-                        msg_b, raw_shape,
-                    )
-                )
-                return
-            except UnicodeEncodeError:
-                pass  # surrogates: Python json handles them below
+        # v2 native assembly (round 19): cache-hit fragments splice
+        # uid + template bytes; full AdmissionResponses — patches,
+        # warnings, status tables included — pack once and render in
+        # C++. None = the classified Python-only tail (annotations,
+        # surrogates).
+        rec = (
+            pack_frag_record(req_id, r, raw_shape)
+            if type(r) is FragVerdict
+            else pack_verdict_record(req_id, r, raw_shape)
+        )
+        if rec is not None:
+            bulk_by_frontend.setdefault(frontend, []).append(rec)
+            return
         from policy_server_tpu.models import (
             AdmissionReviewResponse,
             RawReviewResponse,
@@ -949,38 +1088,48 @@ class BatcherSink:
 
 def _deliver(frontend: NativeFrontend, req_id: int, raw_shape: bool, fut) -> None:
     """Map a resolved batcher future to the HTTP answer — the native
-    analog of api/handlers._evaluate's error mapping."""
+    analog of api/handlers._evaluate's error mapping. Runs as a future
+    done-callback: ANY escape would strand the HTTP request until the
+    caller's webhook timeout, so the whole body is guarded."""
     from policy_server_tpu.evaluation.errors import PolicyNotFoundError
 
-    exc = fut.exception()
-    if exc is not None:
-        if isinstance(exc, PolicyNotFoundError):
-            frontend.complete(req_id, 404, _api_error_body(404, str(exc)))
-        else:
-            logger.error("Evaluation error: %s", exc)
+    try:
+        exc = fut.exception()
+        if exc is not None:
+            if isinstance(exc, PolicyNotFoundError):
+                frontend.complete(
+                    req_id, 404, _api_error_body(404, str(exc))
+                )
+            else:
+                logger.error("Evaluation error: %s", exc)
+                frontend.complete(
+                    req_id, 500, _api_error_body(500, "Something went wrong")
+                )
+            return
+        r = fut.result()
+        rec = (
+            pack_frag_record(req_id, r, raw_shape)
+            if type(r) is FragVerdict
+            else pack_verdict_record(req_id, r, raw_shape)
+        )
+        if rec is not None:
+            frontend.complete_verdict_rec(rec)
+            return
+        from policy_server_tpu.models import (
+            AdmissionReviewResponse,
+            RawReviewResponse,
+        )
+
+        env = RawReviewResponse(r) if raw_shape else AdmissionReviewResponse(r)
+        frontend.complete(req_id, 200, json.dumps(env.to_dict()).encode())
+    except Exception as e:  # noqa: BLE001 — answer, never hang
+        logger.error("verdict delivery failed: %s", e)
+        try:
             frontend.complete(
                 req_id, 500, _api_error_body(500, "Something went wrong")
             )
-        return
-    r = fut.result()
-    if _verdict_is_native(r):
-        try:
-            frontend.complete_verdict(
-                req_id, r.uid, r.allowed,
-                r.status.code if r.status else None,
-                r.status.message if r.status else None,
-                raw_shape,
-            )
-            return
-        except UnicodeEncodeError:
-            pass  # surrogates in uid/message: Python json handles them
-    from policy_server_tpu.models import (
-        AdmissionReviewResponse,
-        RawReviewResponse,
-    )
-
-    env = RawReviewResponse(r) if raw_shape else AdmissionReviewResponse(r)
-    frontend.complete(req_id, 200, json.dumps(env.to_dict()).encode())
+        except Exception:  # noqa: BLE001 — frontend gone
+            pass
 
 
 class BridgeSink:

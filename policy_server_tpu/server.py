@@ -802,6 +802,12 @@ class PolicyServer:
                 "Rows answered by an identical row in the same batch",
                 dedup.get("batch_dup_hits", 0),
             )
+            yield (
+                metrics_names.FRAGMENT_HITS, "counter",
+                "Cache-hit rows answered as pre-serialized response "
+                "fragments (zero per-row materialization)",
+                dedup.get("fragment_hits", 0),
+            )
             # Host-pipeline decomposition (PROFILE.md round 6): where the
             # per-row host time goes on the native dispatch path
             profile = getattr(environment, "host_profile", None) or {}

@@ -59,6 +59,7 @@ VERDICT_CACHE_HITS = "policy_server_verdict_cache_hits"
 VERDICT_CACHE_MISSES = "policy_server_verdict_cache_misses"
 VERDICT_CACHE_BYTES = "policy_server_verdict_cache_bytes"
 BATCH_DEDUP_HITS = "policy_server_batch_dedup_hits"
+FRAGMENT_HITS = "policy_server_fragment_hits"
 BUDGET_ROUTED_BATCHES = "policy_server_budget_routed_batches"
 SHED_REQUESTS = "policy_server_shed_requests"
 EXPIRED_DROPPED = "policy_server_expired_dropped_rows"

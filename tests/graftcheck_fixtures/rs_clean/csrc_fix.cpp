@@ -1,0 +1,11 @@
+// RS fixture (clean): keys in to_dict order.
+static bool parse_verdict_record(int x) {
+  std::string resp;
+  resp += "{\"uid\": ";
+  resp += ", \"allowed\": ";
+  resp += ", \"status\": {";
+  resp += "\"message\": ";
+  resp += ", \"code\": ";
+  resp += "}";
+  return true;
+}

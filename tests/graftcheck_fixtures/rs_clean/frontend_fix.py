@@ -1,0 +1,6 @@
+"""RS fixture (clean): total classification."""
+
+NATIVE_RESPONSE_FIELDS = frozenset({"uid", "allowed", "status"})
+PYTHON_ONLY_RESPONSE_FIELDS = frozenset({"audit_annotations"})
+NATIVE_STATUS_FIELDS = frozenset({"message", "code"})
+PYTHON_ONLY_STATUS_FIELDS: frozenset = frozenset()

@@ -1,0 +1,12 @@
+// RS fixture (violation): the emitter writes code before message —
+// byte order can never match json.dumps of the model's to_dict.
+static bool parse_verdict_record(int x) {
+  std::string resp;
+  resp += "{\"uid\": ";
+  resp += ", \"allowed\": ";
+  resp += ", \"status\": {";
+  resp += "\"code\": ";
+  resp += "\"message\": ";
+  resp += "}";
+  return true;
+}

@@ -1,0 +1,7 @@
+"""RS fixture (violation): the classification carries a stale entry
+(``patch`` is not on this fixture's model)."""
+
+NATIVE_RESPONSE_FIELDS = frozenset({"uid", "allowed", "status", "patch"})
+PYTHON_ONLY_RESPONSE_FIELDS = frozenset()
+NATIVE_STATUS_FIELDS = frozenset({"message", "code"})
+PYTHON_ONLY_STATUS_FIELDS: frozenset = frozenset()

@@ -1,0 +1,28 @@
+"""RS fixture (violation): AdmissionResponse gained a ``priority``
+field that nobody classified."""
+
+
+def _drop_none(d):
+    return {k: v for k, v in d.items() if v is not None}
+
+
+class ValidationStatus:
+    def to_dict(self):
+        return _drop_none(
+            {
+                "message": self.message,
+                "code": self.code,
+            }
+        )
+
+
+class AdmissionResponse:
+    def to_dict(self):
+        return _drop_none(
+            {
+                "uid": self.uid,
+                "allowed": self.allowed,
+                "priority": self.priority,  # unclassified (RS01)
+                "status": self.status.to_dict() if self.status else None,
+            }
+        )

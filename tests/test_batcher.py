@@ -534,3 +534,88 @@ def test_rtt_estimator_discards_compile_bearing_samples():
     assert legacy._dev_rtt[bucket] == pytest.approx(
         0.7 * 0.005 + 0.3 * 0.02
     )
+
+
+# ---------------------------------------------------------------------------
+# fragment fast lane (round 19: pre-serialized cache hits)
+# ---------------------------------------------------------------------------
+
+
+def test_fragment_lane_serves_hits_with_metrics(env):
+    """Warm replays through the fused batcher pipeline answer as
+    fragment hits: the counter moves, sink verdicts stay correct for
+    allowed AND denied shapes, the futures path still yields full
+    AdmissionResponses, and every row's evaluation metric is recorded
+    (the memoized-metric lane must not drop counts)."""
+    import threading as _threading
+    import time
+
+    from policy_server_tpu.api import service as service_mod
+
+    batcher = MicroBatcher(
+        env,
+        max_batch_size=8,
+        batch_timeout_ms=1.0,
+        policy_timeout=5.0,
+        host_fastpath_threshold=0,
+        latency_budget_ms=0,
+    ).start()
+
+    class Sink:
+        def __init__(self):
+            self.got = {}
+            self.lock = _threading.Lock()
+
+        def deliver_many(self, items):
+            with self.lock:
+                for token, resp, exc in items:
+                    self.got[token] = (resp, exc)
+
+    try:
+        items = [
+            ("priv", pod_review("default", privileged=False)),
+            ("priv", pod_review("default", privileged=True)),
+            ("ns", pod_review("blocked", privileged=False)),
+        ] * 4
+        frag_before = env.dedup_stats["fragment_hits"]
+        # wave 1 populates the blob tier (misses), wave 2 hits
+        for _wave in range(2):
+            sink = Sink()
+            batcher.submit_many(
+                items, RequestOrigin.VALIDATE, sink=sink,
+                tokens=list(range(len(items))),
+            )
+            deadline = time.perf_counter() + 30
+            while time.perf_counter() < deadline:
+                with sink.lock:
+                    if len(sink.got) == len(items):
+                        break
+                time.sleep(0.005)
+            assert len(sink.got) == len(items)
+        assert env.dedup_stats["fragment_hits"] > frag_before
+        # hit-wave verdicts: correct allowed/denied split with the
+        # denial's status intact
+        for i, (pid, _req) in enumerate(items):
+            resp, exc = sink.got[i]
+            assert exc is None
+            if pid == "priv" and i % 3 == 1:
+                assert resp.allowed is False
+                assert resp.status.code == 400
+            elif pid == "ns":
+                assert resp.allowed is False
+            else:
+                assert resp.allowed is True
+        # futures path converts fragments back to AdmissionResponse
+        fut = batcher.submit(
+            "priv", pod_review("default", privileged=True),
+            RequestOrigin.VALIDATE,
+        )
+        resp = fut.result(timeout=30)
+        assert type(resp).__name__ == "AdmissionResponse"
+        assert resp.allowed is False
+        # metrics recorded for every delivered row (memoized lane incl.)
+        reg = service_mod._registry()
+        total = reg.counter_value(metrics_mod.EVALUATIONS_TOTAL)
+        assert total >= 2 * len(items) + 1
+    finally:
+        batcher.shutdown()

@@ -476,3 +476,46 @@ def test_breaker_stats_consistent_under_concurrent_short_circuits():
         assert s["open"] == 1 and s["trips"] == 1
     # per-call denials were counted exactly (lock-guarded increment)
     assert breaker.short_circuits == 4 * 500
+
+
+# ---------------------------------------------------------------------------
+# Checker — native/Python response-shape totality (RS01/RS02, round 19)
+# ---------------------------------------------------------------------------
+
+
+def test_respshape_violation_fixture_flagged():
+    """RS01: an unclassified to_dict field AND a stale classification
+    entry; RS02: emitter key order diverging from to_dict."""
+    from tools.graftcheck import respshape
+
+    findings = respshape.check(
+        FIXTURES / "rs_violation",
+        models_path="models_fix.py",
+        frontend_path="frontend_fix.py",
+        csrc_path="csrc_fix.cpp",
+    )
+    syms = {f.symbol for f in findings}
+    assert "unclassified:AdmissionResponse.priority" in syms
+    assert "stale:AdmissionResponse.patch" in syms
+    # the fixture's C++ emits code before message
+    assert "order:ValidationStatus.code" in syms
+
+
+def test_respshape_clean_fixture_passes():
+    from tools.graftcheck import respshape
+
+    assert respshape.check(
+        FIXTURES / "rs_clean",
+        models_path="models_fix.py",
+        frontend_path="frontend_fix.py",
+        csrc_path="csrc_fix.cpp",
+    ) == []
+
+
+def test_respshape_repo_classification_is_total():
+    """Acceptance: the live native serializer's field classification is
+    total over the response models and the C++ emitter's key order
+    matches to_dict's."""
+    from tools.graftcheck import respshape
+
+    assert respshape.check(REPO_ROOT) == []

@@ -359,3 +359,159 @@ def test_mini_soak_engine_gates_green():
     applied = [f for f in doc["faults"] if f["applied_at"] is not None]
     assert len(applied) >= 3
     assert any(f["kind"] == "sighup" for f in applied)
+
+
+# ---------------------------------------------------------------------------
+# deterministic restart handover (round 19 — the r18 flake's regression)
+# ---------------------------------------------------------------------------
+
+
+def _bare_engine():
+    """A SoakEngine shell with only the handover-relevant state — the
+    hold/await helpers read nothing else."""
+    from tools.soak.engine import SoakEngine
+
+    eng = SoakEngine.__new__(SoakEngine)
+    eng._restart_in_progress = False
+    return eng
+
+
+def test_await_handover_holds_until_flag_clears():
+    import threading
+    import time as _time
+
+    eng = _bare_engine()
+    eng._restart_in_progress = True
+    released_at = {}
+
+    def clear():
+        _time.sleep(0.4)
+        eng._restart_in_progress = False
+        released_at["t"] = _time.monotonic()
+
+    threading.Thread(target=clear, daemon=True).start()
+    t0 = _time.monotonic()
+    eng._await_handover(timeout=10.0)
+    waited = _time.monotonic() - t0
+    assert waited >= 0.35, "probe resumed inside the handover window"
+    assert not eng._restart_in_progress
+
+
+def test_handover_probes_never_observe_the_reboot_window(tmp_path):
+    """Seeded end-to-end shape of the r18 flake: a fake server whose
+    handover window answers WRONG statuses (the desynced 200/500 the
+    soak observed), fronted by the engine's hold + routing-ready gate.
+    Probes driven through the gate must only ever see the ready
+    answers, across every seed."""
+    import json as _json
+    import random
+    import socket
+    import threading
+    import time as _time
+    from types import SimpleNamespace
+
+    from tools.soak import engine as engine_mod
+
+    body = _json.dumps({"ok": True}).encode()
+
+    def http(status: int) -> bytes:
+        reason = {200: "OK", 404: "Not Found", 500: "Error"}[status]
+        return (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+
+    # fake server: while `window` is set, answers the DESYNCED statuses
+    # the r18 flake recorded; after, answers 200s
+    window = threading.Event()
+    window.set()
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(16)
+    port = lsock.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        lsock.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed at teardown
+            with conn:
+                conn.settimeout(2.0)
+                try:
+                    while not stop.is_set():
+                        data = conn.recv(65536)
+                        if not data:
+                            break
+                        status = 500 if window.is_set() else 200
+                        conn.sendall(http(status))
+                except OSError:
+                    pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    try:
+        for seed in (3, 11, 42):
+            rng = random.Random(seed)
+            eng = _bare_engine()
+            eng.api_port = port
+            eng._restart_in_progress = True
+            probe = SimpleNamespace(path="/validate/p", body=b"{}")
+            eng._restart_probes = [probe]
+            # routing flips ready at a seeded moment; the engine's gate
+            # (readiness + canary) must absorb it deterministically
+            delay = 0.2 + rng.random() * 0.4
+
+            def flip(d=delay):
+                _time.sleep(d)
+                window.clear()
+
+            window.set()
+            t = threading.Thread(target=flip, daemon=True)
+            t.start()
+            server = SimpleNamespace(
+                state=SimpleNamespace(
+                    readiness=lambda: (
+                        (503, "booting") if window.is_set() else (200, "ok")
+                    )
+                )
+            )
+            assert eng._await_routing_ready(server, timeout=30.0)
+            eng._restart_in_progress = False
+            # the probes the engine releases after the gate: always the
+            # ready answer, never the window's desynced one
+            results = eng._probe(eng._restart_probes * 4)
+            assert [status for _p, status, _b in results] == [200] * 4
+            t.join(timeout=5)
+    finally:
+        stop.set()
+        lsock.close()
+
+
+def test_restart_gate_requires_routing_ready(monkeypatch):
+    """The SLO gate fails a restart event whose handover never proved
+    routing re-established (pre-round-19 events cannot silently pass)."""
+    rec = SLORecorder(window_seconds=5.0)
+    rec.record(200, 1.0, "ok")
+    ok_event = {
+        "warm_boot_used": True,
+        "verdicts_bit_exact": True,
+        "routing_ready_before_probes": True,
+    }
+    stale_event = {
+        "warm_boot_used": True,
+        "verdicts_bit_exact": True,
+    }
+    good = rec.gate(
+        p99_budget_ms=1000.0, fault_events=[], min_fault_events=0,
+        restart_storm={"planned": 1, "events": [ok_event]},
+    )
+    assert good["checks"]["restart_storm_survived"] is True
+    bad = rec.gate(
+        p99_budget_ms=1000.0, fault_events=[], min_fault_events=0,
+        restart_storm={"planned": 1, "events": [stale_event]},
+    )
+    assert bad["checks"]["restart_storm_survived"] is False

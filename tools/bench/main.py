@@ -28,6 +28,12 @@ def main() -> int:
         i = sys.argv.index("--mesh-child")
         bench_mesh_child(sys.argv[i + 1])
         return 0
+    if "--predicate-e2e-child" in sys.argv:
+        from tools.bench.predicate import bench_predicate_e2e_child
+
+        i = sys.argv.index("--predicate-e2e-child")
+        bench_predicate_e2e_child(sys.argv[i + 1])
+        return 0
     if "--native-client" in sys.argv:
         from tools.bench.native import _native_client_main
 

@@ -13,11 +13,22 @@ Run ``make phase-report`` (wired into ``make all``); ``--gate`` exits
 nonzero when the residual exceeds RESIDUAL_GATE_FRACTION of wall. The
 soak engine computes the same attribution over its own traffic at
 gate time and records it in the soak artifact.
+
+Baseline-diff mode (round 19): ``--baseline PATH`` reads a COMMITTED
+attribution artifact before the run and prints per-phase deltas — a
+phase-level regression/improvement is a diffed number, not a narrated
+one. ``make phase-report`` passes the committed
+``BENCH_phase_attribution.json`` itself, so every run diffs against the
+last committed round; ``--gate-improvement PHASES:RATIO`` (e.g.
+``handoff+blob_dedup+deliver:2.0``) additionally exits nonzero unless
+the named phases' combined µs/row improved by ≥ RATIO vs that baseline
+(the round-19 acceptance gate).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from pathlib import Path
@@ -35,8 +46,79 @@ PRIOR_UNATTRIBUTED_US_PER_ROW = 53.0
 ARTIFACT = str(_REPO_ROOT / "BENCH_phase_attribution.json")
 
 
+def load_baseline(path: str) -> dict | None:
+    """The COMMITTED artifact's attribution. Prefers ``git show HEAD:``
+    over the on-disk file: a prior uncommitted run already overwrote
+    the artifact with its own output, and diffing a run against itself
+    reads as "no movement" (the improvement gate would compute ~1.0x on
+    a genuinely improved tree). Git-less environments (the Docker test
+    stage) fall back to the on-disk bytes. None (with a note on stderr)
+    when neither source is usable — a fresh checkout must still produce
+    a report."""
+    import subprocess
+
+    try:
+        rel = str(Path(path).resolve().relative_to(_REPO_ROOT))
+        out = subprocess.run(
+            ["git", "show", f"HEAD:{rel}"],
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout)["attribution"]
+    except (OSError, ValueError, KeyError, subprocess.TimeoutExpired):
+        pass
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return doc["attribution"]
+    except (OSError, ValueError, KeyError) as e:
+        print(f"phase-report: no usable baseline at {path}: {e}",
+              file=sys.stderr)
+        return None
+
+
+def baseline_diff(att: dict, base: dict) -> dict:
+    """Per-phase µs/row deltas vs a baseline attribution (negative =
+    improvement), plus wall/residual movement."""
+    phases = sorted(
+        set(att["phase_us_per_row"]) | set(base.get("phase_us_per_row", {}))
+    )
+    return {
+        "phases": {
+            p: {
+                "baseline_us_per_row": base.get("phase_us_per_row", {}).get(p, 0.0),
+                "now_us_per_row": att["phase_us_per_row"].get(p, 0.0),
+                "delta_us_per_row": round(
+                    att["phase_us_per_row"].get(p, 0.0)
+                    - base.get("phase_us_per_row", {}).get(p, 0.0),
+                    2,
+                ),
+            }
+            for p in phases
+        },
+        "wall_us_per_row": {
+            "baseline": base.get("wall_us_per_row", 0.0),
+            "now": att["wall_us_per_row"],
+        },
+        "residual_us_per_row": {
+            "baseline": base.get("residual_us_per_row", 0.0),
+            "now": att["residual_us_per_row"],
+        },
+    }
+
+
+def improvement_ratio(att: dict, base: dict, phases: list[str]) -> float:
+    """baseline/now combined µs/row over the named phases (≥1 =
+    improved)."""
+    now = sum(att["phase_us_per_row"].get(p, 0.0) for p in phases)
+    then = sum(base.get("phase_us_per_row", {}).get(p, 0.0) for p in phases)
+    return then / max(1e-9, now)
+
+
 def run_report(
-    quick: bool = False, artifact_path: str = ARTIFACT
+    quick: bool = False,
+    artifact_path: str = ARTIFACT,
+    baseline: dict | None = None,
 ) -> dict:
     from policy_server_tpu.api.service import RequestOrigin
     from policy_server_tpu.evaluation.environment import (
@@ -117,6 +199,8 @@ def run_report(
                 ),
             },
         }
+        if baseline is not None:
+            doc["baseline_diff"] = baseline_diff(att, baseline)
         write_json_artifact(artifact_path, doc)
         return doc
     finally:
@@ -133,8 +217,22 @@ def main(argv: list[str] | None = None) -> int:
         help="exit 1 when the residual exceeds the gate fraction",
     )
     ap.add_argument("--artifact", default=ARTIFACT)
+    ap.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="committed attribution artifact to diff against (read "
+        "before the run overwrites --artifact)",
+    )
+    ap.add_argument(
+        "--gate-improvement", default=None, metavar="PHASES:RATIO",
+        help="exit 1 unless the '+'-joined phases' combined us/row "
+        "improved >= RATIO vs --baseline (e.g. "
+        "handoff+blob_dedup+deliver:2.0)",
+    )
     args = ap.parse_args(argv)
-    doc = run_report(quick=args.quick, artifact_path=args.artifact)
+    base = load_baseline(args.baseline) if args.baseline else None
+    doc = run_report(
+        quick=args.quick, artifact_path=args.artifact, baseline=base
+    )
     att = doc["attribution"]
     print(
         f"phase-report: {att['batches_complete']} batches, "
@@ -146,8 +244,22 @@ def main(argv: list[str] | None = None) -> int:
     for phase, us in sorted(
         att["phase_us_per_row"].items(), key=lambda kv: -kv[1]
     ):
-        print(f"  {phase:<18} {us:>10.2f} us/row")
+        if base is not None:
+            b = base.get("phase_us_per_row", {}).get(phase, 0.0)
+            print(
+                f"  {phase:<18} {us:>10.2f} us/row   "
+                f"(baseline {b:>8.2f}, {us - b:+8.2f})"
+            )
+        else:
+            print(f"  {phase:<18} {us:>10.2f} us/row")
+    if base is not None:
+        print(
+            f"  wall: {base.get('wall_us_per_row', 0.0)} -> "
+            f"{att['wall_us_per_row']} us/row (baseline diff recorded "
+            "in the artifact)"
+        )
     print(f"artifact: {args.artifact}")
+    rc = 0
     if args.gate and not doc["gate"]["passed"]:
         print(
             "phase-report: GATE FAILED — unattributed residual "
@@ -155,8 +267,31 @@ def main(argv: list[str] | None = None) -> int:
             f"exceeds {RESIDUAL_GATE_FRACTION * 100:.0f}%",
             file=sys.stderr,
         )
-        return 1
-    return 0
+        rc = 1
+    if args.gate_improvement:
+        spec, _, ratio_s = args.gate_improvement.partition(":")
+        phases = [p for p in spec.split("+") if p]
+        want = float(ratio_s or "2.0")
+        if base is None:
+            print(
+                "phase-report: IMPROVEMENT GATE FAILED — no baseline "
+                "to diff against",
+                file=sys.stderr,
+            )
+            rc = 1
+        else:
+            got = improvement_ratio(att, base, phases)
+            print(
+                f"improvement gate [{'+'.join(phases)}]: "
+                f"{got:.2f}x vs baseline (need >= {want:.2f}x)"
+            )
+            if got < want:
+                print(
+                    "phase-report: IMPROVEMENT GATE FAILED",
+                    file=sys.stderr,
+                )
+                rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ from tools.graftcheck import (
     concurrency,
     failpoint_drift,
     observability,
+    respshape,
     statestore_fs,
     tracepurity,
 )
@@ -70,6 +71,7 @@ def run_checkers(root: Path, skip_docs: bool = False) -> list[Finding]:
     findings += observability.check(root)
     findings += failpoint_drift.check(root)
     findings += statestore_fs.check(root)
+    findings += respshape.check(root)
     if not skip_docs:
         findings += docs_drift(root)
     return findings

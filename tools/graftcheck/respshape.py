@@ -1,0 +1,265 @@
+"""Checker 6 — native/Python response-shape totality (RS01/RS02).
+
+Round 19 grew the native frontend's verdict serializer into full
+batch-granular response assembly: csrc/httpfront.cpp renders
+AdmissionResponse shapes (patches, warnings, status tables) byte-exactly
+from packed records. That duplicates the response SHAPE in two runtimes,
+and the classic rot is silent: someone adds a field to the Python model,
+the Python responder serializes it, the native fast path silently drops
+it, and the differential corpus only catches it if a fixture happens to
+exercise the new field. These rules make the shape contract a build
+gate:
+
+* **RS01 — classification totality.** runtime/native_frontend.py
+  declares the ONE source of truth: every ``AdmissionResponse`` /
+  ``ValidationStatus`` field is either in the NATIVE_*_FIELDS set (the
+  packer ships it, the C++ renders it) or in the PYTHON_ONLY_*_FIELDS
+  set (pack_verdict_record must refuse, the Python responder renders).
+  A model ``to_dict`` field in neither set — or a classified name no
+  longer on the model — fails ``make check`` before it can fail in
+  production.
+
+* **RS02 — emitter key-order parity.** The C++ emitter
+  (parse_verdict_record) must emit the natively-classified JSON keys in
+  exactly the model ``to_dict``'s order (json.dumps preserves dict
+  insertion order, so key order IS byte order). The checker extracts the
+  literal ``\\"key\\": `` sequence from the C++ and requires the
+  native response keys and status keys to appear, in to_dict order.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.graftcheck.base import Finding
+
+# json key -> which nested key-order stream it belongs to is derived
+# from the model classes themselves; these are the class names checked
+_RESPONSE_CLASS = "AdmissionResponse"
+_STATUS_CLASS = "ValidationStatus"
+
+_CPP_KEY_RE = re.compile(r'\\"([A-Za-z]+)\\": ')
+
+
+def _to_dict_entries(tree: ast.Module, class_name: str) -> list[tuple[str, str]]:
+    """(json_key, model_attr) pairs from ``class_name.to_dict``'s dict
+    literal, in source order. The attr is the first ``self.X`` reference
+    inside the entry's value expression."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == class_name):
+            continue
+        for fn in node.body:
+            if not (
+                isinstance(fn, ast.FunctionDef) and fn.name == "to_dict"
+            ):
+                continue
+            for d in ast.walk(fn):
+                if not isinstance(d, ast.Dict):
+                    continue
+                entries: list[tuple[str, str]] = []
+                for key, value in zip(d.keys, d.values):
+                    if not (
+                        isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                    ):
+                        continue
+                    attr = None
+                    for sub in ast.walk(value):
+                        if (
+                            isinstance(sub, ast.Attribute)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"
+                        ):
+                            attr = sub.attr
+                            break
+                    if attr is not None:
+                        entries.append((key.value, attr))
+                if entries:
+                    return entries
+    return []
+
+
+def _frozenset_values(tree: ast.Module, name: str) -> set[str] | None:
+    """Constant members of a module-level ``name = frozenset({...})``
+    (or annotated / empty-frozenset form). None when not found."""
+    for node in tree.body:
+        target = None
+        value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        if not (isinstance(target, ast.Name) and target.id == name):
+            continue
+        if value is None:
+            return None
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "frozenset"
+        ):
+            if not value.args:
+                return set()
+            arg = value.args[0]
+            if isinstance(arg, (ast.Set, ast.List, ast.Tuple)):
+                return {
+                    e.value
+                    for e in arg.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                }
+        return None
+    return None
+
+
+def _cpp_key_sequence(text: str, anchor: str) -> list[str]:
+    """The escaped-JSON-key literals emitted by the C++ function whose
+    definition contains ``anchor``, in source order."""
+    i = text.find(anchor)
+    if i < 0:
+        return []
+    # function body ends at the next top-level definition marker
+    ends = [
+        j for j in (
+            text.find("\nstatic ", i + 1),
+            text.find('\nextern "C"', i + 1),
+            text.find("\nvoid ", i + 1),
+            text.find("\nint64_t ", i + 1),
+        )
+        if j > 0
+    ]
+    body = text[i:min(ends)] if ends else text[i:]
+    return _CPP_KEY_RE.findall(body)
+
+
+def _ordered_subsequence(needles: list[str], haystack: list[str]) -> str | None:
+    """None when ``needles`` appear in ``haystack`` in order; else the
+    first needle that breaks the order (or is missing)."""
+    pos = 0
+    for n in needles:
+        try:
+            pos = haystack.index(n, pos)
+        except ValueError:
+            return n
+    return None
+
+
+def check(
+    root: str | Path,
+    models_path: str = "policy_server_tpu/models/admission.py",
+    frontend_path: str = "policy_server_tpu/runtime/native_frontend.py",
+    csrc_path: str = "csrc/httpfront.cpp",
+) -> list[Finding]:
+    root = Path(root)
+    findings: list[Finding] = []
+    try:
+        models_tree = ast.parse((root / models_path).read_text())
+        frontend_tree = ast.parse((root / frontend_path).read_text())
+        cpp_text = (root / csrc_path).read_text()
+    except (OSError, SyntaxError) as e:
+        return [
+            Finding(
+                "respshape", "RS00", models_path, 0, "parse",
+                f"response-shape sources unreadable: {e}",
+            )
+        ]
+
+    specs = [
+        (
+            _RESPONSE_CLASS,
+            "NATIVE_RESPONSE_FIELDS",
+            "PYTHON_ONLY_RESPONSE_FIELDS",
+        ),
+        (
+            _STATUS_CLASS,
+            "NATIVE_STATUS_FIELDS",
+            "PYTHON_ONLY_STATUS_FIELDS",
+        ),
+    ]
+    native_json_keys: dict[str, list[str]] = {}
+    for class_name, native_name, pyonly_name in specs:
+        entries = _to_dict_entries(models_tree, class_name)
+        if not entries:
+            findings.append(
+                Finding(
+                    "respshape", "RS00", models_path, 0,
+                    f"model:{class_name}",
+                    f"{class_name}.to_dict dict literal not found — "
+                    "RS01 cannot prove the classification total",
+                )
+            )
+            continue
+        native = _frozenset_values(frontend_tree, native_name)
+        pyonly = _frozenset_values(frontend_tree, pyonly_name)
+        if native is None or pyonly is None:
+            findings.append(
+                Finding(
+                    "respshape", "RS00", frontend_path, 0,
+                    f"classification:{class_name}",
+                    f"{native_name}/{pyonly_name} frozensets not found "
+                    "in the native frontend — the classification source "
+                    "of truth is gone",
+                )
+            )
+            continue
+        attrs = {attr for _key, attr in entries}
+        for attr in sorted(attrs - native - pyonly):
+            findings.append(
+                Finding(
+                    "respshape", "RS01", models_path, 0,
+                    f"unclassified:{class_name}.{attr}",
+                    f"{class_name}.{attr} is serialized by to_dict but "
+                    f"classified neither native ({native_name}) nor "
+                    f"python-only ({pyonly_name}) — the native fast "
+                    "path would silently drop it",
+                )
+            )
+        for attr in sorted((native | pyonly) - attrs):
+            findings.append(
+                Finding(
+                    "respshape", "RS01", frontend_path, 0,
+                    f"stale:{class_name}.{attr}",
+                    f"classified field {class_name}.{attr} is not "
+                    "serialized by to_dict — stale classification entry",
+                )
+            )
+        overlap = native & pyonly
+        for attr in sorted(overlap):
+            findings.append(
+                Finding(
+                    "respshape", "RS01", frontend_path, 0,
+                    f"ambiguous:{class_name}.{attr}",
+                    f"{class_name}.{attr} is classified BOTH native and "
+                    "python-only",
+                )
+            )
+        native_json_keys[class_name] = [
+            key for key, attr in entries if attr in native
+        ]
+
+    # RS02: the C++ emitter's literal key order vs to_dict's
+    cpp_keys = _cpp_key_sequence(cpp_text, "static bool parse_verdict_record")
+    if not cpp_keys:
+        findings.append(
+            Finding(
+                "respshape", "RS02", csrc_path, 0, "emitter",
+                "parse_verdict_record emits no JSON key literals — the "
+                "native emitter moved; update respshape.py's anchor",
+            )
+        )
+        return findings
+    for class_name, keys in native_json_keys.items():
+        broken = _ordered_subsequence(keys, cpp_keys)
+        if broken is not None:
+            findings.append(
+                Finding(
+                    "respshape", "RS02", csrc_path, 0,
+                    f"order:{class_name}.{broken}",
+                    f"native emitter does not emit '{broken}' in "
+                    f"{class_name}.to_dict's key order — the bytes "
+                    "cannot match json.dumps",
+                )
+            )
+    return findings

@@ -286,6 +286,20 @@ class SoakEngine:
         pos = 0
         sock_ = None
         while not stop.is_set():
+            if self._restart_in_progress:
+                # handover hold (round 19): a pipelined burst straddling
+                # the reboot is how the r18 flake happened — a burst's
+                # conn died mid-read and the positional response
+                # attribution desynced (an unknown-policy slot read its
+                # neighbor's 200, a midbody probe read an in-flight
+                # 500). Probes and traffic HOLD until routing is
+                # re-established, and the conn is dropped so nothing
+                # spans the handover.
+                if sock_ is not None:
+                    sock_.close()
+                    sock_ = None
+                stop.wait(0.1)
+                continue
             t_burst = time.perf_counter()
             burst = [
                 items[order[(pos + i) % len(order)]]
@@ -608,7 +622,10 @@ class SoakEngine:
                 b"Content-Length: 50000\r\n\r\npartial-then-gone"
             )
             c.close()
-        # the server must still answer cleanly right after
+        # the server must still answer cleanly right after — but never
+        # mid-handover (a restart beginning during the disconnect loop
+        # above must not turn this probe into a coin flip)
+        self._await_handover()
         probe = scenarios.build_trace(1, 4).items[0]
         conn = _HttpConn(self.api_port)
         try:
@@ -662,6 +679,40 @@ class SoakEngine:
             self._say(f"policies.yml rewritten ({rw.note})")
 
     # -- restart storm (round 17) ------------------------------------------
+
+    def _await_handover(self, timeout: float = 600.0) -> None:
+        """Hold until any in-flight restart handover completes — wave
+        probes must observe either the OLD serving server or the NEW
+        ready one, never the window between them (round 19: the
+        deterministic-handover contract; the r18 restart-storm flake was
+        exactly a probe landing inside that window)."""
+        deadline = time.monotonic() + timeout
+        while self._restart_in_progress and time.monotonic() < deadline:
+            time.sleep(0.2)
+
+    def _await_routing_ready(self, server, timeout: float = 120.0) -> bool:
+        """Routing re-established on the NEW server: the in-process
+        readiness verdict answers 200 AND one canary probe (the first
+        restart-probe corpus item, expectation-OK by construction)
+        round-trips the real HTTP stack with a definitive in-band
+        answer. Only then do the held probes/clients resume."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if server.state.readiness()[0] != 200:
+                    time.sleep(0.1)
+                    continue
+            except Exception:  # noqa: BLE001 — state mid-build
+                time.sleep(0.1)
+                continue
+            try:
+                canary = self._probe(self._restart_probes[:1])
+                if canary and canary[0][1] in (200, 429):
+                    return True
+            except OSError:
+                pass  # listener not accepting yet
+            time.sleep(0.1)
+        return False
 
     def _probe(self, probes: list) -> list:
         """Serve the fixed probe corpus and return (path, status, body)
@@ -736,11 +787,16 @@ class SoakEngine:
         server.state.audit_watch = feed
         server.state.audit.watch_feed = feed
         self.feed = feed
+        # deterministic handover (round 19): the post-restart probe —
+        # and every held client/wave — resumes only after routing is
+        # provably re-established (readiness 200 + a canary round-trip)
+        routing_ready = self._await_routing_ready(server)
         post = self._probe(self._restart_probes)
         self.recorder.close_fault_window("server_restart")
         self._restart_in_progress = False
         report = dict(server.state.boot_report or {})
         event = {
+            "routing_ready_before_probes": routing_ready,
             "at": round(down_at - t0, 1),
             "down_s": round(time.monotonic() - down_at, 1),
             "feed_stop_s": round(feed_stopped - down_at, 1),

@@ -296,6 +296,12 @@ class SLORecorder:
             # check — the restart's fault window is CLOSED the moment
             # the post-restart probe answers, so nothing after ready
             # hides behind it.
+            # round 19: the handover must also be DETERMINISTIC — the
+            # engine proves routing was re-established (readiness 200 +
+            # a canary round-trip) BEFORE any held probe resumed, so a
+            # probe can never land inside the reboot window again (the
+            # r18 flake). Events from engines predating the field fail
+            # the gate rather than silently passing.
             events = restart_storm.get("events") or []
             checks["restart_storm_survived"] = (
                 restart_storm.get("planned", 0) > 0
@@ -303,6 +309,7 @@ class SLORecorder:
                 and all(
                     e.get("warm_boot_used")
                     and e.get("verdicts_bit_exact")
+                    and e.get("routing_ready_before_probes")
                     and not e.get("error")
                     for e in events
                 )
