@@ -34,6 +34,13 @@ RUN mkdir -p build && \
         -o build/httpfront-cpython-312-x86_64-linux-gnu.so \
         csrc/httpfront.cpp \
       || echo "WARNING: httpfront build failed; --frontend native will fall back to python"; }
+# native TLS termination dlopens libssl/libcrypto at RUNTIME (no
+# OpenSSL -dev headers needed at build time); python:3.12-slim ships
+# libssl3, so prove it resolves in the runtime base here — if this ever
+# regresses (slimmer base, removed package) the build says so instead
+# of every container silently serving TLS through the aiohttp fallback
+RUN python -c "import ctypes; ctypes.CDLL('libssl.so.3')" \
+    || echo "WARNING: libssl.so.3 missing; native TLS will fall back to aiohttp"
 
 # test stage: the graftcheck gate (static analysis + counter/OTLP/
 # dashboard consistency + failpoint and cli-docs drift) runs against the

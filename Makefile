@@ -29,13 +29,15 @@ fuzz:
 	python -m pytest tests/test_fuzz_differential.py tests/test_differential.py -q
 
 # fault-injection chaos suite: shedding, deadline drops, breaker
-# trip/recover, fetch retry, shutdown-under-load (failpoints armed by the
-# tests themselves; slow-marked cases included). Runs with the graftcheck
-# lock-order sanitizer armed — tests/conftest.py instruments every
-# package lock, records per-thread acquisition stacks, and errors the
-# session on any lock-order inversion or cycle.
+# trip/recover, fetch retry, shutdown-under-load, plus the round-20 TLS
+# storms (cert rotation under HTTPS load, corrupted-reload last-good,
+# tls.handshake failpoint). Failpoints armed by the tests themselves;
+# slow-marked cases included. Runs with the graftcheck lock-order
+# sanitizer armed — tests/conftest.py instruments every package lock,
+# records per-thread acquisition stacks, and errors the session on any
+# lock-order inversion or cycle.
 chaos:
-	GRAFTCHECK_LOCKSAN=1 python -m pytest tests/test_resilience.py -q
+	GRAFTCHECK_LOCKSAN=1 python -m pytest tests/test_resilience.py tests/test_resilience_tls.py -q
 
 # seeded mini-soak through the FULL serving stack (tools/soak/): ~20 s
 # of trace replay against the native frontend with a mid-soak fault
@@ -85,9 +87,13 @@ check:
 fastenc:
 	python -c "import sys; from policy_server_tpu.ops import fastenc; p = fastenc._build_library(); print(p); sys.exit(0 if p else 1)"
 
-# native HTTP front-end (runtime/native_frontend.py compiles on demand)
+# native HTTP front-end (runtime/native_frontend.py compiles on demand).
+# TLS termination needs no OpenSSL headers — httpfront.cpp dlopens
+# libssl/libcrypto (.so.3 / .so.1.1) at runtime; when neither resolves
+# the build still succeeds and the server falls back LOUDLY to aiohttp
+# TLS, so this target also prints whether native TLS is live.
 httpfront:
-	python -c "import sys; from policy_server_tpu.runtime import native_frontend; p = native_frontend._build_library(); print(p); sys.exit(0 if p else 1)"
+	python -c "import sys; from policy_server_tpu.runtime import native_frontend; p = native_frontend._build_library(); print(p); print('native TLS:', 'available' if native_frontend.tls_available() else 'UNAVAILABLE (libssl did not resolve; aiohttp TLS fallback)'); sys.exit(0 if p else 1)"
 
 # both native extensions, loudly: the runtime soft-fails to Python
 # fallbacks, so these targets exit nonzero on a failed build — CI sees

@@ -42,6 +42,7 @@
 // Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -60,6 +61,7 @@
 #include <cstdint>
 #include <deque>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -867,6 +869,181 @@ struct StaticResp {
 
 enum { ST_404 = 0, ST_405 = 1, ST_413 = 2, ST_503 = 3, ST_400 = 4, ST_MAX = 5 };
 
+// -------------------------------------------------------------------- tls --
+//
+// Native TLS termination (round 20): OpenSSL is bound at RUNTIME via
+// dlopen — this toolchain ships libssl.so.1.1/libcrypto.so.1.1 but no
+// development headers, so the needed subset of the OpenSSL 1.1 API is
+// declared here (the 1.1 ABI is stable; the same names resolve against
+// 3.x). A missing or incomplete libssl leaves TlsApi::ok false and
+// httpfront_tls_available() reports it, so the Python side degrades
+// LOUDLY to the aiohttp TLS frontend (round-11 fallback precedent)
+// instead of silently serving plaintext.
+//
+// Handshakes run on memory BIOs entirely inside the event loop: the
+// socket stays in the same non-blocking epoll state machine, raw bytes
+// are pumped socket→rbio and wbio→socket, and SSL_read/SSL_write sit
+// between the socket and the UNCHANGED plaintext parser/assembler.
+// kTLS offload after the userspace handshake needs OpenSSL 3.x
+// (SSL_OP_ENABLE_KTLS); against 1.1 the capability probe answers no
+// and the Python side logs it — a probe, never a silent downgrade.
+
+constexpr int kSSL_ERROR_WANT_READ = 2;
+constexpr int kSSL_ERROR_WANT_WRITE = 3;
+constexpr int kSSL_ERROR_ZERO_RETURN = 6;
+constexpr int kSSL_CTRL_SET_MIN_PROTO_VERSION = 123;
+constexpr int kSSL_CTRL_EXTRA_CHAIN_CERT = 14;
+constexpr long kTLS1_2_VERSION = 0x0303;
+constexpr int kSSL_VERIFY_PEER = 0x01;
+constexpr int kSSL_VERIFY_FAIL_IF_NO_PEER_CERT = 0x02;
+
+struct TlsApi {
+  bool ok = false;
+  bool ktls = false;  // SSL_sendfile present (OpenSSL 3.x kTLS build)
+  std::string err;    // why the binding is unavailable
+  // libcrypto
+  void* (*BIO_new)(const void*) = nullptr;
+  const void* (*BIO_s_mem)() = nullptr;
+  int (*BIO_write)(void*, const void*, int) = nullptr;
+  int (*BIO_read)(void*, void*, int) = nullptr;
+  size_t (*BIO_ctrl_pending)(void*) = nullptr;
+  void* (*BIO_new_mem_buf)(const void*, int) = nullptr;
+  int (*BIO_free)(void*) = nullptr;
+  void* (*PEM_read_bio_X509)(void*, void*, void*, void*) = nullptr;
+  void* (*PEM_read_bio_PrivateKey)(void*, void*, void*, void*) = nullptr;
+  int (*X509_STORE_add_cert)(void*, void*) = nullptr;
+  void (*X509_free)(void*) = nullptr;
+  void (*EVP_PKEY_free)(void*) = nullptr;
+  void (*ERR_clear_error)() = nullptr;
+  unsigned long (*ERR_get_error)() = nullptr;
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+  // libssl
+  const void* (*TLS_server_method)() = nullptr;
+  void* (*SSL_CTX_new)(const void*) = nullptr;
+  void (*SSL_CTX_free)(void*) = nullptr;
+  long (*SSL_CTX_ctrl)(void*, int, long, void*) = nullptr;
+  int (*SSL_CTX_use_certificate)(void*, void*) = nullptr;
+  int (*SSL_CTX_use_PrivateKey)(void*, void*) = nullptr;
+  int (*SSL_CTX_check_private_key)(const void*) = nullptr;
+  void (*SSL_CTX_set_verify)(void*, int, void*) = nullptr;
+  void* (*SSL_CTX_get_cert_store)(const void*) = nullptr;
+  void* (*SSL_new)(void*) = nullptr;
+  void (*SSL_free)(void*) = nullptr;
+  void (*SSL_set_bio)(void*, void*, void*) = nullptr;
+  void (*SSL_set_accept_state)(void*) = nullptr;
+  int (*SSL_do_handshake)(void*) = nullptr;
+  int (*SSL_read)(void*, void*, int) = nullptr;
+  int (*SSL_write)(void*, const void*, int) = nullptr;
+  int (*SSL_get_error)(const void*, int) = nullptr;
+  int (*SSL_shutdown)(void*) = nullptr;
+};
+
+TlsApi* tls_api() {
+  static TlsApi* api = [] {
+    TlsApi* a = new TlsApi();
+    // matched pairs only: a 3.x libssl over a 1.1 libcrypto (or the
+    // reverse) resolves symbols but corrupts state
+    const char* pairs[][2] = {{"libssl.so.3", "libcrypto.so.3"},
+                              {"libssl.so.1.1", "libcrypto.so.1.1"},
+                              {"libssl.so", "libcrypto.so"}};
+    void* hs = nullptr;
+    void* hc = nullptr;
+    for (auto& p : pairs) {
+      hc = dlopen(p[1], RTLD_NOW | RTLD_GLOBAL);
+      if (hc == nullptr) continue;
+      hs = dlopen(p[0], RTLD_NOW | RTLD_GLOBAL);
+      if (hs != nullptr) break;
+    }
+    if (hs == nullptr || hc == nullptr) {
+      a->err = "libssl/libcrypto not found (tried .so.3, .so.1.1, .so)";
+      return a;
+    }
+    const char* missing = nullptr;
+    auto need = [&](void* h, const char* name) -> void* {
+      void* p = dlsym(h, name);
+      if (p == nullptr && missing == nullptr) missing = name;
+      return p;
+    };
+#define TLS_SYM(handle, name) \
+  a->name = reinterpret_cast<decltype(a->name)>(need(handle, #name))
+    TLS_SYM(hc, BIO_new);
+    TLS_SYM(hc, BIO_s_mem);
+    TLS_SYM(hc, BIO_write);
+    TLS_SYM(hc, BIO_read);
+    TLS_SYM(hc, BIO_ctrl_pending);
+    TLS_SYM(hc, BIO_new_mem_buf);
+    TLS_SYM(hc, BIO_free);
+    TLS_SYM(hc, PEM_read_bio_X509);
+    TLS_SYM(hc, PEM_read_bio_PrivateKey);
+    TLS_SYM(hc, X509_STORE_add_cert);
+    TLS_SYM(hc, X509_free);
+    TLS_SYM(hc, EVP_PKEY_free);
+    TLS_SYM(hc, ERR_clear_error);
+    TLS_SYM(hc, ERR_get_error);
+    TLS_SYM(hc, ERR_error_string_n);
+    TLS_SYM(hs, TLS_server_method);
+    TLS_SYM(hs, SSL_CTX_new);
+    TLS_SYM(hs, SSL_CTX_free);
+    TLS_SYM(hs, SSL_CTX_ctrl);
+    TLS_SYM(hs, SSL_CTX_use_certificate);
+    TLS_SYM(hs, SSL_CTX_use_PrivateKey);
+    TLS_SYM(hs, SSL_CTX_check_private_key);
+    TLS_SYM(hs, SSL_CTX_set_verify);
+    TLS_SYM(hs, SSL_CTX_get_cert_store);
+    TLS_SYM(hs, SSL_new);
+    TLS_SYM(hs, SSL_free);
+    TLS_SYM(hs, SSL_set_bio);
+    TLS_SYM(hs, SSL_set_accept_state);
+    TLS_SYM(hs, SSL_do_handshake);
+    TLS_SYM(hs, SSL_read);
+    TLS_SYM(hs, SSL_write);
+    TLS_SYM(hs, SSL_get_error);
+    TLS_SYM(hs, SSL_shutdown);
+#undef TLS_SYM
+    if (missing != nullptr) {
+      a->err = std::string("libssl symbol missing: ") + missing;
+      return a;
+    }
+    a->ktls = dlsym(hs, "SSL_sendfile") != nullptr;
+    a->ok = true;
+    return a;
+  }();
+  return api;
+}
+
+thread_local char g_tls_err[256] = {0};
+
+void set_tls_err(const char* what) {
+  TlsApi* a = tls_api();
+  unsigned long e = a->ok ? a->ERR_get_error() : 0;
+  if (e != 0) {
+    char ebuf[160];
+    a->ERR_error_string_n(e, ebuf, sizeof(ebuf));
+    snprintf(g_tls_err, sizeof(g_tls_err), "%s: %s", what, ebuf);
+    while (a->ERR_get_error() != 0) {  // drain the queue for next time
+    }
+  } else {
+    snprintf(g_tls_err, sizeof(g_tls_err), "%s", what);
+  }
+}
+
+// One SSL_CTX "generation". Hot rotation swaps the Front's current
+// generation under a mutex taken only at accept/swap time; every live
+// connection pins the generation it handshook under via a refcount, so
+// established connections DRAIN on the old identity while new accepts
+// see the new one — exactly certs.py's SNI-callback contract.
+struct TlsCtx {
+  void* ctx = nullptr;  // SSL_CTX*
+  std::atomic<long> refs{1};
+};
+
+void tls_ctx_unref(TlsCtx* t) {
+  if (t != nullptr && t->refs.fetch_add(-1, std::memory_order_acq_rel) == 1) {
+    tls_api()->SSL_CTX_free(t->ctx);
+    delete t;
+  }
+}
+
 // ------------------------------------------------------------------- conn --
 
 struct PendingResp {
@@ -892,6 +1069,22 @@ struct Conn {
   // but never completes the request).
   int64_t last_activity_ns = 0;
   int64_t request_start_ns = 0;
+  // TLS termination (round 20): non-null ssl marks a TLS connection.
+  // The handshake deadline anchors at accept_ns and is NEVER refreshed
+  // by arriving bytes — a ClientHello dripped one byte at a time is the
+  // slowloris shape moved down one layer, and it must die on the same
+  // absolute clock no matter how diligently it drips.
+  void* ssl = nullptr;   // SSL* (owns both BIOs once set_bio'd)
+  void* rbio = nullptr;  // socket→SSL ciphertext
+  void* wbio = nullptr;  // SSL→socket ciphertext
+  TlsCtx* tls = nullptr;           // generation pinned at accept
+  std::string enc_out;             // encrypted bytes awaiting send()
+  size_t enc_off = 0;
+  int64_t accept_ns = 0;           // handshake-arrival deadline anchor
+  bool tls_established = false;    // SSL_do_handshake returned 1
+  bool tls_shutdown_sent = false;  // close_notify already queued
+  bool tls_fail_injected = false;  // `tls.handshake` failpoint armed
+  bool reject_after_handshake = false;  // over-cap: 503 once readable
   bool want_write = false;
   bool closing = false;       // stop parsing further requests
   bool flush_queued = false;  // dedup marker within one process_comps pass
@@ -955,6 +1148,15 @@ struct Front {
   std::atomic<int64_t> read_timeout_ns{0};
   std::atomic<int64_t> max_conns{0};
   std::atomic<int64_t> live_conns{0};
+  // TLS (round 20): the current SSL_CTX generation for NEW accepts.
+  // The mutex is taken at accept and swap only — accept-rate, not
+  // per-byte — so rotation never contends with the serving byte path.
+  std::mutex tls_mu;
+  TlsCtx* tls_current = nullptr;  // guarded by tls_mu
+  std::atomic<int64_t> tls_handshake_timeout_ns{0};
+  // `tls.handshake` failpoint: -1 = fail every handshake, n>0 = fail
+  // the next n, 0 = disarmed
+  std::atomic<long> tls_fail_next{0};
   std::atomic<int64_t> stats[STAT_N] = {};
 };
 
@@ -962,7 +1164,12 @@ enum {
   S_CONNS = 0, S_REQUESTS, S_PARSED, S_FALLBACKS, S_NATIVE_SER, S_PY_SER,
   S_RING_FULL, S_BAD_REQ, S_ROUTE_MISS, S_OVERSIZE, S_BYTES_IN, S_BYTES_OUT,
   S_FRAMING_NS, S_OUTSTANDING, S_DISCONNECTS, S_IDLE_CLOSES, S_CONN_CAP,
+  // TLS termination (round 20) — fills the STAT_N=24 budget exactly
+  S_TLS_CONNS, S_TLS_HS_OK, S_TLS_HS_FAIL, S_TLS_HS_TIMEOUT,
+  S_TLS_HS_DISCONNECT, S_TLS_FAIL_INJECTED, S_TLS_CLEAN_CLOSES,
 };
+static_assert(S_TLS_CLEAN_CLOSES == STAT_N - 1,
+              "stats enum must fit the ABI's fixed STAT_N slots");
 
 void wake_fd(int fd) {
   uint64_t one = 1;
@@ -1027,7 +1234,41 @@ void conn_destroy(Loop* lp, Conn* c, bool midbody) {
   lp->front->live_conns.fetch_add(-1, std::memory_order_relaxed);
   if (midbody)
     lp->front->stats[S_DISCONNECTS].fetch_add(1, std::memory_order_relaxed);
+  if (c->ssl != nullptr) tls_api()->SSL_free(c->ssl);  // frees both BIOs
+  if (c->tls != nullptr) tls_ctx_unref(c->tls);
   delete c;
+}
+
+void tls_flush(Loop* lp, Conn* c);
+
+// Server-initiated clean close of a TLS connection: queue close_notify,
+// best-effort flush it (one non-blocking send — the alert is ~2 dozen
+// bytes), then tear down. Used by every path that CHOOSES to close
+// (closing-complete, idle reap, conn-cap 503) so well-behaved clients
+// see an orderly TLS EOF instead of a truncation-looking RST.
+void tls_graceful_destroy(Loop* lp, Conn* c) {
+  TlsApi* a = tls_api();
+  if (!c->tls_shutdown_sent) {
+    c->tls_shutdown_sent = true;
+    // count at decision time, before the alert hits the wire: the
+    // peer's clean-EOF observation must never precede the counter
+    lp->front->stats[S_TLS_CLEAN_CLOSES].fetch_add(
+        1, std::memory_order_relaxed);
+    a->SSL_shutdown(c->ssl);
+    while (a->BIO_ctrl_pending(c->wbio) > 0) {
+      char buf[4096];
+      int n = a->BIO_read(c->wbio, buf, sizeof(buf));
+      if (n <= 0) break;
+      c->enc_out.append(buf, (size_t)n);
+    }
+    if (c->enc_off < c->enc_out.size()) {
+      ssize_t r = send(c->fd, c->enc_out.data() + c->enc_off,
+                       c->enc_out.size() - c->enc_off, MSG_NOSIGNAL);
+      if (r > 0)
+        lp->front->stats[S_BYTES_OUT].fetch_add(r, std::memory_order_relaxed);
+    }
+  }
+  conn_destroy(lp, c, false);
 }
 
 // flush completed head-of-line responses into the socket
@@ -1036,6 +1277,10 @@ void conn_flush(Loop* lp, Conn* c) {
     c->out += c->pipeline.front()->wire;
     if (c->pipeline.front()->close_after) c->closing = true;
     c->pipeline.pop_front();
+  }
+  if (c->ssl != nullptr) {
+    tls_flush(lp, c);
+    return;
   }
   while (c->out_off < c->out.size()) {
     ssize_t n = send(c->fd, c->out.data() + c->out_off,
@@ -1068,6 +1313,68 @@ void conn_flush(Loop* lp, Conn* c) {
     c->want_write = false;
   }
   if (c->closing && c->pipeline.empty()) conn_destroy(lp, c, false);
+}
+
+// TLS half of conn_flush: encrypt pending plaintext through SSL_write,
+// drain the write BIO, and push ciphertext to the socket with the same
+// EAGAIN→EPOLLOUT discipline as the plaintext path. c->out/c->out_off
+// hold PLAINTEXT not yet consumed by SSL_write; enc_out/enc_off hold
+// ciphertext not yet accepted by the kernel.
+void tls_flush(Loop* lp, Conn* c) {
+  TlsApi* a = tls_api();
+  Front* f = lp->front;
+  if (c->tls_established && !c->tls_shutdown_sent) {
+    while (c->out_off < c->out.size()) {
+      size_t chunk = c->out.size() - c->out_off;
+      if (chunk > (1u << 20)) chunk = 1u << 20;
+      a->ERR_clear_error();
+      int n = a->SSL_write(c->ssl, c->out.data() + c->out_off, (int)chunk);
+      if (n <= 0) break;  // WANT_READ mid-rekey: retry after next read
+      c->out_off += (size_t)n;
+    }
+    if (c->out_off >= c->out.size()) {
+      c->out.clear();
+      c->out_off = 0;
+    }
+  }
+  while (a->BIO_ctrl_pending(c->wbio) > 0) {
+    char buf[16384];
+    int n = a->BIO_read(c->wbio, buf, sizeof(buf));
+    if (n <= 0) break;
+    c->enc_out.append(buf, (size_t)n);
+  }
+  while (c->enc_off < c->enc_out.size()) {
+    ssize_t n = send(c->fd, c->enc_out.data() + c->enc_off,
+                     c->enc_out.size() - c->enc_off, MSG_NOSIGNAL);
+    if (n > 0) {
+      c->enc_off += (size_t)n;
+      f->stats[S_BYTES_OUT].fetch_add(n, std::memory_order_relaxed);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (!c->want_write) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.fd = c->fd;
+        epoll_ctl(lp->ep, EPOLL_CTL_MOD, c->fd, &ev);
+        c->want_write = true;
+      }
+      return;
+    }
+    conn_destroy(lp, c, false);
+    return;
+  }
+  c->enc_out.clear();
+  c->enc_off = 0;
+  if (c->want_write) {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = c->fd;
+    epoll_ctl(lp->ep, EPOLL_CTL_MOD, c->fd, &ev);
+    c->want_write = false;
+  }
+  if (c->closing && c->pipeline.empty() && c->out_off >= c->out.size())
+    tls_graceful_destroy(lp, c);
 }
 
 // queue an immediate (statically known) response, preserving pipeline order
@@ -1493,10 +1800,13 @@ void reject_over_cap(Front* f, int fd) {
                    "Content-Length: %zu\r\nRetry-After: 1\r\n"
                    "Connection: close\r\n\r\n%s",
                    sizeof(kBody) - 1, kBody);
+  // count BEFORE the send: the client's read of this 503 (or the EOF
+  // from close) must never win a race against the counter — tests and
+  // operators read stats the instant the rejection is observable
+  f->stats[S_CONN_CAP].fetch_add(1, std::memory_order_relaxed);
   ssize_t r = send(fd, wire, (size_t)n, MSG_NOSIGNAL);
   (void)r;
   close(fd);
-  f->stats[S_CONN_CAP].fetch_add(1, std::memory_order_relaxed);
 }
 
 void do_accept(Loop* lp) {
@@ -1505,10 +1815,31 @@ void do_accept(Loop* lp) {
     int fd = accept4(f->listen_fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) break;  // EAGAIN / another loop won the race
+    // pin the CURRENT TLS generation before the cap decision: an
+    // over-cap TLS accept must still handshake, because its in-band 503
+    // is unreadable until the session keys exist
+    TlsCtx* tctx = nullptr;
+    {
+      std::lock_guard<std::mutex> g(f->tls_mu);
+      if (f->tls_current != nullptr) {
+        f->tls_current->refs.fetch_add(1, std::memory_order_relaxed);
+        tctx = f->tls_current;
+      }
+    }
     int64_t cap = f->max_conns.load(std::memory_order_relaxed);
-    if (cap > 0 &&
-        f->live_conns.load(std::memory_order_relaxed) >= cap) {
+    int64_t live = f->live_conns.load(std::memory_order_relaxed);
+    bool over_cap = cap > 0 && live >= cap;
+    if (over_cap && tctx == nullptr) {
       reject_over_cap(f, fd);
+      continue;
+    }
+    if (over_cap && live >= cap + 64) {
+      // the close_notify-clean 503 needs a live handshake, which costs
+      // state — past a bounded grace pool the only safe answer to a
+      // connection flood is the plain close the cap exists to deliver
+      close(fd);
+      tls_ctx_unref(tctx);
+      f->stats[S_CONN_CAP].fetch_add(1, std::memory_order_relaxed);
       continue;
     }
     int one = 1;
@@ -1516,11 +1847,49 @@ void do_accept(Loop* lp) {
     Conn* c = new Conn();
     c->fd = fd;
     c->last_activity_ns = now_ns();
+    if (tctx != nullptr) {
+      TlsApi* a = tls_api();
+      void* ssl = a->SSL_new(tctx->ctx);
+      void* rb = ssl != nullptr ? a->BIO_new(a->BIO_s_mem()) : nullptr;
+      void* wb = rb != nullptr ? a->BIO_new(a->BIO_s_mem()) : nullptr;
+      if (wb == nullptr) {
+        if (rb != nullptr) a->BIO_free(rb);
+        if (ssl != nullptr) a->SSL_free(ssl);
+        tls_ctx_unref(tctx);
+        close(fd);
+        delete c;
+        continue;
+      }
+      a->SSL_set_bio(ssl, rb, wb);  // ssl owns both BIOs from here
+      a->SSL_set_accept_state(ssl);
+      c->ssl = ssl;
+      c->rbio = rb;
+      c->wbio = wb;
+      c->tls = tctx;
+      c->accept_ns = now_ns();
+      c->reject_after_handshake = over_cap;
+      f->stats[S_TLS_CONNS].fetch_add(1, std::memory_order_relaxed);
+      // `tls.handshake` failpoint: claim one injected failure slot
+      long fn = f->tls_fail_next.load(std::memory_order_relaxed);
+      while (fn != 0) {
+        if (fn < 0) {
+          c->tls_fail_injected = true;
+          break;
+        }
+        if (f->tls_fail_next.compare_exchange_weak(
+                fn, fn - 1, std::memory_order_relaxed)) {
+          c->tls_fail_injected = true;
+          break;
+        }
+      }
+    }
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.fd = fd;
     if (epoll_ctl(lp->ep, EPOLL_CTL_ADD, fd, &ev) != 0) {
       close(fd);
+      if (c->ssl != nullptr) tls_api()->SSL_free(c->ssl);
+      if (c->tls != nullptr) tls_ctx_unref(c->tls);
       delete c;
       continue;
     }
@@ -1530,7 +1899,121 @@ void do_accept(Loop* lp) {
   }
 }
 
+// TLS read path: pump ciphertext into the read BIO, run the handshake
+// state machine until established, then SSL_read plaintext into the
+// SAME c->in the plaintext parser consumes — everything downstream of
+// the record layer is shared with the plaintext frontend byte for byte.
+void tls_conn_read(Loop* lp, Conn* c) {
+  Front* f = lp->front;
+  TlsApi* a = tls_api();
+  char buf[65536];
+  c->last_activity_ns = now_ns();
+  bool peer_closed = false;
+  for (;;) {
+    ssize_t n = recv(c->fd, buf, sizeof(buf), 0);
+    if (n > 0) {
+      f->stats[S_BYTES_IN].fetch_add(n, std::memory_order_relaxed);
+      a->BIO_write(c->rbio, buf, (int)n);
+      if (n < (ssize_t)sizeof(buf)) break;
+      continue;
+    }
+    if (n == 0 || !(errno == EAGAIN || errno == EWOULDBLOCK)) {
+      peer_closed = true;  // EOF and hard errors both end the conn below
+      break;
+    }
+    break;
+  }
+  if (c->tls_fail_injected) {
+    // `tls.handshake` failpoint: refuse the handshake outright — the
+    // client observes a connection torn down mid-handshake, the server
+    // accounts it as an injected failure, never a mystery
+    f->stats[S_TLS_FAIL_INJECTED].fetch_add(1, std::memory_order_relaxed);
+    f->stats[S_TLS_HS_FAIL].fetch_add(1, std::memory_order_relaxed);
+    conn_destroy(lp, c, false);
+    return;
+  }
+  if (!c->tls_established) {
+    a->ERR_clear_error();
+    int r = a->SSL_do_handshake(c->ssl);
+    if (r == 1) {
+      c->tls_established = true;
+      f->stats[S_TLS_HS_OK].fetch_add(1, std::memory_order_relaxed);
+      if (c->reject_after_handshake) {
+        // over-cap accept: the 503 is finally READABLE — the same
+        // message + Retry-After the plaintext cap sends, answered
+        // in-band and closed with close_notify
+        f->stats[S_CONN_CAP].fetch_add(1, std::memory_order_relaxed);
+        c->req_close = true;
+        auto pr = std::make_unique<PendingResp>();
+        pr->id = 0;
+        pr->close_after = true;
+        fill_response(lp, pr.get(), 503,
+                      "application/json; charset=utf-8",
+                      "{\"message\": \"connection limit reached; retry "
+                      "later\", \"status\": 503}",
+                      1, "");
+        c->pipeline.push_back(std::move(pr));
+        c->closing = true;
+        conn_flush(lp, c);  // flushes Finished + 503, then clean-closes
+        return;
+      }
+    } else {
+      int err = a->SSL_get_error(c->ssl, r);
+      if (err != kSSL_ERROR_WANT_READ && err != kSSL_ERROR_WANT_WRITE) {
+        // hard handshake failure (garbage record, protocol floor,
+        // wrong-CA client cert): flush the pending alert so the peer
+        // sees a TLS alert rather than a bare RST, count, drop
+        f->stats[S_TLS_HS_FAIL].fetch_add(1, std::memory_order_relaxed);
+        while (a->BIO_ctrl_pending(c->wbio) > 0) {
+          int n = a->BIO_read(c->wbio, buf, sizeof(buf));
+          if (n <= 0) break;
+          c->enc_out.append(buf, (size_t)n);
+        }
+        if (c->enc_off < c->enc_out.size()) {
+          ssize_t sr = send(c->fd, c->enc_out.data() + c->enc_off,
+                            c->enc_out.size() - c->enc_off, MSG_NOSIGNAL);
+          if (sr > 0)
+            f->stats[S_BYTES_OUT].fetch_add(sr, std::memory_order_relaxed);
+        }
+        conn_destroy(lp, c, false);
+        return;
+      }
+      if (peer_closed) {
+        f->stats[S_TLS_HS_DISCONNECT].fetch_add(1,
+                                                std::memory_order_relaxed);
+        conn_destroy(lp, c, false);
+        return;
+      }
+      conn_flush(lp, c);  // push ServerHello…Finished; wait for more
+      return;
+    }
+  }
+  // established: drain every full record into the plaintext buffer
+  bool tls_eof = false;
+  for (;;) {
+    int n = a->SSL_read(c->ssl, buf, sizeof(buf));
+    if (n > 0) {
+      c->in.append(buf, (size_t)n);
+      continue;
+    }
+    int err = a->SSL_get_error(c->ssl, n);
+    if (err == kSSL_ERROR_WANT_READ || err == kSSL_ERROR_WANT_WRITE) break;
+    tls_eof = true;  // close_notify (ZERO_RETURN) or corrupt record
+    break;
+  }
+  if (peer_closed || tls_eof) {
+    bool midbody = c->state != 0;
+    conn_destroy(lp, c, midbody);
+    return;
+  }
+  conn_parse(lp, c);  // flushes via conn_flush→tls_flush; may destroy
+}
+
 void conn_read(Loop* lp, Conn* c) {
+  if (c->ssl != nullptr) {
+    tls_conn_read(lp, c);
+    return;
+  }
   char buf[65536];
   c->last_activity_ns = now_ns();
   for (;;) {
@@ -1563,10 +2046,20 @@ void sweep_timeouts(Loop* lp, int64_t now) {
   Front* f = lp->front;
   int64_t idle = f->idle_timeout_ns.load(std::memory_order_relaxed);
   int64_t readt = f->read_timeout_ns.load(std::memory_order_relaxed);
-  if (idle <= 0 && readt <= 0) return;
+  int64_t hst = f->tls_handshake_timeout_ns.load(std::memory_order_relaxed);
+  if (idle <= 0 && readt <= 0 && hst <= 0) return;
   std::vector<Conn*> victims;
+  std::vector<Conn*> hs_victims;
   for (auto& kv : lp->conns) {
     Conn* c = kv.second;
+    // TLS handshake-arrival deadline: anchored at ACCEPT, never
+    // refreshed — a dripped ClientHello is slowloris one layer down
+    // and dies on the same absolute clock as a silent socket
+    if (c->ssl != nullptr && !c->tls_established && hst > 0 &&
+        now - c->accept_ns > hst) {
+      hs_victims.push_back(c);
+      continue;
+    }
     if (readt > 0 && c->request_start_ns != 0 &&
         now - c->request_start_ns > readt) {
       victims.push_back(c);
@@ -1580,9 +2073,18 @@ void sweep_timeouts(Loop* lp, int64_t now) {
       victims.push_back(c);
     }
   }
+  for (Conn* c : hs_victims) {
+    f->stats[S_TLS_HS_TIMEOUT].fetch_add(1, std::memory_order_relaxed);
+    conn_destroy(lp, c, false);
+  }
   for (Conn* c : victims) {
     f->stats[S_IDLE_CLOSES].fetch_add(1, std::memory_order_relaxed);
-    conn_destroy(lp, c, false);
+    // a server-chosen close of an established TLS conn says so with
+    // close_notify — reaped abusers still deserve a decodable EOF
+    if (c->ssl != nullptr && c->tls_established)
+      tls_graceful_destroy(lp, c);
+    else
+      conn_destroy(lp, c, false);
   }
 }
 
@@ -1978,6 +2480,13 @@ void httpfront_destroy(void* h) {
     close(lp->comp_efd);
   }
   close(f->sub_efd);
+  {
+    std::lock_guard<std::mutex> g(f->tls_mu);
+    if (f->tls_current != nullptr) {
+      tls_ctx_unref(f->tls_current);
+      f->tls_current = nullptr;
+    }
+  }
   delete f;
 }
 
@@ -2084,6 +2593,168 @@ int64_t httpfront_render_verdict(const uint8_t* buf, int64_t len,
 
 int64_t httpfront_outstanding(void* h) {
   return ((Front*)h)->stats[S_OUTSTANDING].load(std::memory_order_relaxed);
+}
+
+// ------------------------------------------------------------- TLS C ABI --
+
+// 1 when the dlopen'd OpenSSL binding resolved completely; 0 otherwise
+// (httpfront_tls_error says why). The Python caller uses a 0 to degrade
+// LOUDLY to the aiohttp TLS frontend — never to serve plaintext.
+int httpfront_tls_available(void) { return tls_api()->ok ? 1 : 0; }
+
+const char* httpfront_tls_error(void) {
+  if (!tls_api()->ok) return tls_api()->err.c_str();
+  return g_tls_err;
+}
+
+// Build one SSL_CTX generation from PEM bytes (certs.py's last-good
+// identity snapshot): cert_pem may carry leaf+chain; a non-empty ca_pem
+// turns on mTLS with CPython's CERT_REQUIRED semantics (verify peer,
+// fail the handshake without a client cert; no CA-name hints — the
+// ssl.SSLContext oracle sends none either, keeping handshake
+// transcripts comparable). Returns an opaque refcounted handle or null
+// with httpfront_tls_error set.
+void* httpfront_tls_ctx_create(const uint8_t* cert_pem, int64_t cert_len,
+                               const uint8_t* key_pem, int64_t key_len,
+                               const uint8_t* ca_pem, int64_t ca_len) {
+  TlsApi* a = tls_api();
+  if (!a->ok) {
+    snprintf(g_tls_err, sizeof(g_tls_err), "%s", a->err.c_str());
+    return nullptr;
+  }
+  a->ERR_clear_error();
+  void* ctx = a->SSL_CTX_new(a->TLS_server_method());
+  if (ctx == nullptr) {
+    set_tls_err("SSL_CTX_new failed");
+    return nullptr;
+  }
+  // TLS 1.2 floor, matching ssl.SSLContext's webhook posture
+  a->SSL_CTX_ctrl(ctx, kSSL_CTRL_SET_MIN_PROTO_VERSION, kTLS1_2_VERSION,
+                  nullptr);
+  bool ok = true;
+  void* bio = a->BIO_new_mem_buf(cert_pem, (int)cert_len);
+  void* leaf =
+      bio != nullptr ? a->PEM_read_bio_X509(bio, nullptr, nullptr, nullptr)
+                     : nullptr;
+  if (leaf == nullptr) {
+    set_tls_err("identity PEM holds no certificate");
+    ok = false;
+  } else {
+    if (a->SSL_CTX_use_certificate(ctx, leaf) != 1) {
+      set_tls_err("SSL_CTX_use_certificate failed");
+      ok = false;
+    }
+    a->X509_free(leaf);
+    while (ok) {  // remaining PEM blocks are the chain, ctx takes them
+      void* extra = a->PEM_read_bio_X509(bio, nullptr, nullptr, nullptr);
+      if (extra == nullptr) {
+        a->ERR_clear_error();  // expected end-of-PEM parse error
+        break;
+      }
+      if (a->SSL_CTX_ctrl(ctx, kSSL_CTRL_EXTRA_CHAIN_CERT, 0, extra) != 1) {
+        a->X509_free(extra);
+        set_tls_err("SSL_CTX add chain cert failed");
+        ok = false;
+      }
+    }
+  }
+  if (bio != nullptr) a->BIO_free(bio);
+  if (ok) {
+    bio = a->BIO_new_mem_buf(key_pem, (int)key_len);
+    void* pkey =
+        bio != nullptr
+            ? a->PEM_read_bio_PrivateKey(bio, nullptr, nullptr, nullptr)
+            : nullptr;
+    if (pkey == nullptr) {
+      set_tls_err("identity PEM holds no private key");
+      ok = false;
+    } else {
+      if (a->SSL_CTX_use_PrivateKey(ctx, pkey) != 1 ||
+          a->SSL_CTX_check_private_key(ctx) != 1) {
+        set_tls_err("private key does not match certificate");
+        ok = false;
+      }
+      a->EVP_PKEY_free(pkey);
+    }
+    if (bio != nullptr) a->BIO_free(bio);
+  }
+  if (ok && ca_pem != nullptr && ca_len > 0) {
+    void* store = a->SSL_CTX_get_cert_store(ctx);
+    bio = a->BIO_new_mem_buf(ca_pem, (int)ca_len);
+    int added = 0;
+    for (;;) {
+      void* x = bio != nullptr
+                    ? a->PEM_read_bio_X509(bio, nullptr, nullptr, nullptr)
+                    : nullptr;
+      if (x == nullptr) {
+        a->ERR_clear_error();
+        break;
+      }
+      if (a->X509_STORE_add_cert(store, x) == 1) added++;
+      a->X509_free(x);
+    }
+    if (bio != nullptr) a->BIO_free(bio);
+    if (added == 0) {
+      set_tls_err("client-CA PEM holds no certificate");
+      ok = false;
+    } else {
+      a->SSL_CTX_set_verify(
+          ctx, kSSL_VERIFY_PEER | kSSL_VERIFY_FAIL_IF_NO_PEER_CERT,
+          nullptr);
+    }
+  }
+  if (!ok) {
+    a->SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  TlsCtx* t = new TlsCtx();
+  t->ctx = ctx;
+  return t;
+}
+
+void httpfront_tls_ctx_free(void* tctx) { tls_ctx_unref((TlsCtx*)tctx); }
+
+// Atomically swap the generation NEW accepts handshake under; takes its
+// own reference (the caller's handle stays valid until tls_ctx_free).
+// Established connections keep draining on the generation they pinned
+// at accept — hot rotation never cuts a live session. Null disables TLS
+// for new connections.
+void httpfront_set_tls(void* h, void* tctx) {
+  Front* f = (Front*)h;
+  TlsCtx* t = (TlsCtx*)tctx;
+  if (t != nullptr) t->refs.fetch_add(1, std::memory_order_relaxed);
+  TlsCtx* old = nullptr;
+  {
+    std::lock_guard<std::mutex> g(f->tls_mu);
+    old = f->tls_current;
+    f->tls_current = t;
+  }
+  if (old != nullptr) tls_ctx_unref(old);
+}
+
+// Handshake-arrival deadline (ms; 0 disables): measured from ACCEPT,
+// never refreshed by arriving bytes — the TLS-layer slowloris clock.
+void httpfront_tls_configure(void* h, int64_t handshake_timeout_ms) {
+  Front* f = (Front*)h;
+  f->tls_handshake_timeout_ns.store(
+      handshake_timeout_ms > 0 ? handshake_timeout_ms * 1000000ll : 0,
+      std::memory_order_relaxed);
+}
+
+// `tls.handshake` failpoint backend: fail the next n handshakes (n>0),
+// every handshake (-1), or disarm (0). Failures are torn down before
+// any handshake progress and counted under tls_fail_injected.
+void httpfront_tls_fail_handshakes(void* h, long n) {
+  ((Front*)h)->tls_fail_next.store(n, std::memory_order_relaxed);
+}
+
+// Capability probe for kTLS offload after the userspace handshake: the
+// loaded OpenSSL must be a 3.x kTLS build (SSL_sendfile present).
+// Against 1.1 this answers 0 and the Python side LOGS the probe result
+// — an explicit no, never a silent downgrade.
+int httpfront_ktls_supported(void) {
+  TlsApi* a = tls_api();
+  return (a->ok && a->ktls) ? 1 : 0;
 }
 
 void httpfront_stats(void* h, int64_t* out, int cap) {

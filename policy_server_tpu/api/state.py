@@ -73,6 +73,16 @@ class ApiServerState:
     # /metrics framing counters read it through the state so the scrape
     # follows whatever is actually serving
     native_frontend: Any = None
+    # native TLS termination manager (runtime/native_frontend.
+    # NativeTlsManager); None under plaintext, --native-tls off, or the
+    # loud aiohttp-TLS fallback — /metrics reads rotation generations
+    # and handshake counters through it
+    native_tls: Any = None
+    # the last-good TLS identity machinery (certs.ReloadableTlsContext);
+    # set whenever TLS is configured (native OR aiohttp termination) so
+    # cert-expiry/reload observability does not depend on which frontend
+    # terminates the handshake
+    tls_reloadable: Any = None
     # the tenant registry (tenancy.TenantManager); None on single-tenant
     # deployments (no --tenants manifest) — every existing URL then maps
     # to this state's own epoch pointer, unchanged

@@ -159,6 +159,19 @@ class ReloadableTlsContext:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.reloads = 0  # guarded-by: _lock
+        self.reload_failures = 0  # guarded-by: _lock
+        # applied client-CA snapshot (round 20): the PEM text the serving
+        # contexts were built against — native TLS builds its SSL_CTX from
+        # THIS, never from files mid-rotation
+        self._client_ca_data: str | None = (  # guarded-by: _lock
+            read_client_ca_data(tls_config.client_ca_file)
+            if tls_config.client_ca_file
+            else None
+        )
+        # post-swap listeners (round 20, native TLS hot rotation): called
+        # OUTSIDE the lock after a successful identity or client-CA swap,
+        # each isolated — a listener failure never poisons the reload
+        self._listeners: list = []
         # watched-file digests live on the instance (not watcher-loop
         # locals) so the SIGHUP path (reload_now) shares one digest state
         # with the poll loop — a forced reload must not retrigger the
@@ -173,6 +186,62 @@ class ReloadableTlsContext:
         with self._lock:
             sslobj.context = self._inner
         return None
+
+    # -- snapshots for parallel termination paths (round 20) ---------------
+
+    def identity_snapshot(self) -> tuple[bytes, bytes]:
+        """The last-good (cert_pem, key_pem) byte pair the serving
+        contexts were built from — the single source the native frontend
+        builds its SSL_CTX generations against."""
+        with self._lock:
+            return self._identity
+
+    def client_ca_snapshot(self) -> str | None:
+        """The APPLIED client-CA PEM snapshot (None when mTLS is off) —
+        what the serving contexts actually trust, which during a failed
+        CA rotation is the previous bundle, not whatever is on disk."""
+        with self._lock:
+            return self._client_ca_data
+
+    def counters(self) -> tuple[int, int]:
+        """(reloads, reload_failures) under one lock acquisition."""
+        with self._lock:
+            return self.reloads, self.reload_failures
+
+    def identity_not_after(self) -> float | None:
+        """Expiry (epoch seconds) of the last-good server certificate,
+        decoded without the `cryptography` package via the stdlib ssl
+        module's certificate decoder. None when undecodable."""
+        with self._lock:
+            cert_bytes = self._identity[0]
+        import tempfile
+
+        try:
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf:
+                cf.write(cert_bytes)
+                cf.flush()
+                decoded = ssl._ssl._test_decode_cert(cf.name)
+            return float(ssl.cert_time_to_seconds(decoded["notAfter"]))
+        except Exception:  # noqa: BLE001 — introspection never breaks serving
+            return None
+
+    def add_reload_listener(self, fn) -> None:
+        """Register ``fn()`` to run after every SUCCESSFUL identity or
+        client-CA swap (the native frontend rebuilds its SSL_CTX
+        generation here). Called outside the lock; exceptions are logged
+        and contained."""
+        self._listeners.append(fn)
+
+    def _notify_listeners(self) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — contain listener faults
+                logger.error("TLS reload listener failed: %s", e)
+
+    def _count_failure(self) -> None:
+        with self._lock:
+            self.reload_failures += 1
 
     # -- reload rules (certs.rs:86-161) -----------------------------------
 
@@ -201,7 +270,9 @@ class ReloadableTlsContext:
                     "TLS server identity reloaded",
                     extra={"span_fields": {"server_identity": True}},
                 )
+                self._notify_listeners()
             except Exception as e:  # noqa: BLE001 — keep old identity
+                self._count_failure()
                 logger.error(
                     "TLS identity reload failed, keeping previous: %s", e
                 )
@@ -216,7 +287,9 @@ class ReloadableTlsContext:
                     "TLS client CAs reloaded",
                     extra={"span_fields": {"client_cas": True}},
                 )
+                self._notify_listeners()
             except Exception as e:  # noqa: BLE001 — keep old CAs
+                self._count_failure()
                 logger.error(
                     "TLS client-CA reload failed, keeping previous: %s", e
                 )
@@ -237,7 +310,9 @@ class ReloadableTlsContext:
                 "TLS server identity reloaded (SIGHUP)",
                 extra={"span_fields": {"server_identity": True}},
             )
+            self._notify_listeners()
         except Exception as e:  # noqa: BLE001 — keep old identity
+            self._count_failure()
             logger.error(
                 "TLS identity reload failed, keeping previous: %s", e
             )
@@ -250,7 +325,9 @@ class ReloadableTlsContext:
                     "TLS client CAs reloaded (SIGHUP)",
                     extra={"span_fields": {"client_cas": True}},
                 )
+                self._notify_listeners()
             except Exception as e:  # noqa: BLE001 — keep old CAs
+                self._count_failure()
                 logger.error(
                     "TLS client-CA reload failed, keeping previous: %s", e
                 )
@@ -334,6 +411,7 @@ class ReloadableTlsContext:
             # snapshot or — on failure — stay on the previous trust state.
             self.outer.load_verify_locations(cadata=ca_data)
             self._inner = ctx
+            self._client_ca_data = ca_data
             self.reloads += 1
 
     def stop(self) -> None:

@@ -344,6 +344,24 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
               help="Native frontend: cap on concurrent connections; "
                    "accepts over it answer an in-band 503 + "
                    "Retry-After and close (counted; 0 = uncapped)")),
+        ("--native-tls", "KUBEWARDEN_NATIVE_TLS",
+         dict(default="auto", metavar="MODE", choices=["auto", "off"],
+              help="Native frontend TLS termination: 'auto' terminates "
+                   "TLS on the C++ epoll loops when --cert/--key are "
+                   "set and libssl loads — SIGHUP/digest hot-rotation "
+                   "atomically swaps the SSL_CTX for NEW connections "
+                   "while established ones drain on the old identity, "
+                   "and a failed reload keeps last-good serving; when "
+                   "libssl is missing the server falls back LOUDLY to "
+                   "the aiohttp TLS frontend. 'off' keeps aiohttp "
+                   "terminating TLS even under --frontend native")),
+        ("--native-tls-handshake-timeout-seconds",
+         "KUBEWARDEN_NATIVE_TLS_HANDSHAKE_TIMEOUT_SECONDS",
+         dict(type=float, default=10.0, metavar="SECONDS",
+              help="Native TLS: the full handshake must COMPLETE "
+                   "within this window measured from accept — byte "
+                   "drips never refresh it, so a TLS-layer slowloris "
+                   "is reaped on schedule (0 disables)")),
         ("--tenants", "KUBEWARDEN_TENANTS",
          dict(default=None, metavar="TENANTS_FILE",
               help="Multi-tenant serving (round 16, tenancy.py): a YAML "

@@ -280,6 +280,17 @@ class Config:
     native_idle_timeout_seconds: float = 75.0
     native_read_timeout_seconds: float = 30.0
     native_max_connections: int = 0
+    # native TLS termination (round 20): 'auto' terminates TLS on the
+    # C++ epoll loops when --cert/--key are set and libssl loads
+    # (hot-rotation swaps the SSL_CTX for new connections; established
+    # ones drain on the old identity), falling back LOUDLY to the
+    # aiohttp TLS frontend when libssl is unavailable; 'off' keeps
+    # aiohttp terminating TLS even under --frontend native
+    native_tls: str = "auto"
+    # native TLS handshake-arrival bound: the full handshake must
+    # COMPLETE within this window measured from accept — byte drips
+    # never refresh it (slowloris at the TLS layer); 0 disables
+    native_tls_handshake_timeout_seconds: float = 10.0
     # durable last-good state store (round 17, statestore.py): the
     # crash-tolerance directory holding the content-addressed policy
     # artifact cache, the per-tenant last-good epoch manifests, and the
@@ -444,6 +455,15 @@ class Config:
             raise ValueError("--native-read-timeout-seconds must be >= 0")
         if self.native_max_connections < 0:
             raise ValueError("--native-max-connections must be >= 0")
+        if self.native_tls not in ("auto", "off"):
+            raise ValueError(
+                f"invalid native TLS mode {self.native_tls!r} "
+                "(expected auto or off)"
+            )
+        if self.native_tls_handshake_timeout_seconds < 0:
+            raise ValueError(
+                "--native-tls-handshake-timeout-seconds must be >= 0"
+            )
         if not (0.0 <= self.reload_divergence_threshold <= 1.0):
             raise ValueError(
                 "--reload-divergence-threshold must be in [0, 1]"
@@ -589,6 +609,10 @@ class Config:
                 args.native_read_timeout_seconds
             ),
             native_max_connections=int(args.native_max_connections),
+            native_tls=getattr(args, "native_tls", "auto"),
+            native_tls_handshake_timeout_seconds=float(
+                getattr(args, "native_tls_handshake_timeout_seconds", 10.0)
+            ),
             state_dir=args.state_dir or None,
             state_audit_spill_seconds=float(args.state_audit_spill_seconds),
             selfheal_interval_seconds=float(args.selfheal_interval_seconds),

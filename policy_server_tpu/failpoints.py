@@ -77,6 +77,12 @@ Sites instrumented (grep for ``failpoints.fire``):
                     TenantAdmission.admit) — ``raise`` = an admission-
                     layer fault for one tenant; its requests answer
                     in-band errors while other tenants admit normally
+``tls.handshake``   native TLS accept path (runtime/native_frontend.py
+                    NativeTlsManager failpoint poll) — an armed
+                    ``raise`` makes the native loops refuse EVERY new
+                    handshake (counted, alert sent, connection closed)
+                    until the site disarms; established connections
+                    keep serving, so the blast radius is accept-only
 ==================  =====================================================
 
 Every fire is counted (``fired_count(site)``) so chaos tests can assert

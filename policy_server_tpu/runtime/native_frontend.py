@@ -91,6 +91,14 @@ _STAT_NAMES = (
     "midbody_disconnects",
     "idle_timeout_closes",
     "conn_cap_rejections",
+    # TLS termination (round 20) — order pinned to the C++ stats enum
+    "tls_connections",
+    "tls_handshakes_ok",
+    "tls_handshakes_failed",
+    "tls_handshake_timeouts",
+    "tls_handshake_disconnects",
+    "tls_handshakes_fail_injected",
+    "tls_clean_closes",
 )
 
 # buffer we hand httpfront_stats, passed as its cap argument (the C side
@@ -115,7 +123,7 @@ def _build_library() -> Path | None:
         return out
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        str(_SRC), "-o", str(out),
+        str(_SRC), "-o", str(out), "-ldl",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=180)
@@ -184,6 +192,26 @@ def _load() -> ctypes.CDLL | None:
         pylib.httpfront_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ]
+        # TLS termination (round 20): the OpenSSL binding is resolved at
+        # RUNTIME inside the .so (dlopen) — these entry points exist even
+        # when libssl does not, and tls_available() reports which case
+        # this process is in
+        lib.httpfront_tls_available.restype = ctypes.c_int
+        lib.httpfront_tls_error.restype = ctypes.c_char_p
+        lib.httpfront_ktls_supported.restype = ctypes.c_int
+        lib.httpfront_tls_ctx_create.restype = ctypes.c_void_p
+        lib.httpfront_tls_ctx_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.httpfront_tls_ctx_free.argtypes = [ctypes.c_void_p]
+        lib.httpfront_set_tls.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.httpfront_tls_configure.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.httpfront_tls_fail_handshakes.argtypes = [
+            ctypes.c_void_p, ctypes.c_long,
+        ]
         _lib = lib
         _pylib = pylib
         return _lib
@@ -191,6 +219,52 @@ def _load() -> ctypes.CDLL | None:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def tls_available() -> bool:
+    """True when the native frontend can terminate TLS: the extension
+    loaded AND its runtime dlopen of libssl/libcrypto resolved every
+    needed symbol. False demands the LOUD aiohttp-TLS fallback."""
+    if _load() is None:
+        return False
+    return bool(_lib.httpfront_tls_available())
+
+
+def tls_error() -> str:
+    """Why native TLS is unavailable (or the last ctx-build error)."""
+    if _load() is None:
+        return "native frontend unavailable (httpfront.cpp failed to build/load)"
+    return (_lib.httpfront_tls_error() or b"").decode("utf-8", "replace")
+
+
+def ktls_supported() -> bool:
+    """Capability probe for kernel-TLS offload after the userspace
+    handshake (needs an OpenSSL 3.x kTLS build). A plain answer — the
+    caller logs it; nothing silently downgrades either way."""
+    return _load() is not None and bool(_lib.httpfront_ktls_supported())
+
+
+def tls_ctx_create(
+    cert_pem: bytes, key_pem: bytes, ca_pem: bytes | None = None
+) -> int:
+    """Build one native SSL_CTX generation from PEM bytes (certs.py's
+    last-good identity snapshot; ``ca_pem`` turns on mTLS with
+    CPython-CERT_REQUIRED semantics). Returns an opaque handle; raises
+    RuntimeError with the native error string on failure."""
+    if _load() is None:
+        raise RuntimeError(tls_error())
+    handle = _lib.httpfront_tls_ctx_create(
+        cert_pem, len(cert_pem), key_pem, len(key_pem),
+        ca_pem, len(ca_pem) if ca_pem else 0,
+    )
+    if not handle:
+        raise RuntimeError(f"native TLS context build failed: {tls_error()}")
+    return handle
+
+
+def tls_ctx_free(handle: int) -> None:
+    if _lib is not None and handle:
+        _lib.httpfront_tls_ctx_free(handle)
 
 
 def render_verdict_bytes(record: bytes) -> bytes | None:
@@ -343,6 +417,38 @@ class NativeFrontend:
             if self._closed or not self._handle:
                 return
             self._lib.httpfront_stop_accepting(self._handle)
+
+    # -- TLS termination (round 20) ---------------------------------------
+
+    def set_tls(self, ctx_handle: int | None) -> None:
+        """Swap the SSL_CTX generation NEW accepts handshake under (the
+        native side takes its own reference — the caller's handle stays
+        valid until its tls_ctx_free). Established connections drain on
+        the generation they pinned at accept. None disables TLS for new
+        connections."""
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._lib.httpfront_set_tls(self._handle, ctx_handle or None)
+
+    def configure_tls(self, handshake_timeout_ms: int) -> None:
+        """Handshake-arrival deadline, measured from ACCEPT and never
+        refreshed by arriving bytes — the TLS-layer slowloris clock
+        (0 disables)."""
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._lib.httpfront_tls_configure(
+                self._handle, int(handshake_timeout_ms)
+            )
+
+    def fail_tls_handshakes(self, n: int) -> None:
+        """`tls.handshake` failpoint backend: fail the next ``n``
+        handshakes (n>0), every handshake (-1), or disarm (0)."""
+        with self._lock:
+            if self._closed or not self._handle:
+                return
+            self._lib.httpfront_tls_fail_handshakes(self._handle, int(n))
 
     # -- self-heal surface (round 17, supervision.SelfHealWatchdog) --------
 
@@ -601,6 +707,135 @@ class NativeFrontend:
                             {"message": "Something went wrong", "status": 500}
                         ).encode(),
                     )
+
+
+class NativeTlsManager:
+    """Glue between certs.py's last-good identity machinery and the
+    native frontend's TLS termination (round 20).
+
+    * builds SSL_CTX generations from ``ReloadableTlsContext``
+      SNAPSHOTS — the validated bytes the aiohttp contexts serve, never
+      files on disk mid-rotation;
+    * registers a reload listener so SIGHUP/digest rotation atomically
+      swaps the generation NEW connections handshake under, while
+      established connections drain on the one they pinned at accept; a
+      failed native rebuild keeps the previous generation serving
+      (counted and logged, mirroring certs.py's keep-last-good rule);
+    * bridges the ``tls.handshake`` failpoint: a short poll loop fires
+      the pure-Python site and arms/disarms the native refuse-handshakes
+      knob, so chaos and soak can fault the TLS accept path without the
+      C++ side knowing what a failpoint is.
+    """
+
+    HANDSHAKE_TIMEOUT_MS = 10_000
+    _FAILPOINT_POLL_SECONDS = 0.25
+
+    def __init__(
+        self,
+        frontend: NativeFrontend,
+        reloadable,
+        *,
+        handshake_timeout_ms: int | None = None,
+    ):
+        self._frontend = frontend
+        self._reloadable = reloadable
+        self._lock = threading.Lock()
+        self._ctx_handle: int | None = None  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._fail_armed = False
+        self.generations = 0  # successful installs (guarded-by: _lock)
+        self.failed_swaps = 0  # guarded-by: _lock
+        frontend.configure_tls(
+            self.HANDSHAKE_TIMEOUT_MS
+            if handshake_timeout_ms is None
+            else int(handshake_timeout_ms)
+        )
+        self._install_current()  # raises when the identity will not build
+        reloadable.add_reload_listener(self._on_reload)
+        self._thread = threading.Thread(
+            target=self._failpoint_loop, name="native-tls-failpoints",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _install_current(self) -> None:
+        cert_pem, key_pem = self._reloadable.identity_snapshot()
+        ca = self._reloadable.client_ca_snapshot()
+        handle = tls_ctx_create(
+            cert_pem, key_pem, ca.encode() if ca else None
+        )
+        self._frontend.set_tls(handle)
+        with self._lock:
+            old, self._ctx_handle = self._ctx_handle, handle
+            self.generations += 1
+        if old:
+            tls_ctx_free(old)
+
+    def _on_reload(self) -> None:
+        if self._stop.is_set():
+            # the reloadable outlives this manager (its watcher thread
+            # is daemon-global); a post-stop rotation must not rebuild
+            # contexts for torn-down loops
+            return
+        try:
+            self._install_current()
+            logger.info(
+                "native TLS generation rotated (generation %d): new "
+                "connections handshake under the new identity, "
+                "established connections drain on the old one",
+                self.generations,
+            )
+        except Exception as e:  # noqa: BLE001 — keep last-good serving
+            with self._lock:
+                self.failed_swaps += 1
+            logger.error(
+                "native TLS generation rebuild failed; the previous "
+                "identity keeps serving: %s", e,
+            )
+
+    def _failpoint_loop(self) -> None:
+        while not self._stop.wait(self._FAILPOINT_POLL_SECONDS):
+            self.poll_failpoint_once()
+
+    def poll_failpoint_once(self) -> None:
+        """One ``tls.handshake`` failpoint evaluation (the loop body,
+        and the deterministic entry tests drive directly): an armed
+        raising site makes the native loops refuse EVERY new handshake
+        until the site disarms; disarming restores service."""
+        try:
+            failpoints.fire("tls.handshake")
+            armed = False
+        except Exception:  # noqa: BLE001 — any raise means "refuse"
+            armed = True
+        if armed != self._fail_armed:
+            self._fail_armed = armed
+            self._frontend.fail_tls_handshakes(-1 if armed else 0)
+
+    def snapshot(self) -> dict:
+        """Rotation/identity introspection for runtime metrics."""
+        reloads, reload_failures = self._reloadable.counters()
+        with self._lock:
+            generations = self.generations
+            failed_swaps = self.failed_swaps
+        return {
+            "generations": generations,
+            "failed_swaps": failed_swaps,
+            "reloads": reloads,
+            "reload_failures": reload_failures,
+            "cert_expiry_epoch": self._reloadable.identity_not_after(),
+            "ktls": ktls_supported(),
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            handle, self._ctx_handle = self._ctx_handle, None
+        if handle:
+            tls_ctx_free(handle)
 
 
 def _shed_body(retry_after: int) -> bytes:

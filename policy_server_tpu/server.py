@@ -70,6 +70,9 @@ class PolicyServer:
         # native HTTP frontend (runtime/native_frontend.py); None under
         # --frontend python or after a native-load fallback
         self._native_frontend = None
+        # native TLS termination manager (NativeTlsManager); None under
+        # plaintext, --native-tls off, or the aiohttp-TLS fallback
+        self._native_tls = None
         # self-heal watchdog (supervision.py): rebuilds a wedged batcher
         # dispatch loop / frontend drainer; started with the servers
         self._selfheal = None
@@ -1167,6 +1170,88 @@ class PolicyServer:
                 "native frontend's connection cap was reached",
                 nstats.get("conn_cap_rejections", 0),
             )
+            # Native TLS termination (round 20). The expiry gauge and
+            # reload counters follow certs.py through the state, so
+            # they export under the aiohttp TLS fallback too; the
+            # handshake counters come from the native loops and are
+            # zero under aiohttp termination or plaintext (families
+            # still export so dashboard panels resolve everywhere).
+            _reloadable = getattr(state, "tls_reloadable", None)
+            _tlsmgr = getattr(state, "native_tls", None)
+            _expiry = (
+                _reloadable.identity_not_after()
+                if _reloadable is not None
+                else None
+            )
+            _tls_reloads, _tls_reload_failures = (
+                _reloadable.counters()
+                if _reloadable is not None
+                else (0, 0)
+            )
+            yield (
+                metrics_names.TLS_CERT_EXPIRY_SECONDS, "gauge",
+                "Seconds until the serving TLS identity's notAfter "
+                "(negative = expired; 0 when TLS is off or the leaf "
+                "is undecodable)",
+                (_expiry - _time.time()) if _expiry is not None else 0,
+            )
+            yield (
+                metrics_names.TLS_HANDSHAKES_OK, "counter",
+                "TLS handshakes completed by the native frontend",
+                nstats.get("tls_handshakes_ok", 0),
+            )
+            yield (
+                metrics_names.TLS_HANDSHAKES_FAILED, "counter",
+                "Native TLS handshakes that failed hard (bad record, "
+                "mTLS client-CA rejection, injected tls.handshake "
+                "faults)",
+                nstats.get("tls_handshakes_failed", 0),
+            )
+            yield (
+                metrics_names.TLS_HANDSHAKE_TIMEOUTS, "counter",
+                "Native TLS handshakes reaped by the arrival timeout "
+                "(byte drips never refresh it — the TLS-layer "
+                "slowloris defense)",
+                nstats.get("tls_handshake_timeouts", 0),
+            )
+            yield (
+                metrics_names.TLS_HANDSHAKE_DISCONNECTS, "counter",
+                "Connections that disconnected mid-handshake before "
+                "the native TLS handshake completed",
+                nstats.get("tls_handshake_disconnects", 0),
+            )
+            yield (
+                metrics_names.TLS_CLEAN_CLOSES, "counter",
+                "Native TLS connections closed with a close_notify "
+                "alert (in-band rejections included — no "
+                "truncation-looking RSTs for well-behaved clients)",
+                nstats.get("tls_clean_closes", 0),
+            )
+            yield (
+                metrics_names.TLS_GENERATIONS, "counter",
+                "SSL_CTX generations installed on the native loops "
+                "(boot + each successful hot-rotation; established "
+                "connections drain on the generation they pinned)",
+                _tlsmgr.snapshot()["generations"] if _tlsmgr else 0,
+            )
+            yield (
+                metrics_names.TLS_RELOADS, "counter",
+                "TLS identity/client-CA hot reloads applied by "
+                "certs.py (SIGHUP or digest-watch rotation)",
+                _tls_reloads,
+            )
+            yield (
+                metrics_names.TLS_RELOAD_FAILURES, "counter",
+                "TLS reload attempts that failed validation; the "
+                "last-good identity kept serving each time",
+                _tls_reload_failures,
+            )
+            yield (
+                metrics_names.TLS_NATIVE_TERMINATION, "gauge",
+                "1 when TLS terminates on the native epoll loops, 0 "
+                "under the aiohttp terminator or plaintext",
+                1 if _tlsmgr is not None else 0,
+            )
             # Predicate-program optimizer + Pallas kernel path (round
             # 15). Optimizer facts are static per serving epoch (the
             # pass re-runs for every reload candidate); gauges follow
@@ -1485,6 +1570,12 @@ class PolicyServer:
             tls_context = create_tls_config_and_watch_certificate_changes(
                 config.tls_config
             )
+            # cert-expiry/reload observability reads the last-good
+            # identity machinery through the state, independent of
+            # which frontend terminates the handshake
+            state.tls_reloadable = getattr(
+                tls_context, "_reloadable", None
+            )
 
         # -- boot report (round 17): how warm this boot actually was ------
         # "warm" = the state store carried a last-good manifest forward;
@@ -1540,10 +1631,15 @@ class PolicyServer:
             )
         native = False
         if self.config.frontend == "native":
-            if self.tls_context is not None:
+            if (
+                self.tls_context is not None
+                and self.config.native_tls == "off"
+            ):
                 logger.warning(
-                    "--frontend native is not supported with TLS yet; "
-                    "serving with the Python frontend"
+                    "--native-tls off with --frontend native: TLS "
+                    "terminates on the aiohttp frontend (the native "
+                    "loops cannot share its port); serving with the "
+                    "Python frontend"
                 )
             else:
                 native = self._start_native_frontend()
@@ -1606,8 +1702,15 @@ class PolicyServer:
         live on the readiness port). Returns False — with ONE loud line —
         on any build/load/bind failure, and the caller serves through the
         always-available Python frontend instead (the round-7 soft-dep
-        pattern: degraded, never broken)."""
+        pattern: degraded, never broken). With TLS configured, the
+        handshake terminates ON the native epoll loops (round 20):
+        certs.py's last-good identity builds the SSL_CTX, hot-rotation
+        swaps it for NEW connections while established ones drain on
+        the old, and a missing/unlinkable libssl falls back LOUDLY to
+        the aiohttp TLS terminator — degraded in throughput, identical
+        in trust surface."""
         sock = None
+        tls_manager = None
         try:
             from policy_server_tpu.api.handlers import MAX_BODY_BYTES
             from policy_server_tpu.runtime import native_frontend as nf
@@ -1631,8 +1734,32 @@ class PolicyServer:
                 ),
                 max_connections=self.config.native_max_connections,
             )
+            if self.tls_context is not None:
+                if not nf.tls_available():
+                    raise RuntimeError(
+                        f"native TLS unavailable ({nf.tls_error()}); "
+                        "TLS will terminate on the aiohttp frontend"
+                    )
+                reloadable = getattr(self.tls_context, "_reloadable", None)
+                if reloadable is None:
+                    raise RuntimeError(
+                        "TLS context carries no reloadable identity "
+                        "(embedding without certs.py?)"
+                    )
+                tls_manager = nf.NativeTlsManager(
+                    front, reloadable,
+                    handshake_timeout_ms=int(
+                        self.config.native_tls_handshake_timeout_seconds
+                        * 1000
+                    ),
+                )
             front.start()
         except Exception as e:  # noqa: BLE001 — fall back, never refuse boot
+            if tls_manager is not None:
+                import contextlib
+
+                with contextlib.suppress(Exception):
+                    tls_manager.stop()
             if sock is not None:
                 import contextlib
 
@@ -1645,6 +1772,8 @@ class PolicyServer:
             return False
         self._native_frontend = front
         self.state.native_frontend = front
+        self._native_tls = tls_manager
+        self.state.native_tls = tls_manager
         self.api_port = sock.getsockname()[1]
         if self.config.enable_pprof:
             logger.warning(
@@ -1656,6 +1785,11 @@ class PolicyServer:
             "native HTTP frontend started",
             extra={"span_fields": {
                 "addr": self.config.addr, "port": self.api_port,
+                "tls": tls_manager is not None,
+                "ktls": (
+                    tls_manager.snapshot()["ktls"]
+                    if tls_manager is not None else False
+                ),
             }},
         )
         return True
@@ -1867,6 +2001,13 @@ class PolicyServer:
             # The server built the environment, so the server closes it —
             # the batcher only borrows it (two batchers may share one env).
             self.environment.close()
+        if self._native_tls is not None:
+            # the TLS manager stops BEFORE the loops tear down: its
+            # failpoint poll thread and reload listener must not touch
+            # a frontend handle mid-destroy
+            self._native_tls.stop()
+            self._native_tls = None
+            self.state.native_tls = None
         if self._native_frontend is not None:
             # every submitted future is resolved by now (batcher shutdown
             # drains rejecting), so this just flushes the last completions

@@ -208,6 +208,27 @@ PHASE_LATENCY_SECONDS = "policy_server_phase_latency_seconds"
 TAIL_EXEMPLAR_LATENCY_SECONDS = "policy_server_tail_exemplar_latency_seconds"
 FLIGHT_RECORDER_EVENTS = "policy_server_flight_recorder_events"
 FLIGHT_RECORDER_ROWS_SAMPLED = "policy_server_flight_recorder_rows_sampled"
+# round 20 — native TLS termination (csrc/httpfront.cpp memory-BIO
+# handshakes + runtime/native_frontend.NativeTlsManager + certs.py
+# last-good identity machinery): cert-expiry horizon, handshake
+# outcome accounting (ok / hard failure / arrival-timeout slowloris
+# reap / mid-handshake disconnect / close_notify-clean closes), and
+# the hot-rotation generation/reload counters. The expiry gauge and
+# reload counters export under BOTH terminators (native and the
+# aiohttp fallback — they read certs.py through the state); the
+# handshake counters are native-frontend stats, zero under aiohttp
+# termination or plaintext (families still export so dashboard panels
+# resolve everywhere).
+TLS_CERT_EXPIRY_SECONDS = "policy_server_tls_cert_expiry_seconds"
+TLS_HANDSHAKES_OK = "policy_server_tls_handshakes_ok"
+TLS_HANDSHAKES_FAILED = "policy_server_tls_handshakes_failed"
+TLS_HANDSHAKE_TIMEOUTS = "policy_server_tls_handshake_timeouts"
+TLS_HANDSHAKE_DISCONNECTS = "policy_server_tls_handshake_disconnects"
+TLS_CLEAN_CLOSES = "policy_server_tls_clean_closes"
+TLS_GENERATIONS = "policy_server_tls_generations"
+TLS_RELOADS = "policy_server_tls_reloads"
+TLS_RELOAD_FAILURES = "policy_server_tls_reload_failures"
+TLS_NATIVE_TERMINATION = "policy_server_tls_native_termination"
 
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".

@@ -1,0 +1,269 @@
+"""TLS rotation + handshake-abuse chaos suite (``make chaos``).
+
+Round 20's native TLS termination under the storms round 13 built for
+the plaintext surface: sustained HTTPS traffic across a SIGHUP-driven
+certificate rotation (zero unexplained non-2xx; established connections
+finish on the identity they pinned at accept), a corrupted-cert reload
+that must keep last-good serving, and the ``tls.handshake`` failpoint
+arming/disarming the native accept path. Runs under the lock-order
+sanitizer via ``make chaos`` — the SSL_CTX generation swap takes
+certs.py's lock, the manager's lock, and the frontend's lock on
+different threads, and 0 inversions is part of the acceptance bar."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import socket
+import ssl
+import threading
+import time
+
+import pytest
+import requests
+
+from test_server import ServerHandle, make_config, pod_review_body
+from policy_server_tpu import failpoints
+from policy_server_tpu.config import TlsConfig
+from policy_server_tpu.telemetry import metrics as metrics_mod
+from tools import tlsgen
+
+nf = pytest.importorskip(
+    "policy_server_tpu.runtime.native_frontend",
+    reason="native frontend module unavailable",
+)
+
+pytestmark = [
+    pytest.mark.skipif(
+        not nf.native_available(),
+        reason="httpfront.cpp failed to build (no g++?)",
+    ),
+    pytest.mark.skipif(
+        not tlsgen.openssl_available(),
+        reason="openssl CLI unavailable — cannot mint test certificates",
+    ),
+    pytest.mark.skipif(
+        nf.native_available() and not nf.tls_available(),
+        reason="libssl unavailable — the rotation storm needs native "
+        "TLS termination",
+    ),
+]
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    """A native-TLS server over a MUTABLE identity directory (rotation
+    tests overwrite the files in place, like a real cert-manager
+    volume)."""
+    cert, key = tlsgen.self_signed_identity(tmp_path, cn="original")
+    tls = TlsConfig(cert_file=str(cert), key_file=str(key))
+    handle = ServerHandle(make_config(frontend="native", tls_config=tls))
+    assert handle.server._native_tls is not None, (
+        "TLS did not terminate natively despite tls_available()"
+    )
+    yield handle, tmp_path
+    handle.stop()
+
+
+def client_ctx() -> ssl.SSLContext:
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def _peer_cn(sock: ssl.SSLSocket) -> str:
+    """CN of the peer certificate via the openssl CLI (the container
+    has no ``cryptography`` package)."""
+    import subprocess
+
+    der = sock.getpeercert(binary_form=True)
+    proc = subprocess.run(
+        ["openssl", "x509", "-inform", "der", "-noout", "-subject"],
+        input=der, capture_output=True,
+    )
+    return proc.stdout.decode().strip()
+
+
+def test_tls_rotation_under_load_storm(tls_server):
+    """SIGHUP mid-storm rotates the serving identity: zero unexplained
+    non-2xx through the swap, NEW connections handshake under the new
+    certificate, and a connection ESTABLISHED before the rotation keeps
+    serving on the old one (it drains, never renegotiates)."""
+    handle, certdir = tls_server
+    server = handle.server
+    port = server.api_port
+    stop = threading.Event()
+    results: list[int] = []
+    errors: list[Exception] = []
+
+    def traffic() -> None:
+        s = requests.Session()
+        while not stop.is_set():
+            try:
+                r = s.post(
+                    f"https://127.0.0.1:{port}/validate/pod-privileged",
+                    json=pod_review_body(False), verify=False, timeout=30,
+                )
+                results.append(r.status_code)
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=traffic, daemon=True) for _ in range(3)
+    ]
+    established = client_ctx().wrap_socket(
+        socket.create_connection(("127.0.0.1", port))
+    )
+    assert "original" in _peer_cn(established)
+    body = json.dumps(pod_review_body(False)).encode()
+    req = (
+        b"POST /validate/pod-privileged HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+    )
+    established.sendall(req)
+    assert established.recv(65536).startswith(b"HTTP/1.1 200")
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        before = server._native_tls.snapshot()["generations"]
+        # rotate in place, then the SIGHUP contract entry point
+        # (ServerHandle's loop thread cannot take real signals)
+        cert2, key2 = tlsgen.self_signed_identity(
+            certdir, cn="rotated", stem="next"
+        )
+        shutil.copy(cert2, certdir / "server.pem")
+        shutil.copy(key2, certdir / "server-key.pem")
+        server.reload_signal()
+        deadline = time.monotonic() + 30
+        while (
+            server._native_tls.snapshot()["generations"] == before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        snap = server._native_tls.snapshot()
+        assert snap["generations"] > before, "rotation never installed"
+        assert snap["failed_swaps"] == 0
+        time.sleep(0.3)  # traffic THROUGH the new generation
+        # new connections pin the rotated identity...
+        fresh = client_ctx().wrap_socket(
+            socket.create_connection(("127.0.0.1", port))
+        )
+        assert "rotated" in _peer_cn(fresh)
+        fresh.close()
+        # ...while the pre-rotation connection keeps serving on the old
+        established.sendall(req)
+        assert established.recv(65536).startswith(b"HTTP/1.1 200")
+        assert "original" in _peer_cn(established)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        established.close()
+    assert not errors, errors
+    assert len(results) > 20
+    non_2xx = [c for c in results if c != 200]
+    assert not non_2xx, f"non-2xx during TLS rotation: {non_2xx[:5]}"
+
+
+def test_tls_corrupted_reload_keeps_last_good(tls_server):
+    """Garbage cert material mid-rotation: the reload fails LOUDLY, the
+    failure is counted, no new SSL_CTX generation installs, and the
+    last-good identity keeps serving new handshakes."""
+    handle, certdir = tls_server
+    server = handle.server
+    port = server.api_port
+    before = server._native_tls.snapshot()
+    (certdir / "server.pem").write_text("-----NOT A CERT-----\n")
+    server.reload_signal()
+    rel = server.tls_context._reloadable
+    deadline = time.monotonic() + 15
+    while (
+        rel.counters()[1] == before["reload_failures"]
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    after = server._native_tls.snapshot()
+    assert after["reload_failures"] > before["reload_failures"]
+    assert after["generations"] == before["generations"]
+    assert after["failed_swaps"] == 0  # the rebuild was never attempted
+    s = client_ctx().wrap_socket(
+        socket.create_connection(("127.0.0.1", port))
+    )
+    assert "original" in _peer_cn(s), "last-good identity was lost"
+    r = requests.post(
+        f"https://127.0.0.1:{port}/validate/pod-privileged",
+        json=pod_review_body(True), verify=False, timeout=30,
+    )
+    assert r.status_code == 200
+    assert r.json()["response"]["allowed"] is False
+    s.close()
+
+
+def test_tls_handshake_failpoint_arms_and_recovers(tls_server):
+    """An armed raising ``tls.handshake`` site makes the native loops
+    refuse EVERY new handshake (counted, alert sent); disarming restores
+    service — and established connections never notice."""
+    handle, _certdir = tls_server
+    server = handle.server
+    port = server.api_port
+    manager = server._native_tls
+    established = client_ctx().wrap_socket(
+        socket.create_connection(("127.0.0.1", port))
+    )
+
+    def boom() -> None:
+        raise failpoints.FailpointError("injected TLS accept outage")
+
+    failpoints.set_failpoint("tls.handshake", boom)
+    manager.poll_failpoint_once()  # deterministic arm, no poll-loop wait
+    assert failpoints.fired_count("tls.handshake") > 0
+    with pytest.raises((ssl.SSLError, OSError)):
+        s = client_ctx().wrap_socket(
+            socket.create_connection(("127.0.0.1", port))
+        )
+        s.settimeout(5)
+        if s.recv(1) == b"":  # a bare close is a refusal too
+            raise ssl.SSLError("refused")
+    front = server._native_frontend
+    deadline = time.monotonic() + 5
+    while (
+        front.stats()["tls_handshakes_fail_injected"] == 0
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    assert front.stats()["tls_handshakes_fail_injected"] >= 1
+
+    failpoints.reset()
+    manager.poll_failpoint_once()  # deterministic disarm
+    ok = client_ctx().wrap_socket(
+        socket.create_connection(("127.0.0.1", port))
+    )
+    assert ok.version() is not None, "service did not recover"
+    ok.close()
+    # the established connection rode through armed + disarmed windows
+    body = json.dumps(pod_review_body(False)).encode()
+    established.sendall(
+        b"POST /validate/pod-privileged HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: %d\r\nConnection: close\r\n\r\n%s"
+        % (len(body), body)
+    )
+    assert established.recv(65536).startswith(b"HTTP/1.1 200")
+    established.close()

@@ -38,7 +38,8 @@ def main() -> int:
         from tools.bench.native import _native_client_main
 
         i = sys.argv.index("--native-client")
-        return _native_client_main(sys.argv[i + 1 : i + 6])
+        # 5 required args + the optional trailing cafile for the TLS bench
+        return _native_client_main(sys.argv[i + 1 : i + 7])
 
     from tools.bench.audit import bench_audit_mixed
     from tools.bench.configs import (
@@ -55,7 +56,7 @@ def main() -> int:
         bench_http_routing_ab,
     )
     from tools.bench.mesh import bench_mesh_dispatch
-    from tools.bench.native import bench_http_native
+    from tools.bench.native import bench_http_native, bench_http_native_tls
     from tools.bench.predicate import bench_predicate_opt_ab
     from tools.bench.serving import bench_batcher_serving
 
@@ -134,6 +135,14 @@ def main() -> int:
         bench_http_native(quick=quick)
     except Exception as e:  # noqa: BLE001
         emit("http_validate_native", 0.0, "error", 0.0, error=repr(e)[:300])
+    try:
+        # round-20 tentpole: the same native c256 shape with TLS
+        # terminated on the native loops + a same-run plaintext A/B;
+        # REFUSES to record under the aiohttp-TLS fallback
+        bench_http_native_tls(quick=quick)
+    except Exception as e:  # noqa: BLE001
+        emit("http_validate_native_tls", 0.0, "error", 0.0,
+             error=repr(e)[:300])
     try:
         # latency-budget router A/B at c64 (VERDICT Weak #3 closure)
         bench_http_routing_ab(n_requests=512 if quick else 1500)

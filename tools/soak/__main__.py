@@ -35,6 +35,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tenants", type=int, default=None,
                     help="tenancy mix size: ten-0 storms a tight quota, "
                          "the rest are paced victims (0/1 disables)")
+    ap.add_argument("--tls", action="store_true",
+                    help="terminate TLS (native when available) and run "
+                         "every client + abuse surface over it, adding "
+                         "the handshake-abuse waves and a windowed "
+                         "tls.handshake accept outage to the storm")
     ap.add_argument("--artifact", default=None)
     ap.add_argument("--tag", default=None)
     args = ap.parse_args(argv)
@@ -52,6 +57,8 @@ def main(argv: list[str] | None = None) -> int:
         v = getattr(args, name)
         if v is not None:
             over[attr] = v
+    if args.tls:
+        over["tls"] = True
     if args.preset == "smoke":
         settings = SoakSettings.smoke(**over)
     elif args.preset == "full":

@@ -11,7 +11,9 @@ with:
   expectation class);
 * an abuse driver executing the trace's connection-abuse waves
   (slowloris drips against the native read timeout, pipelined malformed
-  floods, mid-body disconnects);
+  floods, mid-body disconnects — and, under ``--tls``, the
+  handshake-abuse waves: ClientHello drips into the handshake deadline,
+  mid-handshake disconnect floods, wrong-CA bursts);
 * a churn thread mutating the :class:`SyntheticCluster` that feeds the
   audit snapshot store through the live :class:`WatchFeed`;
 * the :class:`FaultStorm` applying seeded mid-soak faults (SIGHUP
@@ -32,6 +34,7 @@ import os
 import random
 import signal
 import socket
+import ssl as ssl_mod
 import threading
 import time
 from dataclasses import dataclass, field
@@ -96,6 +99,13 @@ class SoakSettings:
     tenants: int = 0
     tenant_storm_quota_rps: float = 50.0
     tenant_victim_rps: float = 30.0  # total across victim tenants
+    # TLS soak (round 20): boot the server with a generated identity
+    # and run EVERY client/abuse surface over TLS — the native frontend
+    # terminates the handshakes on its own loops, and the trace gains
+    # the handshake-abuse waves (tls_slowloris, tls_midhandshake,
+    # tls_wrong_ca) plus a windowed tls.handshake failpoint outage in
+    # the fault storm. Requires the openssl CLI for cert minting.
+    tls: bool = False
     # restart storm (round 17, statestore.py): N mid-soak server
     # restarts — stop, then re-boot the SAME config with the registry
     # failpoint armed; the warm boot must come from the state store
@@ -195,6 +205,17 @@ class SoakEngine:
     settings: SoakSettings
     log: list[str] = field(default_factory=list)
 
+    # the TLS soak tightens the native handshake deadline (default 10 s)
+    # so the tls_slowloris wave proves the reap inside the soak window
+    _TLS_HANDSHAKE_TIMEOUT = 5.0
+    # class-level defaults: run() overwrites these when --tls mints an
+    # identity, but engine surfaces (_conn, _await_routing_ready) must
+    # work on a partially-built engine too (the handover regression test
+    # drives them without run())
+    _client_ssl = None
+    _tls_config = None
+    tls_native = False
+
     @staticmethod
     def _phase_attribution() -> dict | None:
         """The flight recorder's wall-vs-summed-phases reconciliation
@@ -251,7 +272,9 @@ class SoakEngine:
             addr="127.0.0.1",
             port=0,
             readiness_probe_port=0,
-            tls_config=TlsConfig(),
+            # the TLS soak's identity (a restart-storm reboot re-reads
+            # the same cert paths, like a real pod remount)
+            tls_config=getattr(self, "_tls_config", None) or TlsConfig(),
             policies=read_policies_file(policies_path),
             policies_path=str(policies_path),
             policy_timeout_seconds=5.0,
@@ -260,6 +283,10 @@ class SoakEngine:
             request_timeout_ms=2000.0,
             frontend=s.frontend,
             http_workers=s.http_workers,
+            native_tls="auto",
+            native_tls_handshake_timeout_seconds=(
+                self._TLS_HANDSHAKE_TIMEOUT
+            ),
             policy_reload_mode="auto",
             reload_canary_requests=16,
             audit_mode="interval",
@@ -308,7 +335,7 @@ class SoakEngine:
             pos = (pos + s.pipeline) % len(order)
             try:
                 if sock_ is None:
-                    sock_ = _HttpConn(self.api_port)
+                    sock_ = self._conn()
                 payload = b"".join(
                     self._wire(it.path, it.body) for it in burst
                 )
@@ -341,6 +368,24 @@ class SoakEngine:
                 time.sleep(burst_sleep - elapsed)
         if sock_ is not None:
             sock_.close()
+
+    def _conn(self, timeout: float = 30.0) -> "_HttpConn":
+        """One client connection — TLS-wrapped when the soak is."""
+        return _HttpConn(
+            self.api_port, timeout=timeout, ssl_ctx=self._client_ssl
+        )
+
+    def _abuse_sock(self, timeout: float) -> socket.socket:
+        """A raw connection for post-handshake abuse (slowloris drips,
+        malformed floods, mid-body disconnects): under TLS the abuse
+        bytes flow through a COMPLETED handshake, so the plaintext abuse
+        coverage carries over to the TLS surface unchanged."""
+        c = socket.create_connection(
+            ("127.0.0.1", self.api_port), timeout=timeout
+        )
+        if self._client_ssl is not None:
+            c = self._client_ssl.wrap_socket(c)
+        return c
 
     @staticmethod
     def _wire(path: str, body: bytes) -> bytes:
@@ -416,7 +461,7 @@ class SoakEngine:
         while not stop.is_set():
             try:
                 if conn is None:
-                    conn = _HttpConn(self.api_port)
+                    conn = self._conn()
                 conn.sendall(wire * 8)
                 for _ in range(8):
                     status, _h, _b = conn.read_response()
@@ -456,7 +501,7 @@ class SoakEngine:
             t0 = time.perf_counter()
             try:
                 if conn is None:
-                    conn = _HttpConn(self.api_port)
+                    conn = self._conn()
                 conn.sendall(wire)
                 status, _h, _b = conn.read_response()
                 latency_ms = (time.perf_counter() - t0) * 1000.0
@@ -511,6 +556,14 @@ class SoakEngine:
                 # an abuse wave against a mid-reboot server proves only
                 # that a down server is down; wait for the swap
                 stop.wait(0.2)
+            while (
+                time.monotonic() < getattr(self.storm, "tls_outage_until", 0.0)
+                and not stop.is_set()
+            ):
+                # same logic for an injected TLS accept outage: a wave
+                # that cannot even handshake measures the fault, not
+                # the abuse-hardening it came to test
+                stop.wait(0.2)
             if stop.is_set():
                 return
             try:
@@ -528,6 +581,12 @@ class SoakEngine:
             return self._wave_slowloris(wave)
         if wave.kind == "malformed_flood":
             return self._wave_malformed(wave)
+        if wave.kind == "tls_slowloris":
+            return self._wave_tls_slowloris(wave)
+        if wave.kind == "tls_midhandshake":
+            return self._wave_tls_midhandshake(wave)
+        if wave.kind == "tls_wrong_ca":
+            return self._wave_tls_wrong_ca(wave)
         return self._wave_midbody(wave)
 
     def _wave_slowloris(self, wave) -> dict:
@@ -539,9 +598,7 @@ class SoakEngine:
         budget = self.settings.read_timeout_seconds + 6.0
         conns = []
         for _ in range(wave.conns):
-            c = socket.create_connection(
-                ("127.0.0.1", self.api_port), timeout=budget
-            )
+            c = self._abuse_sock(budget)
             c.sendall(b"POST /validate/pod-privileged HTTP/1.1\r\n")
             conns.append(c)
         deadline = time.monotonic() + budget
@@ -560,8 +617,9 @@ class SoakEngine:
                         if c.recv(4096) == b"":
                             closed += 1
                             continue
-                    except BlockingIOError:
-                        pass
+                    except (BlockingIOError, ssl_mod.SSLWantReadError):
+                        pass  # SSLWantReadError: the TLS-soak variant
+                        # of "no bytes yet" on a nonblocking socket
                     finally:
                         c.setblocking(True)
                     still.append(c)
@@ -581,9 +639,7 @@ class SoakEngine:
     def _wave_malformed(self, wave) -> dict:
         got_400 = 0
         for _ in range(wave.conns):
-            c = socket.create_connection(
-                ("127.0.0.1", self.api_port), timeout=15
-            )
+            c = self._abuse_sock(15)
             try:
                 flood = b"".join(
                     b"BLARGH nonsense\r\nGarbage: yes\r\n\r\n"
@@ -614,9 +670,7 @@ class SoakEngine:
 
     def _wave_midbody(self, wave) -> dict:
         for _ in range(wave.conns):
-            c = socket.create_connection(
-                ("127.0.0.1", self.api_port), timeout=15
-            )
+            c = self._abuse_sock(15)
             c.sendall(
                 b"POST /validate/pod-privileged HTTP/1.1\r\nHost: s\r\n"
                 b"Content-Length: 50000\r\n\r\npartial-then-gone"
@@ -627,7 +681,7 @@ class SoakEngine:
         # above must not turn this probe into a coin flip)
         self._await_handover()
         probe = scenarios.build_trace(1, 4).items[0]
-        conn = _HttpConn(self.api_port)
+        conn = self._conn()
         try:
             conn.sendall(self._wire(probe.path, probe.body))
             status, _h, _b = conn.read_response()
@@ -636,6 +690,152 @@ class SoakEngine:
         ok = status in (200, 429, 504)
         return {
             "kind": "midbody_disconnect", "conns": wave.conns,
+            "probe_status": status, "passed": ok,
+        }
+
+    # -- TLS handshake-abuse waves (round 20) ------------------------------
+
+    def _tls_stat(self, name: str) -> int:
+        front = self.server.state.native_frontend
+        return front.stats().get(name, 0) if front is not None else 0
+
+    def _wave_tls_slowloris(self, wave) -> dict:
+        """Drip a ClientHello one byte at a time: the handshake deadline
+        is anchored at accept and drips never refresh it, so every conn
+        must be reaped within the (tightened) handshake timeout."""
+        if not self.tls_native:
+            return {
+                "kind": "tls_slowloris", "passed": None,
+                "note": "skipped: TLS not natively terminated "
+                "(aiohttp has no handshake deadline)",
+            }
+        budget = self._TLS_HANDSHAKE_TIMEOUT + 6.0
+        timeouts_before = self._tls_stat("tls_handshake_timeouts")
+        # a plausible ClientHello prefix, never completed
+        hello = b"\x16\x03\x01\x00\xc8\x01\x00\x00\xc4\x03\x03" + b"\x00" * 64
+        conns = []
+        for _ in range(wave.conns):
+            c = socket.create_connection(
+                ("127.0.0.1", self.api_port), timeout=budget
+            )
+            conns.append(c)
+        deadline = time.monotonic() + budget
+        open_conns = list(conns)
+        pos = 0
+        closed = 0
+        while open_conns and time.monotonic() < deadline:
+            time.sleep(max(0.1, wave.param))
+            still = []
+            for c in open_conns:
+                try:
+                    c.sendall(hello[pos % len(hello):][:1])
+                    c.setblocking(False)
+                    try:
+                        if c.recv(4096) == b"":
+                            closed += 1
+                            continue
+                    except BlockingIOError:
+                        pass
+                    finally:
+                        c.setblocking(True)
+                    still.append(c)
+                except OSError:
+                    closed += 1
+            pos += 1
+            open_conns = still
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        reaped = self._tls_stat("tls_handshake_timeouts") - timeouts_before
+        return {
+            "kind": "tls_slowloris", "conns": wave.conns,
+            "closed": closed, "reaped_as_timeout": reaped,
+            "passed": closed == wave.conns and reaped >= wave.conns,
+        }
+
+    def _wave_tls_midhandshake(self, wave) -> dict:
+        """A flood of connections dropped mid-handshake: the loops must
+        count and reap every one, and serving must be untouched."""
+        before = self._tls_stat("tls_handshake_disconnects")
+        for _ in range(wave.conns):
+            c = socket.create_connection(
+                ("127.0.0.1", self.api_port), timeout=15
+            )
+            c.sendall(b"\x16\x03\x01\x00\xc8\x01\x00")  # fragment
+            c.close()
+        self._await_handover()
+        probe = scenarios.build_trace(1, 4).items[0]
+        conn = self._conn()
+        try:
+            conn.sendall(self._wire(probe.path, probe.body))
+            status, _h, _b = conn.read_response()
+        finally:
+            conn.close()
+        counted = None
+        if self.tls_native:
+            # the reap is event-driven (EPOLLHUP/read-0) — give the
+            # loops a moment to observe the last close
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                counted = (
+                    self._tls_stat("tls_handshake_disconnects") - before
+                )
+                if counted >= wave.conns:
+                    break
+                time.sleep(0.1)
+        ok = status in (200, 429, 504) and (
+            counted is None or counted >= wave.conns
+        )
+        return {
+            "kind": "tls_midhandshake", "conns": wave.conns,
+            "counted_disconnects": counted, "probe_status": status,
+            "passed": ok,
+        }
+
+    def _wave_tls_wrong_ca(self, wave) -> dict:
+        """Clients that verify the server against the WRONG trust root:
+        each aborts its handshake with an alert the server must absorb
+        as a counted failure — and keep serving everyone else."""
+        from tools import tlsgen
+
+        import tempfile
+
+        before = self._tls_stat("tls_handshakes_failed")
+        with tempfile.TemporaryDirectory() as td:
+            ca, _cakey = tlsgen.make_ca(td, cn="wrong-ca")
+            ctx = ssl_mod.create_default_context(cafile=str(ca))
+            ctx.check_hostname = False
+            rejected = 0
+            for _ in range(wave.conns):
+                try:
+                    c = ctx.wrap_socket(
+                        socket.create_connection(
+                            ("127.0.0.1", self.api_port), timeout=15
+                        )
+                    )
+                    c.close()
+                except (ssl_mod.SSLError, OSError):
+                    rejected += 1
+        probe = scenarios.build_trace(1, 4).items[0]
+        conn = self._conn()
+        try:
+            conn.sendall(self._wire(probe.path, probe.body))
+            status, _h, _b = conn.read_response()
+        finally:
+            conn.close()
+        failed = None
+        if self.tls_native:
+            failed = self._tls_stat("tls_handshakes_failed") - before
+        ok = (
+            rejected == wave.conns
+            and status in (200, 429, 504)
+            and (failed is None or failed >= wave.conns)
+        )
+        return {
+            "kind": "tls_wrong_ca", "conns": wave.conns,
+            "rejected": rejected, "counted_failures": failed,
             "probe_status": status, "passed": ok,
         }
 
@@ -718,7 +918,7 @@ class SoakEngine:
         """Serve the fixed probe corpus and return (path, status, body)
         triples — the bit-exactness witness across a restart."""
         out = []
-        conn = _HttpConn(self.api_port)
+        conn = self._conn()
         try:
             for it in probes:
                 conn.sendall(self._wire(it.path, it.body))
@@ -763,6 +963,7 @@ class SoakEngine:
         self.server = server
         self.api_port = server.api_port
         self.native_active = server._native_frontend is not None
+        self.tls_native = server._native_tls is not None
         self.recorder.soak_state = server.state
         self.storm.server = server
         # rebuild the live feed on the NEW server's snapshot store,
@@ -863,7 +1064,7 @@ class SoakEngine:
             f"clients={s.clients} target_rps={s.target_rps} "
             f"objects={s.objects}"
         )
-        trace = scenarios.build_trace(s.seed, s.n_trace_items)
+        trace = scenarios.build_trace(s.seed, s.n_trace_items, tls=s.tls)
         self._say(
             f"trace built: {len(trace.items)} items, "
             f"{len(trace.abuse)} abuse waves"
@@ -871,6 +1072,29 @@ class SoakEngine:
         tmp = tempfile.mkdtemp(prefix="policy-server-soak-")
         policies_path = Path(tmp) / "policies.yml"
         policies_path.write_text(_POLICIES_YAML, encoding="utf-8")
+        # TLS soak: mint the serving identity and the client context
+        # BEFORE _build_config reads self._tls_config
+        self._tls_config = None
+        self._client_ssl = None
+        if s.tls:
+            from policy_server_tpu.config.config import TlsConfig
+            from tools import tlsgen
+
+            if not tlsgen.openssl_available():
+                raise RuntimeError(
+                    "--tls soak needs the openssl CLI to mint certs"
+                )
+            cert, key = tlsgen.self_signed_identity(
+                Path(tmp) / "tls", cn="localhost"
+            )
+            self._tls_config = TlsConfig(
+                cert_file=str(cert), key_file=str(key)
+            )
+            ctx = ssl_mod.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl_mod.CERT_NONE
+            self._client_ssl = ctx
+            self._say(f"TLS soak: identity minted at {cert}")
         tenants_path = None
         tenant_names: list[str] = []
         if s.tenants >= 2:
@@ -896,12 +1120,22 @@ class SoakEngine:
         self.server = server
         self.api_port = server.api_port
         self.native_active = server._native_frontend is not None
+        self.tls_native = server._native_tls is not None
         if s.frontend == "native" and not self.native_active:
             self._say(
                 "NOTE: native frontend unavailable — soaking the python "
                 "frontend (recorded in the artifact)"
             )
-        self._say(f"server up on :{self.api_port} native={self.native_active}")
+        if s.tls and not self.tls_native:
+            self._say(
+                "NOTE: TLS terminating on the aiohttp frontend (no "
+                "native TLS) — handshake-abuse waves degrade to "
+                "availability checks (recorded in the artifact)"
+            )
+        self._say(
+            f"server up on :{self.api_port} native={self.native_active}"
+            + (f" tls_native={self.tls_native}" if s.tls else "")
+        )
 
         # SIGHUP: a REAL signal when we own the main thread (the handler
         # reads THROUGH self.server so it follows restart-storm swaps)
@@ -942,6 +1176,9 @@ class SoakEngine:
             rng, s.duration, server, self.cluster,
             sighup_registered=sighup_registered,
             workers=s.http_workers > 1,
+            # the injected TLS accept outage needs the failpoint-polling
+            # native manager; without it the armed site never refuses
+            tls=s.tls and self.tls_native,
         )
         storm.recorder = self.recorder
         self.storm = storm
@@ -1179,6 +1416,13 @@ class SoakEngine:
                 "churn_ops": self.cluster.churn_ops,
                 "frontend": "native" if self.native_active else "python",
                 "sighup_real_signal": sighup_registered,
+                # where TLS terminated: "native" (the acceptance shape),
+                # "aiohttp" (fallback — TLS on, native termination off),
+                # or "off" (plaintext soak)
+                "tls": (
+                    ("native" if self.tls_native else "aiohttp")
+                    if s.tls else "off"
+                ),
             },
             windows=self.recorder.windows(),
             faults=[
@@ -1213,6 +1457,13 @@ class SoakEngine:
                 },
                 "lifecycle": lifecycle_stats,
                 "native_frontend": native_stats,
+                # the TLS soak's rotation/identity receipts (round 20):
+                # SSL_CTX generations, reload counters, cert expiry —
+                # None on plaintext soaks or aiohttp-TLS fallback
+                "tls": (
+                    server._native_tls.snapshot()
+                    if server._native_tls is not None else None
+                ),
                 # the churn storm's receipts: rewrites written, and the
                 # serving epoch's optimizer accounting at collection
                 # (re-derived per candidate epoch — nonzero here proves
@@ -1264,10 +1515,19 @@ class SoakEngine:
 
 class _HttpConn:
     """One keep-alive client connection + its pipelined read-ahead
-    buffer (socket objects do not accept ad-hoc attributes)."""
+    buffer (socket objects do not accept ad-hoc attributes). With an
+    ``ssl_ctx`` the connection handshakes before the first byte — the
+    TLS soak's every request flows through the native termination."""
 
-    def __init__(self, port: int, timeout: float = 30.0):
+    def __init__(
+        self,
+        port: int,
+        timeout: float = 30.0,
+        ssl_ctx: "ssl_mod.SSLContext | None" = None,
+    ):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+        if ssl_ctx is not None:
+            self.sock = ssl_ctx.wrap_socket(self.sock)
         self.pending = b""
 
     def sendall(self, data: bytes) -> None:

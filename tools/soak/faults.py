@@ -37,6 +37,12 @@ Event kinds:
   watch stream (resourceVersion resume path, no re-LIST).
 * ``worker_kill``     — SIGKILL one prefork HTTP worker (only when the
   engine runs ``http_workers > 1``); the supervisor must respawn it.
+* ``tls_fault``       — arm ``tls.handshake=raise`` for one bounded
+  window (TLS soaks only): the native accept path refuses EVERY new
+  handshake while established connections keep serving; a timer
+  disarms the site at window end, the manager's failpoint poll restores
+  accepts within its 250 ms tick, and the recorder's fault window
+  explains the connection errors the refusals caused.
 """
 
 from __future__ import annotations
@@ -78,6 +84,10 @@ class FaultStorm:
     # to a half-rebooted server tests nothing and loses the reload
     hold: Any = None
     events: list[FaultEvent] = field(default_factory=list)
+    # monotonic end of any in-flight tls_fault accept outage: the abuse
+    # driver holds its waves past it (a wave probe that cannot even
+    # handshake proves only that the injected outage is an outage)
+    tls_outage_until: float = 0.0
     # blast-radius window: recorder fault windows AND the device-fault
     # auto-disarm share it, so an armed fault can never outlive the
     # period the recorder counts its 5xx as explained
@@ -86,7 +96,9 @@ class FaultStorm:
     _stop: threading.Event = field(default_factory=threading.Event)
     _timers: list[threading.Timer] = field(default_factory=list)
 
-    _WINDOWED_KINDS = ("frontend_fault", "worker_kill", "device_fault")
+    _WINDOWED_KINDS = (
+        "frontend_fault", "worker_kill", "device_fault", "tls_fault",
+    )
 
     @classmethod
     def schedule(
@@ -98,6 +110,7 @@ class FaultStorm:
         *,
         sighup_registered: bool = False,
         workers: bool = False,
+        tls: bool = False,
     ) -> "FaultStorm":
         """The seeded timeline: one of each core fault inside the middle
         80% of the soak (faults at the very edges test nothing), plus a
@@ -110,6 +123,8 @@ class FaultStorm:
             kinds += ["reload_poison", "stream_close"]
         if workers:
             kinds.append("worker_kill")
+        if tls:
+            kinds.append("tls_fault")
         lo, hi = 0.1 * duration, 0.9 * duration
         window = min(5.0, max(2.0, 0.15 * duration))
         events = sorted(
@@ -216,6 +231,7 @@ class FaultStorm:
             "frontend_fault": self._frontend_fault,
             "stream_close": self._stream_close,
             "worker_kill": self._worker_kill,
+            "tls_fault": self._tls_fault,
         }[event.kind]
         event.effect = apply_fn()
 
@@ -280,6 +296,36 @@ class FaultStorm:
             return "skipped (no synthetic cluster)"
         self.cluster.close_streams()
         return "all watch streams closed (rv-resume path)"
+
+    def _tls_fault(self) -> str:
+        """A bounded native-TLS accept outage: arm ``tls.handshake``
+        (the manager's 250 ms failpoint poll translates the armed site
+        into frontend-wide handshake refusal) and disarm on a timer at
+        window end. Established connections keep serving throughout —
+        the client loops' reconnect errors inside the window are
+        explained by the recorder's fault window, and anything after it
+        stays loudly unexplained."""
+        failpoints.configure("tls.handshake=raise:soak-tls-outage")
+        self.tls_outage_until = time.monotonic() + self.window_seconds
+        if self.recorder is not None:
+            # the refusal outlasts the disarm by up to one manager poll
+            # tick (250 ms) plus in-flight client retries — stretch the
+            # explained window past that so only REAL post-outage errors
+            # stay unexplained
+            self.recorder.note_fault_window(
+                "tls_fault", duration=self.window_seconds + 1.5
+            )
+        timer = threading.Timer(
+            self.window_seconds,
+            lambda: failpoints.configure("tls.handshake=off"),
+        )
+        timer.daemon = True
+        timer.start()
+        self._timers.append(timer)
+        return (
+            "tls.handshake armed (native accepts refuse), auto-disarm "
+            f"in {self.window_seconds:g}s"
+        )
 
     def _worker_kill(self) -> str:
         procs = [

@@ -56,6 +56,13 @@ class AbuseWave:
     """One connection-abuse wave (engine's abuse driver).
 
     kind: 'slowloris' | 'malformed_flood' | 'midbody_disconnect'
+    — and, when the soak terminates TLS (round 20), the handshake-abuse
+    shapes: 'tls_slowloris' (drip a ClientHello one byte at a time into
+    the native handshake deadline), 'tls_midhandshake' (flood of
+    connections dropped mid-handshake — the reaper must count and reap
+    every one), 'tls_wrong_ca' (clients that refuse the server
+    certificate, aborting with an alert the server must absorb as a
+    counted handshake failure)
     """
 
     kind: str
@@ -445,10 +452,13 @@ def build_trace(
     validate_policy: str = "pod-privileged",
     raw_policy: str = "raw-mutation",
     abuse_waves: int = 3,
+    tls: bool = False,
 ) -> Trace:
     """The composed soak trace: every stream generated from ONE seeded
     rng, shuffled into a single interleaving (the interactions are the
-    point), plus the abuse-wave schedule."""
+    point), plus the abuse-wave schedule. ``tls=True`` appends the
+    handshake-abuse waves (the plaintext waves still run — over TLS —
+    so the post-handshake abuse coverage is preserved, not replaced)."""
     rng = random.Random(seed)
     items: list[ReviewItem] = []
     items += rollout_storm(
@@ -474,4 +484,12 @@ def build_trace(
                 ),
             )
         )
+    if tls:
+        abuse += [
+            AbuseWave(kind="tls_slowloris", conns=rng.randrange(2, 5),
+                      param=0.3),
+            AbuseWave(kind="tls_midhandshake",
+                      conns=rng.randrange(4, 10)),
+            AbuseWave(kind="tls_wrong_ca", conns=rng.randrange(3, 7)),
+        ]
     return Trace(items=items, abuse=abuse)

@@ -1,0 +1,119 @@
+"""Throwaway TLS material for tests, soak waves, and the bench — via the
+``openssl`` CLI only.
+
+The container deliberately lacks the ``cryptography`` package (the repo
+rule: no new dependencies), and the native frontend's own TLS layer
+loads libssl by ``dlopen`` for the same reason. Every surface that needs
+certificates — the differential corpus (tests/test_native_tls.py), the
+rotation chaos storm (tests/test_resilience_tls.py), the soak abuse
+waves (tools/soak), and the TLS bench line (tools/bench) — generates
+them HERE so the shapes stay consistent: a self-signed server identity,
+a private CA, and CA-signed client certificates for the mTLS paths.
+
+Everything is plain subprocess ``openssl``; :func:`openssl_available`
+gates the callers (skip, don't fail, where the binary is missing).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+__all__ = [
+    "openssl_available",
+    "self_signed_identity",
+    "make_ca",
+    "issue_cert",
+]
+
+
+def openssl_available() -> bool:
+    return shutil.which("openssl") is not None
+
+
+def _run(args: list[str]) -> None:
+    proc = subprocess.run(
+        ["openssl", *args], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"openssl {' '.join(args[:3])}... failed: "
+            f"{proc.stderr.strip()[:500]}"
+        )
+
+
+def self_signed_identity(
+    directory: str | os.PathLike,
+    *,
+    cn: str = "localhost",
+    days: int = 2,
+    stem: str = "server",
+) -> tuple[Path, Path]:
+    """One self-signed server identity; returns (cert_path, key_path).
+
+    RSA-2048 keeps handshake CPU representative of a real webhook
+    deployment without dragging the test wall-clock (ECDSA would be
+    faster to mint but the reference deployments ship RSA leaves).
+    """
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    cert, key = d / f"{stem}.pem", d / f"{stem}-key.pem"
+    _run([
+        "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(key), "-out", str(cert),
+        "-days", str(days), "-subj", f"/CN={cn}",
+        "-addext", f"subjectAltName=DNS:{cn},IP:127.0.0.1",
+    ])
+    return cert, key
+
+
+def make_ca(
+    directory: str | os.PathLike,
+    *,
+    cn: str = "test-ca",
+    days: int = 2,
+    stem: str = "ca",
+) -> tuple[Path, Path]:
+    """A private CA for mTLS client-certificate issuance; returns
+    (ca_cert_path, ca_key_path)."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    cert, key = d / f"{stem}.pem", d / f"{stem}-key.pem"
+    _run([
+        "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(key), "-out", str(cert),
+        "-days", str(days), "-subj", f"/CN={cn}",
+    ])
+    return cert, key
+
+
+def issue_cert(
+    directory: str | os.PathLike,
+    ca_cert: str | os.PathLike,
+    ca_key: str | os.PathLike,
+    *,
+    cn: str = "client",
+    days: int = 2,
+    stem: str | None = None,
+) -> tuple[Path, Path]:
+    """A CA-signed certificate (the mTLS client shape); returns
+    (cert_path, key_path). Issue from a DIFFERENT CA than the server
+    trusts to build the wrong-CA abuse client."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    stem = stem or cn
+    key, csr, cert = (
+        d / f"{stem}-key.pem", d / f"{stem}.csr", d / f"{stem}.pem"
+    )
+    _run([
+        "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={cn}",
+    ])
+    _run([
+        "x509", "-req", "-in", str(csr),
+        "-CA", str(ca_cert), "-CAkey", str(ca_key), "-CAcreateserial",
+        "-out", str(cert), "-days", str(days),
+    ])
+    return cert, key
