@@ -52,6 +52,11 @@ COPY tools/ tools/
 COPY tests/ tests/
 COPY Makefile pytest.ini cli-docs.md kubewarden-dashboard.json ./
 RUN make check
+# sanitizer lane: ASan+UBSan rebuilds of the natives, differential
+# corpora + structure-aware fuzzer, LSan teardown audit. Skips LOUDLY
+# (grep the log for SANITIZE_TOOLCHAIN_SKIP) when the stage's toolchain
+# lacks the sanitizer runtimes — never silently.
+RUN make sanitize
 
 FROM python:3.12-slim
 

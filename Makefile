@@ -4,11 +4,11 @@
 IMG ?= policy-server-tpu:latest
 
 .PHONY: all test unit-tests integration-tests bench chaos check docs \
-        docs-check fastenc httpfront natives soak-smoke soak image \
-        dev-stack dev-stack-down dryrun-multichip multichip \
+        docs-check fastenc httpfront natives sanitize soak-smoke soak \
+        image dev-stack dev-stack-down dryrun-multichip multichip \
         restart-drill phase-report clean
 
-all: natives test check soak-smoke multichip restart-drill phase-report
+all: natives test check sanitize soak-smoke multichip restart-drill phase-report
 
 # full suite on the 8-virtual-device CPU backend (tests/conftest.py)
 test:
@@ -76,7 +76,9 @@ phase-report:
 
 # the graftcheck CI gate (tools/graftcheck/): concurrency lint
 # (guarded-by + lock-order cycles), trace-purity lint, observability
-# counter<->OTLP<->dashboard consistency, failpoint/docs drift, and the
+# counter<->OTLP<->dashboard consistency, failpoint/docs drift, the
+# round-21 native checkers (NA01-NA03 ABI drift across the C++/ctypes
+# boundary, NW00-NW03 wire-parser bounds analysis over csrc/), and the
 # cli-docs regeneration diff. Suppressions live in
 # tools/graftcheck/baseline.json (explicit + justified; stale entries
 # fail).
@@ -99,6 +101,18 @@ httpfront:
 # fallbacks, so these targets exit nonzero on a failed build — CI sees
 # the breakage instead of silently shipping the fallback
 natives: fastenc httpfront
+
+# sanitizer lane (round 21, tools/sanitize_lane.py): rebuild all three
+# natives with ASan+UBSan into distinct -san.so artifacts, run the
+# native differential corpora and the structure-aware fuzzer
+# (tools/fuzz_native.py) under the instrumented builds, then a
+# LeakSanitizer audit of the teardown paths (SSL_CTX rotation, rings
+# with in-flight completions, the wedged-drainer intentional leak —
+# suppressions curated in tools/lsan.supp). Skips LOUDLY
+# (SANITIZE_TOOLCHAIN_SKIP) when the toolchain cannot produce sanitized
+# builds — never silently.
+sanitize:
+	python -m tools.sanitize_lane
 
 docs:
 	python -m policy_server_tpu docs --output cli-docs.md

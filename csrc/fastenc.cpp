@@ -792,9 +792,12 @@ int64_t fastenc_encode_batch(void* handle, const char** jsons,
   if ((int64_t)arena_acc.size() > arena_cap ||
       (int64_t)records_acc.size() > records_cap)
     return -2;
-  memcpy(arena, arena_acc.data(), arena_acc.size());
-  memcpy(records, records_acc.data(),
-         records_acc.size() * sizeof(StringRecord));
+  // empty accumulators hand memcpy a null .data() — UB for a nonnull
+  // parameter even at n=0 (no strings in the batch is a real case)
+  if (!arena_acc.empty()) memcpy(arena, arena_acc.data(), arena_acc.size());
+  if (!records_acc.empty())
+    memcpy(records, records_acc.data(),
+           records_acc.size() * sizeof(StringRecord));
   return (int64_t)records_acc.size();
 }
 

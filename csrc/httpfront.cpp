@@ -122,6 +122,7 @@ const char* reason_of(int status) {
 constexpr int K_VALIDATE = 0, K_AUDIT = 1, K_RAW = 2, K_VALIDATE_FB = 3,
               K_AUDIT_FB = 4;
 
+// graftcheck: abi(policy_server_tpu/runtime/native_frontend.py:_REC)
 struct RecHeader {
   uint32_t total_len;
   uint64_t req_id;
@@ -132,12 +133,24 @@ struct RecHeader {
   int64_t t_first_ns, t_parse_ns, t_push_ns;
 } __attribute__((packed));
 
+// graftcheck: wire-input
 uint8_t* build_record(uint64_t req_id, int kind, bool has_ns,
                       const std::string& policy, const std::string& uid,
                       const std::string& ns, const std::string& op,
                       const std::string& gvk, const std::string& tp,
                       const std::string& payload, int64_t t_first,
                       int64_t t_parse, int64_t t_push) {
+  // every wire-length field is narrower than size_t: a field that does
+  // not fit its slot must fail the record, never truncate into a header
+  // whose lens disagree with the bytes that follow (the Python drainer
+  // would mis-split the record body). submit_request bounds the canon
+  // fields and routing bounds policy_id, so this rejects nothing in
+  // practice — it exists so the wire format cannot be corrupted by a
+  // future caller that forgets.
+  if (policy.size() > 0xFFFF || uid.size() > 0xFFFF || ns.size() > 0xFFFF ||
+      op.size() > 0xFFFF || gvk.size() > 0xFFFF || tp.size() > 0xFFFF ||
+      payload.size() > 0xFFFFFFFFull)
+    return nullptr;
   size_t total = sizeof(RecHeader) + policy.size() + uid.size() + ns.size() +
                  op.size() + gvk.size() + tp.size() + payload.size();
   uint8_t* blob = (uint8_t*)malloc(total);
@@ -274,6 +287,7 @@ struct Jp {
   }
 };
 
+// graftcheck: wire-input
 bool valid_utf8(const uint8_t* s, size_t n) {
   size_t i = 0;
   while (i < n) {
@@ -304,6 +318,7 @@ bool valid_utf8(const uint8_t* s, size_t n) {
 // lone surrogates and invalid escapes (Python tolerates lone surrogates;
 // re-emitting them byte-exactly needs surrogate bookkeeping we skip —
 // fallback is correct, just slower).
+// graftcheck: wire-input
 bool jstr(Jp& ps, std::string& out) {
   if (ps.p >= ps.end || *ps.p != '"') return false;
   ps.p++;
@@ -419,6 +434,11 @@ void py_escape(const std::string& s, std::string& out) {
     if ((c & 0xE0) == 0xC0) { len = 2; cp = c & 0x1F; }
     else if ((c & 0xF0) == 0xE0) { len = 3; cp = c & 0x0F; }
     else { len = 4; cp = c & 0x07; }
+    // verdict-record fields reach here unvalidated (the Python packer
+    // is the trusted producer, but httpfront_render_verdict is exported
+    // for arbitrary bytes): a multibyte lead truncated by the end of
+    // the field must not read past it — clamp and escape the garbage
+    if (i + (size_t)len > n) len = (int)(n - i);
     for (int k = 1; k < len; k++) cp = (cp << 6) | (d[i + k] & 0x3F);
     i += len;
     if (cp < 0x10000) {
@@ -1433,6 +1453,20 @@ void submit_request(Loop* lp, Conn* c, const std::string& body,
                          c->traceparent, body, t_first, t0, now_ns());
     }
   }
+  if (rec == nullptr) {
+    // a field overflowed its wire slot (build_record refuses to
+    // truncate): answer 400 in-band — the request is malformed, and a
+    // silent drop would read as a network fault
+    f->stats[S_BAD_REQ].fetch_add(1, std::memory_order_relaxed);
+    PendingResp* raw_pr = pr.get();
+    c->pipeline.push_back(std::move(pr));
+    const StaticResp& st = f->statics[ST_400];
+    fill_response(lp, raw_pr, st.status, st.content_type, st.body, 0,
+                  st.extra);
+    f->stats[S_FRAMING_NS].fetch_add(now_ns() - t0,
+                                     std::memory_order_relaxed);
+    return;
+  }
   int pushed = lp->ring.push(rec);
   if (pushed < 0) {
     free(rec);
@@ -1520,6 +1554,7 @@ bool ieq(const char* a, size_t alen, const char* b) {
 
 // Parse as many complete requests as the input buffer holds. Returns false
 // when the connection was destroyed.
+// graftcheck: wire-input
 bool conn_parse(Loop* lp, Conn* c) {
   Front* f = lp->front;
   constexpr size_t MAX_HEAD = 64 * 1024;
@@ -1633,6 +1668,15 @@ bool conn_parse(Loop* lp, Conn* c) {
             break;
           }
         }
+      }
+      if (c->route >= 0 && c->policy_id.size() > 4096) {
+        // a policy id is a (tenant-qualified) resource name — K8s names
+        // top out at 253 chars. A multi-KB segment is abuse, and the
+        // record header's u16 length slot must never be asked to carry
+        // anything near the 64 KiB header cap: unknown-name 404, same
+        // as the aiohttp router.
+        c->route = -1;
+        c->policy_id.clear();
       }
       if (c->route >= 0 && method != "POST") c->route = -2;
       c->off += head_len;
@@ -1903,6 +1947,7 @@ void do_accept(Loop* lp) {
 // state machine until established, then SSL_read plaintext into the
 // SAME c->in the plaintext parser consumes — everything downstream of
 // the record layer is shared with the plaintext frontend byte for byte.
+// graftcheck: wire-input
 void tls_conn_read(Loop* lp, Conn* c) {
   Front* f = lp->front;
   TlsApi* a = tls_api();
@@ -2009,6 +2054,7 @@ void tls_conn_read(Loop* lp, Conn* c) {
   conn_parse(lp, c);  // flushes via conn_flush→tls_flush; may destroy
 }
 
+// graftcheck: wire-input
 void conn_read(Loop* lp, Conn* c) {
   if (c->ssl != nullptr) {
     tls_conn_read(lp, c);
@@ -2214,6 +2260,8 @@ void push_comp(Front* f, uint64_t req_id, int status, int retry_after,
 // empty); a present patch always renders patchType "JSONPatch" (the
 // Python packer refuses anything else). auditAnnotations never travels
 // natively — the Python responder stays the oracle for it.
+// graftcheck: abi(policy_server_tpu/runtime/native_frontend.py:_BULK_REC)
+// graftcheck: wire-input
 static bool parse_verdict_record(const uint8_t* buf, int64_t len,
                                  int64_t& off, uint64_t& req_id,
                                  std::string& body) {
@@ -2493,6 +2541,7 @@ void httpfront_destroy(void* h) {
 // Drain parsed requests into `buf` (concatenated records, each prefixed by
 // its u32 total_len). Blocks up to timeout_ms when nothing is pending.
 // Returns bytes written, 0 on timeout, -1 once stopped AND fully drained.
+// graftcheck: wire-input
 int64_t httpfront_poll(void* h, uint8_t* buf, int64_t cap, int timeout_ms) {
   Front* f = (Front*)h;
   int64_t deadline = now_ns() + (int64_t)timeout_ms * 1000000ll;
@@ -2557,6 +2606,7 @@ void httpfront_complete(void* h, uint64_t req_id, int status,
 // it once per batch and pays ONE ctypes crossing + ONE frontend lock
 // instead of one per request, and the full response shape (patches,
 // warnings, status reason/details tables) renders natively.
+// graftcheck: wire-input
 void httpfront_complete_verdict_bulk(void* h, const uint8_t* buf,
                                      int64_t len, int64_t count) {
   Front* f = (Front*)h;
@@ -2580,6 +2630,7 @@ void httpfront_complete_verdict_bulk(void* h, const uint8_t* buf,
 // insufficient capacity. This is the SAME parse+emit path serving uses,
 // so the byte-exactness the corpus proves is the byte-exactness
 // production emits.
+// graftcheck: wire-input
 int64_t httpfront_render_verdict(const uint8_t* buf, int64_t len,
                                  uint8_t* out, int64_t cap) {
   int64_t off = 0;

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 import subprocess
 import sys
 import sysconfig
@@ -49,11 +50,20 @@ def _build_library() -> Path | None:
     out_dir = _REPO_ROOT / "build"
     out_dir.mkdir(exist_ok=True)
     tag = sysconfig.get_config_var("SOABI") or f"py{sys.version_info[0]}{sys.version_info[1]}"
-    out = out_dir / f"fastenc-{tag}.so"
+    # POLICY_SERVER_NATIVE_SAN=asan (tools/sanitize_lane.py): sanitized
+    # variant under a distinct name, production cache untouched
+    san = os.environ.get("POLICY_SERVER_NATIVE_SAN", "") == "asan"
+    out = out_dir / f"fastenc-{tag}{'-san' if san else ''}.so"
     if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
         return out
+    opt = (
+        ["-O1", "-g", "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=all"]
+        if san
+        else ["-O2"]
+    )
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "g++", *opt, "-shared", "-fPIC", "-std=c++17",
         str(_SRC), "-o", str(out),
     ]
     try:

@@ -40,6 +40,7 @@ from __future__ import annotations
 import ctypes
 import json
 import math
+import os
 import socket
 import struct
 import subprocess
@@ -118,11 +119,21 @@ def _build_library() -> Path | None:
     tag = sysconfig.get_config_var("SOABI") or (
         f"py{sys.version_info[0]}{sys.version_info[1]}"
     )
-    out = out_dir / f"httpfront-{tag}.so"
+    # POLICY_SERVER_NATIVE_SAN=asan (tools/sanitize_lane.py) builds an
+    # ASan+UBSan-instrumented variant under a distinct name so the
+    # sanitize lane never poisons the production build cache
+    san = os.environ.get("POLICY_SERVER_NATIVE_SAN", "") == "asan"
+    out = out_dir / f"httpfront-{tag}{'-san' if san else ''}.so"
     if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
         return out
+    opt = (
+        ["-O1", "-g", "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=all"]
+        if san
+        else ["-O2"]
+    )
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        "g++", *opt, "-shared", "-fPIC", "-std=c++17", "-pthread",
         str(_SRC), "-o", str(out), "-ldl",
     ]
     try:
@@ -881,6 +892,9 @@ PYTHON_ONLY_STATUS_FIELDS: frozenset = frozenset()
 _BULK_REC = struct.Struct("<QBBBBiiiiii")
 _WARN_LEN = struct.Struct("<I")
 _CAUSE_LEN = struct.Struct("<ii")
+# the record's leading u64 alone — the in-band error path recovers
+# req_ids from records whose bulk fill failed as a unit
+_REC_REQ_ID = struct.Struct("<Q")
 # status codes ride an i32 with -1 as the absent sentinel: anything
 # outside [0, 2^31) must take the Python renderer (json has no such
 # bound; struct.pack would raise, not truncate)
@@ -1261,7 +1275,7 @@ class BatcherSink:
                 for record in records:
                     try:
                         frontend.complete(
-                            struct.unpack_from("<Q", record)[0], 500,
+                            _REC_REQ_ID.unpack_from(record)[0], 500,
                             _api_error_body(500, "Something went wrong"),
                         )
                     except Exception:  # noqa: BLE001

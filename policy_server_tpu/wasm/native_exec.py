@@ -49,6 +49,12 @@ _IF = 0x04
 
 _MEM_OPS = set(range(0x28, 0x3F))  # loads + stores (memarg offset in imm)
 
+# f32/f64 immediates and slot values cross the boundary as raw IEEE-754
+# bit patterns in 64-bit slots; one spelling per layout (graftcheck NA03)
+_SLOT_U64 = struct.Struct("<Q")
+_SLOT_I64 = struct.Struct("<q")
+_SLOT_F64 = struct.Struct("<d")
+
 
 class NativeUnsupported(Exception):
     """Module uses a construct the native core does not model."""
@@ -75,12 +81,21 @@ def _build_library() -> Path | None:
     out_dir = _REPO_ROOT / "build"
     out_dir.mkdir(exist_ok=True)
     tag = sysconfig.get_config_var("SOABI") or f"py{sys.version_info[0]}{sys.version_info[1]}"
-    out = out_dir / f"wasmint-{tag}.so"
+    # POLICY_SERVER_NATIVE_SAN=asan (tools/sanitize_lane.py): sanitized
+    # variant under a distinct name, production cache untouched
+    san = os.environ.get("POLICY_SERVER_NATIVE_SAN", "") == "asan"
+    out = out_dir / f"wasmint-{tag}{'-san' if san else ''}.so"
     if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
         return out
+    opt = (
+        ["-O1", "-g", "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=all"]
+        if san
+        else ["-O2"]
+    )
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            ["g++", *opt, "-shared", "-fPIC", "-std=c++17",
              str(_SRC), "-o", str(out)],
             check=True, capture_output=True, timeout=180,
         )
@@ -338,9 +353,7 @@ class _CompiledModule:
             elif op == 0x41 or op == 0x42:
                 ia[pc] = imm
             elif op in (0x43, 0x44):
-                ia[pc] = struct.unpack(
-                    "<q", struct.pack("<d", float(imm))
-                )[0]
+                ia[pc] = _SLOT_I64.unpack(_SLOT_F64.pack(float(imm)))[0]
             elif op >= 0xFC00:
                 sub = op & 0xFF
                 if sub in (8, 9):
@@ -585,13 +598,13 @@ class NativeInstance:
     @staticmethod
     def _encode_slot(value, valtype) -> int:
         if valtype in (F32, F64):
-            return struct.unpack("<Q", struct.pack("<d", float(value)))[0]
+            return _SLOT_U64.unpack(_SLOT_F64.pack(float(value)))[0]
         return int(value) & 0xFFFFFFFFFFFFFFFF
 
     @staticmethod
     def _decode_slot(bits: int, valtype):
         if valtype in (F32, F64):
-            return struct.unpack("<d", struct.pack("<Q", bits & 0xFFFFFFFFFFFFFFFF))[0]
+            return _SLOT_F64.unpack(_SLOT_U64.pack(bits & 0xFFFFFFFFFFFFFFFF))[0]
         v = bits & 0xFFFFFFFFFFFFFFFF
         return v - (1 << 64) if v >= (1 << 63) else v
 

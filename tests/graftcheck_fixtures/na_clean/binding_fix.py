@@ -1,0 +1,20 @@
+"""Consistent bindings for the clean NA fixture (see csrc_fix.cpp)."""
+
+import ctypes
+import struct
+
+_HDR = struct.Struct("<IHH")
+_REC2 = struct.Struct("<II")
+
+lib = ctypes.CDLL("libnat.so")
+
+lib.nat_create.argtypes = [ctypes.c_int]
+lib.nat_create.restype = ctypes.c_void_p
+
+lib.nat_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+lib.nat_poll.restype = ctypes.c_int64
+
+
+def frame(n, a, b):
+    # module-level Struct constants are the approved spelling
+    return _HDR.pack(n, a, b) + _REC2.pack(a, b)

@@ -1,0 +1,39 @@
+// Clean ABI fixture: the same constructs as na_drift with every axis
+// consistent — must produce zero findings.
+#include <cstdint>
+#include <cstring>
+
+// graftcheck: abi(binding_fix.py:_HDR)
+struct NatHdr {
+  uint32_t len;
+  uint16_t kind;
+  uint16_t flags;
+} __attribute__((packed));
+
+// offsets-mode anchor: hand-rolled fixed-header reads pinned to _REC2
+// graftcheck: abi(binding_fix.py:_REC2)
+static bool parse_hdr(const uint8_t* buf, int64_t len, int64_t off) {
+  if (len - off < 8) return false;
+  uint32_t a;
+  uint32_t b;
+  memcpy(&a, buf + off, 4);
+  memcpy(&b, buf + off + 4, 4);
+  off += 8;
+  return a <= b;
+}
+
+extern "C" {
+
+void* nat_create(int fd) {
+  (void)fd;
+  return nullptr;
+}
+
+int64_t nat_poll(void* h, uint8_t* buf, int64_t cap) {
+  (void)h;
+  (void)buf;
+  (void)cap;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,22 @@
+"""Seeded drifted bindings for the NA fixture (see csrc_fix.cpp)."""
+
+import ctypes
+import struct
+
+# drifted: the C NatHdr packs {u32, u16, u8}; this claims {u32, u16, u16}
+_HDR = struct.Struct("<IHH")
+
+lib = ctypes.CDLL("libnat.so")
+
+# no matching extern "C" export at all
+lib.nat_missing.argtypes = [ctypes.c_void_p]
+lib.nat_missing.restype = ctypes.c_int
+
+# arg2 is int64_t in C but bound as c_int; the int64_t return has no
+# declared restype (ctypes' implicit c_int truncates it)
+lib.nat_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+
+
+def frame(n):
+    # inline wire-format literal: the layout's second spelling
+    return struct.pack("<I", n)

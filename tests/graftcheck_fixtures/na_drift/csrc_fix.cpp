@@ -1,0 +1,33 @@
+// Seeded ABI-drift fixture: every construct here is wrong on exactly
+// one axis; test_graftcheck.py pins the finding each one must yield.
+#include <cstdint>
+#include <cstring>
+
+// drifted layout: C packs {u32, u16, u8}, binding_fix._HDR says "<IHH"
+// graftcheck: abi(binding_fix.py:_HDR)
+struct NatHdr {
+  uint32_t len;
+  uint16_t kind;
+  uint8_t flags;
+} __attribute__((packed));
+
+// packed wire struct with no abi anchor at all
+struct Orphan {
+  uint64_t a;
+} __attribute__((packed));
+
+extern "C" {
+
+void* nat_create(int fd) {
+  (void)fd;
+  return nullptr;
+}
+
+int64_t nat_poll(void* h, uint8_t* buf, int64_t cap) {
+  (void)h;
+  (void)buf;
+  (void)cap;
+  return 0;
+}
+
+}  // extern "C"

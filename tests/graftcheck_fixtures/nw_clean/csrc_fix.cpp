@@ -1,0 +1,62 @@
+// Clean wire-bounds fixture: the same shapes as nw_violation with the
+// guards in place, plus one bounds-ok escape — must yield zero findings.
+#include <cstdio>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// the memcpy'd length is range-checked before it reaches resize
+// graftcheck: wire-input
+static bool parse_rec(const uint8_t* buf, int64_t len) {
+  int64_t off = 0;
+  uint32_t n;
+  memcpy(&n, buf + off, 4);
+  off += 4;
+  if ((int64_t)n > len - off) return false;
+  std::vector<uint8_t> v;
+  v.resize(n);
+  return true;
+}
+
+// bounded replacement for the banned primitive
+static void copy_name(char* dst, size_t cap, const char* src) {
+  snprintf(dst, cap, "%s", src);
+}
+
+// the take(n, p) lambda idiom: passing a tainted count to a
+// locally-defined bounds-checking lambda counts as the dominating check
+// graftcheck: wire-input
+static bool parse_fields(const uint8_t* buf, int64_t len) {
+  int64_t off = 0;
+  auto take = [&](int64_t n, const uint8_t*& p) {
+    if (off + n > len) return false;
+    p = buf + off;
+    off += n;
+    return true;
+  };
+  uint32_t flen;
+  memcpy(&flen, buf + off, 4);
+  off += 4;
+  const uint8_t* fld;
+  if (!take(flen, fld)) return false;
+  std::string s((const char*)fld, (size_t)flen);
+  return true;
+}
+
+// narrowing cast dominated by an explicit range check
+// graftcheck: wire-input
+static uint16_t header_len(const std::string& out) {
+  if (out.size() > 0xFFFF) return 0;
+  uint16_t plen = (uint16_t)out.size();
+  return plen;
+}
+
+// the escape hatch: a cast the analysis would flag, annotated with why
+// it is safe
+// graftcheck: wire-input
+static uint16_t digest_len(const std::string& out) {
+  // graftcheck: bounds-ok(digest strings are fixed 32-byte hex)
+  uint16_t dlen = (uint16_t)out.size();
+  return dlen;
+}

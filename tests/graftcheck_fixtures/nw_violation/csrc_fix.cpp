@@ -1,0 +1,32 @@
+// Seeded wire-bounds violations: each function is wrong on exactly one
+// axis; test_graftcheck.py pins the finding each one must yield.
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// NW01: a memcpy'd wire length drives resize with no dominating check
+// graftcheck: wire-input
+static bool parse_rec(const uint8_t* buf, int64_t len) {
+  (void)len;
+  int64_t off = 0;
+  uint32_t n;
+  memcpy(&n, buf + off, 4);
+  std::vector<uint8_t> v;
+  v.resize(n);
+  return true;
+}
+
+// NW02: banned unbounded copy primitive (flagged file-wide, no
+// wire-input annotation needed)
+static void copy_name(char* dst, const char* src) {
+  strcpy(dst, src);
+}
+
+// NW03: narrowing cast of a size_t-valued .size() with no dominating
+// range check
+// graftcheck: wire-input
+static uint16_t header_len(const std::string& out) {
+  uint16_t plen = (uint16_t)out.size();
+  return plen;
+}

@@ -519,3 +519,91 @@ def test_respshape_repo_classification_is_total():
     from tools.graftcheck import respshape
 
     assert respshape.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# Checkers 8/9 — native ABI drift + wire-parser bounds (round 21)
+# ---------------------------------------------------------------------------
+
+
+def test_native_abi_drift_fixture_flags_every_seeded_violation():
+    from tools.graftcheck import native_abi
+
+    d = FIXTURES / "na_drift"
+    findings = native_abi.check(
+        d, csrc_paths=[d / "csrc_fix.cpp"], py_paths=[d / "binding_fix.py"]
+    )
+    assert rules_of(findings) == {"NA01", "NA02", "NA03"}
+    # NA01: phantom binding, incompatible argtype, missing 64-bit restype
+    assert symbols_of(findings, "NA01") == {
+        "nat_missing", "nat_poll:arg2", "nat_poll:restype",
+    }
+    # NA02: drifted anchored layout + unanchored packed struct
+    assert symbols_of(findings, "NA02") == {"abi:NatHdr", "abi:Orphan"}
+    # NA03: inline wire-format literal
+    assert symbols_of(findings, "NA03") == {"inline-fmt:<I"}
+
+
+def test_native_abi_clean_fixture_passes():
+    """Struct-mode AND offsets-mode anchors resolve with zero findings
+    when both sides agree."""
+    from tools.graftcheck import native_abi
+
+    c = FIXTURES / "na_clean"
+    assert native_abi.check(
+        c, csrc_paths=[c / "csrc_fix.cpp"], py_paths=[c / "binding_fix.py"]
+    ) == []
+
+
+def test_native_bounds_violation_fixture_flags_every_seeded_violation():
+    from tools.graftcheck import native_bounds
+
+    v = FIXTURES / "nw_violation"
+    findings = native_bounds.check(v, csrc_paths=[v / "csrc_fix.cpp"])
+    assert rules_of(findings) == {"NW01", "NW02", "NW03"}
+    assert symbols_of(findings, "NW01") == {"parse_rec:n:resize"}
+    assert symbols_of(findings, "NW02") == {"banned:strcpy"}
+    assert symbols_of(findings, "NW03") == {"header_len:narrow:out.size()"}
+
+
+def test_native_bounds_clean_fixture_passes():
+    """Range checks, the take() lambda idiom, snprintf, a dominating
+    size check, and the bounds-ok escape all clear the lint."""
+    from tools.graftcheck import native_bounds
+
+    c = FIXTURES / "nw_clean"
+    assert native_bounds.check(c, csrc_paths=[c / "csrc_fix.cpp"]) == []
+
+
+def test_native_checkers_repo_clean_and_armed():
+    """Acceptance: both native checkers run clean on the live tree with
+    an EMPTY baseline, and the bounds lint is armed (NW00 would fire if
+    csrc/httpfront.cpp lost its wire-input annotations)."""
+    from tools.graftcheck import native_abi, native_bounds
+
+    assert native_abi.check(REPO_ROOT) == []
+    assert native_bounds.check(REPO_ROOT) == []
+    assert load_baseline(REPO_ROOT / "tools/graftcheck/baseline.json") == {}
+
+
+def test_native_abi_stale_baseline_fails():
+    """A baseline entry naming a fixed NA finding is reported stale —
+    the suppression cannot outlive the bug."""
+    from tools.graftcheck import native_abi
+
+    d = FIXTURES / "na_drift"
+    findings = native_abi.check(
+        d, csrc_paths=[d / "csrc_fix.cpp"], py_paths=[d / "binding_fix.py"]
+    )
+    baseline = {
+        "NA01:binding_fix.py:nat_missing": "known, tracked",
+        "NA02:gone.cpp:abi:Retired": "fixed two rounds ago",
+    }
+    res = apply_baseline(findings, baseline)
+    assert res.stale == ["NA02:gone.cpp:abi:Retired"]
+    suppressed = {s[0].symbol for s in res.suppressed}
+    assert suppressed == {"nat_missing"}
+    assert {f.symbol for f in res.new} == {
+        "nat_poll:arg2", "nat_poll:restype",
+        "abi:NatHdr", "abi:Orphan", "inline-fmt:<I",
+    }

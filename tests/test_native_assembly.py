@@ -232,27 +232,27 @@ def test_classification_is_total_over_the_model():
 def test_malformed_records_answer_minus_one_not_crash():
     """The native emitter is exported for arbitrary test input: length
     fields that wrap signed sentinels (warning len >= 2^31) or giant
-    cause counts must answer None (C -1), never crash the process."""
-    import struct as _struct
+    cause counts must answer None (C -1), never crash the process.
 
-    def rec(flags, n_warn, n_causes, tail=b""):
-        return nf._BULK_REC.pack(
-            1, 1, 0, flags, n_warn, -1, 1, -1, -1, -1, n_causes
-        ) + b"u" + tail
+    Round 21: the cases live in tools/fuzz_native.py's shared
+    verdict_record_corpus() — the same seeds the structure-aware fuzzer
+    mutates under ``make sanitize``, so the unit test and the fuzzer can
+    never drift apart."""
+    from tools.fuzz_native import verdict_record_corpus
 
-    # warning length with the top bit set (0x80000010)
-    assert nf.render_verdict_bytes(
-        rec(2, 1, -1, _struct.pack("<I", 0x80000010))
-    ) is None
-    # huge warning length that exceeds the buffer
-    assert nf.render_verdict_bytes(
-        rec(2, 1, -1, _struct.pack("<I", 1 << 30))
-    ) is None
-    # giant cause count with no backing bytes
-    assert nf.render_verdict_bytes(rec(1, 0, 0x7FFFFFFF)) is None
-    # truncated record
-    assert nf.render_verdict_bytes(b"\x01\x02\x03") is None
-    # a well-formed record still renders after all that
+    corpus = verdict_record_corpus()
+    # the promoted round-19 regressions must still be in the corpus
+    assert {n for n, _, e in corpus if e == "reject"} >= {
+        "r19-warnlen-topbit", "r19-warnlen-oversize",
+        "r19-causes-giant", "r19-truncated",
+    }
+    for name, record, expect in corpus:
+        rendered = nf.render_verdict_bytes(record)
+        if expect == "reject":
+            assert rendered is None, name
+        else:
+            assert rendered is not None, name
+    # a model-packed record still renders after all that
     ok = nf.pack_verdict_record(1, AdmissionResponse(uid="u", allowed=True), False)
     assert nf.render_verdict_bytes(ok) is not None
 

@@ -20,6 +20,8 @@ from pathlib import Path
 from tools.graftcheck import (
     concurrency,
     failpoint_drift,
+    native_abi,
+    native_bounds,
     observability,
     respshape,
     statestore_fs,
@@ -72,6 +74,8 @@ def run_checkers(root: Path, skip_docs: bool = False) -> list[Finding]:
     findings += failpoint_drift.check(root)
     findings += statestore_fs.check(root)
     findings += respshape.check(root)
+    findings += native_abi.check(root)
+    findings += native_bounds.check(root)
     if not skip_docs:
         findings += docs_drift(root)
     return findings

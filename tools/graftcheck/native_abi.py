@@ -1,0 +1,647 @@
+"""native_abi — ABI-drift lint across the C++/ctypes boundary (NA01-NA03).
+
+The measured fast path crosses the language boundary three ways, and all
+three have drifted by hand before (round 18 widened RecHeader, round 19
+retired a record format): the ``extern "C"`` export signatures vs the
+ctypes ``argtypes``/``restype`` declarations, the packed wire structs /
+hand-rolled parse offsets vs the Python ``struct.Struct`` constants, and
+ad-hoc inline format strings that silently fork a wire layout. A
+one-sided edit corrupts frames at runtime; this checker makes it fail
+``make check`` instead.
+
+NA01 — every ``lib.<name>.argtypes``/``restype`` declaration in the
+binding modules must match a C export of the same name: same arity,
+width/sign-compatible integer types, pointer-compatible buffer types,
+and a declared ``restype`` whenever the C return is a pointer or 64-bit
+integer (ctypes' implicit ``c_int`` default truncates those on LP64).
+Unknown typedefs (function-pointer callbacks) are skipped on either
+side — under-approximation beats false alarms.
+
+NA02 — packed record layouts are tied together with an explicit anchor
+comment in the C++ source::
+
+    // graftcheck: abi(policy_server_tpu/runtime/native_frontend.py:_REC)
+    struct RecHeader { ... } __attribute__((packed));
+
+For a struct anchor the field list is expanded to a ``struct`` format
+character sequence and diffed against the referenced module-level
+``struct.Struct`` (or plain format-string) constant. For a function
+anchor (a hand-rolled offset parser like ``parse_verdict_record``) the
+fixed-header reads — ``memcpy(&v, buf + off + K, N)``, ``buf[off + K]``
+— and the first constant ``off += N`` advance are collected into an
+(offset, size) map and diffed against the Python Struct's computed
+field offsets. Any ``__attribute__((packed))`` struct *without* an
+anchor is itself a finding: un-anchored layouts are exactly the ones
+that drift.
+
+NA03 — inline ``struct.pack``/``unpack``/``unpack_from`` format
+literals in the binding modules are banned: every wire format must be a
+module-level ``struct.Struct`` constant so NA02 anchors (and round-over
+diffs) have one canonical spelling to check.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.graftcheck.base import Finding
+
+CHECKER = "native_abi"
+
+# binding / bridge modules scanned by default (repo-relative). These are
+# the only modules allowed to speak the native wire formats.
+DEFAULT_PY_PATHS = (
+    "policy_server_tpu/runtime/native_frontend.py",
+    "policy_server_tpu/runtime/frontend.py",
+    "policy_server_tpu/ops/fastenc.py",
+    "policy_server_tpu/wasm/native_exec.py",
+)
+
+# C type -> acceptable normalized ctypes spellings. A C type missing
+# from this table (function-pointer typedefs, opaque handles) skips the
+# comparison for that position.
+_SCALAR_COMPAT: dict[str, frozenset[str]] = {
+    "int": frozenset({"c_int", "c_int32"}),
+    "int32_t": frozenset({"c_int32", "c_int"}),
+    "unsigned": frozenset({"c_uint", "c_uint32"}),
+    "uint32_t": frozenset({"c_uint32", "c_uint"}),
+    "int64_t": frozenset({"c_int64", "c_longlong"}),
+    "uint64_t": frozenset({"c_uint64", "c_ulonglong"}),
+    "long": frozenset({"c_long", "c_int64"}),  # LP64: both are 64-bit
+    "size_t": frozenset({"c_size_t"}),
+    "double": frozenset({"c_double"}),
+    "float": frozenset({"c_float"}),
+    "bool": frozenset({"c_bool"}),
+    "void*": frozenset({"c_void_p"}),
+    "char*": frozenset({"c_char_p", "POINTER(c_char)", "c_void_p"}),
+    "uint8_t*": frozenset(
+        {"c_char_p", "POINTER(c_char)", "POINTER(c_uint8)", "c_void_p"}
+    ),
+    "int8_t*": frozenset({"c_char_p", "POINTER(c_int8)", "c_void_p"}),
+    "uint16_t*": frozenset({"POINTER(c_uint16)"}),
+    "int16_t*": frozenset({"POINTER(c_int16)"}),
+    "int*": frozenset({"POINTER(c_int)", "POINTER(c_int32)"}),
+    "int32_t*": frozenset({"POINTER(c_int32)", "POINTER(c_int)"}),
+    "uint32_t*": frozenset({"POINTER(c_uint32)", "POINTER(c_uint)"}),
+    "int64_t*": frozenset({"POINTER(c_int64)", "POINTER(c_longlong)"}),
+    "uint64_t*": frozenset({"POINTER(c_uint64)", "POINTER(c_ulonglong)"}),
+    # an out-parameter array of buffer pointers; ctypes models it as an
+    # array of void* because the pointee type never crosses the boundary
+    "uint8_t**": frozenset({"POINTER(c_void_p)", "POINTER(POINTER(c_uint8))"}),
+    "char**": frozenset({"POINTER(c_char_p)", "POINTER(c_void_p)"}),
+    "void**": frozenset({"POINTER(c_void_p)"}),
+}
+
+# C return types where ctypes' implicit int restype silently truncates:
+# a missing .restype declaration on these is a finding, not a style nit.
+_RESTYPE_REQUIRED = frozenset(
+    {"void*", "char*", "uint8_t*", "int64_t", "uint64_t", "double"}
+)
+
+# struct field type -> struct-module format char (little-endian packed)
+_FMT_OF_CTYPE: dict[str, str] = {
+    "uint8_t": "B", "int8_t": "b",
+    "uint16_t": "H", "int16_t": "h",
+    "uint32_t": "I", "int32_t": "i",
+    "uint64_t": "Q", "int64_t": "q",
+    "double": "d", "float": "f",
+}
+
+_FMT_SIZE = {"B": 1, "b": 1, "H": 2, "h": 2, "I": 4, "i": 4,
+             "Q": 8, "q": 8, "d": 8, "f": 4}
+
+# function definitions at file scope (inside extern "C" blocks these sit
+# at column 0); args may span lines. Over-matching internal helpers is
+# harmless — the join with the Python side is by bound name.
+_FN_DEF_RE = re.compile(
+    r"^(?:static\s+)?((?:const\s+)?[A-Za-z_]\w*(?:\s*\*+)?)\s+"
+    r"([A-Za-z_]\w*)\s*\(([^)]*)\)\s*\{",
+    re.M,
+)
+
+_ABI_ANCHOR_RE = re.compile(r"//\s*graftcheck:\s*abi\(([^)]+)\)")
+_PACKED_STRUCT_RE = re.compile(
+    r"struct\s+(\w+)\s*\{(.*?)\}\s*__attribute__\s*\(\s*\(\s*packed\s*\)\s*\)",
+    re.S,
+)
+_STRUCT_FIELD_RE = re.compile(
+    r"^\s*([A-Za-z_]\w*)\s+(\w+(?:\s*\[\s*\d+\s*\])?"
+    r"(?:\s*,\s*\w+(?:\s*\[\s*\d+\s*\])?)*)\s*;",
+)
+_MEMCPY_READ_RE = re.compile(
+    r"memcpy\(\s*&\w+\s*,\s*buf\s*\+\s*off(?:\s*\+\s*(\d+))?\s*,\s*(\d+)\s*\)"
+)
+_BYTE_READ_RE = re.compile(r"buf\[\s*off(?:\s*\+\s*(\d+))?\s*\]")
+_OFF_ADVANCE_RE = re.compile(r"\boff\s*\+=\s*(\d+)\s*;")
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return str(path.relative_to(root))
+    except ValueError:
+        return str(path)
+
+
+def _norm_c_param(param: str) -> str | None:
+    """``const uint8_t* buf`` -> ``uint8_t*``; None for unparseable."""
+    p = re.sub(r"/\*.*?\*/", " ", param).strip()
+    if not p or p == "void" or p == "...":
+        return None
+    p = re.sub(r"\bconst\b", " ", p)
+    m = re.match(r"^\s*([A-Za-z_]\w*)\s*((?:\*\s*)*)\s*([A-Za-z_]\w*)?\s*$", p)
+    if m is None:
+        return "?"
+    stars = m.group(2).count("*")
+    return m.group(1) + "*" * stars
+
+
+def parse_c_exports(text: str) -> dict[str, dict]:
+    """name -> {ret, args: [normalized C types], line}."""
+    out: dict[str, dict] = {}
+    for m in _FN_DEF_RE.finditer(text):
+        ret = re.sub(r"\s+", "", re.sub(r"\bconst\b", "", m.group(1)))
+        name = m.group(2)
+        raw_args = m.group(3).strip()
+        args: list[str | None] = []
+        if raw_args and raw_args != "void":
+            for piece in raw_args.split(","):
+                args.append(_norm_c_param(piece))
+        out[name] = {
+            "ret": ret,
+            "args": args,
+            "line": text.count("\n", 0, m.start()) + 1,
+        }
+    return out
+
+
+def _norm_ctype_node(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        fn = _norm_ctype_node(node.func)
+        if fn == "POINTER" and len(node.args) == 1:
+            return f"POINTER({_norm_ctype_node(node.args[0])})"
+        return fn
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    return "?"
+
+
+def parse_py_bindings(tree: ast.AST) -> dict[str, dict]:
+    """fn name -> {argtypes: [...] | None, restype: str | None, line}."""
+    out: dict[str, dict] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Attribute):
+            continue
+        if tgt.attr not in ("argtypes", "restype"):
+            continue
+        if not isinstance(tgt.value, ast.Attribute):
+            continue
+        fname = tgt.value.attr
+        rec = out.setdefault(
+            fname, {"argtypes": None, "restype": None, "line": node.lineno}
+        )
+        if tgt.attr == "argtypes":
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                rec["argtypes"] = [_norm_ctype_node(e) for e in node.value.elts]
+        else:
+            rec["restype"] = _norm_ctype_node(node.value)
+        rec["line"] = min(rec["line"], node.lineno)
+    return out
+
+
+def _module_structs(tree: ast.AST) -> dict[str, tuple[str, int]]:
+    """Module-level ``NAME = struct.Struct("fmt")`` or ``NAME = "<fmt"``
+    constants -> name -> (fmt, line)."""
+    out: dict[str, tuple[str, int]] = {}
+    for node in ast.iter_child_nodes(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        val = node.value
+        if (
+            isinstance(val, ast.Call)
+            and _norm_ctype_node(val.func) == "Struct"
+            and val.args
+            and isinstance(val.args[0], ast.Constant)
+            and isinstance(val.args[0].value, str)
+        ):
+            out[tgt.id] = (val.args[0].value, node.lineno)
+        elif (
+            isinstance(val, ast.Constant)
+            and isinstance(val.value, str)
+            and re.fullmatch(r"[<>=!@]?[0-9a-zA-Z]*", val.value)
+            and any(ch in _FMT_SIZE for ch in val.value)
+        ):
+            out[tgt.id] = (val.value, node.lineno)
+    return out
+
+
+def _expand_fmt(fmt: str) -> list[str] | None:
+    """'<IQBB6HI3q' -> ['I','Q','B','B','H'*6,'I','q'*3]; None if a char
+    is outside the fixed-width integer/float set this checker models."""
+    body = fmt[1:] if fmt[:1] in "<>=!@" else fmt
+    out: list[str] = []
+    count = ""
+    for ch in body:
+        if ch.isdigit():
+            count += ch
+            continue
+        if ch == "x":
+            out.extend("x" * (int(count) if count else 1))
+            count = ""
+            continue
+        if ch not in _FMT_SIZE:
+            return None
+        out.extend(ch * (int(count) if count else 1))
+        count = ""
+    return out
+
+
+def _fmt_layout(fmt: str) -> tuple[list[tuple[int, int]], int] | None:
+    """(offset, size) per field + total size for a packed format."""
+    chars = _expand_fmt(fmt)
+    if chars is None:
+        return None
+    fields: list[tuple[int, int]] = []
+    off = 0
+    for ch in chars:
+        size = 1 if ch == "x" else _FMT_SIZE[ch]
+        if ch != "x":
+            fields.append((off, size))
+        off += size
+    return fields, off
+
+
+def _struct_fields_to_fmt(body: str) -> list[str] | None:
+    """C struct body -> expected format char sequence; None on an
+    unmodeled field type (pointers, nested structs)."""
+    out: list[str] = []
+    for line in body.splitlines():
+        line = re.sub(r"//.*", "", line)
+        m = _STRUCT_FIELD_RE.match(line)
+        if m is None:
+            if line.strip() and not line.strip().startswith("/*"):
+                # a field we cannot model makes the whole diff unsound
+                if re.search(r"\w\s+\w", line):
+                    return None
+            continue
+        ctype = m.group(1)
+        ch = _FMT_OF_CTYPE.get(ctype)
+        if ch is None:
+            return None
+        for decl in m.group(2).split(","):
+            arr = re.search(r"\[\s*(\d+)\s*\]", decl)
+            out.extend(ch * (int(arr.group(1)) if arr else 1))
+    return out
+
+
+def _anchor_targets(text: str) -> list[dict]:
+    """Each ``// graftcheck: abi(file:CONST)`` with the construct that
+    follows it: a packed struct (mode=struct, fields) or a function
+    (mode=offsets, header reads + advance)."""
+    out: list[dict] = []
+    for m in _ABI_ANCHOR_RE.finditer(text):
+        target = m.group(1).strip()
+        line = text.count("\n", 0, m.start()) + 1
+        rest = text[m.end():]
+        sm = re.match(r"\s*struct\s+(\w+)\s*\{", rest)
+        rec: dict = {"target": target, "line": line}
+        if sm is not None:
+            depth, i = 1, sm.end()
+            while i < len(rest) and depth:
+                if rest[i] == "{":
+                    depth += 1
+                elif rest[i] == "}":
+                    depth -= 1
+                i += 1
+            rec.update(
+                mode="struct",
+                name=sm.group(1),
+                body=rest[sm.end(): i - 1],
+                packed=bool(
+                    re.match(
+                        r"\s*__attribute__\s*\(\s*\(\s*packed\s*\)\s*\)",
+                        rest[i:],
+                    )
+                ),
+            )
+        else:
+            fm = re.search(r"([A-Za-z_]\w*)\s*\([^)]*\)\s*\{", rest[:400])
+            if fm is None:
+                rec.update(mode="dangling")
+                out.append(rec)
+                continue
+            start = rest.index("{", fm.start())
+            depth, i = 1, start + 1
+            while i < len(rest) and depth:
+                if rest[i] == "{":
+                    depth += 1
+                elif rest[i] == "}":
+                    depth -= 1
+                i += 1
+            body = rest[start: i]
+            # fixed header = reads before the first constant `off +=`
+            adv = _OFF_ADVANCE_RE.search(body)
+            header = body[: adv.start()] if adv else body
+            reads = [
+                (int(g or 0), int(n))
+                for g, n in _MEMCPY_READ_RE.findall(header)
+            ]
+            reads += [(int(g or 0), 1) for g in _BYTE_READ_RE.findall(header)]
+            rec.update(
+                mode="offsets",
+                name=fm.group(1),
+                reads=sorted(reads),
+                advance=int(adv.group(1)) if adv else None,
+            )
+        out.append(rec)
+    return out
+
+
+def check(
+    root: str | Path,
+    csrc_paths: list[Path] | None = None,
+    py_paths: list[Path] | None = None,
+) -> list[Finding]:
+    root = Path(root)
+    if csrc_paths is None:
+        csrc_paths = sorted((root / "csrc").glob("*.cpp"))
+    if py_paths is None:
+        py_paths = [root / p for p in DEFAULT_PY_PATHS]
+    findings: list[Finding] = []
+
+    exports: dict[str, dict] = {}
+    export_file: dict[str, Path] = {}
+    csrc_texts: dict[Path, str] = {}
+    for cp in csrc_paths:
+        if not cp.exists():
+            continue
+        text = cp.read_text()
+        csrc_texts[cp] = text
+        for name, sig in parse_c_exports(text).items():
+            exports[name] = sig
+            export_file[name] = cp
+
+    # ---- NA01: ctypes bindings vs extern "C" signatures -----------------
+    py_trees: dict[Path, ast.AST] = {}
+    for pp in py_paths:
+        if not pp.exists():
+            continue
+        tree = ast.parse(pp.read_text())
+        py_trees[pp] = tree
+        for fname, b in parse_py_bindings(tree).items():
+            rel = _rel(pp, root)
+            sig = exports.get(fname)
+            if sig is None:
+                findings.append(
+                    Finding(
+                        CHECKER, "NA01", rel, b["line"], fname,
+                        f"ctypes binding `{fname}` has no matching "
+                        f"extern \"C\" export in csrc/ — renamed or removed "
+                        f"on one side only",
+                    )
+                )
+                continue
+            c_args = sig["args"]
+            py_args = b["argtypes"]
+            if py_args is None:
+                if c_args:
+                    findings.append(
+                        Finding(
+                            CHECKER, "NA01", rel, b["line"],
+                            f"{fname}:argtypes",
+                            f"`{fname}` takes {len(c_args)} argument(s) in C "
+                            f"but declares no .argtypes — every call site "
+                            f"relies on implicit int coercion",
+                        )
+                    )
+            elif len(py_args) != len(c_args):
+                findings.append(
+                    Finding(
+                        CHECKER, "NA01", rel, b["line"], f"{fname}:arity",
+                        f"`{fname}` argtypes declares {len(py_args)} "
+                        f"argument(s) but the C export takes {len(c_args)}",
+                    )
+                )
+            else:
+                for i, (c_t, py_t) in enumerate(zip(c_args, py_args)):
+                    if c_t is None or c_t not in _SCALAR_COMPAT:
+                        continue  # unmodeled typedef: skip, never guess
+                    if py_t in ("?",):
+                        continue
+                    if py_t not in _SCALAR_COMPAT[c_t]:
+                        findings.append(
+                            Finding(
+                                CHECKER, "NA01", rel, b["line"],
+                                f"{fname}:arg{i}",
+                                f"`{fname}` argument {i}: C declares "
+                                f"`{c_t}` but ctypes passes `{py_t}`",
+                            )
+                        )
+            ret = sig["ret"]
+            restype = b["restype"]
+            if ret in _RESTYPE_REQUIRED and restype in (None, "?"):
+                findings.append(
+                    Finding(
+                        CHECKER, "NA01", rel, b["line"], f"{fname}:restype",
+                        f"`{fname}` returns `{ret}` but declares no "
+                        f".restype — ctypes' implicit c_int default "
+                        f"truncates it on LP64",
+                    )
+                )
+            elif (
+                restype not in (None, "None", "?")
+                and ret in _SCALAR_COMPAT
+                and restype not in _SCALAR_COMPAT[ret]
+            ):
+                findings.append(
+                    Finding(
+                        CHECKER, "NA01", rel, b["line"], f"{fname}:restype",
+                        f"`{fname}` returns `{ret}` but .restype is "
+                        f"`{restype}`",
+                    )
+                )
+
+    # ---- NA02: packed layouts vs struct.Struct constants ----------------
+    struct_consts: dict[str, dict[str, tuple[str, int]]] = {}
+    for pp, tree in py_trees.items():
+        struct_consts[_rel(pp, root)] = _module_structs(tree)
+
+    for cp, text in csrc_texts.items():
+        rel_c = _rel(cp, root)
+        anchored_names: set[str] = set()
+        for anc in _anchor_targets(text):
+            if anc["mode"] == "dangling":
+                findings.append(
+                    Finding(
+                        CHECKER, "NA02", rel_c, anc["line"],
+                        f"abi:{anc['target']}",
+                        "graftcheck abi anchor is not followed by a struct "
+                        "or function definition",
+                    )
+                )
+                continue
+            anchored_names.add(anc["name"])
+            target = anc["target"]
+            if ":" not in target:
+                findings.append(
+                    Finding(
+                        CHECKER, "NA02", rel_c, anc["line"], f"abi:{target}",
+                        "abi anchor must name `<repo-relative .py>:<CONST>`",
+                    )
+                )
+                continue
+            tfile, tconst = target.rsplit(":", 1)
+            consts = struct_consts.get(tfile)
+            if consts is None:
+                tp = root / tfile
+                if tp.exists():
+                    consts = _module_structs(ast.parse(tp.read_text()))
+                    struct_consts[tfile] = consts
+            entry = (consts or {}).get(tconst)
+            if entry is None:
+                findings.append(
+                    Finding(
+                        CHECKER, "NA02", rel_c, anc["line"],
+                        f"abi:{anc['name']}",
+                        f"abi anchor references `{target}` but no such "
+                        f"module-level struct constant exists",
+                    )
+                )
+                continue
+            fmt, _fline = entry
+            if not fmt.startswith("<"):
+                findings.append(
+                    Finding(
+                        CHECKER, "NA02", rel_c, anc["line"],
+                        f"abi:{anc['name']}",
+                        f"`{target}` format {fmt!r} is not explicitly "
+                        f"little-endian packed ('<' prefix) — native "
+                        f"structs are",
+                    )
+                )
+                continue
+            if anc["mode"] == "struct":
+                if not anc["packed"]:
+                    findings.append(
+                        Finding(
+                            CHECKER, "NA02", rel_c, anc["line"],
+                            f"abi:{anc['name']}",
+                            f"struct {anc['name']} carries an abi anchor "
+                            f"but is not __attribute__((packed)) — the "
+                            f"compiler may pad it",
+                        )
+                    )
+                    continue
+                expected = _struct_fields_to_fmt(anc["body"])
+                actual = _expand_fmt(fmt)
+                if expected is None:
+                    findings.append(
+                        Finding(
+                            CHECKER, "NA02", rel_c, anc["line"],
+                            f"abi:{anc['name']}",
+                            f"struct {anc['name']} has a field type this "
+                            f"checker cannot model — restructure or drop "
+                            f"the anchor",
+                        )
+                    )
+                elif actual is None or expected != [
+                    c for c in actual if c != "x"
+                ]:
+                    findings.append(
+                        Finding(
+                            CHECKER, "NA02", rel_c, anc["line"],
+                            f"abi:{anc['name']}",
+                            f"struct {anc['name']} layout "
+                            f"[{''.join(expected)}] != {target} format "
+                            f"{fmt!r} — one side changed without the other",
+                        )
+                    )
+            else:  # offsets mode
+                layout = _fmt_layout(fmt)
+                if layout is None:
+                    findings.append(
+                        Finding(
+                            CHECKER, "NA02", rel_c, anc["line"],
+                            f"abi:{anc['name']}",
+                            f"{target} format {fmt!r} has chars this "
+                            f"checker cannot model",
+                        )
+                    )
+                    continue
+                fields, total = layout
+                problems = []
+                if sorted(fields) != anc["reads"]:
+                    problems.append(
+                        f"field reads {anc['reads']} != {target} layout "
+                        f"{sorted(fields)}"
+                    )
+                if anc["advance"] is not None and anc["advance"] != total:
+                    problems.append(
+                        f"fixed-header advance `off += {anc['advance']}` != "
+                        f"{target} size {total}"
+                    )
+                if anc["advance"] is None:
+                    problems.append(
+                        "no constant `off += N` advance found to pin the "
+                        "fixed-header size"
+                    )
+                for prob in problems:
+                    findings.append(
+                        Finding(
+                            CHECKER, "NA02", rel_c, anc["line"],
+                            f"abi:{anc['name']}",
+                            f"{anc['name']} vs {target}: {prob}",
+                        )
+                    )
+        for sm in _PACKED_STRUCT_RE.finditer(text):
+            if sm.group(1) in anchored_names:
+                continue
+            line = text.count("\n", 0, sm.start()) + 1
+            findings.append(
+                Finding(
+                    CHECKER, "NA02", rel_c, line, f"abi:{sm.group(1)}",
+                    f"packed struct {sm.group(1)} has no `// graftcheck: "
+                    f"abi(<file>:<CONST>)` anchor — its Python mirror "
+                    f"cannot be drift-checked",
+                )
+            )
+
+    # ---- NA03: inline wire-format literals ------------------------------
+    for pp, tree in py_trees.items():
+        rel = _rel(pp, root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "struct"
+                and fn.attr in (
+                    "pack", "unpack", "pack_into", "unpack_from", "calcsize"
+                )
+            ):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant):
+                findings.append(
+                    Finding(
+                        CHECKER, "NA03", rel, node.lineno,
+                        f"inline-fmt:{node.args[0].value}",
+                        f"inline struct.{fn.attr}({node.args[0].value!r}, "
+                        f"...) — hoist the format to a module-level "
+                        f"struct.Struct constant so the layout has one "
+                        f"checkable spelling",
+                    )
+                )
+    return findings

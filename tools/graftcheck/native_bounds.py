@@ -1,0 +1,386 @@
+"""native_bounds — wire-parser bounds lint for csrc/ (NW01-NW03).
+
+A lightweight C++ analysis — comment/string-stripping tokenizer plus
+per-function dataflow, no libclang — over the functions that consume
+untrusted bytes (the round-19 review found exactly this bug class live:
+caller-supplied lengths in ``parse_verdict_record`` driving
+``std::string(nullptr, huge)`` and an unbounded ``reserve``).
+
+Functions are opted in with an annotation on the line above (or on) the
+definition::
+
+    // graftcheck: wire-input
+    bool conn_parse(Loop* lp, Conn* c) { ... }
+
+NW01 — inside a wire-input function, a *tainted* integer (assigned from
+``memcpy(&v, <buffer>, n)``, a buffer byte read, or ``strto*``) must be
+dominated by a bounds check before it reaches an allocation/copy sink:
+``reserve``/``resize``/``new T[n]``/``malloc``, ``std::string(p, n)`` /
+``assign``/``append``, ``memcpy``, or buffer-offset arithmetic. A check
+is a relational comparison naming the variable, OR passing it to a
+locally-defined lambda whose body bounds-checks its parameter (the
+``take(n, p)`` idiom). ``uint8_t``-typed reads are width-bounded (max
+255) and exempt from allocation-sink taint. An assignment whose RHS is
+itself a clamp (``a < b ? a : b``, ``std::min``/``max``/``clamp``)
+sanitizes the destination.
+
+NW02 — banned functions anywhere in csrc/ (unbounded copy/format/parse
+primitives with safe in-tree replacements): strcpy, strcat, sprintf,
+vsprintf, gets, alloca, atoi, atol, strtok, scanf family.
+
+NW03 — narrowing casts of length-like expressions inside wire-input
+functions: a cast to a <=16-bit type of anything tainted or carrying
+``.size()``/``.length()``, or a cast to a 32-bit type of a
+``.size()``/``.length()`` expression (size_t is 64-bit here). A
+dominating relational check on the same expression/variable clears it.
+
+Escape hatch for all three, on the flagged line or the line above::
+
+    // graftcheck: bounds-ok(<why this is safe>)
+
+NW00 — the lint must not go silently dead: in live-repo mode,
+csrc/httpfront.cpp (the socket-facing parser) must carry at least one
+wire-input annotation.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.graftcheck.base import Finding
+
+CHECKER = "native_bounds"
+
+_BANNED = (
+    "strcpy", "strcat", "sprintf", "vsprintf", "gets", "alloca",
+    "atoi", "atol", "strtok", "scanf", "sscanf", "fscanf",
+)
+_BANNED_RE = re.compile(r"\b(" + "|".join(_BANNED) + r")\s*\(")
+
+_WIRE_RE = re.compile(r"//\s*graftcheck:\s*wire-input\b")
+_OK_RE = re.compile(r"//\s*graftcheck:\s*bounds-ok\(([^)]*)\)")
+
+_FN_DEF_RE = re.compile(
+    r"^(?:static\s+)?(?:inline\s+)?[A-Za-z_][\w:<>,*&\s]*?"
+    r"\b([A-Za-z_]\w*)\s*\(([^)]*)\)\s*\{",
+    re.M,
+)
+
+_SMALL_DECL_RE = re.compile(r"\b(?:uint8_t|int8_t|bool|char)\s+(\w+)")
+_MEMCPY_TAINT_RE = re.compile(r"memcpy\(\s*&(\w+)\s*,")
+_BYTE_TAINT_RE = re.compile(r"\b(\w+)\s*=\s*[\w.>-]*\w+\s*\[")
+_STRTO_TAINT_RE = re.compile(r"\b(\w+)\s*=\s*strto(?:ll|ull|l|ul|d)\s*\(")
+_ASSIGN_RE = re.compile(r"(?:^|[^=<>!+\-*/&|])(?:[\w.]+->)?(\w+)\s*=\s*([^=].*)")
+_REL_RE = re.compile(r"[<>]=?")
+_LAMBDA_RE = re.compile(r"auto\s+(\w+)\s*=\s*\[[^\]]*\]\s*\(([^)]*)\)")
+_CLAMP_RE = re.compile(r"(std::)?(min|max|clamp)\s*\(|\?[^:]*:")
+
+# sink -> regex capturing the length-ish argument expression
+_SINK_RES: list[tuple[str, re.Pattern[str]]] = [
+    ("reserve", re.compile(r"\.\s*reserve\s*\(([^;]*)\)")),
+    ("resize", re.compile(r"\.\s*resize\s*\(([^;]*)\)")),
+    ("new[]", re.compile(r"\bnew\s+[\w:]+\s*\[([^\]]*)\]")),
+    ("malloc", re.compile(r"\bmalloc\s*\(([^;]*)\)")),
+    ("string(p,n)", re.compile(r"\bstring\s*\(\s*[^,;()]*,([^;]*)\)")),
+    ("assign", re.compile(r"\.\s*assign\s*\(\s*[^,;()]*,([^;]*)\)")),
+    ("append", re.compile(r"\.\s*append\s*\(\s*[^,;()]*,([^;]*)\)")),
+    ("memcpy", re.compile(r"\bmemcpy\s*\([^,]+,[^,]+,([^;]*)\)")),
+    ("ptr-arith", re.compile(r"\b(?:off|pos|cursor)\s*\+=\s*([^;]*);")),
+]
+
+_NARROW16 = r"u?int(?:8|16)_t|short|unsigned\s+short"
+_NARROW32 = r"int|int32_t|uint32_t|unsigned|unsigned\s+int"
+_CAST16_RE = re.compile(r"\(\s*(?:%s)\s*\)\s*([\w.\->]+(?:\(\))?)" % _NARROW16)
+_CAST32_RE = re.compile(r"\(\s*(?:%s)\s*\)\s*([\w.\->]+(?:\(\))?)" % _NARROW32)
+_SIZE_EXPR = re.compile(r"\.(size|length)\s*\(\s*\)")
+
+
+def _strip(text: str) -> str:
+    """Blank out comments and string/char literals, preserving newlines
+    and column positions, so regexes never match inside either."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                out[i] = " "
+                i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            while i + 1 < n and not (text[i] == "*" and text[i + 1] == "/"):
+                if text[i] != "\n":
+                    out[i] = " "
+                i += 1
+            if i + 1 < n:
+                out[i] = out[i + 1] = " "
+                i += 2
+        elif c in "\"'":
+            quote = c
+            out[i] = " "
+            i += 1
+            while i < n and text[i] != quote:
+                if text[i] == "\\":
+                    out[i] = " "
+                    i += 1
+                    if i < n and text[i] != "\n":
+                        out[i] = " "
+                        i += 1
+                    continue
+                if text[i] != "\n":
+                    out[i] = " "
+                i += 1
+            if i < n:
+                out[i] = " "
+                i += 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _match_brace(text: str, open_idx: int) -> int:
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(text) - 1
+
+
+def _functions(clean: str) -> list[dict]:
+    out = []
+    for m in _FN_DEF_RE.finditer(clean):
+        open_idx = clean.index("{", m.end() - 1)
+        end = _match_brace(clean, open_idx)
+        out.append(
+            {
+                "name": m.group(1),
+                "params": m.group(2),
+                "def_line": clean.count("\n", 0, m.start()) + 1,
+                "body_start": open_idx,
+                "body": clean[open_idx:end + 1],
+                "body_line": clean.count("\n", 0, open_idx) + 1,
+            }
+        )
+    return out
+
+
+def _checking_lambdas(body: str) -> set[str]:
+    """Names of locally-defined lambdas whose body bounds-checks a
+    parameter (the `take(n, p)` idiom): passing a var to one counts as
+    a dominating check on that var."""
+    out: set[str] = set()
+    for m in _LAMBDA_RE.finditer(body):
+        params = re.findall(r"(\w+)\s*(?:,|$)", m.group(2))
+        brace = body.find("{", m.end())
+        if brace < 0:
+            continue
+        lam_body = body[brace:_match_brace(body, brace) + 1]
+        for line in lam_body.splitlines():
+            if _REL_RE.search(line) and any(
+                re.search(r"\b%s\b" % re.escape(p), line) for p in params
+            ):
+                out.add(m.group(1))
+                break
+    return out
+
+
+def _analyze_wire_fn(
+    fn: dict, raw_lines: list[str], rel: str, findings: list[Finding]
+) -> None:
+    body = fn["body"]
+    base_line = fn["body_line"]
+    lines = body.splitlines()
+    lambdas = _checking_lambdas(body)
+    lam_call_res = {
+        name: re.compile(r"\b%s\s*\(([^)]*)\)" % re.escape(name))
+        for name in lambdas
+    }
+
+    small: set[str] = set(_SMALL_DECL_RE.findall(fn["params"]))
+    tainted: set[str] = set()
+    checked: set[str] = set()
+
+    def suppressed(lineno: int) -> str | None:
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(raw_lines):
+                mm = _OK_RE.search(raw_lines[ln - 1])
+                if mm:
+                    return mm.group(1)
+        return None
+
+    for idx, line in enumerate(lines):
+        lineno = base_line + idx
+        small.update(_SMALL_DECL_RE.findall(line))
+
+        # --- taint sources ---
+        for m in _MEMCPY_TAINT_RE.finditer(line):
+            if m.group(1) not in small:
+                tainted.add(m.group(1))
+        for m in _STRTO_TAINT_RE.finditer(line):
+            tainted.add(m.group(1))
+        bm = _BYTE_TAINT_RE.search(line)
+        if bm and bm.group(1) not in small and "]" in line:
+            tainted.add(bm.group(1))
+
+        # --- checks (marked before sinks on the same line: a guard and
+        # its guarded use share lines in idiomatic ternaries) ---
+        if _REL_RE.search(line):
+            for v in list(tainted):
+                if re.search(r"\b%s\b" % re.escape(v), line):
+                    checked.add(v)
+        for name, call_re in lam_call_res.items():
+            for cm in call_re.finditer(line):
+                for v in list(tainted):
+                    if re.search(r"\b%s\b" % re.escape(v), cm.group(1)):
+                        checked.add(v)
+
+        # --- taint propagation / sanitization via assignment ---
+        am = _ASSIGN_RE.search(line)
+        if am and "==" not in line:
+            dst, rhs = am.group(1), am.group(2)
+            rhs_tainted = any(
+                re.search(r"\b%s\b" % re.escape(v), rhs)
+                for v in tainted - checked
+            )
+            if rhs_tainted:
+                if _CLAMP_RE.search(rhs):
+                    tainted.discard(dst)
+                    checked.discard(dst)
+                elif dst not in small:
+                    tainted.add(dst)
+                    checked.discard(dst)
+
+        # --- sinks ---
+        live = tainted - checked
+        if not live:
+            continue
+        for sink, sink_re in _SINK_RES:
+            for sm in sink_re.finditer(line):
+                # sizeof(v) is a compile-time width, not the value of v
+                arg = re.sub(r"sizeof\s*\([^)]*\)", "", sm.group(1))
+                for v in sorted(live):
+                    if re.search(r"\b%s\b" % re.escape(v), arg):
+                        why = suppressed(lineno)
+                        if why is not None:
+                            break
+                        findings.append(
+                            Finding(
+                                CHECKER, "NW01", rel, lineno,
+                                f"{fn['name']}:{v}:{sink}",
+                                f"wire-tainted length `{v}` reaches "
+                                f"{sink} in {fn['name']} with no "
+                                f"dominating bounds check — a hostile "
+                                f"record drives the allocation/copy "
+                                f"directly",
+                            )
+                        )
+                        break
+
+    # --- NW03: narrowing casts ---
+    for idx, line in enumerate(lines):
+        lineno = base_line + idx
+        for cast_re, wide_ok in ((_CAST16_RE, False), (_CAST32_RE, True)):
+            for cm in cast_re.finditer(line):
+                operand = cm.group(1)
+                is_size = bool(_SIZE_EXPR.search(operand))
+                is_tainted = any(
+                    re.search(r"\b%s\b" % re.escape(v), operand)
+                    for v in tainted
+                )
+                if wide_ok and not is_size:
+                    continue  # 32-bit casts only flagged for size_t exprs
+                if not (is_size or is_tainted):
+                    continue
+                # dominating check on the same expression or variable
+                # anywhere earlier in the function clears it
+                needle = operand.strip()
+                pre = "\n".join(lines[:idx])
+                dominated = False
+                for pl in pre.splitlines():
+                    if needle in pl and _REL_RE.search(pl):
+                        dominated = True
+                        break
+                if dominated:
+                    continue
+                if suppressed(lineno) is not None:
+                    continue
+                findings.append(
+                    Finding(
+                        CHECKER, "NW03", rel, lineno,
+                        f"{fn['name']}:narrow:{needle}",
+                        f"narrowing cast of length-like `{needle}` in "
+                        f"wire-input {fn['name']} with no dominating "
+                        f"range check — oversize input truncates "
+                        f"silently",
+                    )
+                )
+
+
+def check(
+    root: str | Path, csrc_paths: list[Path] | None = None
+) -> list[Finding]:
+    root = Path(root)
+    live_mode = csrc_paths is None
+    if csrc_paths is None:
+        csrc_paths = sorted((root / "csrc").glob("*.cpp"))
+    findings: list[Finding] = []
+    for cp in csrc_paths:
+        if not cp.exists():
+            continue
+        raw = cp.read_text()
+        raw_lines = raw.splitlines()
+        try:
+            rel = str(cp.relative_to(root))
+        except ValueError:
+            rel = str(cp)
+        clean = _strip(raw)
+
+        # NW02: banned primitives, file-wide
+        for m in _BANNED_RE.finditer(clean):
+            lineno = clean.count("\n", 0, m.start()) + 1
+            sup = None
+            for ln in (lineno, lineno - 1):
+                if 1 <= ln <= len(raw_lines):
+                    mm = _OK_RE.search(raw_lines[ln - 1])
+                    if mm:
+                        sup = mm.group(1)
+            if sup is not None:
+                continue
+            findings.append(
+                Finding(
+                    CHECKER, "NW02", rel, lineno,
+                    f"banned:{m.group(1)}",
+                    f"banned function `{m.group(1)}` — unbounded "
+                    f"copy/format/parse primitive; use the bounded "
+                    f"replacement",
+                )
+            )
+
+        wire_count = 0
+        for fn in _functions(clean):
+            dl = fn["def_line"]
+            annotated = any(
+                _WIRE_RE.search(raw_lines[ln - 1])
+                for ln in (dl - 1, dl)
+                if 1 <= ln <= len(raw_lines)
+            )
+            if not annotated:
+                continue
+            wire_count += 1
+            _analyze_wire_fn(fn, raw_lines, rel, findings)
+
+        if live_mode and cp.name == "httpfront.cpp" and wire_count == 0:
+            findings.append(
+                Finding(
+                    CHECKER, "NW00", rel, 1, "not-armed",
+                    "csrc/httpfront.cpp (the socket-facing parser) has "
+                    "no `// graftcheck: wire-input` annotations — the "
+                    "bounds lint is not armed on the surface it exists "
+                    "for",
+                )
+            )
+    return findings
