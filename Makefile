@@ -6,7 +6,7 @@ IMG ?= policy-server-tpu:latest
 .PHONY: all test unit-tests integration-tests bench chaos check docs \
         docs-check fastenc httpfront natives sanitize soak-smoke soak \
         image dev-stack dev-stack-down dryrun-multichip multichip \
-        restart-drill phase-report clean
+        restart-drill phase-report shards-ab clean
 
 all: natives test check sanitize soak-smoke multichip restart-drill phase-report
 
@@ -73,6 +73,12 @@ restart-drill:
 phase-report:
 	JAX_PLATFORMS=cpu python -m tools.bench.phasereport --gate \
 	  --baseline BENCH_phase_attribution.json
+
+# the 1-vs-M serving-shard A/B on an all-unique miss stream: certifies
+# bit-exact verdicts, counter parity, and the M=1 router bypass, and
+# records req/s + host-phase decomposition per arm (round 22)
+shards-ab:
+	JAX_PLATFORMS=cpu python -m tools.bench.shards_ab --gate
 
 # the graftcheck CI gate (tools/graftcheck/): concurrency lint
 # (guarded-by + lock-order cycles), trace-purity lint, observability

@@ -2225,6 +2225,16 @@ void loop_main(Loop* lp) {
   for (Conn* c : cs) conn_destroy(lp, c, false);
 }
 
+// Multi-shard completion contract (round 22, runtime/shards.py): with
+// --serving-shards M > 1 completions for one frontend arrive from M
+// independent dispatch/delivery threads plus the router's heartbeat
+// thread (fence-time 503s). That is already safe here — the CompStack
+// is a lock-free multi-producer stack and req_id routing is loop-local
+// — but it relies on the Python side's exactly-once guarantee: a row's
+// owner token (_Pending.owner) ensures at most one shard (or the
+// router) ever calls complete()/fill for a given req_id, so this layer
+// never needs dedup. Retry-After is written for any status when
+// retry_after > 0 (429 shed and 503 fence share the path).
 void push_comp(Front* f, uint64_t req_id, int status, int retry_after,
                std::string&& body) {
   int idx = (int)((req_id >> 56) & 0x7F);

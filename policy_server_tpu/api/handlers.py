@@ -164,18 +164,23 @@ async def _evaluate(
         future = await batcher.submit_async(policy_id, request, origin)
         return await future
     except ShedError as e:
-        # admission-time load shed: the queue cannot meet this request's
-        # deadline budget — an HTTP 429 with Retry-After beats evaluating
-        # work the API server will time out anyway
+        # admission-time load shed (429) or shard fence (503, FencedError
+        # subclass): either way the row cannot be answered with a verdict
+        # now, and an HTTP error with Retry-After beats evaluating work
+        # the API server will time out anyway. Status and message come
+        # off the exception class so both surfaces stay byte-identical
+        # with the native frontend's _shed_body.
         import math as _math
 
         retry_after = max(1, _math.ceil(e.retry_after_seconds))
         return web.json_response(
             {
-                "message": "policy server overloaded; retry later",
+                "message": getattr(
+                    e, "message", "policy server overloaded; retry later"
+                ),
                 "retry_after_seconds": retry_after,
             },
-            status=429,
+            status=getattr(e, "http_status", 429),
             headers={"Retry-After": str(retry_after)},
         )
     except PolicyNotFoundError as e:

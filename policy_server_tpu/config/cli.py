@@ -458,6 +458,30 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "REBUILDS a wedged one instead of serving zombies "
                    "(counted on /metrics as "
                    "policy_server_selfheal_*_revives). 0 disables")),
+        ("--serving-shards", "KUBEWARDEN_SERVING_SHARDS",
+         dict(type=int, default=1, metavar="M",
+              help="Host-local serving shards (runtime/shards.py): M "
+                   "full serving stacks — each with its own evaluation "
+                   "environment (verdict cache + breaker) and "
+                   "micro-batcher, sharing the promoted epoch artifacts "
+                   "and the XLA compilation cache read-only — behind a "
+                   "health + queue-depth-EWMA router. A shard whose "
+                   "dispatch loop wedges or dies is fenced within one "
+                   "heartbeat interval (queued rows re-routed to a "
+                   "sibling or answered 503 with Retry-After, never "
+                   "double-answered) and warm-revived in place without "
+                   "touching its siblings; SIGTERM drains shards in "
+                   "sequence. 1 bypasses the router entirely — the "
+                   "serving path is byte-identical to a routerless "
+                   "build")),
+        ("--shard-heartbeat-seconds", "KUBEWARDEN_SHARD_HEARTBEAT_SECONDS",
+         dict(type=float, default=0.5, metavar="SECONDS",
+              help="Shard router heartbeat cadence: each tick probes "
+                   "every shard's dispatch loop, fences a wedged/dead "
+                   "shard (draining its queued rows to the healthiest "
+                   "sibling), and warm-revives it. Bounds the fencing "
+                   "latency after a shard death. Ignored when "
+                   "--serving-shards is 1")),
         ("--worker-respawn-giveup", "KUBEWARDEN_WORKER_RESPAWN_GIVEUP",
          dict(type=int, default=5, metavar="N",
               help="Prefork respawn breaker: a frontend worker slot "

@@ -306,6 +306,18 @@ class Config:
     # batcher dispatch loop / native-frontend drainer instead of serving
     # zombies; the check cadence in seconds (0 disables)
     selfheal_interval_seconds: float = 5.0
+    # host-local serving shards (round 22, runtime/shards.py): M full
+    # serving stacks (each its own EvaluationEnvironment — verdict cache
+    # + breaker — and MicroBatcher) behind a health/queue-depth router;
+    # the promoted epoch artifacts and the XLA compilation cache are
+    # shared read-only. 1 = the router is BYPASSED entirely and the
+    # serving path is byte- and path-identical to previous rounds
+    serving_shards: int = 1
+    # shard heartbeat cadence: how often the router probes each shard's
+    # dispatch loop; a wedged/dead shard is fenced within one interval
+    # (queued rows re-routed to a sibling or answered 503+Retry-After)
+    # and warm-revived in place without touching its siblings
+    shard_heartbeat_seconds: float = 0.5
     # flight recorder (round 18, telemetry/flightrec.py): always-on
     # batch-granular phase timelines + per-phase histograms + tail
     # exemplars at <2% overhead; False disables the recorder AND the
@@ -447,6 +459,10 @@ class Config:
             raise ValueError("--state-audit-spill-seconds must be > 0")
         if self.selfheal_interval_seconds < 0:
             raise ValueError("--selfheal-interval-seconds must be >= 0")
+        if self.serving_shards < 1:
+            raise ValueError("--serving-shards must be >= 1")
+        if self.shard_heartbeat_seconds <= 0:
+            raise ValueError("--shard-heartbeat-seconds must be > 0")
         if self.worker_respawn_giveup < 1:
             raise ValueError("--worker-respawn-giveup must be >= 1")
         if self.native_idle_timeout_seconds < 0:
@@ -616,6 +632,10 @@ class Config:
             state_dir=args.state_dir or None,
             state_audit_spill_seconds=float(args.state_audit_spill_seconds),
             selfheal_interval_seconds=float(args.selfheal_interval_seconds),
+            serving_shards=int(getattr(args, "serving_shards", 1)),
+            shard_heartbeat_seconds=float(
+                getattr(args, "shard_heartbeat_seconds", 0.5)
+            ),
             flight_recorder=args.flight_recorder == "on",
             recorder_ring_events=int(args.recorder_ring_events),
             recorder_row_sample_rate=float(args.recorder_row_sample_rate),

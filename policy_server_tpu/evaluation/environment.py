@@ -434,7 +434,7 @@ class EvaluationEnvironmentBuilder:
                     ) from e
                 init_errors[name] = str(e)
 
-        return EvaluationEnvironment(
+        env = EvaluationEnvironment(
             backend=self.backend,
             bound=bound,
             groups=groups,
@@ -452,6 +452,12 @@ class EvaluationEnvironmentBuilder:
             predicate_opt=self.predicate_opt,
             kernel=self.kernel,
         )
+        # the source policy mapping the environment was built from: the
+        # shard router (runtime/shards.py) rebuilds sibling environments
+        # from it, so every build path (boot, reload, rollback) carries
+        # it uniformly. Not read by the serving path.
+        env.source_policies = dict(policies)
+        return env
 
 
 # Stats-dict key schemas of the round-15 optimizer/kernel surfaces.

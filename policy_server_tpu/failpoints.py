@@ -83,6 +83,20 @@ Sites instrumented (grep for ``failpoints.fire``):
                     handshake (counted, alert sent, connection closed)
                     until the site disarms; established connections
                     keep serving, so the blast radius is accept-only
+``shard.dispatch``  top of each MicroBatcher dispatch-loop iteration
+                    (runtime/batcher.py _loop), BEFORE any queue pop —
+                    an armed ``raise`` kills that shard's dispatch
+                    thread holding zero rows, the shard-death drill:
+                    the router's heartbeat fences the shard (queued
+                    rows re-route to a sibling or answer 503) and
+                    warm-revives it. Scope with the shard's failpoint
+                    scope (``shard-<i>``) to kill one specific shard
+``shard.heartbeat`` head of each per-shard heartbeat probe
+                    (runtime/shards.py ShardRouter), under that
+                    shard's ``shard-<i>`` scope — ``raise`` = the
+                    probe itself faults for one shard; the router
+                    counts it and treats the shard as unprobeable
+                    (fenced) until the site disarms
 ==================  =====================================================
 
 Every fire is counted (``fired_count(site)``) so chaos tests can assert

@@ -71,11 +71,27 @@ class ShedError(Exception):
     already exceeds the request's deadline budget: evaluating it would be
     pure waste (the admission-webhook model: the API server enforces a
     hard ``timeoutSeconds`` per review). The HTTP layer maps this to
-    429 + Retry-After."""
+    ``http_status`` + Retry-After."""
+
+    http_status = 429
+    message = "policy server overloaded; retry later"
 
     def __init__(self, retry_after_seconds: float):
-        super().__init__("policy server overloaded; retry later")
+        super().__init__(self.message)
         self.retry_after_seconds = max(0.001, retry_after_seconds)
+
+
+class FencedError(ShedError):
+    """A fenced serving shard's answer for rows it can no longer serve
+    (round 22, runtime/shards.py): the shard's dispatch loop died or
+    wedged, the router drained its queue, and no healthy sibling had
+    room — the row was provably never dispatched, so retrying is safe
+    and correct. Maps to 503 + Retry-After (a server-side availability
+    event, not client overload — the 429 trend lines must not absorb
+    fencing)."""
+
+    http_status = 503
+    message = "serving shard fenced; retry later"
 
 
 @dataclass
@@ -115,6 +131,14 @@ class _Pending:
     # in-flight cap releases exactly once per row; None when no quota
     # applies (every single-tenant deployment)
     quota_token: Any = None
+    # shard-ownership token (round 22, runtime/shards.py): the
+    # MicroBatcher currently responsible for resolving this row.
+    # Stamped at every enqueue (under the queue mutex on the burst
+    # path), cleared by fence_drain while it holds that mutex, and
+    # re-stamped by the sibling's enqueue on re-route — exactly one
+    # owner exists at any instant, so a fenced row can never be
+    # double-answered.
+    owner: Any = None
 
 
 def _set_many(items: list) -> None:
@@ -261,6 +285,11 @@ class MicroBatcher:
         self.admission = admission
         self.scheduler = scheduler
         self.tenant = tenant
+        # shard failpoint scope (round 22, runtime/shards.py): set by
+        # the ShardRouter to "shard-<i>" so a scoped shard.dispatch arm
+        # kills ONE shard's dispatch thread; None (scope passthrough)
+        # for unsharded batchers
+        self.failpoint_scope: str | None = None
         # policy-lifecycle shadow recorder (lifecycle.ShadowRecorder):
         # every formed batch's (policy_id, request) pairs feed the
         # hot-reload canary's replay ring. None = disabled (no reload
@@ -506,6 +535,30 @@ class MicroBatcher:
                 ),
             )
 
+    def fence_drain(self) -> list[_Pending]:
+        """Atomically remove every not-yet-dispatched row from the
+        submission queue (the shard router's fencing action, round 22).
+        A row still queued is provably owned by NO batch worker — its
+        future/sink has never been touched — so the router may re-route
+        it to a sibling shard (preserving its deadline, trace context,
+        and tenant quota token: no re-admission, the eventual resolution
+        releases the quota exactly once) or answer it 503+Retry-After,
+        without any double-answer window. Rows already popped by the
+        dispatch loop resolve through their batch worker as usual (the
+        batch pools survive a dead dispatch thread).
+
+        ``unfinished_tasks`` is deliberately left alone (nothing joins
+        on this queue); full-queue overload waiters are woken so they
+        observe the freed space."""
+        q = self._queue
+        with q.mutex:
+            taken = list(q.queue)
+            q.queue.clear()
+            for p in taken:
+                p.owner = None  # ownership passes to the fencing router
+            q.not_full.notify_all()
+        return taken
+
     def queue_depth(self) -> int:
         """Requests currently waiting for batch formation (introspection
         for the /metrics runtime gauges)."""
@@ -726,6 +779,7 @@ class MicroBatcher:
                 wait = min(self._WAIT_SLICE_SECONDS, remaining)
             try:
                 self._queue.put(pending, timeout=wait)
+                pending.owner = self  # shard-ownership token (round 22)
             except queue.Full:
                 continue
             # Close the stranding window: shutdown may have completed BOTH
@@ -762,6 +816,7 @@ class MicroBatcher:
         self._admit_quota([pending])
         try:
             self._queue.put_nowait(pending)
+            pending.owner = self  # shard-ownership token (round 22)
             # same stranding window as _put_waiting: shutdown may have
             # finished both drains between the check above and this put
             if self._stopping and not pending.future.done():
@@ -886,6 +941,8 @@ class MicroBatcher:
             )
             take = pendings[: max(0, space)]
             if take:
+                for p in take:
+                    p.owner = self  # ownership stamped under the mutex
                 q.queue.extend(take)
                 q.unfinished_tasks += len(take)
                 # one consumer (the dispatch loop): a single notify wakes
@@ -1159,40 +1216,66 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            # live lane MOMENTARILY empty: this — and only this — is
-            # when the best-effort audit lane may claim an idle slot.
-            # Checked at the loop top (not just on get-timeout): under
-            # steady load the queue drains to zero between bursts for
-            # milliseconds at a time, and those gaps ARE the idle
-            # capacity audit rides; a 50 ms fully-quiet window would
-            # never occur. The audit dispatch runs on its own pool, so
-            # the live get below is not delayed.
-            if self._queue.qsize() == 0:
-                self._maybe_dispatch_audit()
+            batch: list[_Pending] = []
             try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch = [first]
-            # Backlog drains immediately — the batch-timeout window only
-            # bounds ADDED latency when load is light; it must never shrink
-            # batches when the queue is already deep (that collapses
-            # throughput to batch-of-one under pressure).
-            deadline = first.enqueued_at + self.batch_timeout
-            while len(batch) < self.max_batch_size:
+                # shard-death chaos site (round 22): fired BEFORE any
+                # queue pop, so an injected raise kills this dispatch
+                # thread holding zero rows — the clean wedge the shard
+                # router's heartbeat fences and warm-revives. Fired
+                # under the shard's failpoint scope (set by the router)
+                # so chaos can kill ONE specific shard; scope(None) is
+                # a passthrough for unsharded batchers.
+                with failpoints.scope(self.failpoint_scope):
+                    failpoints.fire("shard.dispatch")
+                # live lane MOMENTARILY empty: this — and only this — is
+                # when the best-effort audit lane may claim an idle slot.
+                # Checked at the loop top (not just on get-timeout):
+                # under steady load the queue drains to zero between
+                # bursts for milliseconds at a time, and those gaps ARE
+                # the idle capacity audit rides; a 50 ms fully-quiet
+                # window would never occur. The audit dispatch runs on
+                # its own pool, so the live get below is not delayed.
+                if self._queue.qsize() == 0:
+                    self._maybe_dispatch_audit()
                 try:
-                    batch.append(self._queue.get_nowait())
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
                     continue
-                except queue.Empty:
-                    pass
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._queue.get(timeout=remaining))
-                except queue.Empty:
-                    break
-            self._launch_batch(batch)
+                batch.append(first)
+                # Backlog drains immediately — the batch-timeout window
+                # only bounds ADDED latency when load is light; it must
+                # never shrink batches when the queue is already deep
+                # (that collapses throughput to batch-of-one under
+                # pressure).
+                deadline = first.enqueued_at + self.batch_timeout
+                while len(batch) < self.max_batch_size:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                        continue
+                    except queue.Empty:
+                        pass
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+                self._launch_batch(batch)
+            except BaseException:
+                # the dispatch thread is dying (real mid-iteration bug
+                # or armed shard.dispatch fault): rows already popped
+                # into ``batch`` are owned by NO batch worker and would
+                # strand unresolved — answer each 503+Retry-After first
+                # so every submitted row still resolves exactly once,
+                # then re-raise so dispatch_wedged() sees a dead thread
+                # and the self-heal/shard-fencing machinery engages.
+                for p in batch:
+                    try:
+                        self._fail(p, FencedError(0.5))
+                    except Exception:  # noqa: BLE001 — best-effort drain
+                        pass
+                raise
 
     def _launch_batch(self, batch: list[_Pending]) -> None:
         """Hand a formed batch to the pipeline pool (bounded in-flight)."""
@@ -1743,11 +1826,13 @@ class MicroBatcher:
         live_ids = {id(p) for p in live}
         delivery = _DeliveryBatch()
         metrics_sink: list = []
+        hit_rows = 0  # cache-hit (FragVerdict) rows — mix attribution
         for p, result in zip(runnable, results):
             if id(p) not in live_ids:
                 continue
             try:
                 if type(result) is FragVerdict:
+                    hit_rows += 1
                     # pre-serialized cache-hit lane (round 19): fragment
                     # eligibility proved the service-layer constraints
                     # are the identity on this shape, so post_evaluate's
@@ -1821,6 +1906,12 @@ class MicroBatcher:
                 flightrec.PH_DELIVER, int(done_at * 1e9),
                 time.perf_counter_ns(), rows=len(live), batch=brec.bid,
             )
+            # hit/miss mix marker (round 22): one event per batch tags
+            # how many delivered rows rode the pre-serialized cache-hit
+            # lane, so attribution() can split every phase interval into
+            # hit-batch vs miss-batch groups — the decomposition that
+            # localizes the ~3.5x miss-path gap (make phase-report)
+            brec.rec.record_batch_mix(brec.bid, hit_rows, len(live))
             if live:
                 # per-row recorder work is BATCH-granular by design (the
                 # <=2% overhead contract): one exemplar offer — the

@@ -49,10 +49,11 @@ MAX_FRAME = 32 * 1024 * 1024  # bridge frames (body + header + framing)
 
 def _shed_headers(status: int, payload: bytes) -> dict | None:
     """Reconstruct the Retry-After header on the worker side of the
-    bridge: load-shed 429s carry ``retry_after_seconds`` in the JSON body
-    (the frame format has no header channel), and the HTTP answer a
-    worker serves must match the in-process one."""
-    if status != 429:
+    bridge: load-shed 429s and shard-fence 503s carry
+    ``retry_after_seconds`` in the JSON body (the frame format has no
+    header channel), and the HTTP answer a worker serves must match the
+    in-process one."""
+    if status not in (429, 503):
         return None
     try:
         retry_after = json.loads(payload).get("retry_after_seconds")

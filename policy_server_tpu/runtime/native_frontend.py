@@ -849,11 +849,18 @@ class NativeTlsManager:
             tls_ctx_free(handle)
 
 
-def _shed_body(retry_after: int) -> bytes:
-    # byte parity with api/handlers._evaluate's 429 json_response
+_SHED_MESSAGE = "policy server overloaded; retry later"
+
+
+def _shed_body(
+    retry_after: int,
+    message: str = _SHED_MESSAGE,
+) -> bytes:
+    # byte parity with api/handlers._evaluate's shed json_response; the
+    # message parameter carries FencedError's 503 text (shard fenced)
     return json.dumps(
         {
-            "message": "policy server overloaded; retry later",
+            "message": message,
             "retry_after_seconds": retry_after,
         }
     ).encode()
@@ -1229,7 +1236,11 @@ class BatcherSink:
             fut = batcher.submit_nowait(policy_id, request, origin)
         except ShedError as e:
             retry = max(1, math.ceil(e.retry_after_seconds))
-            frontend.complete(req_id, 429, _shed_body(retry), retry)
+            status = getattr(e, "http_status", 429)
+            msg = getattr(e, "message", _SHED_MESSAGE)
+            frontend.complete(
+                req_id, status, _shed_body(retry, msg), retry
+            )
             return
         fut.add_done_callback(
             lambda f: _deliver(frontend, req_id, raw_shape, f)
@@ -1325,7 +1336,11 @@ class BatcherSink:
 
         if isinstance(exc, ShedError):
             retry = max(1, math.ceil(exc.retry_after_seconds))
-            frontend.complete(req_id, 429, _shed_body(retry), retry)
+            status = getattr(exc, "http_status", 429)
+            msg = getattr(exc, "message", _SHED_MESSAGE)
+            frontend.complete(
+                req_id, status, _shed_body(retry, msg), retry
+            )
         elif isinstance(exc, PolicyNotFoundError):
             frontend.complete(req_id, 404, _api_error_body(404, str(exc)))
         else:

@@ -1,0 +1,511 @@
+"""Host-local serving shards — failure-domain isolation for the batcher.
+
+Round 22 generalizes the serving path from "N frontends → 1 batcher" to
+"N frontends → M host-local serving shards". Each shard is a FULL
+serving stack: its own :class:`EvaluationEnvironment` (verdict cache +
+device breaker — a poisoned cache or tripped breaker is contained to
+one shard) and its own :class:`MicroBatcher` (dispatch thread + batch
+pools). What shards share is deliberately read-only: the promoted epoch
+artifacts (every sibling environment is rebuilt from the SAME source
+policy mapping, so verdicts are bit-exact across shards), the
+persistent XLA compilation cache, and — per tenant — the
+``TenantAdmission`` quota and ``FairDispatchScheduler`` instances, so
+multi-tenant fairness and in-flight caps compose across a tenant's
+shard set instead of multiplying by M.
+
+The :class:`ShardRouter` in front duck-types the ``MicroBatcher``
+surface the rest of the stack already consumes (the native drainer, the
+prefork bridge, the aiohttp handlers, the lifecycle manager, the
+self-heal watchdog), which buys three properties for free:
+
+* **M=1 bypass** — :func:`build_serving_shards` returns the plain
+  ``MicroBatcher`` unchanged when one shard is configured. No router
+  object exists on the path at all: the 1-shard configuration is byte-
+  and path-identical to every previous round, so BENCH trend lines stay
+  comparable (the bench-honesty contract, proven by the A/B in
+  tests/test_shards.py).
+* **epoch atomicity** — a SIGHUP reload builds a whole NEW router
+  (fresh sibling environments from the candidate policy set) and the
+  lifecycle manager flips the ONE ``state.batcher`` pointer, exactly as
+  it always flipped one batcher: all M shards swap in the same atomic
+  store, and the old router drain-retires through the same
+  ``queue_depth``/``shutdown`` protocol.
+* **supervised supervision** — the router's heartbeat thread is itself
+  watched by the r17 ``SelfHealWatchdog`` through the same
+  ``dispatch_wedged``/``revive_dispatch`` pair it uses for batchers.
+
+Routing and fencing contract
+----------------------------
+
+Every submission (a ``submit_many`` burst from the native drainer or
+prefork bridge, or a single row from the aiohttp path) is routed WHOLE
+to one healthy shard by queue-depth EWMA — burst granularity keeps the
+router off the per-row hot path. The heartbeat probes each shard's
+dispatch thread every ``heartbeat_seconds``; a shard that wedged or
+died is **fenced** within one interval:
+
+1. queued rows are drained atomically (``MicroBatcher.fence_drain`` —
+   under the queue mutex, so a drained row is provably owned by no
+   batch worker and has never touched its future/sink);
+2. drained rows **re-route** to the healthiest sibling, preserving
+   deadline, trace context, and tenant quota token (no re-admission —
+   the eventual resolution releases the quota exactly once), or answer
+   ``503 + Retry-After`` (:class:`FencedError`) when no sibling has
+   room — never both, never double-answered: per-row ownership is the
+   ``_Pending.owner`` token, stamped under the queue mutex at every
+   enqueue and cleared by the fence drain;
+3. the shard is **warm-revived** in place (``revive_dispatch`` — queue,
+   pools, caches, and compiled programs all survive; only the forming
+   thread is rebuilt) without touching its siblings. A still-armed
+   ``shard.dispatch`` failpoint simply re-kills it and the next tick
+   re-fences.
+
+``shutdown()`` drains shards IN SEQUENCE (the rolling-restart half of
+the contract: SIGTERM resolves every queued row shard by shard before
+the process exits) and closes only the sibling environments the router
+itself created — shard 0 borrows the caller's environment, exactly as a
+lone ``MicroBatcher`` always has.
+
+Chaos sites: ``shard.dispatch`` (batcher.py, kills one dispatch thread
+holding zero rows) and ``shard.heartbeat`` (here, faults one shard's
+probe); both scope under the shard's ``shard-<i>`` failpoint scope so a
+test or the soak storm can kill ONE specific shard.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.runtime.batcher import (
+    FencedError,
+    MicroBatcher,
+)
+from policy_server_tpu.telemetry.tracing import logger
+
+# queue-depth EWMA smoothing: new = (1-alpha)*old + alpha*depth. 0.2
+# follows a sustained imbalance within ~5 probes while one deep burst
+# cannot flip the routing decision by itself.
+_EWMA_ALPHA = 0.2
+
+
+class _Shard:
+    """One serving shard: the batcher, its environment, and the router's
+    per-shard routing state."""
+
+    __slots__ = (
+        "index", "batcher", "env", "owns_env", "healthy", "ewma", "scope",
+    )
+
+    def __init__(
+        self, index: int, batcher: MicroBatcher, env: Any, owns_env: bool
+    ) -> None:
+        self.index = index
+        self.batcher = batcher
+        self.env = env
+        self.owns_env = owns_env
+        self.healthy = True  # guarded-by: ShardRouter._lock
+        self.ewma = 0.0  # guarded-by: ShardRouter._lock
+        # the shard's failpoint scope: chaos arms shard.dispatch /
+        # shard.heartbeat under it to kill THIS shard only; the batcher
+        # fires its dispatch-loop site under this scope
+        self.scope = f"shard-{index}"
+        batcher.failpoint_scope = self.scope
+
+
+class ShardRouter:
+    """Health + queue-depth-EWMA router over M serving shards (module
+    docstring). Duck-types the ``MicroBatcher`` surface; unknown
+    attributes delegate to shard 0's batcher so shard-agnostic readers
+    (config knobs, tenant identity, degraded-mode gates) keep working
+    unchanged."""
+
+    def __init__(
+        self,
+        shards: list[_Shard],
+        heartbeat_seconds: float = 0.5,
+        supervisor: Any = None,
+        statestore: Any = None,
+    ) -> None:
+        assert len(shards) >= 2, "one shard never builds a router (bypass)"
+        self._shards = shards
+        self.heartbeat_seconds = max(0.05, float(heartbeat_seconds))
+        # SupervisorStats: shard revives count into the same
+        # policy_server_selfheal_batcher_revives family the watchdog
+        # feeds — a shard revive IS a batcher revive
+        self._supervisor = supervisor
+        # durable incident log (statestore.record_shard_event): fencing
+        # forensics survive the process
+        self._statestore = statestore
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        # shards fenced (wedged/dead dispatch or faulted probe)
+        self.shard_fences = 0  # guarded-by: _stats_lock
+        # queued rows re-routed to a sibling at fence time
+        self.shard_reroutes = 0  # guarded-by: _stats_lock
+        # queued rows answered 503+Retry-After at fence time
+        self.shard_fenced_rows = 0  # guarded-by: _stats_lock
+        # warm revives of a fenced shard's dispatch thread
+        self.shard_respawns = 0  # guarded-by: _stats_lock
+        # shard.heartbeat failpoint faults observed by the prober
+        self.shard_heartbeat_faults = 0  # guarded-by: _stats_lock
+        self._stop = threading.Event()
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+
+    # -- attribute delegation ----------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        # only consulted for attributes the router does not define:
+        # config knobs, tenant identity, degraded-mode flags, the
+        # shadow recorder — all shard-agnostic, all identical across
+        # shards by construction
+        return getattr(self._shards[0].batcher, name)
+
+    @property
+    def env(self) -> Any:
+        """Shard 0's environment — the one the caller built and owns
+        (readiness introspection, the lifecycle manager's epoch
+        bookkeeping, runtime_stats all read it here)."""
+        return self._shards[0].env
+
+    @property
+    def serving_shards(self) -> int:
+        return len(self._shards)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ShardRouter":
+        for s in self._shards:
+            s.batcher.start()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._heartbeat_loop, name="shard-heartbeat",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def warmup(self) -> None:
+        # every shard compiles its own programs (its own environment);
+        # with a persistent XLA cache configured, siblings warm from
+        # shard 0's compilation artifacts instead of recompiling
+        for s in self._shards:
+            s.batcher.warmup()
+
+    def shutdown(self) -> None:
+        """SIGTERM contract: stop the heartbeat, then drain shards IN
+        SEQUENCE — each shard's shutdown resolves every queued/waiting
+        row (verdict or in-band 503) before the next begins, so a
+        rolling restart never drops a verdict. Sibling environments the
+        router created are closed last; shard 0's is the caller's."""
+        self._stopping = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for s in self._shards:
+            s.batcher.shutdown()
+        for s in self._shards:
+            if s.owns_env:
+                try:
+                    s.env.close()
+                except Exception as e:  # noqa: BLE001 — teardown resilience
+                    logger.error(
+                        "shard %d environment close failed: %s", s.index, e
+                    )
+
+    # -- self-heal surface (the watchdog supervises the supervisor) ---------
+
+    def dispatch_wedged(self) -> bool:
+        """True when the HEARTBEAT thread died outside shutdown — the
+        per-shard dispatch threads are the heartbeat's own charges, so
+        the watchdog only needs to supervise the supervisor."""
+        t = self._thread
+        return (
+            t is not None
+            and not t.is_alive()
+            and not self._stopping
+            and not self._stop.is_set()
+        )
+
+    def revive_dispatch(self) -> bool:
+        if not self.dispatch_wedged():
+            return False
+        self._thread = threading.Thread(
+            target=self._heartbeat_loop, name="shard-heartbeat-revived",
+            daemon=True,
+        )
+        self._thread.start()
+        return True
+
+    # -- routing ------------------------------------------------------------
+
+    def _pick(self) -> _Shard:
+        """The healthiest shard by queue-depth EWMA. When every shard is
+        fenced (a full-storm instant), route to the least-loaded one
+        anyway: its queue still accepts, and the next heartbeat either
+        revives it or fence-drains the rows into 503s — a row is never
+        stranded either way."""
+        with self._lock:
+            best = None
+            best_any = None
+            for s in self._shards:
+                s.ewma = (
+                    (1.0 - _EWMA_ALPHA) * s.ewma
+                    + _EWMA_ALPHA * s.batcher.queue_depth()
+                )
+                if best_any is None or s.ewma < best_any.ewma:
+                    best_any = s
+                if s.healthy and (best is None or s.ewma < best.ewma):
+                    best = s
+            return best if best is not None else best_any
+
+    def _pick_batcher(self) -> MicroBatcher:
+        return self._pick().batcher
+
+    def submit(self, policy_id, request, origin):
+        return self._pick_batcher().submit(policy_id, request, origin)
+
+    def submit_nowait(self, policy_id, request, origin):
+        return self._pick_batcher().submit_nowait(policy_id, request, origin)
+
+    async def submit_async(self, policy_id, request, origin):
+        return await self._pick_batcher().submit_async(
+            policy_id, request, origin
+        )
+
+    def evaluate(self, policy_id, request, origin, timeout=None):
+        return self._pick_batcher().evaluate(
+            policy_id, request, origin, timeout=timeout
+        )
+
+    def submit_many(
+        self, items, origin, sink=None, tokens=None, trace_ctxs=None
+    ):
+        # burst granularity: the whole submit_many lands on ONE shard —
+        # the router costs M queue-depth reads per burst, nothing per row
+        return self._pick_batcher().submit_many(
+            items, origin, sink=sink, tokens=tokens, trace_ctxs=trace_ctxs
+        )
+
+    def submit_audit(self, pairs):
+        return self._pick_batcher().submit_audit(pairs)
+
+    def cancel_audit(self, future) -> bool:
+        return any(s.batcher.cancel_audit(future) for s in self._shards)
+
+    # -- introspection -------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return sum(s.batcher.queue_depth() for s in self._shards)
+
+    def audit_lane_depth(self) -> int:
+        return sum(s.batcher.audit_lane_depth() for s in self._shards)
+
+    def estimated_wait(self) -> float:
+        """The wait a request routed NOW would see — the best healthy
+        shard's estimate, since that is where _pick sends it."""
+        with self._lock:
+            healthy = [s for s in self._shards if s.healthy]
+        pool = healthy or self._shards
+        return min(s.batcher.estimated_wait() for s in pool)
+
+    def stats_snapshot(self) -> dict[str, int]:
+        """Key-wise SUM of every shard's counters (the /metrics scrape
+        and the soak receipts read totals), plus the router's own
+        fencing counters under ``shard_*`` keys."""
+        out: dict[str, int] = {}
+        for s in self._shards:
+            for k, v in s.batcher.stats_snapshot().items():
+                out[k] = out.get(k, 0) + v
+        with self._stats_lock:
+            out["shard_fences"] = self.shard_fences
+            out["shard_reroutes"] = self.shard_reroutes
+            out["shard_fenced_rows"] = self.shard_fenced_rows
+            out["shard_respawns"] = self.shard_respawns
+            out["shard_heartbeat_faults"] = self.shard_heartbeat_faults
+        return out
+
+    def shard_health(self) -> list[dict[str, Any]]:
+        """Per-shard health/queue rows for the labelled /metrics gauges
+        and the soak artifact."""
+        with self._lock:
+            return [
+                {
+                    "shard": s.index,
+                    "healthy": s.healthy,
+                    "queue_depth": s.batcher.queue_depth(),
+                    "ewma": round(s.ewma, 3),
+                    "dispatch_alive": not s.batcher.dispatch_wedged(),
+                }
+                for s in self._shards
+            ]
+
+    # -- heartbeat / fencing -------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_seconds):
+            try:
+                self.check_shards()
+            except Exception as e:  # noqa: BLE001 — the prober must live
+                logger.error("shard heartbeat pass failed: %s", e)
+
+    def check_shards(self) -> int:
+        """One heartbeat pass over every shard (exposed for tests and
+        the soak engine's deterministic pokes). Returns the number of
+        shards fenced this pass."""
+        fenced = 0
+        for s in self._shards:
+            probe_fault = False
+            try:
+                with failpoints.scope(s.scope):
+                    failpoints.fire("shard.heartbeat")
+            except Exception:  # noqa: BLE001 — injected probe fault
+                probe_fault = True
+                with self._stats_lock:
+                    self.shard_heartbeat_faults += 1
+            wedged = s.batcher.dispatch_wedged()
+            if not (wedged or probe_fault):
+                with self._lock:
+                    if not s.healthy:
+                        s.healthy = True
+                        s.ewma = 0.0
+                continue
+            self._fence(s, "wedged dispatch" if wedged else "probe fault")
+            fenced += 1
+            # warm revive in place: queue, pools, caches, and compiled
+            # programs all survive — only the forming thread is rebuilt.
+            # A still-armed shard.dispatch fault re-kills it and the
+            # next tick re-fences; siblings are never touched.
+            if wedged and s.batcher.revive_dispatch():
+                with self._stats_lock:
+                    self.shard_respawns += 1
+                if self._supervisor is not None:
+                    self._supervisor.count_batcher_revive()
+                with self._lock:
+                    s.healthy = True
+                    s.ewma = 0.0
+                if self._statestore is not None:
+                    # the respawn's durable receipt: in-memory counters
+                    # die with the router (reload epochs and restarts
+                    # rebuild it), the incident log does not — the soak
+                    # gate counts THESE
+                    try:
+                        self._statestore.record_shard_event(
+                            {"shard": s.index, "reason": "warm-respawn"}
+                        )
+                    except Exception:  # noqa: BLE001 — forensics only
+                        pass
+                logger.error(
+                    "shard %d dispatch loop was DEAD — fenced, drained, "
+                    "and warm-revived in place (siblings untouched)",
+                    s.index,
+                )
+        return fenced
+
+    def _fence(self, victim: _Shard, reason: str) -> None:
+        """Fence one shard: mark it unroutable, atomically drain its
+        not-yet-dispatched rows, and re-route them to the healthiest
+        sibling — or answer 503+Retry-After when no sibling has room.
+        Rows a batch worker already owns resolve through that worker
+        (the batch pools survive a dead dispatch thread)."""
+        with self._lock:
+            victim.healthy = False
+        rows = victim.batcher.fence_drain()
+        with self._stats_lock:
+            self.shard_fences += 1
+        rerouted = 0
+        refused = 0
+        if rows:
+            with self._lock:
+                siblings = [
+                    s for s in self._shards
+                    if s.healthy and s is not victim
+                ]
+                target = (
+                    min(siblings, key=lambda s: s.ewma)
+                    if siblings else None
+                )
+            if target is not None:
+                # re-route preserving deadline/trace/sink AND the tenant
+                # quota token: no re-admission, so the eventual
+                # resolution releases the quota exactly once (the
+                # satellite-2 contract); the sibling's enqueue re-stamps
+                # row ownership under its queue mutex
+                overflow = target.batcher._put_burst(rows)  # noqa: SLF001 — same package
+                rerouted = len(rows) - len(overflow)
+                err = FencedError(self.heartbeat_seconds)
+                for p in overflow:
+                    refused += 1
+                    victim.batcher._fail(p, err)  # noqa: SLF001 — same package
+            else:
+                err = FencedError(self.heartbeat_seconds)
+                for p in rows:
+                    refused += 1
+                    victim.batcher._fail(p, err)  # noqa: SLF001 — same package
+        with self._stats_lock:
+            self.shard_reroutes += rerouted
+            self.shard_fenced_rows += refused
+        if self._statestore is not None:
+            try:
+                self._statestore.record_shard_event(
+                    {
+                        "shard": victim.index,
+                        "reason": reason,
+                        "rows_rerouted": rerouted,
+                        "rows_fenced": refused,
+                    }
+                )
+            except Exception:  # noqa: BLE001 — forensics, never fatal
+                pass
+        logger.error(
+            "FENCED shard %d (%s): %d queued row(s) re-routed, %d "
+            "answered 503+Retry-After; in-flight batches resolve on "
+            "their workers", victim.index, reason, rerouted, refused,
+        )
+
+
+def build_serving_shards(
+    env: Any,
+    make_batcher: Callable[[Any], MicroBatcher],
+    build_env: Callable[[dict], Any] | None,
+    count: int,
+    heartbeat_seconds: float = 0.5,
+    supervisor: Any = None,
+    statestore: Any = None,
+) -> "MicroBatcher | ShardRouter":
+    """Build the serving plane for one tenant: the plain ``MicroBatcher``
+    when ``count <= 1`` (the router BYPASS — byte- and path-identical to
+    a routerless build, the bench-honesty contract), else a
+    :class:`ShardRouter` over ``count`` full stacks. Shard 0 borrows
+    ``env`` (the caller owns and closes it); siblings get fresh
+    environments rebuilt from ``env.source_policies`` via ``build_env``
+    and are owned — and closed — by the router."""
+    primary = make_batcher(env)
+    if count <= 1:
+        return primary
+    if build_env is None:
+        raise ValueError("serving_shards > 1 requires an environment builder")
+    policies = getattr(env, "source_policies", None)
+    if policies is None:
+        raise ValueError(
+            "serving_shards > 1 requires env.source_policies (set by "
+            "EvaluationEnvironmentBuilder.build)"
+        )
+    shards = [_Shard(0, primary, env, owns_env=False)]
+    t0 = time.perf_counter()
+    for i in range(1, count):
+        sib_env = build_env(policies)
+        shards.append(_Shard(i, make_batcher(sib_env), sib_env, owns_env=True))
+    logger.info(
+        "serving shards: built %d sibling stack(s) in %.1f ms "
+        "(shared read-only: epoch artifacts, XLA cache, tenant quotas)",
+        count - 1, (time.perf_counter() - t0) * 1e3,
+    )
+    return ShardRouter(
+        shards, heartbeat_seconds=heartbeat_seconds,
+        supervisor=supervisor, statestore=statestore,
+    )

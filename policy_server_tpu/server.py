@@ -413,6 +413,23 @@ class PolicyServer:
                     max_inflight=default_spec.max_inflight,
                 )
 
+        import dataclasses
+
+        def build_epoch_environment(policies):
+            # defined BEFORE the batcher builders: the shard router
+            # rebuilds sibling environments through it at boot, on every
+            # reload epoch, and on rollback
+            return _build_environment(
+                dataclasses.replace(config, policies=dict(policies)),
+                builder_kwargs,
+            )
+
+        from policy_server_tpu.supervision import SupervisorStats
+
+        supervisor = SupervisorStats()
+
+        from policy_server_tpu.runtime.shards import build_serving_shards
+
         def make_batcher(
             env, tenant_name, admission, spec, tenant_recorder, tracker
         ) -> MicroBatcher:
@@ -444,20 +461,32 @@ class PolicyServer:
                 tenant=tenant_name,
             )
 
-        def build_batcher(env) -> MicroBatcher:
-            """The default tenant's batcher (also every reload epoch's,
-            via the lifecycle manager)."""
-            return make_batcher(
-                env, "default", default_admission, default_spec,
-                recorder, snapshot_store,
+        def build_batcher(env):
+            """The default tenant's serving plane (also every reload
+            epoch's, via the lifecycle manager): the plain MicroBatcher
+            when --serving-shards is 1 (router BYPASS — the path is
+            byte-identical to every previous round), else a ShardRouter
+            over M full stacks whose sibling environments are rebuilt
+            from env.source_policies. The tenant's admission quota and
+            the fair scheduler are SHARED across its shards, so quotas
+            compose instead of multiplying by M."""
+            return build_serving_shards(
+                env,
+                lambda e: make_batcher(
+                    e, "default", default_admission, default_spec,
+                    recorder, snapshot_store,
+                ),
+                build_epoch_environment,
+                config.serving_shards,
+                heartbeat_seconds=config.shard_heartbeat_seconds,
+                supervisor=supervisor,
+                statestore=statestore,
             )
 
         batcher = build_batcher(environment)
         if config.warmup_at_boot and config.evaluation_backend == "jax":
             batcher.warmup()
         batcher.start()
-
-        from policy_server_tpu.supervision import SupervisorStats
 
         state = ApiServerState(
             evaluation_environment=environment,
@@ -468,16 +497,8 @@ class PolicyServer:
             admin_token=config.reload_admin_token,
             statestore=statestore,
             boot_report=boot_report,
-            supervisor=SupervisorStats(),
+            supervisor=supervisor,
         )
-
-        import dataclasses
-
-        def build_epoch_environment(policies):
-            return _build_environment(
-                dataclasses.replace(config, policies=dict(policies)),
-                builder_kwargs,
-            )
 
         def build_oracle_environment(policies):
             # the canary referee: the host-oracle backend over the
@@ -657,7 +678,20 @@ class PolicyServer:
                     env, _n=tenant_name, _a=t_admission, _s=spec,
                     _r=t_recorder,
                 ):
-                    return make_batcher(env, _n, _a, _s, _r, None)
+                    # per-tenant shard set (round 22): the tenant's
+                    # admission quota and the process-wide fair
+                    # scheduler are SHARED across its shards, so tenant
+                    # fairness and in-flight caps compose across the
+                    # set instead of multiplying by M
+                    return build_serving_shards(
+                        env,
+                        lambda e: make_batcher(e, _n, _a, _s, _r, None),
+                        build_epoch_environment,
+                        config.serving_shards,
+                        heartbeat_seconds=config.shard_heartbeat_seconds,
+                        supervisor=supervisor,
+                        statestore=statestore,
+                    )
 
                 def t_read_policies(_spec=spec):
                     # the tenant.reload chaos site: an armed fault here
@@ -1509,6 +1543,71 @@ class PolicyServer:
                 "Native-frontend drainer threads the self-heal watchdog "
                 "found dead and rebuilt",
                 sup.get("frontend_revives", 0),
+            )
+            # Serving shards (round 22, runtime/shards.py): the router's
+            # health/fencing surface. With --serving-shards 1 the plain
+            # batcher serves (no router object exists), so the gauges
+            # report the one implicit shard and every fencing counter is
+            # zero — the families still export so panels resolve.
+            shard_rows = (
+                batcher.shard_health()
+                if hasattr(batcher, "shard_health") else []
+            )
+            yield (
+                metrics_names.SHARDS_SERVING, "gauge",
+                "Host-local serving shards behind the router "
+                "(--serving-shards; 1 = router bypassed)",
+                len(shard_rows) if shard_rows else 1,
+            )
+            yield (
+                metrics_names.SHARD_HEALTHY, "gauge",
+                "Per-shard routability (1 = routable, 0 = fenced "
+                "pending warm revive)",
+                [
+                    ((str(r["shard"]),), 1 if r["healthy"] else 0)
+                    for r in shard_rows
+                ],
+                ("shard",),
+            )
+            yield (
+                metrics_names.SHARD_QUEUE_DEPTH, "gauge",
+                "Per-shard submission queue depth (the router's "
+                "EWMA routing signal reads this)",
+                [
+                    ((str(r["shard"]),), r["queue_depth"])
+                    for r in shard_rows
+                ],
+                ("shard",),
+            )
+            yield (
+                metrics_names.SHARD_FENCES, "counter",
+                "Shards fenced by the heartbeat (wedged/dead dispatch "
+                "loop or faulted probe)",
+                bstats.get("shard_fences", 0),
+            )
+            yield (
+                metrics_names.SHARD_REROUTED_ROWS, "counter",
+                "Queued rows re-routed to a sibling shard at fence time "
+                "(deadline, trace, and quota token preserved)",
+                bstats.get("shard_reroutes", 0),
+            )
+            yield (
+                metrics_names.SHARD_FENCED_ROWS, "counter",
+                "Queued rows answered 503+Retry-After at fence time "
+                "(no sibling had room)",
+                bstats.get("shard_fenced_rows", 0),
+            )
+            yield (
+                metrics_names.SHARD_RESPAWNS, "counter",
+                "Fenced shards warm-revived in place (queue, pools, "
+                "caches, and compiled programs survive)",
+                bstats.get("shard_respawns", 0),
+            )
+            yield (
+                metrics_names.SHARD_HEARTBEAT_FAULTS, "counter",
+                "shard.heartbeat failpoint faults observed by the "
+                "router's prober",
+                bstats.get("shard_heartbeat_faults", 0),
             )
             # Flight recorder (round 18, telemetry/flightrec.py): event
             # volume, row-sampling volume, and the tail-exemplar table —

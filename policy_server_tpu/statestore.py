@@ -172,6 +172,7 @@ class StateStore:
     URLMAP_JOURNAL = "urlmap.journal"
     AUDIT_SPILL = "audit/spill.journal"
     BOOT_REPORT = "last_boot.json"
+    SHARD_EVENTS = "shard_events.json"
 
     def __init__(self, root: str | Path):
         self.root = Path(root)
@@ -193,6 +194,8 @@ class StateStore:
         self._audit_spill_gen = 0  # guarded-by: _lock
         self._audit_rows_restored = 0  # guarded-by: _lock
         self._degraded_loads = 0  # guarded-by: _lock
+        # shard fencing incidents durably logged (round 22)
+        self._shard_events_recorded = 0  # guarded-by: _lock
         for sub in ("", self.ARTIFACTS_DIR, self.QUARANTINE_DIR,
                     self.AUDIT_DIR):
             (self.root / sub).mkdir(parents=True, exist_ok=True)
@@ -555,6 +558,50 @@ class StateStore:
             self.root / self.BOOT_REPORT,
             json.dumps(dict(report), indent=1).encode(),
         )
+
+    # -- shard incident log (round 22, runtime/shards.py) ------------------
+
+    _SHARD_EVENTS_RETAINED = 256
+
+    def record_shard_event(self, event: Mapping[str, Any]) -> None:
+        """Append one shard fencing/respawn incident to a bounded
+        on-disk log — the durable complement of the router's in-memory
+        counters, so post-crash forensics can answer 'which shard died,
+        when, and what happened to its rows' after the process is gone.
+        Best-effort like the boot report: damage loses forensics, never
+        serving."""
+        path = self.root / self.SHARD_EVENTS
+        with self._lock:
+            try:
+                events = json.loads(path.read_bytes())
+                if not isinstance(events, list):
+                    events = []
+            except (OSError, ValueError):
+                events = []
+            events.append({"time": time.time(), **dict(event)})
+            del events[: -self._SHARD_EVENTS_RETAINED]
+            try:
+                atomic_write_bytes(
+                    path, json.dumps(events, indent=1).encode()
+                )
+                self._shard_events_recorded += 1
+            except OSError:
+                pass
+
+    def shard_events(self) -> "list[dict]":
+        """The retained shard incident log, oldest first (empty when
+        nothing was ever fenced or the log was damaged). The durable
+        read side of :meth:`record_shard_event`: router counters reset
+        whenever a reload epoch or restart rebuilds the router, this
+        file does not — the soak's ``shard_kill_survived`` gate counts
+        incidents here."""
+        path = self.root / self.SHARD_EVENTS
+        with self._lock:
+            try:
+                events = json.loads(path.read_bytes())
+            except (OSError, ValueError):
+                return []
+        return events if isinstance(events, list) else []
 
     # -- introspection -----------------------------------------------------
 

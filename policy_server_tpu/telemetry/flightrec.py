@@ -112,6 +112,12 @@ _DISPATCH_NESTED = (
 # event kinds
 _KIND_BATCH = 0
 _KIND_ROW = 1
+# per-batch cache-hit/miss mix marker (round 22): not a phase interval —
+# the start field carries the hit-row count, rows the delivered total.
+# attribution() uses it to split phase time into hit/miss batch groups.
+_KIND_MIX = 2
+
+_KIND_NAMES = ("batch", "row", "mix")
 
 DEFAULT_RING_EVENTS = 65536
 DEFAULT_ROW_SAMPLE_RATE = 0.01
@@ -215,6 +221,20 @@ class FlightRecorder:
         )
         if self._observe is not None:
             self._observe(phase, max(0, end_ns - start_ns) / 1e9)
+
+    def record_batch_mix(
+        self, batch: int, hit_rows: int, total_rows: int
+    ) -> None:
+        """One per-batch marker tagging how many delivered rows rode the
+        pre-serialized cache-hit lane (round 22, the batcher's phase-3
+        FragVerdict count). :meth:`attribution` joins it against the
+        batch's phase intervals to report hit-batch vs miss-batch phase
+        cost separately — the decomposition that shows WHERE the
+        miss-path gap lives. Costs one ring write per batch."""
+        self._write(
+            0, _KIND_MIX, int(hit_rows), 0, int(total_rows), int(batch),
+            None,
+        )
 
     def _write(
         self, phase_i: int, kind: int, start_ns: int, end_ns: int,
@@ -391,7 +411,7 @@ class FlightRecorder:
             {
                 "seq": int(seq1[i]),
                 "phase": PHASES[phase[i]],
-                "kind": "batch" if kind[i] == _KIND_BATCH else "row",
+                "kind": _KIND_NAMES[kind[i]],
                 "start_ns": int(start[i]),
                 "end_ns": int(end[i]),
                 "rows": int(rows[i]),
@@ -455,6 +475,8 @@ class FlightRecorder:
             (1, 0): "native frontend (burst aggregates)",
         }
         for ev in self.snapshot():
+            if ev["kind"] == "mix":
+                continue  # bookkeeping marker, not a timeline interval
             if ev["kind"] == "batch":
                 pid = 1
                 tid = 0 if ev["batch"] < 0 else 1 + (ev["batch"] % 12)
@@ -527,9 +549,19 @@ class FlightRecorder:
         runs UNDER the fetch wait). The residual — dispatch time no
         nested phase explains, plus gaps between the batcher phases —
         is the measured unattributed host floor, reported per row."""
+        snap = self.snapshot()
         batches: dict[int, dict[str, list[tuple[int, int, int]]]] = {}
-        for ev in self.snapshot():
-            if ev["kind"] != "batch" or ev["batch"] < 0 or ev["seq"] < since:
+        # batch id → (hit_rows, total_rows) from the per-batch mix
+        # markers (round 22): joins each batch's phase intervals to its
+        # cache-hit/miss composition
+        mixes: dict[int, tuple[int, int]] = {}
+        for ev in snap:
+            if ev["batch"] < 0 or ev["seq"] < since:
+                continue
+            if ev["kind"] == "mix":
+                mixes[ev["batch"]] = (ev["start_ns"], ev["rows"])
+                continue
+            if ev["kind"] != "batch":
                 continue
             batches.setdefault(ev["batch"], {}).setdefault(
                 ev["phase"], []
@@ -538,18 +570,24 @@ class FlightRecorder:
         def dur(phs, name) -> int:
             return sum(max(0, e - s) for s, e, _r in phs.get(name, ()))
 
-        totals: dict[str, float] = {p: 0.0 for p in PHASES}
-        total_rows = 0
-        total_wall = 0
-        total_residual = 0
-        total_queue = 0
-        complete = 0
-        for phs in batches.values():
+        def _acc() -> dict:
+            return {
+                "totals": {p: 0.0 for p in PHASES},
+                "rows": 0, "wall": 0, "residual": 0, "queue": 0,
+                "batches": 0,
+            }
+
+        overall = _acc()
+        # hit = every delivered row rode the cache-hit lane, miss = none
+        # did, mixed = both in one batch; batches with no mix marker
+        # (producers predating round 22, audit lanes) stay out of the
+        # split but keep counting into the overall numbers
+        groups: dict[str, dict] = {}
+        for bid, phs in batches.items():
             if not all(
                 k in phs for k in (PH_FORM, PH_DISPATCH, PH_DELIVER)
             ):
                 continue
-            complete += 1
             form_s, form_e, rows = phs[PH_FORM][0]
             _disp_s, _disp_e, _ = phs[PH_DISPATCH][0]
             _del_s, del_e, _ = phs[PH_DELIVER][0]
@@ -561,28 +599,52 @@ class FlightRecorder:
             residual = max(0, disp_d - nested) + max(
                 0, wall - (form_d + disp_d + del_d)
             )
-            total_rows += rows
-            total_wall += wall
-            total_residual += residual
-            total_queue += dur(phs, PH_QUEUE_WAIT)
-            for p in PHASES:
-                totals[p] += dur(phs, p)
-        rows = max(1, total_rows)
-        return {
-            "batches_complete": complete,
-            "rows": total_rows,
-            "wall_us_per_row": round(total_wall / rows / 1e3, 2),
-            "queue_wait_us_per_row": round(total_queue / rows / 1e3, 2),
-            "phase_us_per_row": {
-                p: round(totals[p] / rows / 1e3, 2)
-                for p in PHASES
-                if totals[p] > 0
-            },
-            "residual_us_per_row": round(total_residual / rows / 1e3, 2),
-            "residual_fraction_of_wall": round(
-                total_residual / max(1, total_wall), 4
-            ),
+            sinks = [overall]
+            mix = mixes.get(bid)
+            if mix is not None:
+                hits, total = mix
+                name = (
+                    "miss" if hits <= 0
+                    else "hit" if hits >= total
+                    else "mixed"
+                )
+                sinks.append(groups.setdefault(name, _acc()))
+            for acc in sinks:
+                acc["batches"] += 1
+                acc["rows"] += rows
+                acc["wall"] += wall
+                acc["residual"] += residual
+                acc["queue"] += dur(phs, PH_QUEUE_WAIT)
+                for p in PHASES:
+                    acc["totals"][p] += dur(phs, p)
+
+        def _report(acc: dict) -> dict:
+            rows = max(1, acc["rows"])
+            return {
+                "batches_complete": acc["batches"],
+                "rows": acc["rows"],
+                "wall_us_per_row": round(acc["wall"] / rows / 1e3, 2),
+                "queue_wait_us_per_row": round(
+                    acc["queue"] / rows / 1e3, 2
+                ),
+                "phase_us_per_row": {
+                    p: round(acc["totals"][p] / rows / 1e3, 2)
+                    for p in PHASES
+                    if acc["totals"][p] > 0
+                },
+                "residual_us_per_row": round(
+                    acc["residual"] / rows / 1e3, 2
+                ),
+                "residual_fraction_of_wall": round(
+                    acc["residual"] / max(1, acc["wall"]), 4
+                ),
+            }
+
+        out = _report(overall)
+        out["mix_groups"] = {
+            name: _report(acc) for name, acc in sorted(groups.items())
         }
+        return out
 
 
 # ---------------------------------------------------------------------------

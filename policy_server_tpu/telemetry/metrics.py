@@ -230,6 +230,23 @@ TLS_RELOADS = "policy_server_tls_reloads"
 TLS_RELOAD_FAILURES = "policy_server_tls_reload_failures"
 TLS_NATIVE_TERMINATION = "policy_server_tls_native_termination"
 
+# round 22 — host-local serving shards (runtime/shards.py): M full
+# serving stacks behind a health + queue-depth-EWMA router. The shard
+# count and the per-shard health/queue gauges (labelled by shard index)
+# describe the plane; the fence/reroute/respawn counters account every
+# fencing event's row disposition — rerouted rows answered verdicts on
+# a sibling, fenced rows answered 503+Retry-After, and the two must
+# explain every queued row a dead shard held. All zeros/singletons with
+# --serving-shards 1 (families still export so panels resolve).
+SHARDS_SERVING = "policy_server_shards_serving"
+SHARD_HEALTHY = "policy_server_shard_healthy"
+SHARD_QUEUE_DEPTH = "policy_server_shard_queue_depth"
+SHARD_FENCES = "policy_server_shard_fences"
+SHARD_REROUTED_ROWS = "policy_server_shard_rerouted_rows"
+SHARD_FENCED_ROWS = "policy_server_shard_fenced_rows"
+SHARD_RESPAWNS = "policy_server_shard_respawns"
+SHARD_HEARTBEAT_FAULTS = "policy_server_shard_heartbeat_faults"
+
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".
 _EVAL_LABELS = (

@@ -280,7 +280,18 @@ class TenantAdmission:
     honest Retry-After derived from the refill rate — the webhook
     caller can actually use it. Cheap by construction: one lock, a few
     float ops, called once per submit burst (never per row on the bulk
-    path)."""
+    path).
+
+    Composition across serving shards (round 22, runtime/shards.py):
+    ONE TenantAdmission instance fronts a tenant's whole shard set —
+    admission happens before routing, so the quota is tenant-global no
+    matter how many shards serve the tenant. The in-flight cap relies
+    on the batcher's exactly-once release discipline: a row's
+    ``quota_token`` travels WITH the row when a fenced shard's queue is
+    re-routed to a sibling (no re-admission — the row was already
+    paid for) and is released by whichever resolution fires first
+    (verdict, 503 fence, 504 deadline). A shard kill therefore never
+    leaks inflight slots and never double-releases them."""
 
     def __init__(
         self,

@@ -1956,3 +1956,312 @@ def test_statestore_armed_reload_under_load_then_warm_reboot(tmp_path):
         assert r.status_code == 200
     finally:
         handle3.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serving shards (round 22, runtime/shards.py): chaos at server level
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_sighup_flip_under_load_zero_non_2xx():
+    """SIGHUP epoch flip with --serving-shards 2 under sustained load:
+    the reload builds a whole NEW router (fresh sibling environments
+    from the candidate policy set) and the lifecycle flips the one
+    state.batcher pointer — all M shards swap atomically, verdicts stay
+    bit-exact through the flip, zero non-2xx."""
+    import requests as rq
+
+    from policy_server_tpu.runtime.shards import ShardRouter
+    from test_server import ServerHandle, pod_review_body
+
+    config, _policies = _lifecycle_config()
+    config.serving_shards = 2
+    config.shard_heartbeat_seconds = 0.2
+    handle = ServerHandle(config)
+    lifecycle = handle.server.lifecycle
+    router_before = handle.server.state.batcher
+    assert isinstance(router_before, ShardRouter)
+    assert router_before.serving_shards == 2
+    stop = threading.Event()
+    results: list[tuple[int, bool | None, bool]] = []
+    errors: list[Exception] = []
+
+    def traffic(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            privileged = (i + worker) % 2 == 0
+            i += 1
+            try:
+                r = rq.post(
+                    handle.url("/validate/pod-privileged"),
+                    json=pod_review_body(privileged), timeout=30,
+                )
+                allowed = (
+                    r.json()["response"]["allowed"]
+                    if r.status_code == 200 else None
+                )
+                results.append((r.status_code, allowed, privileged))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=traffic, args=(w,), daemon=True)
+        for w in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        before = lifecycle.stats()["reloads"]
+        handle.server.reload_signal()
+        deadline = time.monotonic() + 60
+        while (
+            lifecycle.stats()["reloads"] == before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert lifecycle.stats()["reloads"] > before, "reload never promoted"
+        time.sleep(0.3)  # traffic THROUGH the promoted epoch
+        router_after = handle.server.state.batcher
+        # the flip swapped in a NEW router, still M=2 — epoch atomicity
+        # is the single pointer store, not M per-shard swaps
+        assert isinstance(router_after, ShardRouter)
+        assert router_after is not router_before
+        assert router_after.serving_shards == 2
+        assert all(h["healthy"] for h in router_after.shard_health())
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        handle.stop()
+    assert not errors, errors
+    assert len(results) > 20
+    non_2xx = [r for r in results if r[0] != 200]
+    assert not non_2xx, f"non-2xx during sharded SIGHUP flip: {non_2xx[:5]}"
+    for _code, allowed, privileged in results:
+        assert allowed is (not privileged)  # bit-exact through the flip
+
+
+def test_shard_dispatch_fault_fences_revives_and_recovers():
+    """An armed shard.dispatch fault at server level: shard 0's dispatch
+    loop dies, the router's heartbeat fences it within one beat and
+    warm-revives it in place — traffic through the window answers 200
+    with correct verdicts (fenced rows re-route to the live sibling),
+    and the fence/respawn counters reach the metrics endpoint."""
+    import requests as rq
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    handle = ServerHandle(
+        make_config(serving_shards=2, shard_heartbeat_seconds=0.2)
+    )
+    try:
+        router = handle.server.batcher
+        assert router.serving_shards == 2
+
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if router._shards[0].batcher.dispatch_wedged():
+                break
+            time.sleep(0.02)
+        failpoints.clear("shard.dispatch")
+
+        # traffic through the fence window: every answer 200, correct
+        for i in range(12):
+            privileged = i % 2 == 0
+            r = rq.post(
+                handle.url("/validate/pod-privileged"),
+                json=pod_review_body(privileged), timeout=30,
+            )
+            assert r.status_code == 200, (i, r.status_code)
+            assert r.json()["response"]["allowed"] is (not privileged)
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            stats = router.stats_snapshot()
+            if stats["shard_fences"] >= 1 and stats["shard_respawns"] >= 1:
+                break
+            time.sleep(0.05)
+        stats = router.stats_snapshot()
+        assert stats["shard_fences"] >= 1, stats
+        assert stats["shard_respawns"] >= 1, stats
+        assert all(
+            h["healthy"] and h["dispatch_alive"]
+            for h in router.shard_health()
+        )
+        # operator-visible on /metrics
+        m = rq.get(handle.readiness_url("/metrics"), timeout=10).text
+        metrics: dict[str, float] = {}
+        for line in m.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, _, value = line.rpartition(" ")
+            try:
+                metrics[name.split("{")[0].strip()] = float(value)
+            except ValueError:
+                continue
+        assert metrics["policy_server_shards_serving"] == 2
+        assert metrics["policy_server_shard_fences_total"] >= 1
+        assert metrics["policy_server_shard_respawns_total"] >= 1
+    finally:
+        handle.stop()
+
+
+def test_sharded_pipelined_connection_reroutes_in_order():
+    """Pipelined requests on ONE native connection while a shard dies
+    mid-stream: fenced rows re-route (provably not-yet-dispatched — the
+    fence drain holds the queue mutex) and every response comes back
+    IN ORDER with the verdict of its positional request — the
+    never-double-answered, never-desynced contract at the wire level."""
+    import json as json_mod
+    import socket
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    _native_or_skip()
+    handle = ServerHandle(
+        make_config(
+            frontend="native", serving_shards=2,
+            shard_heartbeat_seconds=0.2,
+        )
+    )
+    conn = None
+    try:
+        router = handle.server.batcher
+
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if router._shards[0].batcher.dispatch_wedged():
+                break
+            time.sleep(0.02)
+        failpoints.clear("shard.dispatch")
+        assert router._shards[0].batcher.dispatch_wedged()
+
+        # one keep-alive connection, N pipelined POSTs back-to-back —
+        # bursts land on BOTH shards (the dead one still enqueues until
+        # the heartbeat fences it)
+        n = 12
+        wire = b""
+        for i in range(n):
+            body = json_mod.dumps(pod_review_body(i % 2 == 0)).encode()
+            wire += (
+                b"POST /validate/pod-privileged HTTP/1.1\r\n"
+                b"Host: t\r\nContent-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"\r\n" + body
+            )
+        conn = socket.create_connection(
+            ("127.0.0.1", handle.server.api_port), timeout=30
+        )
+        conn.sendall(wire)
+
+        buf = b""
+        statuses: list[int] = []
+        verdicts: list[bool | None] = []
+        for _ in range(n):
+            while b"\r\n\r\n" not in buf:
+                chunk = conn.recv(65536)
+                assert chunk, "peer closed mid-pipeline"
+                buf += chunk
+            head, buf = buf.split(b"\r\n\r\n", 1)
+            lines = head.decode("latin-1").split("\r\n")
+            status = int(lines[0].split(" ", 2)[1])
+            clen = 0
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                if k.strip().lower() == "content-length":
+                    clen = int(v.strip())
+            while len(buf) < clen:
+                chunk = conn.recv(65536)
+                assert chunk, "peer closed mid-body"
+                buf += chunk
+            body, buf = buf[:clen], buf[clen:]
+            statuses.append(status)
+            verdicts.append(
+                json_mod.loads(body)["response"]["allowed"]
+                if status == 200 else None
+            )
+        # every pipelined slot answered 200 IN ORDER with the verdict of
+        # ITS OWN request — a re-route that desynced positional
+        # attribution would flip a verdict parity here
+        assert statuses == [200] * n, statuses
+        for i, allowed in enumerate(verdicts):
+            assert allowed is (i % 2 != 0), (i, verdicts)
+        stats = router.stats_snapshot()
+        assert stats["shard_fences"] >= 1, stats
+    finally:
+        if conn is not None:
+            conn.close()
+        handle.stop()
+
+
+def test_shard_heartbeat_probe_fault_fences_then_self_heals():
+    """An armed shard.heartbeat probe fault at server level (the FP04
+    chaos surface for the site): the router's next beat fences the
+    probed shard WITHOUT a respawn (its dispatch loop is alive — a
+    probe fault is evidence of sickness, not a wedge), traffic keeps
+    answering 200 off the sibling, and the beat after the fault clears
+    re-marks the shard healthy."""
+    import requests as rq
+
+    from test_server import ServerHandle, make_config, pod_review_body
+
+    handle = ServerHandle(
+        make_config(serving_shards=2, shard_heartbeat_seconds=0.2)
+    )
+    try:
+        router = handle.server.batcher
+
+        def sick():
+            raise RuntimeError("injected probe fault")
+
+        failpoints.set_failpoint(
+            "shard.heartbeat", sick, count=1, scope="shard-1"
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            stats = router.stats_snapshot()
+            if stats["shard_heartbeat_faults"] >= 1:
+                break
+            time.sleep(0.02)
+        stats = router.stats_snapshot()
+        assert stats["shard_heartbeat_faults"] >= 1, stats
+        assert stats["shard_fences"] >= 1, stats
+
+        # traffic through the fenced window still answers correctly
+        for i in range(8):
+            privileged = i % 2 == 0
+            r = rq.post(
+                handle.url("/validate/pod-privileged"),
+                json=pod_review_body(privileged), timeout=30,
+            )
+            assert r.status_code == 200, (i, r.status_code)
+            assert r.json()["response"]["allowed"] is (not privileged)
+
+        # fault was count=1: the next clean beat self-heals the shard
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(h["healthy"] for h in router.shard_health()):
+                break
+            time.sleep(0.05)
+        health = router.shard_health()
+        assert all(h["healthy"] and h["dispatch_alive"] for h in health), (
+            health
+        )
+        # probe faults never respawn — the dispatch loop was never dead
+        assert router.stats_snapshot()["shard_respawns"] == 0
+    finally:
+        handle.stop()

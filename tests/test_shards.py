@@ -1,0 +1,577 @@
+"""Serving-shard router tests (round 22, runtime/shards.py): the M=1
+bypass identity (bench honesty — one shard IS the plain MicroBatcher,
+byte- and path-identical to every previous round), bit-exact verdicts
+across shard counts, health/EWMA routing, fencing (re-route vs 503,
+per-row ownership), warm revive, heartbeat probe faults, and the
+satellite-2 contract: a fenced row's tenant quota token is released
+exactly once no matter which shard resolves it."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.api.service import RequestOrigin
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironmentBuilder,
+)
+from policy_server_tpu.models import AdmissionReviewRequest, ValidateRequest
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.runtime.batcher import (
+    FencedError,
+    MicroBatcher,
+    ShedError,
+)
+from policy_server_tpu.runtime.shards import ShardRouter, build_serving_shards
+from policy_server_tpu.telemetry import metrics as metrics_mod
+from policy_server_tpu.tenancy import TenantAdmission
+
+from conftest import build_admission_review_dict
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+    metrics_mod.reset_metrics_for_tests()
+
+
+def _policies():
+    return {
+        "ns": parse_policy_entry(
+            "ns",
+            {
+                "module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": ["blocked"]},
+            },
+        ),
+        "priv": parse_policy_entry(
+            "priv", {"module": "builtin://pod-privileged"}
+        ),
+    }
+
+
+def build_env(policies):
+    # verdict cache ON (the shard's own cache is part of its failure
+    # domain); each call builds a FULL fresh environment, exactly what
+    # the router does for sibling shards
+    return EvaluationEnvironmentBuilder(backend="jax").build(policies)
+
+
+def make(env):
+    return MicroBatcher(
+        env, max_batch_size=8, batch_timeout_ms=1.0, policy_timeout=5.0
+    )
+
+
+def review(namespace: str = "default", privileged: bool = False):
+    doc = build_admission_review_dict()
+    doc["request"]["namespace"] = namespace
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": namespace},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def _router(count=2, heartbeat_seconds=30.0, **kw) -> ShardRouter:
+    """A started router whose heartbeat interval is long enough that
+    tests drive fencing deterministically via check_shards()."""
+    env = build_env(_policies())
+    r = build_serving_shards(
+        env, make, build_env, count,
+        heartbeat_seconds=heartbeat_seconds, **kw
+    )
+    r.start()
+    return r
+
+
+def _wait_wedged(batcher, timeout=5.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if batcher.dispatch_wedged():
+            return
+        time.sleep(0.02)
+    raise AssertionError("dispatch thread never wedged")
+
+
+# ---------------------------------------------------------------------------
+# M=1 bypass + bench honesty
+# ---------------------------------------------------------------------------
+
+
+def test_m1_bypass_returns_the_plain_batcher():
+    """--serving-shards 1 must build the EXACT pre-round-22 object: a
+    plain MicroBatcher borrowing the caller's environment, no router on
+    the path at all (the bench-honesty contract)."""
+    env = build_env(_policies())
+    b = build_serving_shards(env, make, build_env, 1)
+    try:
+        assert type(b) is MicroBatcher
+        assert b.env is env
+        assert not hasattr(b, "shard_health")
+        assert b.failpoint_scope is None
+        assert "shard_fences" not in b.stats_snapshot()
+    finally:
+        b.shutdown()
+        env.close()
+
+
+def test_m1_vs_m2_bit_exact_verdicts_and_counter_parity():
+    """The 1-vs-M A/B: the same request corpus answers BIT-EXACT
+    verdicts through one shard and through two, and the M=2 counter
+    snapshot is exactly the M=1 key set plus the shard_* families —
+    nothing else about the serving surface may differ."""
+    corpus = [
+        ("ns", review("default")),
+        ("ns", review("blocked")),
+        ("priv", review(privileged=True)),
+        ("priv", review(privileged=False)),
+    ] * 2
+
+    def run(count):
+        env = build_env(_policies())
+        b = build_serving_shards(env, make, build_env, count)
+        b.start()
+        try:
+            out = []
+            for pid, req in corpus:
+                resp = b.evaluate(
+                    pid, req, RequestOrigin.VALIDATE, timeout=30
+                )
+                out.append(json.dumps(resp.to_dict(), sort_keys=True))
+            return out, set(b.stats_snapshot().keys())
+        finally:
+            b.shutdown()
+            env.close()
+
+    v1, k1 = run(1)
+    v2, k2 = run(2)
+    assert v1 == v2  # bit-exact across shard counts
+    shard_keys = {
+        "shard_fences", "shard_reroutes", "shard_fenced_rows",
+        "shard_respawns", "shard_heartbeat_faults",
+    }
+    assert k2 == k1 | shard_keys
+
+
+# ---------------------------------------------------------------------------
+# Routing
+# ---------------------------------------------------------------------------
+
+
+def test_routing_prefers_the_shallow_queue_by_ewma():
+    r = _router()
+    try:
+        with r._lock:
+            r._shards[0].ewma = 50.0
+            r._shards[1].ewma = 0.0
+        assert r._pick() is r._shards[1]
+        with r._lock:
+            r._shards[0].ewma = 0.0
+            r._shards[1].ewma = 50.0
+        assert r._pick() is r._shards[0]
+    finally:
+        r.shutdown()
+
+
+def test_routing_skips_fenced_shards_and_never_strands():
+    r = _router()
+    try:
+        with r._lock:
+            r._shards[0].healthy = False
+        for _ in range(5):
+            assert r._pick() is r._shards[1]
+        # all fenced: still routes (least-loaded) — the next heartbeat
+        # revives or fence-drains, a row is never stranded
+        with r._lock:
+            r._shards[1].healthy = False
+        assert r._pick() is not None
+    finally:
+        r.shutdown()
+
+
+def test_router_duck_types_the_batcher_surface():
+    r = _router()
+    try:
+        assert r.serving_shards == 2
+        assert r.queue_depth() == 0
+        assert r.audit_lane_depth() == 0
+        assert r.estimated_wait() >= 0.0
+        # unknown attributes delegate to shard 0's batcher
+        assert r.max_batch_size == r._shards[0].batcher.max_batch_size
+        assert r.env is r._shards[0].env
+        resp = r.evaluate(
+            "ns", review("blocked"), RequestOrigin.VALIDATE, timeout=30
+        )
+        assert resp.allowed is False
+    finally:
+        r.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fencing: scoped kill, re-route, warm revive, 503 fallback
+# ---------------------------------------------------------------------------
+
+
+def test_scoped_dispatch_kill_fences_reroutes_and_warm_revives():
+    """Kill ONE shard's dispatch loop via its shard-scoped failpoint:
+    the heartbeat pass fences it, re-routes its queued rows to the
+    sibling (which answers real verdicts), warm-revives the dead loop,
+    and the sibling never blinks."""
+    r = _router()
+    try:
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        victim = r._shards[0].batcher
+        _wait_wedged(victim)
+        failpoints.clear("shard.dispatch")
+        assert not r._shards[1].batcher.dispatch_wedged()
+
+        # rows queued on the DEAD shard: owned by it, going nowhere
+        futs = [
+            victim.submit_nowait(
+                "ns", review("blocked" if i % 2 else "default"),
+                RequestOrigin.VALIDATE,
+            )
+            for i in range(4)
+        ]
+        for p in list(victim._queue.queue):
+            assert p.owner is victim
+
+        fenced = r.check_shards()
+        assert fenced == 1
+        # every row resolves exactly once, with the RIGHT verdict, on
+        # the sibling
+        for i, f in enumerate(futs):
+            resp = f.result(timeout=30)
+            assert resp.allowed is (i % 2 == 0), i
+        stats = r.stats_snapshot()
+        assert stats["shard_fences"] == 1
+        assert stats["shard_reroutes"] == 4
+        assert stats["shard_fenced_rows"] == 0
+        assert stats["shard_respawns"] == 1
+        # warm-revived in place: healthy, dispatch alive, and serving
+        health = r.shard_health()
+        assert all(h["healthy"] and h["dispatch_alive"] for h in health)
+        resp = r.evaluate(
+            "ns", review("default"), RequestOrigin.VALIDATE, timeout=30
+        )
+        assert resp.allowed is True
+    finally:
+        r.shutdown()
+
+
+def test_fence_without_sibling_answers_503_fenced_error():
+    """No healthy sibling at fence time: every queued row fails with
+    FencedError — an in-band 503 + Retry-After, a ShedError subclass so
+    all four HTTP surfaces map it off the class attributes."""
+    r = _router()
+    try:
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        victim = r._shards[0].batcher
+        _wait_wedged(victim)
+        failpoints.clear("shard.dispatch")
+        futs = [
+            victim.submit_nowait(
+                "ns", review("default"), RequestOrigin.VALIDATE
+            )
+            for _ in range(3)
+        ]
+        with r._lock:
+            r._shards[1].healthy = False
+        r._fence(r._shards[0], "test: no sibling")
+        for f in futs:
+            with pytest.raises(FencedError) as exc_info:
+                f.result(timeout=10)
+            e = exc_info.value
+            assert isinstance(e, ShedError)
+            assert e.http_status == 503
+            assert e.retry_after_seconds > 0
+            assert "fenced" in e.message
+        stats = r.stats_snapshot()
+        assert stats["shard_fenced_rows"] == 3
+        assert stats["shard_reroutes"] == 0
+    finally:
+        r.shutdown()
+
+
+def test_fence_drain_clears_ownership_and_reroute_restamps():
+    """The never-double-answered invariant's mechanism: fence_drain
+    clears _Pending.owner under the queue mutex (ownership passes to
+    the router) and the sibling's enqueue re-stamps it — exactly one
+    owner at every instant."""
+    r = _router()
+    try:
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        victim = r._shards[0].batcher
+        sibling = r._shards[1].batcher
+        _wait_wedged(victim)
+        failpoints.clear("shard.dispatch")
+        # pause the sibling too so re-routed rows are observable in its
+        # queue before dispatch
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-1"
+        )
+        _wait_wedged(sibling)
+        failpoints.clear("shard.dispatch")
+
+        futs = [
+            victim.submit_nowait(
+                "ns", review("default"), RequestOrigin.VALIDATE
+            )
+            for _ in range(3)
+        ]
+        rows = victim.fence_drain()
+        assert len(rows) == 3
+        assert all(p.owner is None for p in rows)  # router owns them now
+        assert victim.queue_depth() == 0
+        overflow = sibling._put_burst(rows)
+        assert overflow == []
+        for p in list(sibling._queue.queue):
+            assert p.owner is sibling  # re-stamped by the new owner
+        # revive the sibling: the rows it now owns must resolve
+        assert sibling.revive_dispatch()
+        for f in futs:
+            assert f.result(timeout=30).allowed is True
+        # victim's own revive path still works
+        assert victim.revive_dispatch()
+    finally:
+        r.shutdown()
+
+
+def test_heartbeat_probe_fault_fences_then_recovers():
+    """An armed shard.heartbeat fault makes ONE shard unprobeable: the
+    router fences it (no respawn — the dispatch loop is fine) and the
+    next clean pass restores it."""
+    r = _router()
+    try:
+        def fault():
+            raise RuntimeError("injected probe fault")
+
+        failpoints.set_failpoint(
+            "shard.heartbeat", fault, count=1, scope="shard-1"
+        )
+        assert r.check_shards() == 1
+        health = {h["shard"]: h for h in r.shard_health()}
+        assert health[0]["healthy"] is True
+        assert health[1]["healthy"] is False
+        stats = r.stats_snapshot()
+        assert stats["shard_heartbeat_faults"] == 1
+        assert stats["shard_fences"] == 1
+        assert stats["shard_respawns"] == 0  # nothing to revive
+        # fault consumed: the next pass recovers the shard
+        assert r.check_shards() == 0
+        assert all(h["healthy"] for h in r.shard_health())
+    finally:
+        r.shutdown()
+
+
+def test_dead_dispatch_mid_iteration_fails_held_rows_exactly_once():
+    """Crash-safety inside the dispatch loop: a death AFTER rows were
+    popped (not at the loop head) must still resolve them — the _loop
+    BaseException handler answers each held row 503 before re-raising."""
+    env = build_env(_policies())
+    b = make(env)
+    b.start()
+    try:
+        calls = {"n": 0}
+
+        def die_second_call():
+            # first fire: loop head before the queue pop — let it pass.
+            # The kill lands via _launch_batch monkeypatch below instead.
+            calls["n"] += 1
+
+        orig_launch = b._launch_batch
+
+        def exploding_launch(batch):
+            raise RuntimeError("injected mid-iteration death")
+
+        b._launch_batch = exploding_launch
+        fut = b.submit_nowait(
+            "ns", review("default"), RequestOrigin.VALIDATE
+        )
+        with pytest.raises(FencedError):
+            fut.result(timeout=10)
+        _wait_wedged(b)
+        b._launch_batch = orig_launch
+        assert b.revive_dispatch()
+        resp = b.evaluate(
+            "ns", review("default"), RequestOrigin.VALIDATE, timeout=30
+        )
+        assert resp.allowed is True
+    finally:
+        b.shutdown()
+        env.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: tenant quota released exactly once across a shard fence
+# ---------------------------------------------------------------------------
+
+
+class _CountingAdmission(TenantAdmission):
+    """TenantAdmission that counts release() rows — the floor-at-zero
+    semantics of the real class would silently absorb a double release,
+    so the test counts raw calls instead."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.released_rows = 0
+        self._release_lock = threading.Lock()
+
+    def release(self, n: int = 1) -> None:
+        with self._release_lock:
+            self.released_rows += n
+        super().release(n)
+
+
+def _quota_router(adm) -> ShardRouter:
+    env = build_env(_policies())
+
+    def make_quota(e):
+        return MicroBatcher(
+            e, max_batch_size=8, batch_timeout_ms=1.0,
+            policy_timeout=5.0, admission=adm,
+        )
+
+    r = build_serving_shards(
+        env, make_quota, build_env, 2, heartbeat_seconds=30.0
+    )
+    r.start()
+    return r
+
+
+def test_shard_kill_releases_quota_exactly_once_on_reroute():
+    """The satellite-2 regression: a quota-capped tenant's burst is
+    mid-queue when its shard dies. Re-routed rows must NOT be
+    re-admitted (the row was already paid for) and each row's in-flight
+    claim releases exactly once when the sibling answers — the cap
+    returns to zero, no leak, no double release."""
+    adm = _CountingAdmission("capped", max_inflight=8)
+    r = _quota_router(adm)
+    try:
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        victim = r._shards[0].batcher
+        _wait_wedged(victim)
+        failpoints.clear("shard.dispatch")
+        futs = victim.submit_many(
+            [("ns", review("default")) for _ in range(6)],
+            RequestOrigin.VALIDATE,
+        )
+        assert adm.stats()["inflight"] == 6  # admitted, unresolved
+        assert r.check_shards() == 1
+        for f in futs:
+            assert f.result(timeout=30).allowed is True
+        assert adm.released_rows == 6  # exactly once per row
+        assert adm.stats()["inflight"] == 0  # no leaked claims
+        assert adm.stats()["admitted_rows"] == 6  # no re-admission
+    finally:
+        r.shutdown()
+
+
+def test_shard_kill_releases_quota_exactly_once_on_503():
+    """Same contract when no sibling has room: the fence-time 503 is a
+    resolution too — it must release the quota claim exactly once."""
+    adm = _CountingAdmission("capped", max_inflight=8)
+    r = _quota_router(adm)
+    try:
+        def die():
+            raise RuntimeError("injected shard death")
+
+        failpoints.set_failpoint(
+            "shard.dispatch", die, count=1, scope="shard-0"
+        )
+        victim = r._shards[0].batcher
+        _wait_wedged(victim)
+        failpoints.clear("shard.dispatch")
+        futs = victim.submit_many(
+            [("ns", review("default")) for _ in range(4)],
+            RequestOrigin.VALIDATE,
+        )
+        assert adm.stats()["inflight"] == 4
+        with r._lock:
+            r._shards[1].healthy = False
+        r._fence(r._shards[0], "test: no sibling")
+        for f in futs:
+            with pytest.raises(FencedError):
+                f.result(timeout=10)
+        assert adm.released_rows == 4
+        assert adm.stats()["inflight"] == 0
+        # the tenant can immediately admit a fresh burst up to its cap
+        adm.admit(8)
+        adm.release(8)
+    finally:
+        r.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Shutdown contract
+# ---------------------------------------------------------------------------
+
+
+def test_shutdown_drains_shards_in_sequence_and_closes_owned_envs():
+    env = build_env(_policies())
+    closed = []
+    r = build_serving_shards(
+        env, make, build_env, 3, heartbeat_seconds=30.0
+    )
+    r.start()
+    for s in r._shards[1:]:
+        orig_close = s.env.close
+        def tracking_close(_orig=orig_close, _i=s.index):
+            closed.append(_i)
+            _orig()
+        s.env.close = tracking_close
+    futs = [
+        r.submit_nowait("ns", review("default"), RequestOrigin.VALIDATE)
+        for _ in range(4)
+    ]
+    r.shutdown()
+    # every queued row resolved (verdict or in-band shutdown answer)
+    for f in futs:
+        assert f.done()
+    assert closed == [1, 2]  # siblings closed, in order
+    env.close()  # shard 0's env is the CALLER's — router must not close

@@ -163,6 +163,19 @@ def run_report(
         _drive_bulk(batcher, items, origin, 128, 2048)
         wall_s = time.perf_counter() - t0
         att = rec.attribution(since=cursor)
+        # miss wave (round 22): a short all-unique tail the verdict
+        # cache has never seen, so the mix split gets a non-hit group
+        # to compare against — the gated numbers above stay on the
+        # baseline-comparable all-hit shape; only mix_groups is
+        # recomputed over BOTH waves (same XLA buckets, no cold
+        # compiles: the warm wave fixed the shapes)
+        n_miss = 1500 if quick else 4000
+        miss_items = [
+            ("pod-security-group", r)
+            for r in build_requests(n_miss, seed=991)
+        ]
+        _drive_bulk(batcher, miss_items, origin, 128, 2048)
+        att["mix_groups"] = rec.attribution(since=cursor)["mix_groups"]
         gate_ok = (
             att["batches_complete"] > 0
             and att["residual_fraction_of_wall"] <= RESIDUAL_GATE_FRACTION
@@ -258,6 +271,34 @@ def main(argv: list[str] | None = None) -> int:
             f"{att['wall_us_per_row']} us/row (baseline diff recorded "
             "in the artifact)"
         )
+    mix = att.get("mix_groups") or {}
+    if mix:
+        print("cache-mix split (hit = every row pre-serialized, miss = none):")
+        for name in ("hit", "miss", "mixed"):
+            rep = mix.get(name)
+            if rep is None:
+                continue
+            top = sorted(
+                rep["phase_us_per_row"].items(), key=lambda kv: -kv[1]
+            )[:3]
+            tops = ", ".join(f"{p} {us:.2f}" for p, us in top)
+            print(
+                f"  {name:<6} {rep['rows']:>7} rows in "
+                f"{rep['batches_complete']:>5} batches, wall "
+                f"{rep['wall_us_per_row']:>8.2f} us/row, residual "
+                f"{rep['residual_us_per_row']:>7.2f}   top: {tops}"
+            )
+        h = mix.get("hit")
+        # unique rows can still share pre-serialized fragments (the
+        # blob tier keys on verdict CONTENT), so an all-unique wave
+        # often classifies "mixed" rather than pure "miss"
+        other = mix.get("miss") or mix.get("mixed")
+        if h and other and h["wall_us_per_row"] > 0:
+            print(
+                f"  non-hit/hit wall ratio: "
+                f"{other['wall_us_per_row'] / h['wall_us_per_row']:.2f}x"
+                " (where the miss-path gap lives)"
+            )
     print(f"artifact: {args.artifact}")
     rc = 0
     if args.gate and not doc["gate"]["passed"]:

@@ -116,6 +116,13 @@ class SoakSettings:
     # crash-consistent periodic spill and the promotion-time manifests
     # is carried across (make restart-drill does the real SIGKILL).
     restarts: int = 0
+    # serving shards (round 22, runtime/shards.py): M host-local
+    # serving stacks behind the health/EWMA router. > 1 adds the
+    # shard_kill storm event (one dispatch loop dies mid-service; the
+    # heartbeat must fence, disposition the queue, and warm-revive) and
+    # the `shard_kill_survived` gate check. 1 = router bypassed, the
+    # pre-round-22 shape.
+    serving_shards: int = 1
 
     @classmethod
     def smoke(cls, **over) -> "SoakSettings":
@@ -133,6 +140,7 @@ class SoakSettings:
             churn_ops_per_second=300.0, window_seconds=2.5,
             preset="smoke", tag="r13_smoke", policy_rewrites=2,
             tenants=2, p99_budget_ms=950.0, restarts=1,
+            serving_shards=2,
         )
         base.update(over)
         return cls(**base)
@@ -150,7 +158,7 @@ class SoakSettings:
             # 4-tenant mix: every SIGHUP runs 5 concurrent reload
             # pipelines (see smoke's budget note)
             policy_rewrites=5, tenants=4, p99_budget_ms=950.0,
-            restarts=2,
+            restarts=2, serving_shards=2,
         )
         base.update(over)
         return cls(**base)
@@ -283,6 +291,7 @@ class SoakEngine:
             request_timeout_ms=2000.0,
             frontend=s.frontend,
             http_workers=s.http_workers,
+            serving_shards=s.serving_shards,
             native_tls="auto",
             native_tls_handshake_timeout_seconds=(
                 self._TLS_HANDSHAKE_TIMEOUT
@@ -1179,6 +1188,7 @@ class SoakEngine:
             # the injected TLS accept outage needs the failpoint-polling
             # native manager; without it the armed site never refuses
             tls=s.tls and self.tls_native,
+            shards=s.serving_shards > 1,
         )
         storm.recorder = self.recorder
         self.storm = storm
@@ -1370,6 +1380,38 @@ class SoakEngine:
         lifecycle_stats = (
             server.lifecycle.stats() if server.lifecycle else {}
         )
+        # collected BEFORE the gate: the shard_kill_survived check reads
+        # the router's fence/respawn receipts out of this snapshot —
+        # PREFERRING the statestore's durable incident log, because the
+        # in-memory counters belong to the CURRENT router and reset to
+        # zero whenever a reload epoch or the restart storm rebuilds it
+        # (the smoke preset does both after its shard_kill wave)
+        batcher_stats = server.batcher.stats_snapshot()
+        shard_kills = [
+            e for e in storm.events if e.kind == "shard_kill"
+        ]
+        shard_log = (
+            server.state.statestore.shard_events()
+            if server.state.statestore is not None else []
+        )
+        logged_respawns = sum(
+            1 for e in shard_log if e.get("reason") == "warm-respawn"
+        )
+        logged_fences = len(shard_log) - logged_respawns
+        shard_fences = max(
+            logged_fences, batcher_stats.get("shard_fences", 0)
+        )
+        shard_respawns = max(
+            logged_respawns, batcher_stats.get("shard_respawns", 0)
+        )
+        shard_rerouted = max(
+            sum(e.get("rows_rerouted", 0) for e in shard_log),
+            batcher_stats.get("shard_reroutes", 0),
+        )
+        shard_fenced_rows = max(
+            sum(e.get("rows_fenced", 0) for e in shard_log),
+            batcher_stats.get("shard_fenced_rows", 0),
+        )
         gate = self.recorder.gate(
             p99_budget_ms=s.p99_budget_ms,
             fault_events=storm.events,
@@ -1390,10 +1432,25 @@ class SoakEngine:
                 {"planned": s.restarts, "events": self._restarts_done}
                 if s.restarts else None
             ),
+            shard_storm=(
+                {
+                    "planned": len(shard_kills),
+                    "applied": sum(
+                        1 for e in shard_kills
+                        if e.applied_at is not None
+                        and not e.effect.startswith("APPLY FAILED")
+                    ),
+                    "shards": s.serving_shards,
+                    "fences": shard_fences,
+                    "respawns": shard_respawns,
+                    "rerouted_rows": shard_rerouted,
+                    "fenced_rows": shard_fenced_rows,
+                }
+                if s.serving_shards > 1 else None
+            ),
         )
         feed_stats = self.feed.stats()
         scanner_stats = server.state.audit.stats()
-        batcher_stats = server.batcher.stats_snapshot()
         native_stats = (
             server.state.native_frontend.stats()
             if server.state.native_frontend is not None else {}
@@ -1415,6 +1472,7 @@ class SoakEngine:
                 "cluster_objects": self.cluster.object_count(),
                 "churn_ops": self.cluster.churn_ops,
                 "frontend": "native" if self.native_active else "python",
+                "serving_shards": s.serving_shards,
                 "sighup_real_signal": sighup_registered,
                 # where TLS terminated: "native" (the acceptance shape),
                 # "aiohttp" (fallback — TLS on, native termination off),
@@ -1455,6 +1513,25 @@ class SoakEngine:
                         "audit_preemptions", "bulk_submits",
                     )
                 },
+                # the router's fence/respawn receipts (round 22) plus
+                # per-shard terminal health — None with serving_shards=1
+                # (plain batcher, no router object). Run-cumulative
+                # counts come from the durable incident log (the final
+                # router's own counters only cover the last epoch)
+                "shards": (
+                    {
+                        "health": server.batcher.shard_health(),
+                        "shard_fences": shard_fences,
+                        "shard_reroutes": shard_rerouted,
+                        "shard_fenced_rows": shard_fenced_rows,
+                        "shard_respawns": shard_respawns,
+                        "shard_heartbeat_faults": batcher_stats.get(
+                            "shard_heartbeat_faults", 0
+                        ),
+                        "incident_log": shard_log,
+                    }
+                    if hasattr(server.batcher, "shard_health") else None
+                ),
                 "lifecycle": lifecycle_stats,
                 "native_frontend": native_stats,
                 # the TLS soak's rotation/identity receipts (round 20):

@@ -37,6 +37,13 @@ Event kinds:
   watch stream (resourceVersion resume path, no re-LIST).
 * ``worker_kill``     — SIGKILL one prefork HTTP worker (only when the
   engine runs ``http_workers > 1``); the supervisor must respawn it.
+* ``shard_kill``      — arm ``shard.dispatch=raise*1`` (only when the
+  engine runs ``serving_shards > 1``): one shard's dispatch loop dies
+  mid-service, the router's heartbeat fences it within one beat
+  (queued rows re-route to a sibling or answer 503+Retry-After — never
+  both, never neither) and warm-revives it in place. The SLO gate's
+  ``shard_kill_survived`` check requires the fence AND the respawn
+  actually happened and every fenced row was accounted.
 * ``tls_fault``       — arm ``tls.handshake=raise`` for one bounded
   window (TLS soaks only): the native accept path refuses EVERY new
   handshake while established connections keep serving; a timer
@@ -98,6 +105,7 @@ class FaultStorm:
 
     _WINDOWED_KINDS = (
         "frontend_fault", "worker_kill", "device_fault", "tls_fault",
+        "shard_kill",
     )
 
     @classmethod
@@ -111,6 +119,7 @@ class FaultStorm:
         sighup_registered: bool = False,
         workers: bool = False,
         tls: bool = False,
+        shards: bool = False,
     ) -> "FaultStorm":
         """The seeded timeline: one of each core fault inside the middle
         80% of the soak (faults at the very edges test nothing), plus a
@@ -125,6 +134,8 @@ class FaultStorm:
             kinds.append("worker_kill")
         if tls:
             kinds.append("tls_fault")
+        if shards:
+            kinds.append("shard_kill")
         lo, hi = 0.1 * duration, 0.9 * duration
         window = min(5.0, max(2.0, 0.15 * duration))
         events = sorted(
@@ -232,6 +243,7 @@ class FaultStorm:
             "stream_close": self._stream_close,
             "worker_kill": self._worker_kill,
             "tls_fault": self._tls_fault,
+            "shard_kill": self._shard_kill,
         }[event.kind]
         event.effect = apply_fn()
 
@@ -325,6 +337,29 @@ class FaultStorm:
         return (
             "tls.handshake armed (native accepts refuse), auto-disarm "
             f"in {self.window_seconds:g}s"
+        )
+
+    def _shard_kill(self) -> str:
+        """Kill one serving shard's dispatch loop mid-service: arm
+        ``shard.dispatch`` for exactly one fire — the next dispatch
+        iteration of whichever shard pops the arm first dies at the
+        loop head (holding zero rows). The router's heartbeat must
+        fence the dead shard, disposition its queue (sibling re-route
+        or 503), and warm-revive it. Auto-disarm at window end for the
+        pathological case where no dispatch iteration ran inside the
+        window (idle trace) — a lingering arm would otherwise kill a
+        shard minutes later, outside the recorder's explained window."""
+        failpoints.configure("shard.dispatch=raise:soak-shard-kill*1")
+        timer = threading.Timer(
+            self.window_seconds,
+            lambda: failpoints.configure("shard.dispatch=off"),
+        )
+        timer.daemon = True
+        timer.start()
+        self._timers.append(timer)
+        return (
+            "shard.dispatch armed x1 (one shard dies; heartbeat must "
+            f"fence + warm-revive), auto-disarm in {self.window_seconds:g}s"
         )
 
     def _worker_kill(self) -> str:

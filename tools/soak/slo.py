@@ -223,6 +223,7 @@ class SLORecorder:
         policy_rewrites: "dict | None" = None,
         tenant_mix: "dict | None" = None,
         restart_storm: "dict | None" = None,
+        shard_storm: "dict | None" = None,
     ) -> dict[str, Any]:
         t = self.totals()
         sighups = [
@@ -313,6 +314,26 @@ class SLORecorder:
                     and not e.get("error")
                     for e in events
                 )
+            )
+        if shard_storm is not None:
+            # shard-kill storm (round 22, runtime/shards.py): every
+            # scheduled shard.dispatch kill was armed AND the router
+            # provably reacted — at least one fence (the heartbeat
+            # caught the dead loop) and every fence was answered by a
+            # warm revive (no shard stays dark). Row accounting rides
+            # the global zero-unexplained check: a fenced row answers a
+            # 503 inside the kill's declared fault window or re-routes
+            # to a sibling and answers a verdict — a row answered twice
+            # (or never) surfaces as unexplained/timeout and fails the
+            # soak outright.
+            checks["shard_kill_survived"] = (
+                shard_storm.get("planned", 0) > 0
+                and shard_storm.get("applied", 0)
+                >= shard_storm["planned"]
+                and shard_storm.get("shards", 0) > 1
+                and shard_storm.get("fences", 0) >= 1
+                and shard_storm.get("respawns", 0)
+                >= shard_storm.get("fences", 0)
             )
         return {
             "passed": all(checks.values()),
