@@ -254,16 +254,103 @@ async def audit_handler(request: web.Request) -> web.Response:
         return web.json_response(AdmissionReviewResponse(result).to_dict())
 
 
+def _audit_reports_etag(state: ApiServerState) -> str:
+    """The GET /audit/reports validator: snapshot generation (what the
+    cluster looks like) + serving epoch (which policy set judged it) +
+    report-store version (what the sweeps actually wrote). Any change an
+    unchanged-ETag response could hide bumps one of the three."""
+    scanner = state.audit
+    generation = scanner.snapshot.stats().get("generation", 0)
+    epoch = (
+        state.lifecycle.current_epoch if state.lifecycle is not None else 0
+    )
+    return f'"audit-{generation}-{epoch}-{scanner.reports.version()}"'
+
+
 async def audit_reports_handler(request: web.Request) -> web.Response:
     """GET /audit/reports[/{namespace}] — the background audit scanner's
     PolicyReport-style output (round 10): per-resource × per-policy raw
     verdicts stamped with the policy epoch that produced them, plus
-    summary counters and scanner freshness. 404 when --audit-mode off."""
+    summary counters and scanner freshness. 404 when --audit-mode off.
+    Round 23: carries an ETag and honors If-None-Match with 304, so
+    pollers that have not migrated to /audit/stream stop re-serializing
+    unchanged full reports."""
     state = request.app[STATE_KEY]
     if state.audit is None:
         return api_error(404, "the background audit scanner is disabled")
     namespace = request.match_info.get("namespace")
-    return web.json_response(state.audit.report_payload(namespace))
+    etag = _audit_reports_etag(state)
+    if request.headers.get("If-None-Match") == etag:
+        return web.Response(status=304, headers={"ETag": etag})
+    return web.json_response(
+        state.audit.report_payload(namespace), headers={"ETag": etag}
+    )
+
+
+async def audit_stream_handler(request: web.Request) -> web.StreamResponse:
+    """GET /audit/stream[?cursor=N] — the verdict matrix's watch-style
+    changelog as chunked JSON lines (round 23). Each line carries a
+    monotonic ``matrixVersion``; a client that disconnects resumes with
+    ``?cursor=<last seen>`` and replays exactly the missed entries, or
+    gets a RESYNC marker + full state when the ring no longer covers the
+    cursor. A slow consumer overflows its own bounded queue and is
+    dropped with a counted close — the sweep applier never blocks on a
+    client. 404 without --audit-matrix; 503 over the client cap."""
+    state = request.app[STATE_KEY]
+    matrix = state.audit_matrix
+    if matrix is None:
+        return api_error(404, "the verdict matrix is disabled")
+    if matrix.stream_clients() >= state.audit_stream_max_clients:
+        return api_error(
+            503,
+            f"audit stream client cap reached "
+            f"({state.audit_stream_max_clients}); retry later",
+        )
+    cursor: int | None = None
+    raw_cursor = request.query.get("cursor")
+    if raw_cursor is not None:
+        try:
+            cursor = int(raw_cursor)
+        except ValueError:
+            return api_error(422, f"invalid cursor {raw_cursor!r}")
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "application/x-ndjson",
+            "Cache-Control": "no-cache",
+        },
+    )
+    resp.enable_chunked_encoding()
+    await resp.prepare(request)
+    sub = matrix.subscribe(cursor)
+    try:
+        while True:
+            entries, dead = matrix.drain(sub)
+            for entry in entries:
+                await resp.write(
+                    json.dumps(entry, separators=(",", ":")).encode()
+                    + b"\n"
+                )
+            if dead:
+                # the queue overflowed while we were writing: tell the
+                # client honestly (it reconnects with its cursor) and
+                # close — a silent gap would corrupt its matrix view
+                await resp.write(
+                    json.dumps(
+                        {
+                            "type": "OVERFLOW",
+                            "matrixVersion": matrix.version,
+                        },
+                        separators=(",", ":"),
+                    ).encode() + b"\n"
+                )
+                break
+            await asyncio.sleep(0.1)
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass  # client went away — the cursor contract covers its return
+    finally:
+        matrix.unsubscribe(sub)
+    return resp
 
 
 async def validate_raw_handler(request: web.Request) -> web.Response:
@@ -356,14 +443,18 @@ async def policies_reload_handler(request: web.Request) -> web.Response:
     if denied is not None:
         return denied
     started = state.lifecycle.request_reload("admin-endpoint")
-    return web.json_response(
-        {
-            "status": "reload started" if started else
-            "reload already in progress",
-            "epoch": state.lifecycle.current_epoch,
-        },
-        status=202,
-    )
+    body = {
+        "status": "reload started" if started else
+        "reload already in progress",
+        "epoch": state.lifecycle.current_epoch,
+    }
+    # last shadow-canary cluster what-if (round 23): the verdict flips
+    # the PREVIOUS candidate would have caused — this reload's own diff
+    # lands once its canary runs (poll this endpoint or the matrix)
+    whatif = state.lifecycle.stats().get("whatif")
+    if whatif is not None:
+        body["whatif"] = whatif
+    return web.json_response(body, status=202)
 
 
 async def _lifecycle_action(
@@ -385,9 +476,11 @@ async def _lifecycle_action(
     except Exception as e:  # noqa: BLE001 — keep the JSON error contract
         logger.error("policy %s failed: %s", action, e)
         return something_went_wrong()
-    return web.json_response(
-        {"status": outcome, "epoch": state.lifecycle.current_epoch}
-    )
+    body = {"status": outcome, "epoch": state.lifecycle.current_epoch}
+    whatif = state.lifecycle.stats().get("whatif")
+    if whatif is not None:
+        body["whatif"] = whatif
+    return web.json_response(body)
 
 
 async def policies_rollback_handler(request: web.Request) -> web.Response:
@@ -483,6 +576,9 @@ def build_router(state: ApiServerState) -> web.Application:
     # wildcard so the report listing wins path resolution
     app.router.add_get("/audit/reports", audit_reports_handler)
     app.router.add_get("/audit/reports/{namespace}", audit_reports_handler)
+    # verdict-matrix changelog stream (round 23) — literal, same
+    # wildcard-shadowing rule as /audit/reports ('stream' is reserved)
+    app.router.add_get("/audit/stream", audit_stream_handler)
     app.router.add_post("/audit/{policy_id}", audit_handler)
     # tenant-routed evaluation surface (round 16, tenancy.py): the
     # tenant rides the path; the un-prefixed routes above stay the
@@ -525,6 +621,9 @@ def build_readiness_router(state: ApiServerState) -> web.Application:
     # surface), cluster-internal like /metrics
     app.router.add_get("/audit/reports", audit_reports_handler)
     app.router.add_get("/audit/reports/{namespace}", audit_reports_handler)
+    # verdict-matrix changelog stream: also on the readiness port (the
+    # main process owns the matrix; prefork workers only proxy POSTs)
+    app.router.add_get("/audit/stream", audit_stream_handler)
     # flight-recorder timeline (round 18): the main-process ring is the
     # one with the batcher/device phases, and the readiness port is
     # always served by the main process — the canonical surface

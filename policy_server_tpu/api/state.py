@@ -63,6 +63,13 @@ class ApiServerState:
     # the live-cluster watch feed (audit.WatchFeed); None unless
     # --audit-watch — /metrics reads it through the state
     audit_watch: Any = None
+    # the persistent verdict matrix (audit.VerdictMatrix); None unless
+    # --audit-matrix — GET /audit/stream then 404s and /metrics exports
+    # the matrix families as zero
+    audit_matrix: Any = None
+    # concurrent GET /audit/stream clients beyond which new subscribers
+    # get an in-band 503 (--audit-stream-max-clients)
+    audit_stream_max_clients: int = 64
     # live soak-window SLO observer (tools/soak engine, in-process
     # soaks): a dict of {rps, p99_ms, shed_rate} the engine refreshes
     # per window so /metrics exposes the soak's live trend; None outside

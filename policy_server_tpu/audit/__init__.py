@@ -1,16 +1,23 @@
 """In-process background audit scanner (round 10) + live watch feed
-(round 13).
+(round 13) + persistent verdict matrix (round 23).
 
 The reference relies on an external companion (Kubewarden's
 audit-scanner) to continuously replay existing cluster resources through
 the policy set; this package keeps that loop in-process, riding the
 micro-batcher's best-effort audit lane so live admission traffic
-strictly preempts it. See scanner.py for the full contract, and
+strictly preempts it. See scanner.py for the full contract,
 watch_feed.py for the list+watch feed that keeps the snapshot inventory
 tracking a LIVE cluster instead of only /validate traffic and a seed
-file.
+file, and matrix.py for the persistent (object × policy) verdict matrix
+that streams verdict changes, spills through the statestore, and serves
+byte-identical admissions from precomputed verdicts.
 """
 
+from policy_server_tpu.audit.matrix import (
+    VerdictMatrix,
+    normalized_payload_hash,
+    policy_fingerprint,
+)
 from policy_server_tpu.audit.reports import PolicyReportStore
 from policy_server_tpu.audit.scanner import AUDIT_MODES, AuditScanner
 from policy_server_tpu.audit.snapshot import (
@@ -25,8 +32,11 @@ __all__ = [
     "AuditScanner",
     "PolicyReportStore",
     "SnapshotStore",
+    "VerdictMatrix",
     "WatchFeed",
+    "normalized_payload_hash",
     "parse_watch_resources",
+    "policy_fingerprint",
     "resource_key",
     "synthesize_review",
 ]

@@ -37,6 +37,9 @@ class PolicyReportStore:
         # (resource_key, policy_id) -> report row dict
         self._rows: dict[tuple[str, str], dict[str, Any]] = {}  # guarded-by: _lock
         self._stale_marked = 0  # guarded-by: _lock
+        # bumps on every mutation (put/drop/retain/mark-stale) — one of
+        # the GET /audit/reports ETag axes (304 short-circuit)
+        self._version = 0  # guarded-by: _lock
 
     @staticmethod
     def row_from_result(
@@ -79,6 +82,8 @@ class PolicyReportStore:
 
     def put(self, rows: list[dict[str, Any]]) -> None:
         with self._lock:
+            if rows:
+                self._version += 1
             for row in rows:
                 self._rows[(row["resource"], row["policy_id"])] = row
 
@@ -97,6 +102,8 @@ class PolicyReportStore:
             dead = [k for k in self._rows if k[0] in keys]
             for k in dead:
                 del self._rows[k]
+            if dead:
+                self._version += 1
         return len(dead)
 
     def retain(self, resource_keys: set, policy_ids: set) -> int:
@@ -114,6 +121,8 @@ class PolicyReportStore:
             ]
             for k in dead:
                 del self._rows[k]
+            if dead:
+                self._version += 1
         return len(dead)
 
     def mark_epoch_stale(self, epoch: int) -> int:
@@ -128,6 +137,8 @@ class PolicyReportStore:
                     row["stale"] = True
                     marked += 1
             self._stale_marked += marked
+            if marked:
+                self._version += 1
         return marked
 
     # -- query surface (GET /audit/reports[/{namespace}]) ------------------
@@ -153,6 +164,10 @@ class PolicyReportStore:
             "stale": len(rows) - len(fresh),
         }
         return {"summary": summary, "reports": rows}
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
 
     def stats(self) -> dict[str, int]:
         with self._lock:

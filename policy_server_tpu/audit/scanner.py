@@ -74,12 +74,19 @@ class AuditScanner:
         interval_seconds: float = 30.0,
         batch_size: int = 256,
         job_timeout_seconds: float = 60.0,
+        matrix: Any = None,
     ) -> None:
         if mode not in AUDIT_MODES:
             raise ValueError(f"invalid audit mode {mode!r}")
         self.state = state
         self.snapshot = snapshot
         self.reports = reports
+        # optional verdict matrix (audit/matrix.py): when armed, sweeps
+        # evaluate the dirty CROSS-PRODUCT (dirty-rows × all-columns +
+        # clean-rows × dirty-columns) and feed results to the matrix
+        # next to the report store; epoch hooks diff policy-content
+        # fingerprints instead of requesting whole-cluster re-judges
+        self.matrix = matrix
         self.mode = mode
         self.interval = max(0.05, float(interval_seconds))
         self.batch_size = max(1, int(batch_size))
@@ -98,6 +105,9 @@ class AuditScanner:
         self._sweep_lock = threading.Lock()
         self._lock = threading.Lock()
         self._full_pending = True  # guarded-by: _lock — first sweep is full
+        # a matrix column-diff promotion requests a DIRTY sweep; this
+        # flag lets on-promote mode run it without a cadence tick
+        self._kick_pending = False  # guarded-by: _lock
         self._full_sweeps = 0  # guarded-by: _lock
         self._dirty_sweeps = 0  # guarded-by: _lock
         self._sweep_errors = 0  # guarded-by: _lock
@@ -129,6 +139,10 @@ class AuditScanner:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.matrix is not None:
+            # final durable spill so the next boot resumes compliance
+            # from the freshest verdicts, not the last cadence tick's
+            self.matrix.maybe_spill(force=True)
 
     # -- triggers ----------------------------------------------------------
 
@@ -138,21 +152,74 @@ class AuditScanner:
         self._wake.set()
         logger.info("audit full sweep requested (%s)", reason)
 
+    def skip_boot_full_sweep(self) -> None:
+        """Warm-boot downgrade: a successful matrix restore proved the
+        covered rows current under the serving column fingerprints, so
+        the pending boot FULL sweep becomes a dirty sweep of whatever
+        the restore could not validate (zero re-judge of clean rows —
+        the restart drill asserts this)."""
+        with self._lock:
+            self._full_pending = False
+            self._kick_pending = True
+
+    def request_dirty_sweep(self, reason: str) -> None:
+        """Kick one dirty sweep out of cadence (matrix column-diff
+        promotions: only the changed columns need re-judging, so a full
+        sweep would throw away exactly the work the matrix preserved)."""
+        with self._lock:
+            self._kick_pending = True
+        self._wake.set()
+        logger.info("audit dirty sweep requested (%s)", reason)
+
+    def _matrix_columns_sync(self, epoch: int) -> "dict | None":
+        """Diff the SERVING policy set's content fingerprints into the
+        matrix columns. Returns the diff, or None when the matrix is off
+        or the environment cannot supply its source policies (then the
+        caller falls back to the pre-matrix full-sweep contract)."""
+        matrix = self.matrix
+        if matrix is None:
+            return None
+        env = self.state.evaluation_environment
+        policies = (
+            getattr(env, "source_policies", None)
+            if env is not None else None
+        )
+        if not policies:
+            return None
+        return matrix.set_columns(policies, epoch)
+
     def on_promote(self, epoch: int) -> None:
-        """Lifecycle post-promote hook: the newly serving policy set must
-        re-judge every resource admitted under the previous one."""
-        self.request_full_sweep(f"epoch-{epoch}-promoted")
+        """Lifecycle post-promote hook. Matrix off: the newly serving
+        policy set must re-judge every resource admitted under the
+        previous one (full sweep). Matrix on: diff column fingerprints —
+        a promotion that changes 2 of 32 policies dirties 2 columns and
+        kicks a dirty sweep; an unchanged-content promotion re-stamps
+        cells and re-judges NOTHING."""
+        diff = self._matrix_columns_sync(epoch)
+        if diff is None:
+            self.request_full_sweep(f"epoch-{epoch}-promoted")
+            return
+        if diff["dirty"] or diff["removed"]:
+            self.request_dirty_sweep(
+                f"epoch-{epoch}-promoted: {len(diff['dirty'])} column(s) "
+                f"dirty, {len(diff['removed'])} removed"
+            )
 
     def on_rollback(self, stale_epoch: int, serving_epoch: int) -> None:
         """Lifecycle rollback hook: the rolled-back epoch's verdicts no
         longer describe a policy set anyone serves — mark them stale,
-        then re-scan under the revived epoch."""
+        then re-scan under the revived epoch. The matrix diffs columns
+        first (a rollback to byte-identical policy content keeps its
+        cells valid; the full sweep's re-judge then re-stamps without
+        emission), but the REPORT rows need the revived epoch's stamp,
+        so the full-sweep contract stays."""
         marked = self.reports.mark_epoch_stale(stale_epoch)
         logger.warning(
             "audit reports from rolled-back policy epoch %d marked stale "
             "(%d rows); full re-scan under epoch %d queued",
             stale_epoch, marked, serving_epoch,
         )
+        self._matrix_columns_sync(serving_epoch)
         self.request_full_sweep(f"epoch-{stale_epoch}-rolled-back")
 
     # -- the cadence loop --------------------------------------------------
@@ -174,7 +241,9 @@ class AuditScanner:
             with self._lock:
                 full = self._full_pending
                 self._full_pending = False
-            if not full and self.mode != "interval":
+                kick = self._kick_pending
+                self._kick_pending = False
+            if not full and not kick and self.mode != "interval":
                 continue
             try:
                 self.sweep(full=full)
@@ -203,8 +272,12 @@ class AuditScanner:
 
     def _prune_deletions(self) -> None:
         """Drain DELETE-evicted snapshot keys and drop their report rows
-        in one bulk pass; called every cadence tick and at sweep heads."""
-        self.reports.drop_resources(self.snapshot.take_deletions())
+        (and matrix rows — each emits a DELETE changelog entry) in one
+        bulk pass; called every cadence tick and at sweep heads."""
+        deleted = self.snapshot.take_deletions()
+        self.reports.drop_resources(deleted)
+        if self.matrix is not None and deleted:
+            self.matrix.evict_rows(deleted)
 
     def _defer_full(self, full: bool) -> None:
         """A full sweep that could not run keeps its claim: without this
@@ -238,6 +311,11 @@ class AuditScanner:
         # rows (a deleted object's verdicts must not read as current
         # cluster posture); one bulk pass, not per-key scans
         self._prune_deletions()
+        matrix = self.matrix
+        if matrix is not None and not matrix.has_columns():
+            # standalone harnesses (bench, tests) that never fire a
+            # lifecycle hook still get columns before the first record
+            self._matrix_columns_sync(epoch)
         items = self.snapshot.collect(dirty_only=not full)
         policy_ids = list(env.policy_ids())
         rows = [
@@ -245,6 +323,28 @@ class AuditScanner:
             for key, request in items
             for pid in policy_ids
         ]
+        dirty_cols: set[str] = set()
+        if matrix is not None:
+            # the dirty CROSS-PRODUCT: dirty-rows × ALL columns (above)
+            # plus clean-rows × dirty-columns. A full sweep already
+            # covers every cell, so it just claims (and thereby clears)
+            # the dirty-column set.
+            dirty_cols = matrix.take_dirty_columns()
+            col_rows = 0
+            if dirty_cols and not full:
+                dirty_keys = {key for key, _req in items}
+                cols = [pid for pid in policy_ids if pid in dirty_cols]
+                extra = [
+                    (key, pid, request)
+                    for key, request in self.snapshot.rows_snapshot()
+                    if key not in dirty_keys
+                    for pid in cols
+                ]
+                col_rows = len(extra)
+                rows.extend(extra)
+            matrix.note_sweep(
+                row_rows=len(rows) - col_rows, column_rows=col_rows
+            )
         scanned = 0
         try:
             for start in range(0, len(rows), self.batch_size):
@@ -274,6 +374,16 @@ class AuditScanner:
                     for (key, pid, request), result in zip(chunk, results)
                 ]
                 self.reports.put(report_rows)
+                if matrix is not None:
+                    matrix.record_rows(
+                        [
+                            (key, pid, request, result)
+                            for (key, pid, request), result in zip(
+                                chunk, results
+                            )
+                        ],
+                        epoch,
+                    )
                 scanned += len(chunk)
                 with self._lock:
                     self._rows_scanned += len(chunk)
@@ -287,6 +397,11 @@ class AuditScanner:
             self.snapshot.remark_dirty(
                 {key for key, _pid, _req in rows[scanned:]}
             )
+            if matrix is not None and dirty_cols:
+                # the claimed columns were not (fully) re-judged; give
+                # them back so the next sweep picks them up (re-judging
+                # an already-landed cell merely re-stamps, never emits)
+                matrix.remark_columns_dirty(dirty_cols)
             raise
         if full:
             # a completed full sweep covered the ENTIRE inventory: any
@@ -297,12 +412,21 @@ class AuditScanner:
             self.reports.retain(
                 {key for key, _pid, _req in rows}, set(policy_ids)
             )
+            if matrix is not None:
+                matrix.retain(
+                    {key for key, _pid, _req in rows}, set(policy_ids)
+                )
         with self._lock:
             if full:
                 self._full_sweeps += 1
                 self._last_full_sweep = time.monotonic()
             else:
                 self._dirty_sweeps += 1
+        if matrix is not None:
+            # durability rides the sweep tail on the spill cadence (and
+            # never the serving path); the scanner drives this — not the
+            # watch feed — so a drill without a kube API still spills
+            matrix.maybe_spill()
         return scanned
 
     # -- introspection -----------------------------------------------------
@@ -333,6 +457,8 @@ class AuditScanner:
         body["scanner"]["snapshot"] = self.snapshot.stats()
         if self.watch_feed is not None:
             body["scanner"]["watch_feed"] = self.watch_feed.stats()
+        if self.matrix is not None:
+            body["scanner"]["matrix"] = self.matrix.stats()
         return body
 
     def stats(self) -> dict[str, Any]:
@@ -370,4 +496,6 @@ class AuditScanner:
         sstats = self.snapshot.stats()
         out["snapshot_resources"] = sstats["resources"]
         out["snapshot_bytes"] = sstats["bytes"]
+        if self.matrix is not None:
+            out["matrix"] = self.matrix.stats()
         return out

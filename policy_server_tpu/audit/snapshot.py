@@ -113,6 +113,8 @@ class SnapshotStore:
         self._superseded = 0  # guarded-by: _lock
         self._evicted = 0  # guarded-by: _lock
         self._deleted = 0  # guarded-by: _lock
+        # bumps on every mutating observe — the /audit/reports ETag axis
+        self._generation = 0  # guarded-by: _lock
 
     # -- recording (the batcher's dirty-set tracker) -----------------------
 
@@ -134,6 +136,7 @@ class SnapshotStore:
         if not prepared:
             return
         with self._lock:
+            self._generation += 1
             for key, request, nbytes in prepared:
                 if request is None:
                     old = self._rows.pop(key, None)
@@ -252,6 +255,28 @@ class SnapshotStore:
         with self._lock:
             self._dirty.update(k for k in keys if k in self._rows)
 
+    def clear_dirty(self, keys: Iterable[str]) -> int:
+        """Drop dirty marks for rows proven current by other means — the
+        verdict matrix's warm-boot restore clears the marks its restored
+        columns fully cover, so the boot sweep re-judges nothing that is
+        provably up to date."""
+        with self._lock:
+            before = len(self._dirty)
+            self._dirty.difference_update(keys)
+            return before - len(self._dirty)
+
+    def rows_snapshot(self) -> list[tuple[str, ValidateRequest]]:
+        """The full inventory WITHOUT clearing dirty marks — the verdict
+        matrix's row axis (clean-rows × dirty-columns sweeps and warm-
+        boot payload-hash validation read this; :meth:`collect` remains
+        the only consumer that claims the dirty set)."""
+        with self._lock:
+            return [(k, row[0]) for k, row in self._rows.items()]
+
+    def dirty_keys(self) -> set[str]:
+        with self._lock:
+            return set(self._dirty)
+
     def take_deletions(self) -> set[str]:
         """Drain the keys evicted by observed DELETEs since the last
         call — the scanner prunes their report rows."""
@@ -268,6 +293,7 @@ class SnapshotStore:
                 "resources": len(self._rows),
                 "bytes": self._bytes,
                 "dirty": len(self._dirty),
+                "generation": self._generation,
                 "recorded": self._recorded,
                 "superseded": self._superseded,
                 "evicted": self._evicted,

@@ -325,6 +325,42 @@ def _flag_specs() -> list[tuple[str, str | None, dict[str, Any]]]:
                    "forces a full re-LIST resync of that kind, so a "
                    "drop can delay freshness but never corrupt the "
                    "inventory")),
+        ("--audit-matrix", "KUBEWARDEN_AUDIT_MATRIX",
+         dict(action="store_true",
+              help="Maintain the persistent (object × policy) verdict "
+                   "matrix (audit/matrix.py): sweeps evaluate only the "
+                   "dirty cross-product (dirty rows × all columns + "
+                   "clean rows × dirty columns — a promotion changing 2 "
+                   "of 32 policies re-judges 2 columns, not the "
+                   "cluster), verdict changes stream on GET "
+                   "/audit/stream with a monotonic matrixVersion "
+                   "cursor, columns spill through --state-dir for warm "
+                   "resume, and a /validate UPDATE byte-identical to a "
+                   "judged row answers from the precomputed verdict. "
+                   "Requires --audit-mode interval|on-promote")),
+        ("--audit-stream-max-clients",
+         "KUBEWARDEN_AUDIT_STREAM_MAX_CLIENTS",
+         dict(type=int, default=64, metavar="N",
+              help="Cap on concurrent GET /audit/stream clients; beyond "
+                   "it new subscribers get an in-band 503 (each client "
+                   "holds a bounded changelog queue — a slow consumer "
+                   "overflows its own queue and is dropped with a "
+                   "counted close, never blocking the applier)")),
+        ("--audit-matrix-spill-seconds",
+         "KUBEWARDEN_AUDIT_MATRIX_SPILL_SECONDS",
+         dict(type=float, default=30.0, metavar="SECONDS",
+              help="Verdict-matrix spill cadence: how often the scanner "
+                   "spills the matrix columns (epoch-fingerprint-keyed) "
+                   "to --state-dir so a warm restart resumes compliance "
+                   "without re-judging clean rows")),
+        ("--audit-matrix-whatif", "KUBEWARDEN_AUDIT_MATRIX_WHATIF",
+         dict(action="store_true",
+              help="During a reload's shadow canary, also evaluate the "
+                   "CANDIDATE epoch's changed columns against the live "
+                   "audit snapshot and surface the cluster-wide what-if "
+                   "verdict diff on the reload status — canarying over "
+                   "the whole cluster, not just the request ring. "
+                   "Requires --audit-matrix")),
         ("--native-idle-timeout-seconds",
          "KUBEWARDEN_NATIVE_IDLE_TIMEOUT_SECONDS",
          dict(type=float, default=75.0, metavar="SECONDS",

@@ -273,6 +273,20 @@ class Config:
     # the snapshot applier; overflow drops the event (counted) and
     # forces a full re-LIST resync of that kind
     audit_watch_max_queue_events: int = 65536
+    # persistent (object × policy) verdict matrix (round 23,
+    # audit/matrix.py): sweeps evaluate only the dirty cross-product,
+    # verdict changes stream on GET /audit/stream, columns spill through
+    # the statestore for warm resume, and byte-identical /validate
+    # UPDATEs answer from precomputed verdicts; requires the scanner
+    audit_matrix: bool = False
+    # concurrent GET /audit/stream clients (beyond it: in-band 503)
+    audit_stream_max_clients: int = 64
+    # matrix spill cadence (scanner-driven, rides the sweep tail)
+    audit_matrix_spill_seconds: float = 30.0
+    # stretch: evaluate a CANDIDATE epoch's changed columns against the
+    # live snapshot during shadow canary and surface the cluster-wide
+    # what-if diff on the reload status
+    audit_matrix_whatif: bool = False
     # native-frontend connection-abuse hardening (csrc/httpfront.cpp,
     # round 13): idle keep-alive reap, per-request read (arrival)
     # timeout bounding slowloris drips, and the concurrent-connection
@@ -455,6 +469,19 @@ class Config:
             raise ValueError(
                 "--audit-watch-max-queue-events must be >= 1"
             )
+        if self.audit_matrix and self.audit_mode == "off":
+            raise ValueError(
+                "--audit-matrix requires the audit scanner "
+                "(--audit-mode interval or on-promote)"
+            )
+        if self.audit_stream_max_clients < 1:
+            raise ValueError("--audit-stream-max-clients must be >= 1")
+        if self.audit_matrix_spill_seconds <= 0:
+            raise ValueError("--audit-matrix-spill-seconds must be > 0")
+        if self.audit_matrix_whatif and not self.audit_matrix:
+            raise ValueError(
+                "--audit-matrix-whatif requires --audit-matrix"
+            )
         if self.state_audit_spill_seconds <= 0:
             raise ValueError("--state-audit-spill-seconds must be > 0")
         if self.selfheal_interval_seconds < 0:
@@ -618,6 +645,12 @@ class Config:
             audit_watch_max_queue_events=int(
                 args.audit_watch_max_queue_events
             ),
+            audit_matrix=args.audit_matrix,
+            audit_stream_max_clients=int(args.audit_stream_max_clients),
+            audit_matrix_spill_seconds=float(
+                args.audit_matrix_spill_seconds
+            ),
+            audit_matrix_whatif=args.audit_matrix_whatif,
             native_idle_timeout_seconds=float(
                 args.native_idle_timeout_seconds
             ),

@@ -250,6 +250,19 @@ class PolicyLifecycleManager:
         # must never fail a promotion or rollback)
         self._on_promote: Callable[[int], None] | None = None
         self._on_rollback: Callable[[int, int], None] | None = None
+        # cluster what-if (round 23, --audit-matrix-whatif): the verdict
+        # matrix to ask for a candidate-vs-serving diff during the
+        # canary stage; None = feature off. Contained like every other
+        # observer — a broken what-if must never fail a promotion.
+        self._whatif_matrix: Any = None
+
+    def set_whatif_matrix(self, matrix: Any) -> None:
+        """Arm the shadow-canary cluster what-if: after a candidate
+        survives the canary, its changed policy columns are evaluated
+        against the LIVE audit snapshot (contained, off the serving
+        path) and the cluster-wide verdict-flip diff is kept on the
+        matrix for the reload-status surface."""
+        self._whatif_matrix = matrix
 
     def set_epoch_hooks(
         self,
@@ -452,6 +465,21 @@ class PolicyLifecycleManager:
                 # stage 3 — shadow canary against the host oracle
                 stage = "canary"
                 self._run_canary(candidate_env, policies)
+                # stage 3½ — cluster what-if (round 23, contained): the
+                # candidate survived the canary, so ask the verdict
+                # matrix what would FLIP cluster-wide if it promoted —
+                # changed columns only, against the live snapshot. A
+                # what-if fault never rejects the candidate.
+                if self._whatif_matrix is not None:
+                    try:
+                        self._whatif_matrix.whatif_diff(
+                            candidate_env, policies
+                        )
+                    except Exception as we:  # noqa: BLE001 — advisory
+                        logger.warning(
+                            "matrix what-if diff failed (advisory, "
+                            "promotion unaffected): %s", we,
+                        )
             except ReloadRejected as e:
                 self._reject(
                     stage, candidate_env, candidate_batcher, reason,
@@ -810,7 +838,7 @@ class PolicyLifecycleManager:
 
     # -- introspection -----------------------------------------------------
 
-    def stats(self) -> dict[str, int]:
+    def stats(self) -> dict[str, Any]:
         """One locked snapshot of the reload surface (runtime_stats /
         tests): counters plus the current epoch gauge."""
         with self._swap_lock:
@@ -823,6 +851,13 @@ class PolicyLifecycleManager:
                 "epoch": self._current.number if self._current else 0,
                 "staged": 1 if self._staged is not None else 0,
                 "last_outcome": self._last_outcome,
+                # last shadow-canary cluster what-if (round 23); None
+                # when --audit-matrix-whatif is off or no reload ran yet
+                "whatif": (
+                    self._whatif_matrix.last_whatif()
+                    if self._whatif_matrix is not None
+                    else None
+                ),
             }
 
     @property

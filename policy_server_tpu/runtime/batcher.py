@@ -268,6 +268,7 @@ class MicroBatcher:
         degraded_mode: str = "oracle",
         shadow_recorder: Any = None,
         audit_tracker: Any = None,
+        verdict_matrix: Any = None,
         admission: Any = None,
         scheduler: Any = None,
         tenant: str = "default",
@@ -301,6 +302,16 @@ class MicroBatcher:
         # was actually admitted. Same one-call-per-batch discipline as
         # the shadow recorder. None = audit disabled.
         self.audit_tracker = audit_tracker
+        # verdict matrix (round 23, audit/matrix.py): lookup admission —
+        # a /validate UPDATE whose canonical payload is byte-identical
+        # (uid normalized out) to the row the audit lane already judged,
+        # for a column whose content fingerprint matches the serving
+        # set, answers from the precomputed verdict as a pre-serialized
+        # fragment BEFORE shed/quota/queue. Eligibility is the fragment
+        # lane's own proof plus a hookless target, so the lookup verdict
+        # and the full-evaluation verdict are the same bytes. None =
+        # matrix off (the pre-round-23 submit paths, bit-identical).
+        self.verdict_matrix = verdict_matrix
         self.max_batch_size = max(1, int(max_batch_size))
         self.batch_timeout = max(0.0, batch_timeout_ms) / 1e3
         self.policy_timeout = policy_timeout
@@ -429,6 +440,12 @@ class MicroBatcher:
         self.audit_batches_dispatched = 0  # guarded-by: _stats_lock
         # rows those batches carried
         self.audit_rows_dispatched = 0  # guarded-by: _stats_lock
+        # -- lookup-admission counters (round 23; /metrics surface) -------
+        # requests answered from the verdict matrix without dispatch
+        self.matrix_lookup_hits = 0  # guarded-by: _stats_lock
+        # eligible requests the matrix could not answer (no cell, stale
+        # column fingerprint, payload drift, ineligible template)
+        self.matrix_lookup_misses = 0  # guarded-by: _stats_lock
         # audit batches popped for dispatch but re-queued because live
         # work arrived first (the preemption contract in action)
         self.audit_preemptions = 0  # guarded-by: _stats_lock
@@ -586,6 +603,8 @@ class MicroBatcher:
                 "audit_batches_dispatched": self.audit_batches_dispatched,
                 "audit_rows_dispatched": self.audit_rows_dispatched,
                 "audit_preemptions": self.audit_preemptions,
+                "matrix_lookup_hits": self.matrix_lookup_hits,
+                "matrix_lookup_misses": self.matrix_lookup_misses,
             }
 
     def estimated_wait(self) -> float:
@@ -708,6 +727,51 @@ class MicroBatcher:
 
     # -- submission --------------------------------------------------------
 
+    def _try_matrix(self, p: "_Pending") -> bool:
+        """Lookup admission (round 23): answer this request from the
+        verdict matrix when every soundness gate holds — VALIDATE origin,
+        UPDATE operation (a CREATE/DELETE changes the inventory by
+        definition), no always-accept namespace short-circuit, a hookless
+        target (pre-eval hooks see request context a precomputed verdict
+        never saw), and the matrix's own gates (payload byte-identity
+        with the judged row, current column fingerprint, fragment
+        eligibility). A hit resolves the pending in-band as a FragVerdict
+        — same completion shape as the round-19 cache-hit lane — before
+        shed/quota/queue ever see it. Returns False untouched on any
+        miss (the caller proceeds down the normal path)."""
+        matrix = self.verdict_matrix
+        if matrix is None or p.origin is not service.RequestOrigin.VALIDATE:
+            return False
+        adm = p.request.admission_request
+        if adm is None or (adm.operation or "").upper() != "UPDATE":
+            return False
+        if getattr(self.env, "always_accept_namespace", None) is not None:
+            return False
+        if self._target_hookless(p.policy_id) is not True:
+            return False
+        tmpl = matrix.lookup(p.policy_id, p.request, self.env)
+        if not tmpl:
+            with self._stats_lock:
+                self.matrix_lookup_misses += 1
+            return False
+        done_at = time.perf_counter()
+        with self._stats_lock:
+            self.matrix_lookup_hits += 1
+        try:
+            service._registry().record_evaluations_batch(  # noqa: SLF001
+                [((done_at - p.enqueued_at) * 1e3, self._metric_of(p, tmpl))]
+            )
+        except Exception:  # noqa: BLE001 — metrics must not fail serving
+            pass
+        verdict = FragVerdict(p.request.uid(), tmpl)
+        # NOT recorded to the audit tracker: the payload is byte-identical
+        # to the inventory row the verdict came from — re-observing would
+        # dirty the row and re-judge what the hit just proved current
+        self._resolve(
+            p, verdict if p.sink is not None else verdict.to_response()
+        )
+        return True
+
     def submit(
         self,
         policy_id: str,
@@ -726,6 +790,8 @@ class MicroBatcher:
             pending.deadline = pending.enqueued_at + self.request_timeout
         if self._stopping:
             self._reject_stopping(pending)
+            return pending.future
+        if self._try_matrix(pending):
             return pending.future
         self._shed_check(pending)
         self._admit_quota([pending])
@@ -811,6 +877,8 @@ class MicroBatcher:
             pending.deadline = pending.enqueued_at + self.request_timeout
         if self._stopping:
             self._reject_stopping(pending)
+            return pending.future
+        if self._try_matrix(pending):
             return pending.future
         self._shed_check(pending)
         self._admit_quota([pending])
@@ -898,6 +966,13 @@ class MicroBatcher:
             for p in pendings:
                 self._reject_stopping(p)
             return futures
+        if self.verdict_matrix is not None:
+            # lookup admission per row BEFORE the burst-level shed/quota:
+            # a hit resolves in-band and must not consume queue space or
+            # tenant quota for work that will never dispatch
+            pendings = [p for p in pendings if not self._try_matrix(p)]
+            if not pendings:
+                return futures
         if deadline is not None:
             est = self.estimated_wait()
             if est > self.request_timeout:
@@ -977,6 +1052,8 @@ class MicroBatcher:
             pending.deadline = pending.enqueued_at + self.request_timeout
         if self._stopping:
             self._reject_stopping(pending)
+            return pending.aio_future
+        if self._try_matrix(pending):
             return pending.aio_future
         self._shed_check(pending)
         self._admit_quota([pending])

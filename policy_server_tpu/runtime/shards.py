@@ -151,6 +151,32 @@ class ShardRouter:
         self.shard_respawns = 0  # guarded-by: _stats_lock
         # shard.heartbeat failpoint faults observed by the prober
         self.shard_heartbeat_faults = 0  # guarded-by: _stats_lock
+        # seed the counters from the durable incident journal (round 23):
+        # a reload epoch or restart rebuilds the router and would zero
+        # them, but the fleet's /metrics and the soak's
+        # shard_kill_survived gate want CUMULATIVE incident counts — the
+        # journal is the authority, the in-memory counters resume from
+        # it. Heartbeat faults are seeded from probe-fault fences (the
+        # only durably-journaled probe faults), a deliberate lower
+        # bound. Best-effort: a damaged journal seeds zero.
+        if statestore is not None:
+            try:
+                log = statestore.shard_events()
+            except Exception:  # noqa: BLE001 — forensics, never fatal
+                log = []
+            for ev in log:
+                if ev.get("reason") == "warm-respawn":
+                    self.shard_respawns += 1
+                else:
+                    self.shard_fences += 1
+                    self.shard_reroutes += int(
+                        ev.get("rows_rerouted", 0) or 0
+                    )
+                    self.shard_fenced_rows += int(
+                        ev.get("rows_fenced", 0) or 0
+                    )
+                    if ev.get("reason") == "probe fault":
+                        self.shard_heartbeat_faults += 1
         self._stop = threading.Event()
         self._stopping = False
         self._thread: threading.Thread | None = None

@@ -171,6 +171,7 @@ class StateStore:
     MANIFESTS_JOURNAL = "manifests.journal"
     URLMAP_JOURNAL = "urlmap.journal"
     AUDIT_SPILL = "audit/spill.journal"
+    MATRIX_SPILL = "audit/matrix.journal"
     BOOT_REPORT = "last_boot.json"
     SHARD_EVENTS = "shard_events.json"
 
@@ -193,6 +194,10 @@ class StateStore:
         # newest generation durably spilled (write-ordering guard)
         self._audit_spill_gen = 0  # guarded-by: _lock
         self._audit_rows_restored = 0  # guarded-by: _lock
+        self._matrix_spills = 0  # guarded-by: _lock
+        # newest matrix generation durably spilled (same ordering guard)
+        self._matrix_spill_gen = 0  # guarded-by: _lock
+        self._matrix_cells_restored = 0  # guarded-by: _lock
         self._degraded_loads = 0  # guarded-by: _lock
         # shard fencing incidents durably logged (round 22)
         self._shard_events_recorded = 0  # guarded-by: _lock
@@ -548,6 +553,63 @@ class StateStore:
         }
         return {"rvs": dict(head.get("rvs") or {}), "fed": fed, "rows": rows}
 
+    # -- verdict matrix spill (round 23, audit/matrix.py) ------------------
+
+    def spill_matrix(
+        self, head: Mapping[str, Any], cells: Iterable[Mapping[str, Any]]
+    ) -> int:
+        """Spill the verdict matrix next to the audit snapshot: the head
+        carries the serving epoch, matrixVersion, and the COLUMN
+        FINGERPRINTS (a warm boot with a different policy set invalidates
+        its columns by fingerprint mismatch, never by trust); each cell
+        record carries one (resource, policy) verdict plus the payload
+        hash that scopes it. Same CRC-framed journal + fsck/quarantine +
+        generation-ordering contract as :meth:`spill_audit`. Returns
+        cells spilled."""
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+        records: list[tuple[int, dict]] = [
+            (gen, {"kind": "matrix-spill-head", "time": time.time(),
+                   **dict(head)})
+        ]
+        count = 0
+        for cell in cells:
+            records.append((gen, dict(cell)))
+            count += 1
+        data = frame_records(records)
+        with self._lock:
+            # generation-ordered like spill_audit: a slower writer with
+            # an older generation discards rather than clobbering
+            if gen < self._matrix_spill_gen:
+                return count
+            atomic_write_bytes(self.root / self.MATRIX_SPILL, data)
+            self._matrix_spill_gen = gen
+            self._matrix_spills += 1
+        return count
+
+    def load_matrix_spill(self) -> dict | None:
+        """The spilled verdict matrix (fsck-salvaged like every journal):
+        ``{"epoch", "version", "cols": {policy_id: fingerprint},
+        "cells": [{...}]}`` or None when no spill survived. Cell records
+        past a torn tail were discarded by the salvage — the boot sweep
+        re-judges whatever the spill lost."""
+        records = self._load_journal(self.MATRIX_SPILL)
+        if not records:
+            return None
+        head = records[0][1]
+        if head.get("kind") != "matrix-spill-head":
+            return None
+        cells = [rec for _g, rec in records[1:] if "k" in rec and "p" in rec]
+        with self._lock:
+            self._matrix_cells_restored = len(cells)
+        return {
+            "epoch": int(head.get("epoch", 0)),
+            "version": int(head.get("version", 0)),
+            "cols": dict(head.get("cols") or {}),
+            "cells": cells,
+        }
+
     # -- boot report -------------------------------------------------------
 
     def record_boot_report(self, report: Mapping[str, Any]) -> None:
@@ -629,5 +691,7 @@ class StateStore:
                 "fsck_quarantined": self._fsck_quarantined,
                 "audit_spills": self._audit_spills,
                 "audit_rows_restored": self._audit_rows_restored,
+                "matrix_spills": self._matrix_spills,
+                "matrix_cells_restored": self._matrix_cells_restored,
                 "degraded_loads": self._degraded_loads,
             }

@@ -247,6 +247,38 @@ SHARD_FENCED_ROWS = "policy_server_shard_fenced_rows"
 SHARD_RESPAWNS = "policy_server_shard_respawns"
 SHARD_HEARTBEAT_FAULTS = "policy_server_shard_heartbeat_faults"
 
+# round 23 — persistent (object × policy) verdict matrix (audit/
+# matrix.py). Residency gauges describe the in-memory matrix; the sweep
+# counters split re-judged rows by WHY they were re-judged (row dirtied
+# by the watch feed vs column dirtied by an epoch promotion) so a
+# promotion touching 2 of 32 policies shows 2 columns' worth of column
+# rows, not a cluster-wide spike. Changelog/stream counters account the
+# /audit/stream fan-out (drops are slow consumers evicted, never the
+# applier blocking); lookup hits/misses are the admission fast path
+# (a /validate UPDATE answered from a precomputed verdict). Spills and
+# restored cells tie the matrix to the statestore journal. All families
+# export as zero with --audit-matrix off so panels resolve.
+MATRIX_ROWS_RESIDENT = "policy_server_audit_matrix_rows_resident"
+MATRIX_CELLS_RESIDENT = "policy_server_audit_matrix_cells_resident"
+MATRIX_COLUMNS = "policy_server_audit_matrix_columns"
+MATRIX_DIRTY_COLUMNS = "policy_server_audit_matrix_dirty_columns"
+MATRIX_VERSION = "policy_server_audit_matrix_version"
+MATRIX_ROW_SWEEP_ROWS = "policy_server_audit_matrix_row_sweep_rows"
+MATRIX_COLUMN_SWEEP_ROWS = "policy_server_audit_matrix_column_sweep_rows"
+MATRIX_ROWS_EVICTED = "policy_server_audit_matrix_rows_evicted"
+MATRIX_COLUMNS_INVALIDATED = (
+    "policy_server_audit_matrix_columns_invalidated"
+)
+MATRIX_CHANGELOG_EMITS = "policy_server_audit_matrix_changelog_emits"
+MATRIX_STREAM_CLIENTS = "policy_server_audit_matrix_stream_clients"
+MATRIX_STREAM_DROPPED_CLIENTS = (
+    "policy_server_audit_matrix_stream_dropped_clients"
+)
+MATRIX_LOOKUP_HITS = "policy_server_audit_matrix_lookup_hits"
+MATRIX_LOOKUP_MISSES = "policy_server_audit_matrix_lookup_misses"
+MATRIX_SPILLS = "policy_server_audit_matrix_spills"
+MATRIX_CELLS_RESTORED = "policy_server_audit_matrix_cells_restored"
+
 # Prometheus requires a fixed label set per metric family; optional reference
 # labels (resource_namespace, error_code) encode absence as "".
 _EVAL_LABELS = (

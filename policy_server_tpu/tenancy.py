@@ -80,7 +80,7 @@ DEFAULT_TENANT = "default"
 
 # names that would shadow literal routes (/audit/reports/...) or the
 # reserved default; rejected at manifest parse, not at serve time
-_RESERVED_TENANT_NAMES = frozenset({DEFAULT_TENANT, "reports"})
+_RESERVED_TENANT_NAMES = frozenset({DEFAULT_TENANT, "reports", "stream"})
 
 
 def unknown_tenant_message(name: str) -> str:

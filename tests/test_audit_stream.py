@@ -1,0 +1,704 @@
+"""Verdict matrix + /audit/stream tests (round 23, audit/matrix.py):
+changelog emission semantics (verdict changes emit, re-stamps do not),
+slow-consumer backpressure (bounded per-client queue, counted drop, the
+applier never blocks), cursor resume (exactly the missed entries, RESYNC
+past the ring), the incremental cross-product sweep's bit-exactness vs a
+full re-sweep, statestore spill/restore, lookup-admission gates, and the
+HTTP surface (NDJSON stream, ETag/304 on /audit/reports)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from policy_server_tpu import failpoints
+from policy_server_tpu.audit import (
+    AuditScanner,
+    PolicyReportStore,
+    SnapshotStore,
+    VerdictMatrix,
+    normalized_payload_hash,
+    policy_fingerprint,
+    resource_key,
+)
+from policy_server_tpu.api.service import RequestOrigin
+from policy_server_tpu.evaluation.environment import (
+    EvaluationEnvironmentBuilder,
+)
+from policy_server_tpu.models import (
+    AdmissionResponse,
+    AdmissionReviewRequest,
+    ValidateRequest,
+)
+from policy_server_tpu.models.policy import parse_policy_entry
+from policy_server_tpu.runtime.batcher import MicroBatcher
+from policy_server_tpu.telemetry import metrics as metrics_mod
+
+from conftest import build_admission_review_dict
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics_mod.reset_metrics_for_tests()
+    yield
+    metrics_mod.reset_metrics_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def pod_review(
+    name: str = "p",
+    namespace: str = "default",
+    privileged: bool = False,
+    operation: str = "CREATE",
+    uid: str | None = None,
+) -> ValidateRequest:
+    doc = build_admission_review_dict()
+    doc["request"]["uid"] = uid or f"uid-{namespace}-{name}"
+    doc["request"]["name"] = name
+    doc["request"]["namespace"] = namespace
+    doc["request"]["operation"] = operation
+    doc["request"]["kind"] = {"group": "", "version": "v1", "kind": "Pod"}
+    doc["request"]["object"] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "securityContext": {"privileged": privileged},
+                }
+            ]
+        },
+    }
+    return ValidateRequest.from_admission(
+        AdmissionReviewRequest.from_dict(doc).request
+    )
+
+
+def _policies(denied=("blocked",)):
+    return {
+        "priv": parse_policy_entry(
+            "priv", {"module": "builtin://pod-privileged"}
+        ),
+        "ns": parse_policy_entry(
+            "ns",
+            {
+                "module": "builtin://namespace-validate",
+                "settings": {"denied_namespaces": list(denied)},
+            },
+        ),
+    }
+
+
+def _allow(uid="u"):
+    return AdmissionResponse(uid=uid, allowed=True)
+
+
+def _deny(uid="u"):
+    return AdmissionResponse.reject(uid, "denied", 400)
+
+
+def _record_one(matrix, req, pid="priv", result=None, epoch=0):
+    matrix.record_rows(
+        [(resource_key(req), pid, req, result or _allow())], epoch
+    )
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + payload identity
+# ---------------------------------------------------------------------------
+
+
+def test_policy_fingerprint_tracks_content_not_identity():
+    a = _policies()
+    b = _policies()  # same content, fresh objects
+    assert policy_fingerprint(a["ns"]) == policy_fingerprint(b["ns"])
+    changed = _policies(denied=("other",))
+    assert policy_fingerprint(a["ns"]) != policy_fingerprint(changed["ns"])
+    assert policy_fingerprint(a["priv"]) == policy_fingerprint(
+        changed["priv"]
+    )
+
+
+def test_normalized_payload_hash_ignores_uid_only():
+    r1 = pod_review("same", uid="uid-one")
+    r2 = pod_review("same", uid="uid-two-entirely-different")
+    r3 = pod_review("same", privileged=True, uid="uid-one")
+    assert normalized_payload_hash(r1) == normalized_payload_hash(r2)
+    assert normalized_payload_hash(r1) != normalized_payload_hash(r3)
+    assert normalized_payload_hash(
+        ValidateRequest.from_raw({"uid": "r"})
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# changelog emission semantics
+# ---------------------------------------------------------------------------
+
+
+def _matrix(snapshot=None, **kw) -> VerdictMatrix:
+    return VerdictMatrix(snapshot=snapshot or SnapshotStore(), **kw)
+
+
+def test_emission_only_on_verdict_change_restamp_is_silent():
+    m = _matrix()
+    m.set_columns(_policies(), 0)
+    req = pod_review("a")
+    sub = m.subscribe(None)
+    _record_one(m, req, result=_allow(), epoch=0)
+    entries, dead = m.drain(sub)
+    assert not dead
+    assert [e["type"] for e in entries] == ["VERDICT"]
+    v_first = entries[0]["matrixVersion"]
+    # re-judge confirming the standing verdict at a NEW epoch: validity
+    # re-stamps, nothing emits, the version does not move
+    _record_one(m, req, result=_allow(), epoch=1)
+    assert m.drain(sub) == ([], False)
+    assert m.version == v_first
+    # the verdict FLIPS: exactly one new emission
+    _record_one(m, req, result=_deny(), epoch=1)
+    entries, _ = m.drain(sub)
+    assert len(entries) == 1
+    assert entries[0]["allowed"] is False
+    assert entries[0]["matrixVersion"] == v_first + 1
+    # an evaluation error evicts the cell with a DELETE emission
+    _record_one(m, req, result=RuntimeError("boom"), epoch=1)
+    entries, _ = m.drain(sub)
+    assert [e["type"] for e in entries] == ["DELETE"]
+    assert m.stats()["cells_resident"] == 0
+
+
+def test_unchanged_promotion_restamps_columns_without_emission():
+    m = _matrix()
+    m.set_columns(_policies(), 0)
+    m.take_dirty_columns()  # boot diff marked everything dirty; claim it
+    for i in range(3):
+        _record_one(m, pod_review(f"p{i}"), pid="priv", epoch=0)
+        _record_one(m, pod_review(f"p{i}"), pid="ns", epoch=0)
+    sub = m.subscribe(None)
+    v_before = m.version
+    # same policy CONTENT, new epoch number: nothing dirty, nothing
+    # emitted, nothing to re-judge — a promotion is not a verdict change
+    diff = m.set_columns(_policies(), 1)
+    assert diff["dirty"] == [] and diff["removed"] == []
+    assert m.take_dirty_columns() == set()
+    assert m.drain(sub) == ([], False)
+    assert m.version == v_before
+    # changed content dirties exactly that column
+    diff = m.set_columns(_policies(denied=("other",)), 2)
+    assert diff["dirty"] == ["ns"]
+    assert m.take_dirty_columns() == {"ns"}
+    # a REMOVED policy withdraws its verdicts as DELETEs
+    only_priv = {"priv": _policies()["priv"]}
+    diff = m.set_columns(only_priv, 3)
+    assert diff["removed"] == ["ns"]
+    entries, _ = m.drain(sub)
+    assert all(
+        e["type"] == "DELETE" and e["policy"] == "ns" for e in entries
+    )
+    assert len(entries) == 3
+
+
+# ---------------------------------------------------------------------------
+# slow-consumer backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_slow_consumer_overflows_and_is_dropped_counted():
+    m = _matrix(client_queue_capacity=16)  # the floor
+    m.set_columns(_policies(), 0)
+    slow = m.subscribe(None)
+    fast = m.subscribe(None)
+    # the publisher (sweep applier) emits far past the slow client's
+    # queue capacity and must NEVER block: this is a plain synchronous
+    # call sequence — completing it at all is the no-blocking proof
+    for i in range(40):
+        _record_one(m, pod_review(f"burst-{i}"), epoch=0)
+        if i % 2:
+            fast.queue.clear()  # the fast client keeps draining
+    entries, dead = m.drain(slow)
+    assert dead is True
+    # the drained tail still delivers what fit before the overflow
+    assert len(entries) == 16
+    assert m.stats()["changelog_dropped_clients"] == 1
+    # dead subscribers stop counting toward the client cap
+    assert m.stream_clients() == 1
+    _, fast_dead = m.drain(fast)
+    assert fast_dead is False
+    m.unsubscribe(slow)
+    m.unsubscribe(fast)
+    # emission never stopped: every verdict landed in the matrix
+    assert m.stats()["cells_resident"] == 40
+
+
+# ---------------------------------------------------------------------------
+# cursor resume
+# ---------------------------------------------------------------------------
+
+
+def test_cursor_resume_replays_exactly_the_missed_entries():
+    m = _matrix()
+    m.set_columns(_policies(), 0)
+    for i in range(5):
+        _record_one(m, pod_review(f"r{i}"), epoch=0)
+    cursor = m.version
+    for i in range(5, 9):
+        _record_one(m, pod_review(f"r{i}"), epoch=0)
+    sub = m.subscribe(cursor)
+    entries, dead = m.drain(sub)
+    assert not dead
+    # exactly the post-cursor entries, in order, no duplicates
+    assert [e["matrixVersion"] for e in entries] == [
+        cursor + 1, cursor + 2, cursor + 3, cursor + 4,
+    ]
+    assert [e["resource"].rsplit("/", 1)[1] for e in entries] == [
+        "r5", "r6", "r7", "r8",
+    ]
+    # a caught-up cursor replays nothing (live tail only)
+    sub2 = m.subscribe(m.version)
+    assert m.drain(sub2) == ([], False)
+    m.unsubscribe(sub)
+    m.unsubscribe(sub2)
+
+
+def test_cursor_older_than_the_ring_gets_resync_plus_full_state():
+    m = _matrix(changelog_capacity=64)  # the ring floor
+    m.set_columns(_policies(), 0)
+    reqs = [pod_review(f"res-{i:03d}") for i in range(80)]
+    for req in reqs:
+        _record_one(m, req, epoch=0)
+    assert m.version == 80  # ring now covers only the last 64
+    sub = m.subscribe(0)
+    entries, _ = m.drain(sub)
+    assert entries[0]["type"] == "RESYNC"
+    assert entries[0]["matrixVersion"] == 80
+    state = entries[1:]
+    # the full current state, stamped with each cell's OWN version
+    assert len(state) == 80
+    assert [e["matrixVersion"] for e in state] == list(range(1, 81))
+    assert {e["resource"] for e in state} == {
+        resource_key(r) for r in reqs
+    }
+    m.unsubscribe(sub)
+
+
+# ---------------------------------------------------------------------------
+# the incremental cross-product sweep (scanner integration)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def env():
+    e = EvaluationEnvironmentBuilder(backend="jax").build(_policies())
+    yield e
+    e.close()
+
+
+def make_scanner(env, batcher, matrix=None, lifecycle=None, **kw):
+    state = SimpleNamespace(
+        evaluation_environment=env, batcher=batcher, lifecycle=lifecycle
+    )
+    kw.setdefault("mode", "interval")
+    kw.setdefault("interval_seconds", 30.0)
+    return AuditScanner(
+        state=state, snapshot=SnapshotStore(),
+        reports=PolicyReportStore(), matrix=matrix, **kw
+    )
+
+
+def _full_resweep_cells(env, batcher, snapshot_rows):
+    """An independent full sweep into a FRESH matrix over the same
+    inventory — the bit-exactness witness."""
+    matrix = _matrix()
+    scanner = make_scanner(env, batcher, matrix=matrix, batch_size=8)
+    scanner.snapshot.observe(snapshot_rows)
+    scanner.sweep(full=True)
+    return matrix.cells_snapshot()
+
+
+def test_dirty_column_sweep_is_bit_exact_vs_full_resweep(env):
+    """Acceptance: after a promotion changes 1 of 2 policies, the dirty
+    sweep re-judges only changed-column × clean-rows (plus dirty-rows ×
+    all columns) and the matrix lands BIT-EXACT against a from-scratch
+    full re-sweep under the new set."""
+    rows = [
+        pod_review("a", privileged=True),
+        pod_review("b"),
+        pod_review("c", namespace="blocked"),
+        pod_review("d", namespace="other"),
+    ]
+    batcher = MicroBatcher(
+        env, max_batch_size=8, policy_timeout=10.0
+    ).start()
+    matrix = _matrix()
+    scanner = make_scanner(env, batcher, matrix=matrix, batch_size=8)
+    env2 = EvaluationEnvironmentBuilder(backend="jax").build(
+        _policies(denied=("other",))
+    )
+    batcher2 = MicroBatcher(
+        env2, max_batch_size=8, policy_timeout=10.0
+    ).start()
+    try:
+        scanner.snapshot.observe(rows)
+        assert scanner.sweep(full=True) == 8  # 4 rows × 2 policies
+        baseline = matrix.cells_snapshot()
+        assert len(baseline) == 8
+        assert baseline[(resource_key(rows[2]), "ns")][0] is False
+        assert baseline[(resource_key(rows[3]), "ns")][0] is True
+        # full sweeps are themselves bit-exact vs an independent build
+        assert baseline == _full_resweep_cells(env, batcher, rows)
+
+        # "promote" a set where only ns changed: swap the serving env
+        # and fire the hook exactly as the lifecycle would
+        scanner.state.evaluation_environment = env2
+        scanner.state.batcher = batcher2
+        scanner.on_promote(1)
+        # the dirty sweep re-judges ONLY the ns column (4 rows), never
+        # the whole 8-cell cluster
+        assert scanner.sweep(full=False) == 4
+        stats = matrix.stats()
+        assert stats["column_sweep_rows"] == 4
+        after = matrix.cells_snapshot()
+        assert len(after) == 8
+        # verdicts flipped where the new settings say so...
+        assert after[(resource_key(rows[2]), "ns")][0] is True
+        assert after[(resource_key(rows[3]), "ns")][0] is False
+        # ...and the whole matrix is bit-exact vs a full re-sweep
+        assert after == _full_resweep_cells(env2, batcher2, rows)
+        # priv cells were never re-judged, only re-stamped
+        for key in (resource_key(r) for r in rows):
+            assert after[(key, "priv")] == baseline[(key, "priv")]
+    finally:
+        batcher.shutdown()
+        batcher2.shutdown()
+        env2.close()
+
+
+def test_deleted_object_evicts_matrix_row_and_report_rows(env):
+    batcher = MicroBatcher(
+        env, max_batch_size=8, policy_timeout=10.0
+    ).start()
+    matrix = _matrix()
+    scanner = make_scanner(env, batcher, matrix=matrix, batch_size=8)
+    try:
+        gone = pod_review("gone")
+        kept = pod_review("kept")
+        scanner.snapshot.observe([gone, kept])
+        scanner.sweep(full=True)
+        assert matrix.stats()["rows_resident"] == 2
+        sub = matrix.subscribe(None)
+        scanner.snapshot.observe([pod_review("gone", operation="DELETE")])
+        scanner.sweep(full=False)
+        entries, _ = matrix.drain(sub)
+        deletes = [e for e in entries if e["type"] == "DELETE"]
+        assert {e["resource"] for e in deletes} == {resource_key(gone)}
+        assert len(deletes) == 2  # one per policy column
+        assert matrix.stats()["rows_resident"] == 1
+        assert not any(
+            r["name"] == "gone"
+            for r in scanner.report_payload()["reports"]
+        )
+        matrix.unsubscribe(sub)
+    finally:
+        batcher.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# spill / restore (statestore durability)
+# ---------------------------------------------------------------------------
+
+
+def test_spill_restore_roundtrip_validates_columns_and_payloads(
+    env, tmp_path
+):
+    from policy_server_tpu.statestore import StateStore
+
+    store = StateStore(str(tmp_path / "state"))
+    rows = [pod_review("a", privileged=True), pod_review("b")]
+    batcher = MicroBatcher(
+        env, max_batch_size=8, policy_timeout=10.0
+    ).start()
+    snapshot = SnapshotStore()
+    matrix = VerdictMatrix(snapshot=snapshot, statestore=store)
+    scanner = AuditScanner(
+        state=SimpleNamespace(
+            evaluation_environment=env, batcher=batcher, lifecycle=None
+        ),
+        snapshot=snapshot, reports=PolicyReportStore(), matrix=matrix,
+        mode="interval", interval_seconds=30.0, batch_size=8,
+    )
+    try:
+        snapshot.observe(rows)
+        scanner.sweep(full=True)
+        before = matrix.cells_snapshot()
+        assert matrix.maybe_spill(force=True) is True
+
+        # warm boot: fresh snapshot with "a" CHANGED and "b" identical
+        snapshot2 = SnapshotStore()
+        changed_a = pod_review("a", privileged=False)
+        snapshot2.observe([changed_a, pod_review("b")])
+        m2 = VerdictMatrix(snapshot=snapshot2, statestore=store)
+        m2.set_columns(_policies(), 0)
+        restored = m2.restore()
+        # only the unchanged row's cells restore (payload-hash gate)
+        assert restored == 2
+        key_b = resource_key(rows[1])
+        cells = m2.cells_snapshot()
+        assert set(cells) == {(key_b, "priv"), (key_b, "ns")}
+        assert cells[(key_b, "priv")] == before[(key_b, "priv")]
+        # the fully covered row's dirty mark cleared; the changed row
+        # stays dirty for the boot sweep
+        assert snapshot2.dirty_keys() == {resource_key(changed_a)}
+        # the version cursor survives the restart (stream resume)
+        assert m2.version >= matrix.version
+        assert m2.stats()["cells_restored"] == 2
+
+        # a DIFFERENT serving policy set invalidates its columns: the
+        # spilled fingerprints no longer match, nothing restores
+        snapshot3 = SnapshotStore()
+        snapshot3.observe([pod_review("b")])
+        m3 = VerdictMatrix(snapshot=snapshot3, statestore=store)
+        m3.set_columns(_policies(denied=("other",)), 0)
+        assert m3.restore() == 1  # priv unchanged; ns content changed
+        assert set(m3.cells_snapshot()) == {(key_b, "priv")}
+    finally:
+        batcher.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# lookup admission (the batcher fast path)
+# ---------------------------------------------------------------------------
+
+
+def test_lookup_gates_payload_identity_and_column_currency(env):
+    m = _matrix()
+    m.set_columns(_policies(), 0)
+    judged = pod_review("obj", operation="UPDATE", uid="uid-judged")
+    _record_one(m, judged, pid="priv", result=_allow(), epoch=0)
+    # byte-identical payload, fresh uid: HIT with the precomputed verdict
+    replay = pod_review("obj", operation="UPDATE", uid="uid-fresh")
+    tmpl = m.lookup("priv", replay, env)
+    assert tmpl and tmpl.allowed is True
+    # changed payload: miss
+    assert m.lookup(
+        "priv", pod_review("obj", privileged=True, operation="UPDATE"), env
+    ) is None
+    # unknown policy / no cell: miss
+    assert m.lookup("ns", replay, env) is None
+    # a stale column fingerprint (policy content changed): miss
+    m.set_columns(
+        {
+            "priv": parse_policy_entry(
+                "priv",
+                {
+                    "module": "builtin://pod-privileged",
+                    "policyMode": "monitor",
+                },
+            ),
+            "ns": _policies()["ns"],
+        },
+        1,
+    )
+    assert m.lookup("priv", replay, env) is None
+    s = m.stats()
+    assert s["lookup_hits"] == 1 and s["lookup_misses"] == 3
+
+
+def test_batcher_answers_byte_identical_update_from_the_matrix(env):
+    m = _matrix()
+    m.set_columns(_policies(), 0)
+    batcher = MicroBatcher(
+        env, max_batch_size=8, policy_timeout=10.0, verdict_matrix=m
+    ).start()
+    try:
+        judged = pod_review("hot", operation="UPDATE", uid="uid-a")
+        _record_one(m, judged, pid="priv", result=_allow("uid-a"), epoch=0)
+        replay = pod_review("hot", operation="UPDATE", uid="uid-b")
+        resp = batcher.submit(
+            "priv", replay, RequestOrigin.VALIDATE
+        ).result(timeout=30)
+        assert resp.allowed is True
+        assert resp.uid == "uid-b"  # the LIVE request's uid, never the
+        # judged row's
+        snap = batcher.stats_snapshot()
+        assert snap["matrix_lookup_hits"] == 1
+        # a CREATE of the same object must never answer from the matrix
+        create = pod_review("hot", operation="CREATE", uid="uid-c")
+        resp = batcher.submit(
+            "priv", create, RequestOrigin.VALIDATE
+        ).result(timeout=30)
+        assert resp.allowed is True
+        assert batcher.stats_snapshot()["matrix_lookup_hits"] == 1
+        # AUDIT origin takes the full path (raw-verdict semantics)
+        results = batcher.submit_audit([("priv", replay)]).result(
+            timeout=30
+        )
+        assert results[0].allowed is True
+        assert batcher.stats_snapshot()["matrix_lookup_hits"] == 1
+    finally:
+        batcher.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: NDJSON stream + ETag/304
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def matrix_server():
+    import requests as _rq  # noqa: F401 — fail fast if missing
+
+    from test_server import ServerHandle, make_config
+
+    metrics_mod.reset_metrics_for_tests()
+    config = make_config(
+        policies={
+            "pod-privileged": parse_policy_entry(
+                "pod-privileged", {"module": "builtin://pod-privileged"}
+            ),
+        },
+        policy_timeout_seconds=5.0,
+        audit_mode="interval",
+        audit_interval_seconds=60.0,
+        audit_batch_size=8,
+        audit_matrix=True,
+    )
+    handle = ServerHandle(config)
+    yield handle
+    handle.stop()
+    metrics_mod.reset_metrics_for_tests()
+
+
+def test_stream_delivers_sweep_verdicts_and_resumes(matrix_server):
+    import requests as rq
+
+    from test_server import pod_review_body
+
+    scanner = matrix_server.server.state.audit
+    matrix = matrix_server.server.state.audit_matrix
+    assert matrix is not None and scanner.matrix is matrix
+
+    doc = pod_review_body(True)
+    doc["request"]["operation"] = "UPDATE"
+    r = rq.post(
+        matrix_server.url("/validate/pod-privileged"), json=doc, timeout=30
+    )
+    assert r.status_code == 200
+
+    lines: list[dict] = []
+    got_line = threading.Event()
+
+    def consume():
+        with rq.get(
+            matrix_server.url("/audit/stream"), stream=True, timeout=30
+        ) as resp:
+            assert resp.status_code == 200
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            for raw in resp.iter_lines():
+                if raw:
+                    lines.append(json.loads(raw))
+                    got_line.set()
+                    return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.perf_counter() + 10
+    while not got_line.is_set() and time.perf_counter() < deadline:
+        scanner.sweep(full=True)
+        time.sleep(0.2)
+    t.join(timeout=10)
+    assert lines, "no stream line arrived"
+    entry = lines[0]
+    assert entry["type"] == "VERDICT"
+    assert entry["policy"] == "pod-privileged"
+    assert entry["allowed"] is False  # the privileged pod
+    assert entry["matrixVersion"] >= 1
+
+    # a caught-up cursor replays nothing; one behind replays from the
+    # ring (subscription-level — the HTTP layer adds only NDJSON)
+    sub0 = matrix.subscribe(matrix.version)
+    assert matrix.drain(sub0) == ([], False)
+    matrix.unsubscribe(sub0)
+    sub = matrix.subscribe(matrix.version - 1)
+    entries, _ = matrix.drain(sub)
+    assert len(entries) == 1
+    matrix.unsubscribe(sub)
+    # malformed cursor is a 422, not a hung stream
+    r = rq.get(
+        matrix_server.url("/audit/stream?cursor=bogus"), timeout=10
+    )
+    assert r.status_code == 422
+
+
+def test_audit_reports_etag_and_304(matrix_server):
+    import requests as rq
+
+    r = rq.get(matrix_server.url("/audit/reports"), timeout=10)
+    assert r.status_code == 200
+    etag = r.headers.get("ETag")
+    assert etag and etag.startswith('"audit-')
+    r2 = rq.get(
+        matrix_server.url("/audit/reports"),
+        headers={"If-None-Match": etag}, timeout=10,
+    )
+    assert r2.status_code == 304
+    assert r2.headers.get("ETag") == etag
+    assert not r2.content
+    # new observed traffic bumps the snapshot generation → fresh ETag
+    from test_server import pod_review_body
+
+    doc = pod_review_body(False)
+    doc["request"]["object"]["metadata"]["name"] = "etag-fresh"
+    assert rq.post(
+        matrix_server.url("/validate/pod-privileged"), json=doc, timeout=30
+    ).status_code == 200
+    # the snapshot observation may land just after the POST returns
+    deadline = time.perf_counter() + 10
+    while time.perf_counter() < deadline:
+        r3 = rq.get(
+            matrix_server.url("/audit/reports"),
+            headers={"If-None-Match": etag}, timeout=10,
+        )
+        if r3.status_code == 200:
+            break
+        time.sleep(0.05)
+    assert r3.status_code == 200
+    assert r3.headers.get("ETag") != etag
+
+
+def test_stream_404_when_matrix_off():
+    import requests as rq
+
+    from test_server import ServerHandle, make_config
+
+    config = make_config(
+        policies={
+            "pod-privileged": parse_policy_entry(
+                "pod-privileged", {"module": "builtin://pod-privileged"}
+            ),
+        },
+        policy_timeout_seconds=5.0,
+        warmup_at_boot=False,
+        audit_mode="interval",
+        audit_interval_seconds=60.0,
+    )
+    handle = ServerHandle(config)
+    try:
+        assert handle.server.state.audit_matrix is None
+        r = rq.get(handle.url("/audit/stream"), timeout=10)
+        assert r.status_code == 404
+        assert "verdict matrix is disabled" in r.json()["message"]
+    finally:
+        handle.stop()

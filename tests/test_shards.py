@@ -575,3 +575,60 @@ def test_shutdown_drains_shards_in_sequence_and_closes_owned_envs():
         assert f.done()
     assert closed == [1, 2]  # siblings closed, in order
     env.close()  # shard 0's env is the CALLER's — router must not close
+
+
+# ---------------------------------------------------------------------------
+# Durable counter seeding (round 23 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeIncidentStore:
+    """Duck-typed statestore carrying a canned shard incident log."""
+
+    def __init__(self, events):
+        self._events = events
+
+    def shard_events(self):
+        return list(self._events)
+
+    def record_shard_event(self, event):
+        self._events.append(dict(event))
+
+
+def test_router_counters_seed_from_durable_incident_journal():
+    """A rebuilt router (reload epoch, restart) resumes the fence/
+    re-route/respawn counters from the statestore incident journal
+    instead of zeroing them — /metrics and the soak gate read
+    CUMULATIVE incident counts across rebuilds."""
+    store = _FakeIncidentStore([
+        {"shard": 1, "reason": "wedged dispatch",
+         "rows_rerouted": 3, "rows_fenced": 2},
+        {"shard": 1, "reason": "warm-respawn"},
+        {"shard": 0, "reason": "probe fault",
+         "rows_rerouted": 0, "rows_fenced": 5},
+    ])
+    r = _router(statestore=store)
+    try:
+        stats = r.stats_snapshot()
+        assert stats["shard_fences"] == 2
+        assert stats["shard_reroutes"] == 3
+        assert stats["shard_fenced_rows"] == 7
+        assert stats["shard_respawns"] == 1
+        assert stats["shard_heartbeat_faults"] == 1
+    finally:
+        r.shutdown()
+
+
+def test_router_counters_zero_on_empty_or_broken_journal():
+    class _Broken:
+        def shard_events(self):
+            raise OSError("journal unreadable")
+
+    for store in (None, _FakeIncidentStore([]), _Broken()):
+        r = _router(statestore=store)
+        try:
+            stats = r.stats_snapshot()
+            assert stats["shard_fences"] == 0
+            assert stats["shard_respawns"] == 0
+        finally:
+            r.shutdown()

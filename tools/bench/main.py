@@ -175,6 +175,19 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         emit("multi_tenant_isolation", 0.0, "error", 0.0,
              error=repr(e)[:300])
+    try:
+        # round-23 tentpole: byte-identical UPDATE replays answered from
+        # the persistent (object × policy) verdict matrix vs the full
+        # evaluation path (audit/matrix.py + the batcher lookup gate)
+        from tools.bench.matrix import bench_matrix_lookup
+
+        bench_matrix_lookup(
+            n_unique=128 if quick else 256,
+            replays=4 if quick else 8,
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("matrix_lookup_admission", 0.0, "error", 0.0,
+             error=repr(e)[:300])
     emit_summary()
     # headline LAST: the driver records the final JSON line
     try:

@@ -219,7 +219,8 @@ class _ServerProc:
 
     def __init__(self, tmp: Path, policies: Path, state_dir: Path,
                  download_dir: Path, log_name: str,
-                 extra_env: dict | None = None):
+                 extra_env: dict | None = None,
+                 extra_args: list[str] | None = None):
         self.api_port = _free_port()
         self.ready_port = _free_port()
         self.log_path = tmp / log_name
@@ -238,6 +239,7 @@ class _ServerProc:
                 "--port", str(self.api_port),
                 "--readiness-probe-port", str(self.ready_port),
                 "--log-level", "warn",
+                *(extra_args or []),
             ],
             cwd=str(_REPO_ROOT), env=env,
             stdout=self._log, stderr=subprocess.STDOUT,
@@ -290,6 +292,53 @@ class _ServerProc:
         self._log.close()
 
 
+def _write_audit_seed(path: Path, n: int = 12) -> int:
+    """A deterministic resources file for ``--audit-resources-file``:
+    the SAME file seeds the cold and warm snapshots, so the warm boot's
+    matrix restore can payload-hash-match the spilled verdict cells
+    against identical rows (round 23: compliance resumes warm)."""
+    items = []
+    for i in range(n):
+        items.append({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": f"audit-pod-{i}",
+                "namespace": "blocked" if i % 3 == 0 else "default",
+            },
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                **({"securityContext": {"privileged": True}}
+                   if i % 2 == 0 else {}),
+            }]},
+        })
+    path.write_text(json.dumps({"items": items}), encoding="utf-8")
+    return n
+
+
+def _scrape_matrix_metrics(ready_port: int) -> dict:
+    """The three matrix families the warm gate reads from /metrics on
+    the readiness server: cells restored at boot + the two sweep-rows
+    counters (zero right after a warm boot == no re-judge of clean
+    rows)."""
+    import requests
+
+    wanted = {
+        "policy_server_audit_matrix_cells_restored": 0.0,
+        "policy_server_audit_matrix_row_sweep_rows_total": 0.0,
+        "policy_server_audit_matrix_column_sweep_rows_total": 0.0,
+    }
+    text = requests.get(
+        f"http://127.0.0.1:{ready_port}/metrics", timeout=10
+    ).text
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in wanted:
+            wanted[parts[0]] = float(parts[1])
+    return wanted
+
+
 def _serve_corpus(api_port: int, corpus: list[tuple[str, bytes]]) -> list:
     import requests
 
@@ -331,15 +380,29 @@ def main(argv: list[str] | None = None) -> int:
     policy_ids = _write_policies(policies_path, artifacts, registry.port)
     state_dir = tmp / "state"
     corpus = _corpus(policy_ids)
+    audit_seed = tmp / "audit-resources.json"
+    seeded = _write_audit_seed(audit_seed)
+    # round 23: the verdict matrix rides the drill — judged on the cold
+    # boot, spilled through the statestore, and the warm boot must
+    # RESUME it (cells restored, zero re-judge of clean rows)
+    matrix_args = [
+        "--audit-mode", "interval",
+        "--audit-matrix",
+        "--audit-resources-file", str(audit_seed),
+        "--audit-matrix-spill-seconds", "0.5",
+    ]
     print(f"[drill] workspace {tmp}; registry :{registry.port}; "
-          f"{len(policy_ids)} policies ({len(artifacts)} fetched)",
+          f"{len(policy_ids)} policies ({len(artifacts)} fetched); "
+          f"verdict matrix armed over {seeded} seeded resources",
           flush=True)
 
     failures: list[str] = []
 
     # -- cold boot --------------------------------------------------------
     cold = _ServerProc(tmp, policies_path, state_dir, tmp / "dl-cold",
-                       "cold.log")
+                       "cold.log",
+                       extra_args=[*matrix_args,
+                                   "--audit-interval-seconds", "0.5"])
     try:
         cold_wall = cold.wait_ready()
         cold_report = json.loads((state_dir / "last_boot.json").read_text())
@@ -351,6 +414,26 @@ def main(argv: list[str] | None = None) -> int:
         for path, status, _body in pre:
             if status != 200:
                 failures.append(f"cold corpus {path} answered {status}")
+
+        # the matrix must have swept the seeded inventory AND spilled it
+        # before the SIGKILL lands — the spill journal is written
+        # atomically, so existence means a complete head + cell set
+        spill_path = state_dir / "audit" / "matrix.journal"
+        spill_deadline = time.monotonic() + 90.0
+        while time.monotonic() < spill_deadline:
+            if spill_path.exists() and spill_path.stat().st_size > 100:
+                break
+            time.sleep(0.2)
+        else:
+            failures.append(
+                "verdict-matrix spill journal never appeared on the "
+                f"cold boot ({spill_path}); log tail:\n{cold.log_tail()}"
+            )
+        matrix_spill_bytes = (
+            spill_path.stat().st_size if spill_path.exists() else 0
+        )
+        print(f"[drill] matrix spilled ({matrix_spill_bytes} bytes) — "
+              "compliance state is durable; killing", flush=True)
 
         # -- SIGKILL under load ------------------------------------------
         stop = threading.Event()
@@ -389,6 +472,7 @@ def main(argv: list[str] | None = None) -> int:
     downtime = 0.0
     post: list = []
     boot_report: dict = {}
+    warm_matrix_metrics: dict = {}
     for i in range(2):
         warm = _ServerProc(
             tmp, policies_path, state_dir, tmp / f"dl-warm{i}",
@@ -396,6 +480,10 @@ def main(argv: list[str] | None = None) -> int:
             extra_env={
                 "FAILPOINTS": "fetch.http=raise:drill-registry-outage"
             },
+            # a long cadence: no timer sweep may fire between ready and
+            # the zero-re-judge metrics sample below
+            extra_args=[*matrix_args,
+                        "--audit-interval-seconds", "600"],
         )
         try:
             warm_wall = warm.wait_ready()
@@ -412,8 +500,15 @@ def main(argv: list[str] | None = None) -> int:
             print(f"[drill] WARM boot {i}: bootstrap "
                   f"{report['time_to_ready_seconds']:.2f}s "
                   f"(wall {warm_wall:.2f}s; registry DOWN, fetch.http "
-                  "armed)", flush=True)
+                  "armed; matrix cells restored: "
+                  f"{report.get('matrix_cells_restored', 0)})", flush=True)
             if i == 0:
+                # round-23 gate half 2: the restored matrix means NO
+                # clean row is re-judged — both sweep-rows counters must
+                # still read zero on the freshly-ready warm server
+                warm_matrix_metrics = _scrape_matrix_metrics(
+                    warm.ready_port
+                )
                 post = _serve_corpus(warm.api_port, corpus)
                 boot_report = report
         finally:
@@ -438,6 +533,29 @@ def main(argv: list[str] | None = None) -> int:
                 f"{report['degraded_sources']} source(s) — the pinned "
                 "path should not even attempt a fetch"
             )
+        if report.get("matrix_cells_restored", 0) <= 0:
+            failures.append(
+                f"warm boot {i} resumed ZERO verdict-matrix cells from "
+                f"the statestore spill: {report}"
+            )
+    if warm_matrix_metrics.get(
+        "policy_server_audit_matrix_cells_restored", 0
+    ) <= 0:
+        failures.append(
+            "warm /metrics does not export restored matrix cells: "
+            f"{warm_matrix_metrics}"
+        )
+    rejudged = (
+        warm_matrix_metrics.get(
+            "policy_server_audit_matrix_row_sweep_rows_total", 0)
+        + warm_matrix_metrics.get(
+            "policy_server_audit_matrix_column_sweep_rows_total", 0)
+    )
+    if rejudged:
+        failures.append(
+            f"warm boot re-judged {rejudged:.0f} row(s) the restored "
+            "matrix had already proven current (gate: zero)"
+        )
     bit_exact = pre == post
     if not bit_exact:
         diffs = [
@@ -472,6 +590,12 @@ def main(argv: list[str] | None = None) -> int:
             for r in warm_runs
         ],
         "boot_report_warm": boot_report,
+        "matrix_seeded_resources": seeded,
+        "matrix_spill_bytes": matrix_spill_bytes,
+        "matrix_cells_restored_warm": boot_report.get(
+            "matrix_cells_restored", 0
+        ),
+        "matrix_rows_rejudged_on_warm_boot": rejudged,
         "registry_outage_armed": True,
         "passed": not failures,
         "failures": failures,

@@ -301,6 +301,13 @@ class SoakEngine:
             audit_mode="interval",
             audit_interval_seconds=s.audit_interval_seconds,
             audit_batch_size=256,
+            # round 23: the persistent (object × policy) verdict matrix
+            # rides every soak — promotions must take the column-diff
+            # path and the matrix must converge to store parity (the
+            # verdict_matrix_converged gate); the spill cadence matches
+            # the snapshot's so a mid-soak restart resumes both
+            audit_matrix=True,
+            audit_matrix_spill_seconds=5.0,
             native_read_timeout_seconds=s.read_timeout_seconds,
             native_idle_timeout_seconds=75.0,
             native_max_connections=4096,
@@ -1412,9 +1419,44 @@ class SoakEngine:
             sum(e.get("rows_fenced", 0) for e in shard_log),
             batcher_stats.get("shard_fenced_rows", 0),
         )
+        # verdict-matrix convergence (round 23): one drain dirty sweep
+        # claims whatever the tail of the churn dirtied after the last
+        # cadence tick, then the matrix must hold a COMPLETE verdict row
+        # for every resident snapshot row, and the mid-soak promotions
+        # must have taken the column-diff path (clean rows re-judged
+        # only under changed columns — column_sweep_rows counts them)
+        matrix_gate = None
+        matrix_obj = server.state.audit_matrix
+        if matrix_obj is not None:
+            try:
+                server.state.audit.sweep(full=False)
+            except Exception as e:  # noqa: BLE001 — gate reads the counters
+                self._say(f"matrix drain sweep failed: {e!r}")
+            mstats = matrix_obj.stats()
+            matrix_rows, matrix_rows_complete = matrix_obj.coverage()
+            matrix_gate = {
+                "snapshot_rows": server.state.audit.snapshot.stats()[
+                    "resources"
+                ],
+                "matrix_rows": matrix_rows,
+                "rows_complete": matrix_rows_complete,
+                "column_sweep_rows": mstats["column_sweep_rows"],
+                "row_sweep_rows": mstats["row_sweep_rows"],
+                "cells_resident": mstats["cells_resident"],
+                "columns": mstats["columns"],
+                "dirty_columns": mstats["dirty_columns"],
+                "matrix_version": mstats["matrix_version"],
+                "changelog_emits": mstats["changelog_emits"],
+                "rows_evicted": mstats["rows_evicted"],
+                "columns_invalidated": mstats["columns_invalidated"],
+                "spills": mstats["spills"],
+                "cells_restored": mstats["cells_restored"],
+            }
+            self._say(f"verdict matrix {json.dumps(matrix_gate)}")
         gate = self.recorder.gate(
             p99_budget_ms=s.p99_budget_ms,
             fault_events=storm.events,
+            matrix=matrix_gate,
             promoted_reloads=(
                 lifecycle_stats.get("reloads")
                 if server.lifecycle is not None else None
@@ -1499,6 +1541,9 @@ class SoakEngine:
                 "watch_feed": feed_stats,
                 "scanner": scanner_stats,
                 "snapshot": snapshot_stats,
+                # the convergence facts the verdict_matrix_converged
+                # gate judged (round 23); None with the matrix off
+                "matrix": matrix_gate,
                 # flight-recorder phase attribution over the soak's own
                 # traffic (round 18): the same wall-vs-summed-phases
                 # reconciliation `make phase-report` gates, computed at

@@ -224,6 +224,7 @@ class SLORecorder:
         tenant_mix: "dict | None" = None,
         restart_storm: "dict | None" = None,
         shard_storm: "dict | None" = None,
+        matrix: "dict | None" = None,
     ) -> dict[str, Any]:
         t = self.totals()
         sighups = [
@@ -334,6 +335,22 @@ class SLORecorder:
                 and shard_storm.get("fences", 0) >= 1
                 and shard_storm.get("respawns", 0)
                 >= shard_storm.get("fences", 0)
+            )
+        if matrix is not None:
+            # verdict-matrix convergence (round 23, audit/matrix.py):
+            # after the engine's drain sweep the persistent (object ×
+            # policy) matrix must hold a COMPLETE verdict row for every
+            # resident snapshot row (store == matrix parity over a soak
+            # of churn, promotions, and restarts), and at least one
+            # mid-soak promotion must have provably taken the
+            # column-diff path — clean rows re-judged ONLY under the
+            # changed columns (column_sweep_rows > 0), not via a
+            # whole-cluster full sweep
+            checks["verdict_matrix_converged"] = (
+                matrix.get("snapshot_rows", 0) > 0
+                and matrix.get("rows_complete", 0)
+                >= matrix.get("snapshot_rows", 0)
+                and matrix.get("column_sweep_rows", 0) > 0
             )
         return {
             "passed": all(checks.values()),
